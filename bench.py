@@ -1,0 +1,136 @@
+"""Headline benchmark: GPT-2-125M training throughput per TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "gpt2_125m_train_tokens_per_sec_per_chip", "value": N,
+   "unit": "tokens/s/chip", "vs_baseline": N / BASELINE}
+
+Baseline: the north star (BASELINE.json) is matching 8xA100 TorchTrainer+NCCL
+tokens/sec/chip for GPT-2-125M. No measured reference number is checked in
+(`published: {}`), so we use 100_000 tokens/s/chip — an estimate for a single
+A100 on GPT-2-125M bf16 at ~25-30% MFU (312 TFLOPs peak, ~6·N FLOPs/token).
+vs_baseline > 1.0 means beating that estimate per chip.
+
+Runs on however many chips are visible (the driver gives one); uses a dp mesh
+over all local devices and reports per-chip throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_TOKENS_PER_SEC_PER_CHIP = 100_000.0
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_bench() -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import (
+        DEFAULT_RULES,
+        MeshSpec,
+        make_mesh,
+        shardings_from_logical,
+    )
+    from ray_tpu.train.spmd import (
+        default_optimizer,
+        make_train_state,
+        make_train_step,
+    )
+
+    smoke = bool(os.environ.get("RAY_TPU_BENCH_SMOKE"))
+    devices = jax.devices()
+    n_dev = len(devices)
+    _log(f"bench devices: {n_dev} x {devices[0].device_kind}")
+
+    if smoke:
+        cfg = gpt2.GPT2Config.tiny()
+        batch_candidates = [8]
+        seq = cfg.max_seq
+        warmup, iters = 1, 2
+    else:
+        cfg = gpt2.GPT2Config.gpt2_125m()
+        batch_candidates = [32, 16, 8, 4]
+        seq = cfg.max_seq
+        warmup, iters = 3, 10
+
+    mesh = make_mesh(MeshSpec(dp=n_dev), devices)
+    shardings = shardings_from_logical(
+        gpt2.param_logical_specs(cfg), DEFAULT_RULES, mesh
+    )
+    opt = default_optimizer(total_steps=1000)
+
+    last_err = None
+    for per_chip_batch in batch_candidates:
+        B = per_chip_batch * n_dev
+        try:
+            state = make_train_state(
+                lambda k: gpt2.init_params(k, cfg),
+                opt,
+                jax.random.key(0),
+                param_shardings=shardings,
+            )
+            step = make_train_step(
+                lambda p, b: gpt2.loss_fn(p, b, cfg),
+                opt,
+                mesh=mesh,
+                batch_spec=P(("dp", "fsdp")),
+                param_shardings=shardings,
+            )
+            tokens = jax.random.randint(
+                jax.random.key(1), (B, seq), 0, cfg.vocab_size
+            )
+            batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+            t0 = time.perf_counter()
+            for _ in range(warmup):
+                state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            _log(
+                f"warmup done (B={B}) in {time.perf_counter() - t0:.1f}s, "
+                f"loss={float(metrics['loss']):.4f}"
+            )
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            dt = time.perf_counter() - t0
+            tokens_per_sec = B * seq * iters / dt
+            per_chip = tokens_per_sec / n_dev
+            _log(
+                f"B={B} seq={seq}: {tokens_per_sec:,.0f} tok/s total, "
+                f"{per_chip:,.0f} tok/s/chip ({dt / iters * 1e3:.1f} ms/step)"
+            )
+            return {
+                "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(
+                    per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4
+                ),
+            }
+        except Exception as e:
+            # Back off only on OOM-shaped failures; anything else is a bug and
+            # must surface immediately rather than burn four compile cycles.
+            msg = f"{type(e).__name__}: {e}"
+            oom = any(
+                s in msg
+                for s in ("RESOURCE_EXHAUSTED", "Out of memory", "OOM", "hbm")
+            )
+            if not oom:
+                raise
+            last_err = e
+            _log(f"batch {B} OOM; backing off")
+    raise RuntimeError(f"all batch sizes failed; last error: {last_err}")
+
+
+if __name__ == "__main__":
+    result = run_bench()
+    print(json.dumps(result), flush=True)

@@ -1,0 +1,52 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+A brand-new framework with the capability set of Ray (reference analyzed in
+SURVEY.md): tasks, actors, owned objects, gang scheduling over TPU pod slices,
+and an AI-library tier (data / train / tune / serve / rllib) whose accelerator
+data plane is XLA collectives over ICI/DCN (jax.jit / pjit / shard_map /
+Pallas) instead of NCCL.
+
+The public API mirrors the capability surface of the reference's
+``python/ray/__init__.py`` (init/remote/get/put/wait/kill/cancel, actors,
+placement groups) while the execution model is TPU-first: the SPMD slice is
+the first-class scheduling unit and XLA owns the accelerator data plane.
+
+Core-runtime symbols are loaded lazily so the pure-compute tier
+(models / ops / parallel / train.spmd) imports without the cluster runtime.
+"""
+
+from ray_tpu._version import __version__
+
+_CORE_API = (
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "method",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "ObjectRef",
+    "ActorHandle",
+)
+
+__all__ = ["__version__", *_CORE_API]
+
+
+def __getattr__(name):
+    if name in _CORE_API:
+        from ray_tpu.core import api as _api
+
+        return getattr(_api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_CORE_API))

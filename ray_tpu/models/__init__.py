@@ -1,0 +1,5 @@
+"""Model zoo for the TPU-native framework (pure-JAX, mesh-shardable)."""
+
+from ray_tpu.models import gpt2
+
+__all__ = ["gpt2"]

@@ -1,0 +1,204 @@
+"""GPT-2 in pure JAX, built mesh-first.
+
+Flagship model of the framework (north star: GPT-2-125M data-parallel on a
+v4 pod — BASELINE.md). Design choices that differ from a torch port:
+
+- Layers are *stacked* along a leading ``layers`` dim and executed with
+  ``lax.scan``: one trace/compile regardless of depth, and the ``layers`` dim
+  is itself shardable (pipeline axis).
+- Every parameter carries a tuple of *logical* axis names
+  (see :mod:`ray_tpu.parallel.sharding`); tensor/fsdp/pipeline parallelism is
+  a rule-table choice, not a model change.
+- bfloat16 activations / float32 params+optimizer by default (MXU-native).
+- Attention dispatches to the Pallas flash kernel on TPU
+  (:mod:`ray_tpu.ops.attention`).
+
+Reference parity note: the reference has no model zoo of its own; its GPT-2
+path is `transformers` + TorchTrainer (reference:
+python/ray/train/examples/transformers/). Here the model is framework-native.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import causal_attention
+
+Params = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304  # 50257 rounded up to a multiple of 128 (lane tiling)
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16  # activation dtype
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "auto"  # "auto" | "pallas" | "reference"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    @staticmethod
+    def gpt2_125m() -> "GPT2Config":
+        return GPT2Config()
+
+    @staticmethod
+    def tiny(
+        n_layer: int = 2,
+        d_model: int = 128,
+        n_head: int = 4,
+        vocab_size: int = 512,
+        max_seq: int = 256,
+    ) -> "GPT2Config":
+        return GPT2Config(
+            vocab_size=vocab_size,
+            n_layer=n_layer,
+            n_head=n_head,
+            d_model=d_model,
+            d_ff=4 * d_model,
+            max_seq=max_seq,
+        )
+
+
+def param_logical_specs(cfg: GPT2Config) -> Params:
+    """Logical axis names per parameter (leaves are tuples of names)."""
+    L = ("layers",)
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": ("seq_param", "embed"),
+        "blocks": {
+            "ln1_scale": L + ("norm",),
+            "ln1_bias": L + ("norm",),
+            "qkv_w": L + ("embed", "mlp"),
+            "qkv_b": L + ("mlp",),
+            "proj_w": L + ("mlp", "embed"),
+            "proj_b": L + ("norm",),
+            "ln2_scale": L + ("norm",),
+            "ln2_bias": L + ("norm",),
+            "fc_w": L + ("embed", "mlp"),
+            "fc_b": L + ("mlp",),
+            "fc2_w": L + ("mlp", "embed"),
+            "fc2_b": L + ("norm",),
+        },
+        "lnf_scale": ("norm",),
+        "lnf_bias": ("norm",),
+    }
+
+
+def init_params(key: jax.Array, cfg: GPT2Config) -> Params:
+    """GPT-2 initialization: N(0, 0.02), residual projections scaled by
+    1/sqrt(2*n_layer), zeros for biases, ones for LN scales."""
+    k = iter(jax.random.split(key, 8))
+    std = 0.02
+    pd = cfg.param_dtype
+    L, D, F, V, S = cfg.n_layer, cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.max_seq
+    resid_std = std / (2 * L) ** 0.5
+
+    def normal(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(pd)
+
+    return {
+        "wte": normal(next(k), (V, D), std),
+        "wpe": normal(next(k), (S, D), std),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, D), pd),
+            "ln1_bias": jnp.zeros((L, D), pd),
+            "qkv_w": normal(next(k), (L, D, 3 * D), std),
+            "qkv_b": jnp.zeros((L, 3 * D), pd),
+            "proj_w": normal(next(k), (L, D, D), resid_std),
+            "proj_b": jnp.zeros((L, D), pd),
+            "ln2_scale": jnp.ones((L, D), pd),
+            "ln2_bias": jnp.zeros((L, D), pd),
+            "fc_w": normal(next(k), (L, D, F), std),
+            "fc_b": jnp.zeros((L, F), pd),
+            "fc2_w": normal(next(k), (L, F, D), resid_std),
+            "fc2_b": jnp.zeros((L, D), pd),
+        },
+        "lnf_scale": jnp.ones((D,), pd),
+        "lnf_bias": jnp.zeros((D,), pd),
+    }
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(x, p, cfg: GPT2Config):
+    """One transformer block. x: [B, S, D]; p: single layer's params."""
+    B, S, D = x.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    qkv = h @ p["qkv_w"].astype(cfg.dtype) + p["qkv_b"].astype(cfg.dtype)
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):  # [B,S,D] -> [B,H,S,Dh]
+        return t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+
+    attn = causal_attention(heads(q), heads(k_), heads(v), impl=cfg.attn_impl)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + attn @ p["proj_w"].astype(cfg.dtype) + p["proj_b"].astype(cfg.dtype)
+
+    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    h = h @ p["fc_w"].astype(cfg.dtype) + p["fc_b"].astype(cfg.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    x = x + h @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
+    return x
+
+
+def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (activation dtype)."""
+    B, S = tokens.shape
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    x = x + params["wpe"].astype(cfg.dtype)[:S][None]
+
+    block_fn = functools.partial(_block, cfg=cfg)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def scan_body(x, layer_params):
+        return block_fn(x, layer_params), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    # Tied embeddings: logits = x @ wte^T (vocab-parallel under tp rules).
+    logits = x @ params["wte"].astype(cfg.dtype).T
+    return logits
+
+
+def loss_fn(
+    params: Params, batch: dict, cfg: GPT2Config
+) -> tuple[jax.Array, dict]:
+    """Next-token cross-entropy. batch: {"tokens": [B, S+1] int32} or
+    {"tokens": [B,S], "targets": [B,S]}."""
+    tokens = batch["tokens"]
+    if "targets" in batch:
+        inputs, targets = tokens, batch["targets"]
+    else:
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    return loss, {"loss": loss, "tokens": jnp.array(targets.size, jnp.int32)}
+
+
+def num_params(cfg: GPT2Config) -> int:
+    V, D, F, L, S = cfg.vocab_size, cfg.d_model, cfg.d_ff, cfg.n_layer, cfg.max_seq
+    per_layer = 4 * D + (D * 3 * D + 3 * D) + (D * D + D) + (D * F + F) + (F * D + D)
+    return V * D + S * D + L * per_layer + 2 * D

@@ -1,0 +1,5 @@
+"""TPU compute kernels (Pallas) with portable reference fallbacks."""
+
+from ray_tpu.ops.attention import causal_attention
+
+__all__ = ["causal_attention"]

@@ -1,0 +1,189 @@
+"""Causal self-attention: Pallas flash kernel on TPU, jnp reference elsewhere.
+
+Flash attention keeps the O(S^2) score matrix out of HBM: each q-block streams
+k/v-blocks through VMEM with a running (max, denominator, accumulator) online
+softmax, so the MXU sees back-to-back [block_q, d] x [d, block_k] matmuls and
+HBM traffic stays O(S·d). The reference framework has no attention kernel of
+its own (it orchestrates engines that bring their own; SURVEY.md §5.7) — this
+is part of the TPU-native compute tier that replaces those engines.
+
+The pallas path is differentiable via custom_vjp: forward runs the flash
+kernel; backward recomputes attention with the reference math (one layer's
+scores alive at a time under remat). A fused flash backward kernel is the
+planned upgrade.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-capable installs; fall back gracefully.
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+
+
+def _masked_scores(q, k, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    S_q, S_k = q.shape[2], k.shape[2]
+    mask = jnp.tril(jnp.ones((S_q, S_k), dtype=bool))
+    return jnp.where(mask[None, None], s, _NEG_INF)
+
+
+def _reference_causal_attention(q, k, v, scale):
+    # q,k,v: [B, H, S, D]
+    p = jax.nn.softmax(_masked_scores(q, k, scale), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k):
+    # Block shapes: q_ref/o_ref [1, 1, block_q, d]; k_ref/v_ref [1, 1, S, d].
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    d = q.shape[-1]
+
+    q_start = qi * block_q
+    # Only iterate k-blocks at or below the diagonal.
+    num_k_blocks = (q_start + block_q + block_k - 1) // block_k
+
+    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        col_ids = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(row_ids >= col_ids, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_q", "block_k", "interpret")
+)
+def _flash_attention_fwd_impl(q, k, v, scale, block_q, block_k, interpret=False):
+    B, H, S, D = q.shape
+    grid = (B, H, S // block_q)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, scale, block_q, block_k, interpret=False):
+    return _flash_attention_fwd_impl(q, k, v, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, scale, block_q, block_k, interpret=False):
+    return (
+        _flash_attention_fwd_impl(q, k, v, scale, block_q, block_k, interpret),
+        (q, k, v),
+    )
+
+
+def _flash_bwd(scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # Recompute softmax (reference math) and differentiate analytically.
+    p = jax.nn.softmax(_masked_scores(q, k, scale), axis=-1)  # [B,H,Sq,Sk] f32
+    g32 = g.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v32)
+    # softmax vjp: ds = p * (dp - sum(dp * p, axis=-1, keepdims=True))
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    impl: str = "auto",
+    scale: float | None = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Causal attention over [batch, heads, seq, head_dim] tensors.
+
+    impl: "auto" (pallas on TPU, reference otherwise), "pallas", "reference".
+    interpret: run the pallas kernel in interpreter mode (CPU testing).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, H, S, D], got shape {q.shape}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "auto":
+        S = q.shape[2]
+        use_pallas = (
+            pltpu is not None
+            and _on_tpu()
+            and S % min(block_q, S) == 0
+            and S % min(block_k, S) == 0
+        )
+        impl = "pallas" if use_pallas else "reference"
+    if impl == "reference":
+        return _reference_causal_attention(q, k, v, scale)
+    if impl != "pallas":
+        raise ValueError(f"unknown attention impl {impl!r}")
+    S = q.shape[2]
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    if S % bq or S % bk:
+        raise ValueError(
+            f"impl='pallas' requires seq len divisible by block sizes; got "
+            f"S={S}, block_q={bq}, block_k={bk}. Use impl='auto' to allow "
+            f"fallback or pick dividing blocks."
+        )
+    return _flash_attention(q, k, v, scale, bq, bk, interpret)

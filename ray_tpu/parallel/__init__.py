@@ -1,0 +1,40 @@
+"""Parallelism primitives: device meshes, sharding rules, SPMD transforms.
+
+TPU-native replacement for the reference's parallelism story (SURVEY.md §2.4):
+where Ray delegates TP/PP/EP to vLLM and provides DP via per-worker torch DDP,
+here every axis (dp / fsdp / tp / sp / pp / ep) is a named mesh axis and XLA
+inserts the collectives (reference contrast:
+python/ray/util/collective/collective.py:328, vllm_models.py:89).
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshSpec,
+    AXIS_NAMES,
+    make_mesh,
+    auto_spec,
+    local_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    LogicalRules,
+    DEFAULT_RULES,
+    logical_to_mesh_spec,
+    named_sharding,
+    shardings_from_logical,
+    shard_tree,
+    constrain,
+)
+
+__all__ = [
+    "MeshSpec",
+    "AXIS_NAMES",
+    "make_mesh",
+    "auto_spec",
+    "local_mesh",
+    "LogicalRules",
+    "DEFAULT_RULES",
+    "logical_to_mesh_spec",
+    "named_sharding",
+    "shardings_from_logical",
+    "shard_tree",
+    "constrain",
+]

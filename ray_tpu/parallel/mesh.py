@@ -1,0 +1,132 @@
+"""Device-mesh construction for TPU slices.
+
+The mesh is the root abstraction of the accelerator data plane: every
+parallelism strategy (data, fully-sharded data, tensor, sequence/context,
+pipeline, expert) is a named axis of one `jax.sharding.Mesh`, and cross-device
+communication compiles to XLA collectives riding ICI within a slice (DCN across
+slices). This replaces the reference's NCCL communicator bootstrapping
+(reference: python/ray/util/collective/collective_group/nccl_collective_group.py:121)
+with a declarative mesh + sharding model.
+
+Axis order puts `tp` (then `sp`) innermost so tensor-parallel collectives —
+the most latency-sensitive — map onto nearest-neighbor ICI links, and `pp`/`dp`
+outermost so they can span DCN in multi-slice deployments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Outer → inner. Outermost axes tolerate the most latency (pipeline, data);
+# innermost need the tightest coupling (tensor parallel).
+AXIS_NAMES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes for each parallelism axis. Product must equal the device count.
+
+    dp:   pure data parallel (gradients all-reduced)
+    fsdp: data parallel with parameters sharded (ZeRO-3 style; XLA all-gathers
+          weights per layer)
+    tp:   tensor parallel (megatron-style sharded matmuls)
+    sp:   sequence/context parallel (ring attention over this axis)
+    pp:   pipeline parallel (layer stages)
+    ep:   expert parallel (MoE experts)
+    """
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.pp * self.dp * self.fsdp * self.ep * self.sp * self.tp
+
+    def sizes(self) -> tuple[int, ...]:
+        return tuple(getattr(self, name) for name in AXIS_NAMES)
+
+    def asdict(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in AXIS_NAMES}
+
+    @property
+    def batch_axes(self) -> tuple[str, ...]:
+        """Mesh axes the global batch dimension is sharded over."""
+        return ("dp", "fsdp")
+
+
+def make_mesh(spec: MeshSpec, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a Mesh laying `spec` over `devices` (default: all devices).
+
+    Devices are reshaped in their natural enumeration order; on real TPU
+    slices `jax.devices()` is already ordered so that adjacent ids are
+    ICI neighbors, which keeps the innermost axes on nearest-neighbor links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if spec.num_devices != len(devices):
+        raise ValueError(
+            f"MeshSpec {spec.asdict()} wants {spec.num_devices} devices, "
+            f"got {len(devices)}"
+        )
+    arr = np.array(devices, dtype=object).reshape(spec.sizes())
+    return Mesh(arr, AXIS_NAMES)
+
+
+def local_mesh() -> Mesh:
+    """A trivial 1-device-per-axis mesh over the first local device."""
+    return make_mesh(MeshSpec(), devices=jax.devices()[:1])
+
+
+def _largest_factor_leq(n: int, cap: int) -> int:
+    for f in range(min(cap, n), 0, -1):
+        if n % f == 0:
+            return f
+    return 1
+
+
+def auto_spec(
+    n_devices: int,
+    *,
+    max_tp: int = 4,
+    max_sp: int = 2,
+    want_fsdp: bool = True,
+) -> MeshSpec:
+    """Heuristic mesh shape for `n_devices`: tp innermost up to `max_tp`,
+    an sp axis if it fits, remaining devices split between dp and fsdp.
+
+    Examples: 8 → (sp=2, tp=4); 4 → (tp=4); 32 → (dp=2, fsdp=2, sp=2, tp=4);
+    16 → (fsdp=2, sp=2, tp=4).
+    """
+    if n_devices < 1:
+        raise ValueError("n_devices must be >= 1")
+    remaining = n_devices
+    tp = _largest_factor_leq(remaining, max_tp)
+    remaining //= tp
+    sp = _largest_factor_leq(remaining, max_sp)
+    remaining //= sp
+    if want_fsdp and remaining > 1:
+        # Split the residue between fsdp and dp; favor fsdp for memory, keep a
+        # dp axis when the residue is large and even.
+        if remaining >= 4 and remaining % 2 == 0:
+            dp = 2
+            fsdp = remaining // 2
+        else:
+            dp = 1
+            fsdp = remaining
+    else:
+        dp = remaining
+        fsdp = 1
+    spec = MeshSpec(dp=dp, fsdp=fsdp, sp=sp, tp=tp)
+    assert spec.num_devices == n_devices, (spec, n_devices)
+    return spec

@@ -1,0 +1,111 @@
+"""Logical-axis sharding rules.
+
+Model code annotates each parameter with *logical* axis names (e.g.
+``("layers", "embed", "mlp")``); a rule table maps logical names to mesh axes.
+Changing the parallelism strategy = changing the rule table, never the model.
+This is the idiomatic JAX/XLA replacement for the reference's per-framework
+parallelism plumbing (torch DDP/FSDP wiring in
+python/ray/train/torch/train_loop_utils.py, vLLM TP/PP config passthrough in
+python/ray/llm/_internal/serve/engines/vllm/vllm_models.py:89).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis name -> mesh axis (str), tuple of mesh axes, or None (replicate)
+LogicalRules = Mapping[str, Any]
+
+# Default rules for transformer-family models.
+#   embed   : the model/hidden dimension — sharded over fsdp (ZeRO-3 style)
+#   mlp     : ffn hidden / attention-heads×head-dim — tensor parallel
+#   heads   : attention head count dim — tensor parallel
+#   vocab   : vocabulary dim — tensor parallel (vocab-parallel embedding/logits)
+#   layers  : stacked layer dim — pipeline stages
+#   experts : MoE expert dim — expert parallel
+#   batch   : global batch — data parallel over (dp, fsdp)
+#   seq     : sequence/context dim — sequence parallel (ring attention)
+#   kv / qkv / head_dim : replicated
+DEFAULT_RULES: dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "mlp": "tp",
+    "heads": "tp",
+    "vocab": "tp",
+    "layers": "pp",
+    "experts": "ep",
+    "head_dim": None,
+    "kv": None,
+    "norm": None,
+}
+
+
+def logical_to_mesh_spec(
+    logical: Sequence[str | None], rules: LogicalRules, mesh: Mesh
+) -> P:
+    """Translate a tuple of logical axis names into a PartitionSpec.
+
+    Mesh axes of size 1 are dropped (replication there is free and keeping the
+    spec minimal lets the same rules run on any mesh shape). A mesh axis may be
+    used at most once per spec; later duplicate uses fall back to replication.
+    """
+    used: set[str] = set()
+    out: list[Any] = []
+    for name in logical:
+        axes = rules.get(name) if name is not None else None
+        if axes is None:
+            out.append(None)
+            continue
+        if isinstance(axes, str):
+            axes = (axes,)
+        picked = [
+            a
+            for a in axes
+            if a in mesh.shape and mesh.shape[a] > 1 and a not in used
+        ]
+        used.update(picked)
+        if not picked:
+            out.append(None)
+        elif len(picked) == 1:
+            out.append(picked[0])
+        else:
+            out.append(tuple(picked))
+    # Trim trailing Nones — cosmetic, keeps specs readable in debug output.
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def named_sharding(mesh: Mesh, *spec: Any) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def shardings_from_logical(
+    logical_tree: Any, rules: LogicalRules, mesh: Mesh
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda logical: NamedSharding(
+            mesh, logical_to_mesh_spec(logical, rules, mesh)
+        ),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def shard_tree(tree: Any, shardings: Any) -> Any:
+    """Place a pytree of arrays onto the mesh according to `shardings`."""
+    return jax.device_put(tree, shardings)
+
+
+def constrain(tree: Any, mesh: Mesh, spec: P) -> Any:
+    """with_sharding_constraint over every leaf (inside jit)."""
+    sharding = NamedSharding(mesh, spec)
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(x, sharding), tree
+    )

@@ -1,0 +1,20 @@
+"""Distributed training tier (JaxTrainer-equivalent lives here).
+
+The SPMD step machinery (:mod:`ray_tpu.train.spmd`) is importable without the
+cluster runtime; the trainer/controller/worker-group stack builds on
+:mod:`ray_tpu.core`.
+"""
+
+from ray_tpu.train.spmd import (
+    TrainState,
+    make_train_state,
+    make_train_step,
+    state_shardings,
+)
+
+__all__ = [
+    "TrainState",
+    "make_train_state",
+    "make_train_step",
+    "state_shardings",
+]

@@ -1,0 +1,31 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the reference's strategy of testing distributed behavior without the
+real hardware (reference: python/ray/tests/conftest.py:596 starts multi-raylet
+local clusters; accelerator tests mock device discovery). Here a virtual
+8-device CPU mesh stands in for a TPU slice so every sharding/collective path
+compiles and runs in CI.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the real TPU may be visible here
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin overrides JAX_PLATFORMS at import time; force CPU after.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
