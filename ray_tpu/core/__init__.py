@@ -1,0 +1,9 @@
+"""Core distributed runtime: tasks, actors, owned objects.
+
+Capability equivalent of the reference's C++ core (GCS + raylet + core worker;
+SURVEY.md §1 layers 2-6), redesigned for the TPU era: the control plane is a
+lightweight asyncio RPC fabric, the CPU object plane is shared memory + socket
+transfer, and the *accelerator* data plane is deliberately absent — device
+arrays move via XLA collectives inside jitted programs (ray_tpu.parallel),
+never through the object store.
+"""

@@ -1,0 +1,521 @@
+"""Public API: init/remote/get/put/wait/kill/cancel + actor machinery.
+
+Reference parity: python/ray/_private/worker.py (init:1407, get:2837,
+put:3020, wait:3091, kill:3271), python/ray/remote_function.py:314,
+python/ray/actor.py:1192. The execution substrate underneath is the
+TPU-native runtime in this package.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import os
+import threading
+import uuid
+from typing import Any, Optional, Sequence
+
+import cloudpickle
+
+from ray_tpu.core.core_worker import CoreWorker
+from ray_tpu.core.errors import RayTpuError
+from ray_tpu.core.gcs import GcsServer
+from ray_tpu.core.node import NodeManager
+from ray_tpu.core.object_ref import ObjectRef
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "method",
+    "nodes",
+    "cluster_resources",
+    "available_resources",
+    "get_runtime_context",
+    "ObjectRef",
+    "ActorHandle",
+]
+
+_lock = threading.RLock()
+_runtime: Optional["Runtime"] = None
+_worker: Optional[CoreWorker] = None
+
+
+class Runtime:
+    """A local cluster: GCS + head node (+ extra nodes via Cluster fixture)."""
+
+    def __init__(
+        self,
+        resources: dict,
+        labels: dict | None = None,
+        session_id: str | None = None,
+    ):
+        self.session_id = session_id or uuid.uuid4().hex[:12]
+        self.gcs = GcsServer(self.session_id)
+        self.gcs_addr = self.gcs.start()
+        self.head = NodeManager(
+            self.gcs_addr,
+            resources,
+            labels=labels,
+            session_id=self.session_id,
+            name="head",
+        )
+        self.head_addr = self.head.start()
+        self.nodes: list[NodeManager] = [self.head]
+
+    def add_node(
+        self,
+        resources: dict,
+        labels: dict | None = None,
+        name: str | None = None,
+        env: dict | None = None,
+    ) -> NodeManager:
+        node = NodeManager(
+            self.gcs_addr,
+            resources,
+            labels=labels,
+            session_id=self.session_id,
+            name=name or f"node{len(self.nodes)}",
+            env=env,
+        )
+        node.start()
+        self.nodes.append(node)
+        return node
+
+    def stop(self) -> None:
+        for node in self.nodes:
+            try:
+                node.stop()
+            except Exception:
+                pass
+        self.gcs.stop()
+
+
+def _default_resources(num_cpus: float | None) -> dict:
+    resources = {"CPU": float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))}
+    try:
+        from ray_tpu.accelerators import tpu as tpu_accel
+
+        resources.update(tpu_accel.detect_resources())
+    except Exception:
+        pass
+    return resources
+
+
+def _default_labels() -> dict:
+    try:
+        from ray_tpu.accelerators import tpu as tpu_accel
+
+        return tpu_accel.detect_labels()
+    except Exception:
+        return {}
+
+
+def init(
+    *,
+    num_cpus: float | None = None,
+    resources: dict | None = None,
+    labels: dict | None = None,
+    ignore_reinit_error: bool = True,
+    _system_config: dict | None = None,
+) -> "Runtime":
+    """Start a local cluster (GCS + head node) and connect this process as
+    the driver."""
+    global _runtime, _worker
+    with _lock:
+        if _runtime is not None:
+            if ignore_reinit_error:
+                return _runtime
+            raise RayTpuError("ray_tpu already initialized")
+        total = _default_resources(num_cpus)
+        total.update(resources or {})
+        node_labels = _default_labels()
+        node_labels.update(labels or {})
+        runtime = Runtime(total, labels=node_labels)
+        worker = CoreWorker(
+            runtime.gcs_addr, runtime.head_addr, kind="driver"
+        )
+        worker.start()
+        _runtime = runtime
+        _worker = worker
+        atexit.register(shutdown)
+        return runtime
+
+
+def _attach_existing_worker(worker: CoreWorker) -> None:
+    """Install a CoreWorker created elsewhere (worker processes)."""
+    global _worker
+    with _lock:
+        _worker = worker
+
+
+def attach_cluster(runtime: "Runtime") -> CoreWorker:
+    """Connect the current process as driver to a Runtime built manually
+    (test Cluster fixture)."""
+    global _runtime, _worker
+    with _lock:
+        if _worker is not None:
+            raise RayTpuError("already connected")
+        worker = CoreWorker(runtime.gcs_addr, runtime.head_addr, kind="driver")
+        worker.start()
+        _runtime = runtime
+        _worker = worker
+        return worker
+
+
+def shutdown() -> None:
+    global _runtime, _worker
+    with _lock:
+        if _worker is not None:
+            _worker.stop()
+            _worker = None
+        if _runtime is not None:
+            _runtime.stop()
+            _runtime = None
+        try:
+            atexit.unregister(shutdown)
+        except Exception:
+            pass
+
+
+def is_initialized() -> bool:
+    return _worker is not None
+
+
+_was_initialized = False
+
+
+def _require_worker(auto_init: bool = True) -> CoreWorker:
+    global _was_initialized
+    if _worker is None:
+        if not auto_init or _was_initialized:
+            # After an explicit shutdown, refs/handles from the old cluster
+            # are dead — auto-reinit would dangle them on a fresh cluster.
+            raise RayTpuError(
+                "ray_tpu is not initialized"
+                + (" (it was shut down)" if _was_initialized else "")
+                + "; call ray_tpu.init()"
+            )
+        init()
+    _was_initialized = True
+    assert _worker is not None
+    return _worker
+
+
+# ---------------------------------------------------------------------------
+# Tasks
+# ---------------------------------------------------------------------------
+
+
+class RemoteFunction:
+    def __init__(self, fn, opts: dict):
+        self._fn = fn
+        self._opts = opts
+        self._payload: bytes | None = None
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = {**self._opts, **opts}
+        rf = RemoteFunction(self._fn, merged)
+        rf._payload = self._payload
+        return rf
+
+    def remote(self, *args, **kwargs):
+        worker = _require_worker()
+        opts = self._opts
+        if self._payload is None:
+            self._payload = cloudpickle.dumps(self._fn)
+        resources = _resources_from_opts(opts)
+        refs = worker.submit_task(
+            self._fn,
+            args,
+            kwargs,
+            name=self._fn.__name__,
+            num_returns=opts.get("num_returns", 1),
+            resources=resources,
+            max_retries=opts.get("max_retries"),
+            label_selector=opts.get("label_selector"),
+            policy=_policy_from_opts(opts),
+            func_payload=self._payload,
+        )
+        return refs[0] if opts.get("num_returns", 1) == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._fn.__name__}' cannot be called directly; "
+            f"use .remote()."
+        )
+
+
+def _resources_from_opts(opts: dict) -> dict:
+    resources = dict(opts.get("resources", {}))
+    num_cpus = opts.get("num_cpus")
+    resources.setdefault("CPU", float(1 if num_cpus is None else num_cpus))
+    if opts.get("num_tpus"):
+        resources["TPU"] = float(opts["num_tpus"])
+    if resources.get("CPU") == 0:
+        del resources["CPU"]
+    return resources
+
+
+def _policy_from_opts(opts: dict) -> str:
+    strategy = opts.get("scheduling_strategy")
+    if strategy is None:
+        return "hybrid"
+    if isinstance(strategy, str):
+        return strategy
+    return str(strategy)
+
+
+# ---------------------------------------------------------------------------
+# Actors
+# ---------------------------------------------------------------------------
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._name, args, kwargs)
+
+    def options(self, **opts):
+        return _BoundActorMethod(self._handle, self._name, opts)
+
+
+class _BoundActorMethod:
+    def __init__(self, handle, name, opts):
+        self._handle = handle
+        self._name = name
+        self._opts = opts
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(
+            self._name, args, kwargs,
+            num_returns=self._opts.get("num_returns", 1),
+        )
+
+
+class ActorHandle:
+    def __init__(
+        self,
+        actor_id: str,
+        class_name: str = "Actor",
+        max_task_retries: int = 0,
+    ):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def _invoke(self, method: str, args, kwargs, num_returns: int = 1):
+        worker = _require_worker()
+        refs = worker.submit_actor_task(
+            self._actor_id,
+            method,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            name=f"{self._class_name}.{method}",
+            max_task_retries=self._max_task_retries,
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id[:12]}…)"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._class_name, self._max_task_retries),
+        )
+
+
+class ActorClass:
+    def __init__(self, cls: type, opts: dict):
+        self._cls = cls
+        self._opts = opts
+
+    def options(self, **opts) -> "ActorClass":
+        return ActorClass(self._cls, {**self._opts, **opts})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = _require_worker()
+        opts = self._opts
+        info = worker.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=opts.get("name"),
+            resources=_resources_from_opts(opts),
+            max_restarts=opts.get("max_restarts", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            label_selector=opts.get("label_selector"),
+            policy=_policy_from_opts(opts),
+        )
+        return ActorHandle(
+            info["actor_id"],
+            self._cls.__name__,
+            max_task_retries=opts.get("max_task_retries", 0),
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated "
+            f"directly; use .remote()."
+        )
+
+
+def remote(*args, **opts):
+    """@remote decorator for functions (tasks) and classes (actors)."""
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, opts)
+        return RemoteFunction(target, opts)
+
+    if len(args) == 1 and callable(args[0]) and not opts:
+        return wrap(args[0])
+    if args:
+        raise TypeError("use @remote or @remote(**options)")
+    return wrap
+
+
+def method(**opts):
+    """Decorator for actor methods to set per-method defaults (num_returns)."""
+
+    def wrap(fn):
+        fn._ray_tpu_method_opts = opts
+        return fn
+
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# Object API
+# ---------------------------------------------------------------------------
+
+
+def get(refs, timeout: float | None = None):
+    worker = _require_worker()
+    single = isinstance(refs, ObjectRef)
+    lst = [refs] if single else list(refs)
+    for r in lst:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+    values = worker.get(lst, timeout=timeout)
+    return values[0] if single else values
+
+
+def put(value) -> ObjectRef:
+    return _require_worker().put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: float | None = None,
+):
+    return _require_worker().wait(
+        list(refs), num_returns=num_returns, timeout=timeout
+    )
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    worker = _require_worker()
+    worker.gcs.call(
+        "kill_actor",
+        {"actor_id": actor._actor_id, "allow_restart": not no_restart},
+    )
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    # Round 1: queued-task cancellation only (running tasks run to completion
+    # unless force, which is not yet supported).
+    raise NotImplementedError(
+        "cancel() lands with the task-cancellation protocol"
+    )
+
+
+def get_actor(name: str) -> ActorHandle:
+    worker = _require_worker()
+    info = worker.gcs.call("get_actor", {"name": name})
+    if info is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(info["actor_id"], "Actor")
+
+
+# ---------------------------------------------------------------------------
+# Introspection
+# ---------------------------------------------------------------------------
+
+
+def nodes() -> list[dict]:
+    worker = _require_worker()
+    view = worker.gcs.call("get_cluster_view")
+    return [
+        {"NodeID": nid, "Alive": v["alive"], "Resources": v["total"],
+         "Available": v["available"], "Labels": v["labels"],
+         "Address": tuple(v["addr"])}
+        for nid, v in view.items()
+    ]
+
+
+def cluster_resources() -> dict:
+    out: dict = {}
+    for n in nodes():
+        if n["Alive"]:
+            for k, v in n["Resources"].items():
+                out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def available_resources() -> dict:
+    out: dict = {}
+    for n in nodes():
+        if n["Alive"]:
+            for k, v in n["Available"].items():
+                out[k] = out.get(k, 0.0) + v
+    return out
+
+
+class RuntimeContext:
+    def __init__(self, worker: CoreWorker):
+        self._worker = worker
+
+    @property
+    def node_id(self) -> str:
+        return self._worker.node_id
+
+    @property
+    def worker_id(self) -> str:
+        return self._worker.worker_id
+
+    @property
+    def actor_id(self) -> str | None:
+        return self._worker._actor_id
+
+    def get(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "worker_id": self.worker_id,
+            "actor_id": self.actor_id,
+            "session_id": self._worker.session_id,
+        }
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_require_worker())

@@ -1,0 +1,67 @@
+"""Runtime configuration, overridable via RAY_TPU_<NAME> env vars.
+
+Equivalent of the reference's RAY_CONFIG flag table
+(reference: src/ray/common/ray_config_def.h:22) — a single typed table,
+env-overridable per process, with head-chosen values shipped to every node
+through the GCS internal config KV so the cluster is consistent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+
+def _env(name: str, default):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return t(raw)
+
+
+@dataclasses.dataclass
+class Config:
+    # Objects smaller than this are stored inline in the owner's memory store
+    # and travel inside RPC replies; larger ones go to shared memory.
+    max_inline_object_bytes: int = 1024 * 1024
+    # Per-node shared-memory object store capacity.
+    object_store_bytes: int = 2 * 1024 * 1024 * 1024
+    # Chunk size for node-to-node object transfer.
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Worker pool
+    min_idle_workers: int = 0
+    worker_start_timeout_s: float = 60.0
+    # Scheduling
+    lease_request_timeout_s: float = 60.0
+    resource_report_interval_s: float = 0.2
+    # Health
+    worker_poll_interval_s: float = 0.5
+    node_heartbeat_interval_s: float = 1.0
+    node_death_timeout_s: float = 10.0
+    # Task defaults
+    default_max_retries: int = 3
+    # Actor defaults
+    default_max_restarts: int = 0
+    # RPC
+    rpc_connect_timeout_s: float = 30.0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def from_json(s: str) -> "Config":
+        return Config(**json.loads(s))
+
+
+def load_config() -> Config:
+    cfg = Config()
+    for f in dataclasses.fields(Config):
+        setattr(cfg, f.name, _env(f.name, getattr(cfg, f.name)))
+    return cfg
+
+
+GLOBAL_CONFIG = load_config()

@@ -1,0 +1,1009 @@
+"""CoreWorker — the library embedded in every driver and worker process.
+
+Reference parity: src/ray/core_worker/core_worker.h:167 (SubmitTask, Put, Get,
+Wait, CreateActor, SubmitActorTask), the lease-based NormalTaskSubmitter
+(task_submission/normal_task_submitter.h:86), the TaskReceiver execution side,
+and the ownership protocol (reference_counter.h:44) in simplified form: the
+submitting process owns task outputs; owners serve value/location lookups and
+track borrows; producing task specs are retained for retry.
+
+One asyncio endpoint carries all roles: owner RPCs ("owner.*"), task execution
+("worker.*"), and the sync user API bridges onto the loop. Execution happens
+on a dedicated executor thread pool so jitted JAX code never blocks the
+control plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu.core import object_ref as object_ref_mod
+from ray_tpu.core import serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.gcs import GcsClient
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import (
+    FAILED,
+    PENDING,
+    READY,
+    OwnerStore,
+    ShmReader,
+    ShmWriter,
+)
+from ray_tpu.core.protocol import ConnectionLost, Endpoint
+
+
+@dataclass
+class TaskSpec:
+    task_id: str
+    name: str
+    func_payload: bytes  # cloudpickled callable (or None for actor methods)
+    args: list  # list of ("v", bytes) | ("r", ObjectRef)
+    kwargs: dict  # name -> same encoding
+    return_ids: list
+    resources: dict
+    retries_left: int = 0
+    label_selector: dict = field(default_factory=dict)
+    policy: str = "hybrid"
+    # actor fields
+    actor_id: str | None = None
+    method: str | None = None
+
+
+@dataclass
+class _SchedKey:
+    resources: tuple
+    selector: tuple
+    policy: str
+
+    def __hash__(self):
+        return hash((self.resources, self.selector, self.policy))
+
+
+class _QueueState:
+    def __init__(self):
+        self.queue: list[TaskSpec] = []
+        self.leases: dict[str, dict] = {}  # lease_id -> grant info
+        self.inflight = 0  # lease requests in flight
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        gcs_addr: tuple,
+        node_addr: tuple,
+        kind: str = "worker",
+        worker_id: str | None = None,
+        max_pending_leases: int = 16,
+    ):
+        self.kind = kind
+        self.worker_id = worker_id or WorkerID.random().hex()
+        self.endpoint = Endpoint(f"{kind}-{self.worker_id[:6]}")
+        self.gcs_addr = tuple(gcs_addr)
+        self.node_addr = tuple(node_addr)
+        self.gcs = GcsClient(self.endpoint, gcs_addr)
+        self.max_pending_leases = max_pending_leases
+
+        self.owner_store: OwnerStore | None = None  # created on loop start
+        self.node_id: str | None = None
+        self.shm_root: str | None = None
+        self.shm_writer: ShmWriter | None = None
+        self.shm_reader: ShmReader | None = None
+        self.session_id: str | None = None
+
+        self._queues: dict[Any, _QueueState] = {}
+        self._task_specs: dict[str, TaskSpec] = {}  # task_id -> spec (lineage)
+
+        # executor side
+        self._executor: concurrent.futures.ThreadPoolExecutor | None = None
+        self._actor_instance: Any = None
+        self._actor_id: str | None = None
+        self._actor_lock: threading.Lock = threading.Lock()
+        self._actor_seq: dict[str, int] = {}  # caller -> next expected seq
+        self._actor_buffer: dict[tuple, Any] = {}  # (caller, seq) -> pending
+
+        # actor-client side: per-actor ordered submitters
+        self._actor_submitters: dict[str, _ActorSubmitter] = {}
+
+        self._stopped = False
+        self._view_cache: dict | None = None
+        self._view_time = 0.0
+
+        for n in [n for n in dir(self) if n.startswith("_h_")]:
+            topic, _, meth = n[3:].partition("_")
+            self.endpoint.register(f"{topic}.{meth}", getattr(self, n))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> tuple:
+        addr = self.endpoint.start()
+        self.owner_store = OwnerStore(self.endpoint.loop)
+        reply = self.endpoint.call(
+            self.node_addr,
+            "node.register_worker",
+            {"worker_id": self.worker_id, "addr": addr, "kind": self.kind},
+            timeout=30,
+        )
+        self.node_id = reply["node_id"]
+        self.shm_root = reply["shm_root"]
+        self.session_id = reply["session_id"]
+        self.shm_writer = ShmWriter(self.shm_root)
+        self.shm_reader = ShmReader(self.shm_root)
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task-exec"
+        )
+        object_ref_mod.install_hooks(
+            self._on_ref_deserialized, self._on_ref_deleted
+        )
+        return addr
+
+    def stop(self) -> None:
+        self._stopped = True
+        object_ref_mod.clear_hooks()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+        self.endpoint.stop()
+
+    # -- ref hooks -----------------------------------------------------------
+
+    def _is_owner(self, ref: ObjectRef) -> bool:
+        return ref.owner_addr == tuple(self.endpoint.address or ())
+
+    def _on_ref_deserialized(self, ref: ObjectRef) -> None:
+        if self._stopped or ref.owner_addr is None:
+            return
+        try:
+            if self._is_owner(ref):
+                # A second in-owner handle to an owned object: must count it,
+                # since its deletion will decrement local_refs symmetrically.
+                oid = ref.hex()
+
+                async def bump():
+                    self.owner_store.ensure(oid).local_refs += 1
+
+                self.endpoint.submit(bump())
+            else:
+                self.endpoint.submit(
+                    self.endpoint.anotify(
+                        ref.owner_addr, "owner.add_borrow", {"oid": ref.hex()}
+                    )
+                )
+        except Exception:
+            pass
+
+    def _on_ref_deleted(self, ref: ObjectRef) -> None:
+        if self._stopped or ref.owner_addr is None:
+            return
+        try:
+            if self._is_owner(ref):
+                self.endpoint.submit(self._release_local_ref(ref.hex()))
+            else:
+                self.endpoint.submit(
+                    self.endpoint.anotify(
+                        ref.owner_addr, "owner.remove_borrow", {"oid": ref.hex()}
+                    )
+                )
+        except Exception:
+            pass
+
+    async def _release_local_ref(self, oid: str) -> None:
+        obj = self.owner_store.objects.get(oid)
+        if obj is None:
+            return
+        obj.local_refs -= 1
+        await self._maybe_free(oid)
+
+    async def _maybe_free(self, oid: str) -> None:
+        obj = self.owner_store.objects.get(oid)
+        if obj is None:
+            return
+        if obj.local_refs <= 0 and obj.borrowers <= 0 and obj.state != PENDING:
+            self.owner_store.delete(oid)
+            self._task_specs.pop(oid, None)
+            for node_id in obj.locations:
+                addr = await self._node_addr_for(node_id)
+                if addr is not None:
+                    try:
+                        await self.endpoint.anotify(
+                            addr, "node.free_object", {"oid": oid}
+                        )
+                    except Exception:
+                        pass
+
+    # -- owner RPCs ----------------------------------------------------------
+
+    async def _h_owner_get_object(self, conn, p):
+        oid = p["oid"]
+        timeout = p.get("timeout")
+        obj = await self.owner_store.wait_ready(oid, timeout)
+        if obj.state == FAILED:
+            return {"error": obj.error}
+        if obj.inline is not None:
+            return {"inline": obj.inline}
+        node_id = next(iter(obj.locations), None)
+        if node_id is None:
+            return {"error": ObjectLostError(f"object {oid} has no locations")}
+        info = await self._node_info_for(node_id) or {}
+        return {
+            "location": {
+                "node_id": node_id,
+                "addr": tuple(info["addr"]) if info.get("addr") else None,
+                "shm_root": info.get("shm_root"),
+                "size": obj.size,
+            }
+        }
+
+    async def _h_owner_wait_ready(self, conn, p):
+        try:
+            obj = await self.owner_store.wait_ready(p["oid"], p.get("timeout"))
+        except asyncio.TimeoutError:
+            return {"ready": False}
+        return {"ready": obj.state != PENDING, "failed": obj.state == FAILED}
+
+    async def _h_owner_add_borrow(self, conn, p):
+        obj = self.owner_store.objects.get(p["oid"])
+        if obj is not None:
+            obj.borrowers += 1
+        return True
+
+    async def _h_owner_remove_borrow(self, conn, p):
+        obj = self.owner_store.objects.get(p["oid"])
+        if obj is not None:
+            obj.borrowers -= 1
+            await self._maybe_free(p["oid"])
+        return True
+
+    async def _h_owner_add_location(self, conn, p):
+        self.owner_store.put_location(p["oid"], p["node_id"], p["size"])
+        return True
+
+    # -- cluster view helpers ------------------------------------------------
+
+    async def _cluster_view(self) -> dict:
+        """GCS cluster view with a short-lived cache (node addresses change
+        only on membership events; don't serialize the view per lookup)."""
+        now = time.monotonic()
+        if self._view_cache is not None and now - self._view_time < 1.0:
+            return self._view_cache
+        view = await self.gcs.acall("get_cluster_view")
+        self._view_cache = view
+        self._view_time = now
+        return view
+
+    async def _node_info_for(self, node_id: str) -> Optional[dict]:
+        info = (await self._cluster_view()).get(node_id)
+        if info is None:
+            # Could be stale — refresh once before giving up.
+            self._view_cache = None
+            info = (await self._cluster_view()).get(node_id)
+        return info
+
+    async def _node_addr_for(self, node_id: str) -> Optional[tuple]:
+        info = await self._node_info_for(node_id)
+        return tuple(info["addr"]) if info else None
+
+    # -- put/get/wait --------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        payload, _ = serialization.dumps(value)
+        oid = ObjectID.random().hex()
+        ref = ObjectRef(ObjectID.from_hex(oid), self.endpoint.address)
+        fut = self.endpoint.submit(self._store_owned(oid, payload))
+        fut.result(timeout=60)
+        return ref
+
+    async def _store_owned(self, oid: str, payload: bytes) -> None:
+        obj = self.owner_store.ensure(oid)
+        obj.local_refs += 1
+        if len(payload) <= GLOBAL_CONFIG.max_inline_object_bytes:
+            self.owner_store.put_inline(oid, payload)
+        else:
+            self.shm_writer.write(oid, payload)
+            await self.endpoint.acall(
+                self.node_addr,
+                "node.object_created",
+                {"oid": oid, "size": len(payload)},
+            )
+            self.owner_store.put_location(oid, self.node_id, len(payload))
+
+    def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        fut = self.endpoint.submit(self._get_async(refs, timeout))
+        try:
+            return fut.result(
+                timeout=None if timeout is None else timeout + 5
+            )
+        except concurrent.futures.TimeoutError:
+            raise GetTimeoutError(f"get timed out after {timeout}s")
+
+    async def _get_async(self, refs: list[ObjectRef], timeout: float | None):
+        payloads = await asyncio.gather(
+            *(self._fetch_payload(r, timeout) for r in refs)
+        )
+        out = []
+        for data in payloads:
+            value, _ = serialization.loads(data)
+            out.append(value)
+        return out
+
+    async def _fetch_payload(
+        self, ref: ObjectRef, timeout: float | None
+    ) -> bytes:
+        oid = ref.hex()
+        if self._is_owner(ref):
+            try:
+                obj = await self.owner_store.wait_ready(oid, timeout)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(f"object {oid[:12]} not ready in time")
+            if obj.state == FAILED:
+                raise obj.error
+            if obj.inline is not None:
+                return obj.inline
+            return await self._fetch_from_location(
+                oid,
+                {
+                    "node_id": next(iter(obj.locations)),
+                    "size": obj.size,
+                    "addr": None,
+                    "shm_root": None,
+                },
+            )
+        reply = await self.endpoint.acall(
+            ref.owner_addr, "owner.get_object", {"oid": oid, "timeout": timeout}
+        )
+        if "error" in reply:
+            err = reply["error"]
+            raise err if isinstance(err, Exception) else ObjectLostError(str(err))
+        if "inline" in reply:
+            return reply["inline"]
+        return await self._fetch_from_location(oid, reply["location"])
+
+    async def _fetch_from_location(self, oid: str, loc: dict) -> bytes:
+        node_id = loc["node_id"]
+        if node_id == self.node_id:
+            return bytes(self.shm_reader.get(oid))
+        # Remote: ask our node to pull it over, then read locally.
+        addr = loc.get("addr") or await self._node_addr_for(node_id)
+        if addr is None:
+            raise ObjectLostError(f"no address for node {node_id[:8]}")
+        await self.endpoint.acall(
+            self.node_addr,
+            "node.pull_object",
+            {"oid": oid, "from_addr": tuple(addr), "size": loc["size"]},
+        )
+        return bytes(self.shm_reader.get(oid))
+
+    def wait(
+        self,
+        refs: list[ObjectRef],
+        num_returns: int = 1,
+        timeout: float | None = None,
+    ):
+        fut = self.endpoint.submit(self._wait_async(refs, num_returns, timeout))
+        return fut.result()
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        loop = asyncio.get_running_loop()
+        tasks = {
+            loop.create_task(self._wait_one(r)): r for r in refs
+        }
+        ready: list = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = set(tasks)
+        try:
+            while pending and len(ready) < num_returns:
+                t = None if deadline is None else max(
+                    0.0, deadline - time.monotonic()
+                )
+                done, pending = await asyncio.wait(
+                    pending,
+                    timeout=t,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    break
+                for d in done:
+                    ready.append(tasks[d])
+        finally:
+            for p in pending:
+                p.cancel()
+        ready_set = set(ready)
+        not_ready = [r for r in refs if r not in ready_set]
+        ready_ordered = [r for r in refs if r in ready_set]
+        return ready_ordered[:num_returns], not_ready + ready_ordered[
+            num_returns:
+        ]
+
+    async def _wait_one(self, ref: ObjectRef):
+        oid = ref.hex()
+        if self._is_owner(ref):
+            await self.owner_store.wait_ready(oid, None)
+            return ref
+        await self.endpoint.acall(
+            ref.owner_addr, "owner.wait_ready", {"oid": oid, "timeout": None}
+        )
+        return ref
+
+    # -- task submission -----------------------------------------------------
+
+    def submit_task(
+        self,
+        func: Any,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str,
+        num_returns: int = 1,
+        resources: dict | None = None,
+        max_retries: int | None = None,
+        label_selector: dict | None = None,
+        policy: str = "hybrid",
+        func_payload: bytes | None = None,
+    ) -> list[ObjectRef]:
+        resources = dict(resources or {"CPU": 1.0})
+        if max_retries is None:
+            max_retries = GLOBAL_CONFIG.default_max_retries
+        task_id = TaskID.random().hex()
+        return_ids = [ObjectID.random().hex() for _ in range(num_returns)]
+        if func_payload is None:
+            func_payload = cloudpickle.dumps(func)
+        spec = TaskSpec(
+            task_id=task_id,
+            name=name,
+            func_payload=func_payload,
+            args=[self._encode_arg(a) for a in args],
+            kwargs={k: self._encode_arg(v) for k, v in kwargs.items()},
+            return_ids=return_ids,
+            resources=resources,
+            retries_left=max_retries,
+            label_selector=dict(label_selector or {}),
+            policy=policy,
+        )
+        refs = [
+            ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, name)
+            for oid in return_ids
+        ]
+        self.endpoint.submit(self._enqueue_task(spec)).result(timeout=30)
+        return refs
+
+    def _encode_arg(self, value: Any):
+        if isinstance(value, ObjectRef):
+            return ("r", value)
+        payload, _refs = serialization.dumps(value)
+        return ("v", payload)
+
+    async def _enqueue_task(self, spec: TaskSpec) -> None:
+        for oid in spec.return_ids:
+            obj = self.owner_store.ensure(oid)
+            obj.local_refs += 1
+            obj.producing_task = spec.task_id
+        self._task_specs[spec.task_id] = spec
+        key = _SchedKey(
+            tuple(sorted(spec.resources.items())),
+            tuple(sorted(map(str, spec.label_selector.items()))),
+            spec.policy,
+        )
+        qs = self._queues.setdefault(key, _QueueState())
+        qs.queue.append(spec)
+        self._pump_queue(key, qs)
+
+    def _pump_queue(self, key, qs: _QueueState) -> None:
+        # Active leases are always busy executing (they pop the queue when
+        # they free up), so concurrency demand counts only in-flight lease
+        # requests — never subtract granted leases or sequential submissions
+        # serialize behind one busy lease.
+        want = min(len(qs.queue), self.max_pending_leases) - qs.inflight
+        for _ in range(max(0, want)):
+            qs.inflight += 1
+            asyncio.ensure_future(self._acquire_and_run(key, qs))
+
+    async def _acquire_and_run(self, key, qs: _QueueState) -> None:
+        sample = qs.queue[0] if qs.queue else None
+        if sample is None:
+            qs.inflight -= 1
+            return
+        try:
+            grant = await self._request_lease(sample)
+        except Exception as e:
+            qs.inflight -= 1
+            # Fail every queued task in this class with the scheduling error.
+            while qs.queue:
+                spec = qs.queue.pop(0)
+                await self._fail_task(spec, e)
+            return
+        qs.inflight -= 1
+        if grant is None:
+            # raced: no more tasks
+            return
+        lease_id = grant["lease_id"]
+        qs.leases[lease_id] = grant
+        try:
+            while qs.queue:
+                spec = qs.queue.pop(0)
+                ok = await self._push_to_worker(spec, grant)
+                if not ok:
+                    break  # worker died; lease dead. retry logic re-queued.
+        finally:
+            qs.leases.pop(lease_id, None)
+            try:
+                await self.endpoint.acall(
+                    grant["node_addr"], "node.return_lease",
+                    {"lease_id": lease_id},
+                )
+            except Exception:
+                pass
+            if qs.queue:
+                self._pump_queue(key, qs)
+
+    async def _request_lease(self, spec: TaskSpec) -> dict | None:
+        payload = {
+            "resources": spec.resources,
+            "label_selector": spec.label_selector,
+            "policy": spec.policy,
+        }
+        node_addr = self.node_addr
+        deadline = time.monotonic() + GLOBAL_CONFIG.lease_request_timeout_s
+        while True:
+            reply = await self.endpoint.acall(
+                node_addr, "node.request_lease", payload
+            )
+            if "lease_id" in reply:
+                reply["node_addr"] = node_addr
+                return reply
+            if "spill" in reply:
+                node_addr = tuple(reply["spill"])
+                continue
+            if "retry_after" in reply:
+                if time.monotonic() > deadline:
+                    raise asyncio.TimeoutError("lease request timed out")
+                await asyncio.sleep(reply["retry_after"])
+                node_addr = self.node_addr
+                continue
+            raise RuntimeError(f"bad lease reply: {reply}")
+
+    async def _push_to_worker(self, spec: TaskSpec, grant: dict) -> bool:
+        """Push one task; on worker death retry or fail. Returns False if the
+        lease's worker is gone."""
+        payload = {
+            "task_id": spec.task_id,
+            "name": spec.name,
+            "func": spec.func_payload,
+            "args": spec.args,
+            "kwargs": spec.kwargs,
+            "return_ids": spec.return_ids,
+            "owner_addr": tuple(self.endpoint.address),
+        }
+        try:
+            reply = await self.endpoint.acall(
+                tuple(grant["worker_addr"]), "worker.push_task", payload
+            )
+        except (ConnectionLost, ConnectionError, OSError):
+            if spec.retries_left > 0:
+                spec.retries_left -= 1
+                await self._enqueue_task_respec(spec)
+            else:
+                await self._fail_task(
+                    spec,
+                    WorkerCrashedError(
+                        f"worker died executing {spec.name} "
+                        f"(task {spec.task_id[:8]})"
+                    ),
+                )
+            return False
+        self._apply_task_reply(spec, reply)
+        return True
+
+    async def _enqueue_task_respec(self, spec: TaskSpec) -> None:
+        key = _SchedKey(
+            tuple(sorted(spec.resources.items())),
+            tuple(sorted(map(str, spec.label_selector.items()))),
+            spec.policy,
+        )
+        qs = self._queues.setdefault(key, _QueueState())
+        qs.queue.append(spec)
+        self._pump_queue(key, qs)
+
+    def _apply_task_reply(self, spec: TaskSpec, reply: dict) -> None:
+        results = reply["results"]
+        for oid, res in zip(spec.return_ids, results):
+            kind = res[0]
+            if kind == "inline":
+                self.owner_store.put_inline(oid, res[1])
+            elif kind == "location":
+                self.owner_store.put_location(oid, res[1], res[2])
+            elif kind == "error":
+                self.owner_store.put_error(oid, res[1])
+        self._task_specs.pop(spec.task_id, None)
+
+    async def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
+        for oid in spec.return_ids:
+            self.owner_store.put_error(oid, error)
+        self._task_specs.pop(spec.task_id, None)
+
+    # -- actor client --------------------------------------------------------
+
+    def create_actor(
+        self,
+        cls: type,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str | None = None,
+        resources: dict | None = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        label_selector: dict | None = None,
+        policy: str = "hybrid",
+    ) -> dict:
+        actor_id = ActorID.random().hex()
+        spec = {
+            "actor_id": actor_id,
+            "name": name,
+            "class_payload": cloudpickle.dumps(cls),
+            "args_payload": serialization.dumps((args, kwargs))[0],
+            "resources": dict(resources or {"CPU": 1.0}),
+            "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
+            "label_selector": dict(label_selector or {}),
+            "policy": policy,
+            "class_name": getattr(cls, "__name__", "Actor"),
+        }
+        info = self.gcs.call("create_actor", {"spec": spec}, timeout=120)
+        return info
+
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        name: str = "",
+        max_task_retries: int = 0,
+    ) -> list[ObjectRef]:
+        task_id = TaskID.random().hex()
+        return_ids = [ObjectID.random().hex() for _ in range(num_returns)]
+        spec = TaskSpec(
+            task_id=task_id,
+            name=name or method,
+            func_payload=None,
+            args=[self._encode_arg(a) for a in args],
+            kwargs={k: self._encode_arg(v) for k, v in kwargs.items()},
+            return_ids=return_ids,
+            resources={},
+            retries_left=max_task_retries,
+            actor_id=actor_id,
+            method=method,
+        )
+        refs = [
+            ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, spec.name)
+            for oid in return_ids
+        ]
+        self.endpoint.submit(self._submit_actor_async(spec)).result(30)
+        return refs
+
+    async def _submit_actor_async(self, spec: TaskSpec) -> None:
+        for oid in spec.return_ids:
+            obj = self.owner_store.ensure(oid)
+            obj.local_refs += 1
+        sub = self._actor_submitters.get(spec.actor_id)
+        if sub is None:
+            sub = self._actor_submitters[spec.actor_id] = _ActorSubmitter(
+                self, spec.actor_id
+            )
+        sub.enqueue(spec)
+
+    # -- execution side (worker role) ---------------------------------------
+
+    async def _h_worker_start_actor(self, conn, p):
+        spec = p["spec"]
+        cls = cloudpickle.loads(spec["class_payload"])
+        (args, kwargs), _ = serialization.loads(spec["args_payload"])
+        max_conc = spec.get("max_concurrency", 1)
+        if max_conc > 1:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max_conc, thread_name_prefix="actor-exec"
+            )
+        loop = asyncio.get_running_loop()
+
+        def make():
+            return cls(*args, **kwargs)
+
+        self._actor_instance = await loop.run_in_executor(self._executor, make)
+        self._actor_id = p["actor_id"]
+        return True
+
+    async def _h_worker_push_task(self, conn, p):
+        if p.get("actor_id") is not None:
+            return await self._execute_actor_task(p)
+        return await self._execute_task(p)
+
+    async def _execute_task(self, p) -> dict:
+        func = cloudpickle.loads(p["func"])
+        args, kwargs = await self._resolve_args(p)
+        loop = asyncio.get_running_loop()
+
+        def run():
+            return func(*args, **kwargs)
+
+        try:
+            if asyncio.iscoroutinefunction(func):
+                result = await func(*args, **kwargs)
+            else:
+                result = await loop.run_in_executor(self._executor, run)
+            results = self._encode_results(p, result)
+            await self._flush_created(results)
+            return {"results": results}
+        except Exception as e:  # noqa: BLE001
+            return {"results": self._error_results(p, e)}
+
+    async def _execute_actor_task(self, p) -> dict:
+        # Per-caller ordering: execute in sequence-number order.
+        caller, seq = p["caller"], p["seq"]
+        expected = self._actor_seq.get(caller, 0)
+        if seq != expected:
+            ev = asyncio.Event()
+            self._actor_buffer[(caller, seq)] = ev
+            await ev.wait()
+        try:
+            instance = self._actor_instance
+            method = getattr(instance, p["method"])
+            args, kwargs = await self._resolve_args(p)
+            loop = asyncio.get_running_loop()
+            try:
+                if asyncio.iscoroutinefunction(method):
+                    result = await method(*args, **kwargs)
+                else:
+                    result = await loop.run_in_executor(
+                        self._executor, lambda: method(*args, **kwargs)
+                    )
+                results = self._encode_results(p, result)
+                await self._flush_created(results)
+                return {"results": results}
+            except Exception as e:  # noqa: BLE001
+                return {"results": self._error_results(p, e)}
+        finally:
+            self._actor_seq[caller] = seq + 1
+            nxt = self._actor_buffer.pop((caller, seq + 1), None)
+            if nxt is not None:
+                nxt.set()
+
+    async def _resolve_args(self, p) -> tuple[tuple, dict]:
+        async def decode(item):
+            kind, payload = item[0], item[1]
+            if kind == "v":
+                value, _ = serialization.loads(payload)
+                return value
+            ref: ObjectRef = payload
+            data = await self._fetch_payload(ref, None)
+            value, _ = serialization.loads(data)
+            return value
+
+        args = await asyncio.gather(*(decode(a) for a in p["args"]))
+        kw_items = list(p["kwargs"].items())
+        kw_values = await asyncio.gather(*(decode(v) for _, v in kw_items))
+        return tuple(args), {k: v for (k, _), v in zip(kw_items, kw_values)}
+
+    def _encode_results(self, p, result) -> list:
+        return_ids = p["return_ids"]
+        if len(return_ids) == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != len(return_ids):
+                raise ValueError(
+                    f"task {p['name']} returned {len(values)} values, "
+                    f"expected {len(return_ids)}"
+                )
+        out = []
+        for oid, value in zip(return_ids, values):
+            payload, _ = serialization.dumps(value)
+            if len(payload) <= GLOBAL_CONFIG.max_inline_object_bytes:
+                out.append(("inline", payload))
+            else:
+                self.shm_writer.write(oid, payload)
+                out.append(("location", self.node_id, len(payload), oid))
+        return out
+
+    async def _flush_created(self, results: list) -> None:
+        """Tell our node about sealed shm objects BEFORE the reply releases
+        the owner to hand out the location (avoids a pull/adopt race)."""
+        for res in results:
+            if res[0] == "location":
+                await self.endpoint.acall(
+                    self.node_addr,
+                    "node.object_created",
+                    {"oid": res[3], "size": res[2]},
+                )
+
+    def _error_results(self, p, exc: Exception) -> list:
+        tb = traceback.format_exc()
+        err = TaskError(p["name"], tb, cause=_safe_exc(exc))
+        return [("error", err) for _ in p["return_ids"]]
+
+    async def _h_worker_shutdown(self, conn, p):
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return True
+
+    async def _h_worker_ping(self, conn, p):
+        return {"worker_id": self.worker_id, "actor_id": self._actor_id}
+
+
+class _ActorSubmitter:
+    """Per-actor ordered, pipelined task submission with restart-aware resend.
+
+    Reference parity: the ActorTaskSubmitter's per-actor queues + sequence
+    numbers (src/ray/core_worker/task_submission/actor_task_submitter.h).
+    Design: sequence numbers are an (epoch, seq) pair where epoch bumps on
+    every actor restart. Tasks are sent pipelined (no await between sends) on
+    one connection, so arrival order matches submission order; the executing
+    side buffers by seq. On connection loss, unacked + queued tasks are
+    resent in original order with fresh seqs under the new epoch.
+    """
+
+    def __init__(self, worker: "CoreWorker", actor_id: str):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.queue: list[TaskSpec] = []
+        self.unacked: dict[str, TaskSpec] = {}  # task_id -> spec (send order)
+        self.addr: tuple | None = None
+        # incarnation bumps on every (re)connect; it namespaces the seq
+        # counter so the executing side always sees a fresh, 0-based ordered
+        # stream after any reconnect/restart (server buffers by caller key).
+        self.incarnation = 0
+        self.seq = 0
+        self._sender_active = False
+        self._reconnecting = False
+
+    def enqueue(self, spec: TaskSpec) -> None:
+        self.queue.append(spec)
+        self._pump()
+
+    def _pump(self) -> None:
+        if self._sender_active or self._reconnecting:
+            return
+        self._sender_active = True
+        asyncio.ensure_future(self._send_loop())
+
+    async def _send_loop(self) -> None:
+        try:
+            while self.queue and not self._reconnecting:
+                if self.addr is None:
+                    if not await self._resolve():
+                        return
+                    continue  # re-check state after the await
+                addr = self.addr
+                spec = self.queue.pop(0)
+                seq = self.seq
+                self.seq += 1
+                self.unacked[spec.task_id] = spec
+                payload = self._payload(spec, seq)
+                try:
+                    conn = await self.worker.endpoint.connect(addr)
+                    fut = asyncio.ensure_future(
+                        conn.request("worker.push_task", payload)
+                    )
+                except (ConnectionLost, ConnectionError, OSError):
+                    await self._on_disconnect()
+                    continue
+                fut.add_done_callback(
+                    lambda f, s=spec: asyncio.ensure_future(
+                        self._on_reply(s, f)
+                    )
+                )
+        finally:
+            self._sender_active = False
+
+    def _payload(self, spec: TaskSpec, seq: int) -> dict:
+        return {
+            "task_id": spec.task_id,
+            "name": spec.name,
+            "actor_id": spec.actor_id,
+            "method": spec.method,
+            "seq": seq,
+            # Key the executing side's ordering buffer by (submitter, actor,
+            # incarnation) so distinct handles/actors never share a counter.
+            "caller": (
+                f"{self.worker.worker_id}:{self.actor_id}:{self.incarnation}"
+            ),
+            "args": spec.args,
+            "kwargs": spec.kwargs,
+            "return_ids": spec.return_ids,
+            "owner_addr": tuple(self.worker.endpoint.address),
+        }
+
+    async def _on_reply(self, spec: TaskSpec, fut: asyncio.Future) -> None:
+        exc = fut.exception() if not fut.cancelled() else ConnectionLost()
+        if exc is None:
+            if spec.task_id in self.unacked:
+                del self.unacked[spec.task_id]
+                self.worker._apply_task_reply(spec, fut.result())
+            return
+        if isinstance(exc, (ConnectionLost, ConnectionError, OSError)):
+            await self._on_disconnect()
+        else:
+            # Application-level error from the RPC layer: fail just this task.
+            if spec.task_id in self.unacked:
+                del self.unacked[spec.task_id]
+                await self.worker._fail_task(spec, exc)
+
+    async def _on_disconnect(self) -> None:
+        if self._reconnecting:
+            return
+        self._reconnecting = True
+        self.addr = None
+        # In-flight tasks: reference semantics — actor tasks are NOT retried
+        # unless max_task_retries was set (they may have side effects and may
+        # already have executed). Queued-but-unsent tasks are safe to send to
+        # the restarted actor.
+        pending = list(self.unacked.values())
+        self.unacked.clear()
+        retry = []
+        for spec in pending:
+            if spec.retries_left > 0:
+                spec.retries_left -= 1
+                retry.append(spec)
+            else:
+                await self.worker._fail_task(
+                    spec,
+                    ActorDiedError(
+                        f"actor task {spec.name} failed: actor "
+                        f"{self.actor_id[:8]} died while the call was in "
+                        f"flight (set max_task_retries to retry)"
+                    ),
+                )
+        self.queue = retry + self.queue
+        try:
+            ok = await self._resolve()
+        finally:
+            self._reconnecting = False
+        if ok:
+            self._pump()
+
+    async def _resolve(self) -> bool:
+        """Find the actor's current address (waiting out restarts). On DEAD,
+        fail everything. Returns True if the actor is reachable."""
+        try:
+            info = await self.worker.gcs.acall(
+                "wait_actor_alive",
+                {"actor_id": self.actor_id, "timeout": 120.0},
+            )
+        except Exception as e:
+            err = e if isinstance(e, ActorDiedError) else ActorDiedError(
+                f"actor {self.actor_id[:8]}: {e}"
+            )
+            for spec in list(self.unacked.values()) + self.queue:
+                await self.worker._fail_task(spec, err)
+            self.unacked.clear()
+            self.queue.clear()
+            return False
+        self.addr = tuple(info["addr"])
+        self.incarnation += 1
+        self.seq = 0
+        return True
+
+
+def _safe_exc(exc: Exception) -> Exception:
+    """Return an exception safe to pickle (fall back to repr)."""
+    try:
+        cloudpickle.loads(cloudpickle.dumps(exc))
+        return exc
+    except Exception:
+        return RuntimeError(repr(exc))

@@ -1,0 +1,54 @@
+"""User-visible runtime errors (reference parity: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; carries the remote traceback.
+
+    Re-raised at every `get` on the task's outputs, like the reference's
+    RayTaskError (reference: python/ray/exceptions.py).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause=None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"task {function_name} failed:\n{traceback_str}"
+        )
+
+    def __reduce__(self):
+        return (TaskError, (self.function_name, self.traceback_str, self.cause))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead (crashed, killed, or out of restarts)."""
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object value was lost and could not be reconstructed."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class SchedulingError(RayTpuError):
+    """No feasible node for the requested resources."""
+
+
+class PlacementGroupError(RayTpuError):
+    pass

@@ -1,0 +1,398 @@
+"""GCS — the cluster control plane.
+
+Reference parity: the GCS server and its managers (src/ray/gcs/gcs_server.h:100
+— node/actor/job/KV/pubsub managers, actor scheduler). One asyncio service
+instead of 11 gRPC services: node registry + heartbeats → cluster view, actor
+table with scheduling and restart-on-death, namespaced KV (function/config
+store), long-lived pubsub over the same connections, and (M3+) placement
+groups.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import ActorDiedError, SchedulingError
+from ray_tpu.core.protocol import Connection, Endpoint
+from ray_tpu.core.scheduler import (
+    NodeView,
+    SchedulingRequest,
+    any_feasible,
+    pick_node,
+)
+
+ALIVE = "ALIVE"
+PENDING = "PENDING"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class ActorRecord:
+    actor_id: str
+    name: str | None
+    spec: dict  # class_payload, args_payload, resources, label_selector, opts
+    state: str = PENDING
+    addr: tuple | None = None
+    worker_id: str | None = None
+    node_id: str | None = None
+    restarts: int = 0
+    killed: bool = False
+    error: str | None = None
+    waiters: list = field(default_factory=list)
+
+
+class GcsServer:
+    def __init__(self, session_id: str):
+        self.session_id = session_id
+        self.endpoint = Endpoint("gcs")
+        self.kv: dict[str, dict[str, bytes]] = {}
+        self.nodes: dict[str, NodeView] = {}
+        self.node_meta: dict[str, dict] = {}
+        self.node_last_seen: dict[str, float] = {}
+        self.actors: dict[str, ActorRecord] = {}
+        self.named_actors: dict[str, str] = {}
+        self.pending_actors: list[str] = []
+        self.subs: dict[str, list[Connection]] = {}
+        self.internal_config: str = GLOBAL_CONFIG.to_json()
+        self._health_task = None
+        for name in [n for n in dir(self) if n.startswith("_h_")]:
+            self.endpoint.register("gcs." + name[3:], getattr(self, name))
+
+    def start(self) -> tuple:
+        addr = self.endpoint.start()
+        self._health_task = self.endpoint.submit(self._health_loop())
+        return addr
+
+    def stop(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+        self.endpoint.stop()
+
+    # -- pubsub --------------------------------------------------------------
+
+    async def _publish(self, channel: str, data: Any) -> None:
+        for conn in list(self.subs.get(channel, [])):
+            if conn.closed:
+                self.subs[channel].remove(conn)
+                continue
+            try:
+                await conn.notify("pub", {"channel": channel, "data": data})
+            except Exception:
+                pass
+
+    async def _h_subscribe(self, conn: Connection, p: dict):
+        for ch in p["channels"]:
+            lst = self.subs.setdefault(ch, [])
+            if conn not in lst:
+                lst.append(conn)
+        return True
+
+    # -- kv ------------------------------------------------------------------
+
+    async def _h_kv_put(self, conn, p):
+        ns = self.kv.setdefault(p.get("ns", ""), {})
+        if not p.get("overwrite", True) and p["key"] in ns:
+            return False
+        ns[p["key"]] = p["value"]
+        return True
+
+    async def _h_kv_get(self, conn, p):
+        return self.kv.get(p.get("ns", ""), {}).get(p["key"])
+
+    async def _h_kv_del(self, conn, p):
+        return self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
+
+    async def _h_kv_keys(self, conn, p):
+        prefix = p.get("prefix", "")
+        return [
+            k for k in self.kv.get(p.get("ns", ""), {}) if k.startswith(prefix)
+        ]
+
+    async def _h_get_internal_config(self, conn, p):
+        return self.internal_config
+
+    # -- nodes ---------------------------------------------------------------
+
+    async def _h_register_node(self, conn, p):
+        view = NodeView(
+            node_id=p["node_id"],
+            addr=tuple(p["addr"]),
+            total=dict(p["resources"]),
+            available=dict(p["resources"]),
+            labels=dict(p.get("labels", {})),
+        )
+        self.nodes[p["node_id"]] = view
+        self.node_meta[p["node_id"]] = {
+            "shm_root": p.get("shm_root"),
+            "hostname": p.get("hostname", "localhost"),
+        }
+        self.node_last_seen[p["node_id"]] = time.monotonic()
+        await self._publish("nodes", {"node_id": p["node_id"], "state": ALIVE})
+        await self._retry_pending_actors()
+        return {"session_id": self.session_id, "config": self.internal_config}
+
+    async def _h_node_heartbeat(self, conn, p):
+        view = self.nodes.get(p["node_id"])
+        if view is None:
+            return False
+        view.available = dict(p["available"])
+        self.node_last_seen[p["node_id"]] = time.monotonic()
+        if p.get("resources_freed"):
+            await self._retry_pending_actors()
+        return True
+
+    async def _h_get_cluster_view(self, conn, p):
+        return {
+            nid: {
+                "addr": v.addr,
+                "total": v.total,
+                "available": v.available,
+                "labels": v.labels,
+                "alive": v.alive,
+                **self.node_meta.get(nid, {}),
+            }
+            for nid, v in self.nodes.items()
+        }
+
+    async def _h_drain_node(self, conn, p):
+        await self._mark_node_dead(p["node_id"], "drained")
+        return True
+
+    async def _health_loop(self):
+        cfg = GLOBAL_CONFIG
+        while True:
+            await asyncio.sleep(cfg.node_heartbeat_interval_s)
+            now = time.monotonic()
+            for nid, view in list(self.nodes.items()):
+                if not view.alive:
+                    continue
+                last = self.node_last_seen.get(nid, 0)
+                if now - last > cfg.node_death_timeout_s:
+                    await self._mark_node_dead(nid, "heartbeat timeout")
+
+    async def _mark_node_dead(self, node_id: str, reason: str):
+        view = self.nodes.get(node_id)
+        if view is None or not view.alive:
+            return
+        view.alive = False
+        view.available = {}
+        await self._publish(
+            "nodes", {"node_id": node_id, "state": DEAD, "reason": reason}
+        )
+        # Fail or restart actors that lived there.
+        for rec in list(self.actors.values()):
+            if rec.node_id == node_id and rec.state in (ALIVE, PENDING):
+                await self._on_actor_failure(rec, f"node {node_id} died")
+
+    # -- actors --------------------------------------------------------------
+
+    async def _h_create_actor(self, conn, p):
+        spec = p["spec"]
+        rec = ActorRecord(
+            actor_id=spec["actor_id"], name=spec.get("name"), spec=spec
+        )
+        if rec.name:
+            if rec.name in self.named_actors:
+                raise ValueError(f"actor name {rec.name!r} already taken")
+            self.named_actors[rec.name] = rec.actor_id
+        self.actors[rec.actor_id] = rec
+        await self._schedule_actor(rec)
+        return self._actor_info(rec)
+
+    async def _schedule_actor(self, rec: ActorRecord) -> None:
+        req = SchedulingRequest(
+            resources=rec.spec.get("resources", {}),
+            label_selector=rec.spec.get("label_selector", {}),
+            policy=rec.spec.get("policy", "hybrid"),
+        )
+        node_id = pick_node(req, "", self.nodes)
+        if node_id is None:
+            if any_feasible(req, self.nodes):
+                if rec.actor_id not in self.pending_actors:
+                    self.pending_actors.append(rec.actor_id)
+                return
+            rec.state = DEAD
+            rec.error = (
+                f"no feasible node for actor resources {req.resources} "
+                f"selector {req.label_selector}"
+            )
+            self._wake(rec)
+            await self._publish("actors", self._actor_info(rec))
+            return
+        view = self.nodes[node_id]
+        rec.node_id = node_id
+        try:
+            reply = await self.endpoint.acall(
+                view.addr, "node.start_actor", {"record": self._start_spec(rec)}
+            )
+        except Exception as e:
+            await self._on_actor_failure(rec, f"start_actor failed: {e!r}")
+            return
+        rec.addr = tuple(reply["worker_addr"])
+        rec.worker_id = reply["worker_id"]
+        rec.state = ALIVE
+        self._wake(rec)
+        await self._publish("actors", self._actor_info(rec))
+
+    def _start_spec(self, rec: ActorRecord) -> dict:
+        return {
+            "actor_id": rec.actor_id,
+            "spec": {
+                k: v
+                for k, v in rec.spec.items()
+                if k != "name" or v is not None
+            },
+            "restart_count": rec.restarts,
+        }
+
+    async def _retry_pending_actors(self):
+        pending, self.pending_actors = self.pending_actors, []
+        for actor_id in pending:
+            rec = self.actors.get(actor_id)
+            if rec is not None and rec.state in (PENDING, RESTARTING):
+                await self._schedule_actor(rec)
+
+    async def _on_actor_failure(self, rec: ActorRecord, reason: str):
+        max_restarts = rec.spec.get("max_restarts", 0)
+        if not rec.killed and (
+            max_restarts == -1 or rec.restarts < max_restarts
+        ):
+            rec.restarts += 1
+            rec.state = RESTARTING
+            rec.addr = None
+            await self._publish("actors", self._actor_info(rec))
+            await self._schedule_actor(rec)
+        else:
+            rec.state = DEAD
+            rec.error = reason
+            rec.addr = None
+            self._wake(rec)
+            await self._publish("actors", self._actor_info(rec))
+
+    async def _h_report_worker_death(self, conn, p):
+        """A node reports a worker process exited (possibly hosting actors)."""
+        for actor_id in p.get("actor_ids", []):
+            rec = self.actors.get(actor_id)
+            if rec is not None and rec.state in (ALIVE, RESTARTING):
+                await self._on_actor_failure(
+                    rec, p.get("reason", "worker died")
+                )
+        return True
+
+    async def _h_get_actor(self, conn, p):
+        rec = self._resolve_actor(p)
+        if rec is None:
+            return None
+        return self._actor_info(rec)
+
+    async def _h_wait_actor_alive(self, conn, p):
+        rec = self._resolve_actor(p)
+        if rec is None:
+            raise ValueError(f"no such actor: {p}")
+        deadline = time.monotonic() + p.get("timeout", 60.0)
+        while rec.state not in (ALIVE, DEAD):
+            ev = asyncio.Event()
+            rec.waiters.append(ev)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"actor {rec.actor_id} not alive in time")
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                raise TimeoutError(f"actor {rec.actor_id} not alive in time")
+        if rec.state == DEAD:
+            raise ActorDiedError(rec.error or "actor died")
+        return self._actor_info(rec)
+
+    async def _h_kill_actor(self, conn, p):
+        rec = self._resolve_actor(p)
+        if rec is None:
+            return False
+        rec.killed = not p.get("allow_restart", False)
+        if rec.node_id and rec.worker_id and rec.state == ALIVE:
+            view = self.nodes.get(rec.node_id)
+            if view is not None and view.alive:
+                try:
+                    await self.endpoint.acall(
+                        view.addr,
+                        "node.kill_worker",
+                        {"worker_id": rec.worker_id, "force": True},
+                    )
+                except Exception:
+                    pass
+        if rec.killed:
+            rec.state = DEAD
+            rec.error = "killed via ray_tpu.kill"
+            if rec.name:
+                self.named_actors.pop(rec.name, None)
+            self._wake(rec)
+            await self._publish("actors", self._actor_info(rec))
+        return True
+
+    async def _h_list_actors(self, conn, p):
+        return [self._actor_info(r) for r in self.actors.values()]
+
+    def _resolve_actor(self, p) -> Optional[ActorRecord]:
+        if p.get("actor_id"):
+            return self.actors.get(p["actor_id"])
+        if p.get("name"):
+            actor_id = self.named_actors.get(p["name"])
+            return self.actors.get(actor_id) if actor_id else None
+        return None
+
+    def _wake(self, rec: ActorRecord):
+        for ev in rec.waiters:
+            ev.set()
+        rec.waiters.clear()
+
+    def _actor_info(self, rec: ActorRecord) -> dict:
+        return {
+            "actor_id": rec.actor_id,
+            "name": rec.name,
+            "state": rec.state,
+            "addr": rec.addr,
+            "node_id": rec.node_id,
+            "worker_id": rec.worker_id,
+            "restarts": rec.restarts,
+            "error": rec.error,
+            "max_concurrency": rec.spec.get("max_concurrency", 1),
+        }
+
+
+class GcsClient:
+    """Thin sync/async facade over the GCS RPCs, usable from any process."""
+
+    def __init__(self, endpoint: Endpoint, gcs_addr: tuple):
+        self.endpoint = endpoint
+        self.addr = tuple(gcs_addr)
+
+    # async ------------------------------------------------------------------
+
+    async def acall(self, method: str, payload: dict | None = None):
+        return await self.endpoint.acall(self.addr, "gcs." + method, payload or {})
+
+    # sync -------------------------------------------------------------------
+
+    def call(self, method: str, payload: dict | None = None, timeout=60.0):
+        return self.endpoint.call(
+            self.addr, "gcs." + method, payload or {}, timeout=timeout
+        )
+
+    def kv_put(self, key: str, value: bytes, ns: str = "", overwrite=True):
+        return self.call(
+            "kv_put", {"ns": ns, "key": key, "value": value, "overwrite": overwrite}
+        )
+
+    def kv_get(self, key: str, ns: str = ""):
+        return self.call("kv_get", {"ns": ns, "key": key})
+
+    def kv_del(self, key: str, ns: str = ""):
+        return self.call("kv_del", {"ns": ns, "key": key})
+
+    def kv_keys(self, prefix: str = "", ns: str = ""):
+        return self.call("kv_keys", {"ns": ns, "prefix": prefix})

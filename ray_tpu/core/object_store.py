@@ -1,0 +1,250 @@
+"""Host-memory object plane: owner memory store + node shared-memory store.
+
+Reference parity: the in-process CoreWorkerMemoryStore
+(src/ray/core_worker/store_provider/memory_store/memory_store.h:47) for small
+objects and the plasma store (src/ray/object_manager/plasma/store.h:55) for
+large ones. TPU-era redesign: large objects are file-backed mmaps under
+/dev/shm — every process on the node maps them directly (zero-copy reads, no
+fd-passing protocol, no resource-tracker state), and the node daemon only
+tracks metadata and capacity. Device arrays never enter this plane.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import mmap
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.core.errors import ObjectLostError
+from ray_tpu.core.ids import ObjectID
+
+
+def default_shm_root(session_id: str, node_id_hex: str) -> str:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+    return os.path.join(base, f"raytpu_{session_id}", node_id_hex[:12])
+
+
+class ShmObjectStore:
+    """Node-scoped store of sealed, immutable byte blobs in shared memory.
+
+    Writers (workers on the node) create-and-fill via `create`/`seal`;
+    any process on the node maps sealed blobs read-only by path. Capacity
+    accounting and deletion live with the node daemon that owns this store;
+    worker-side handles (`ShmReader`) just map.
+    """
+
+    def __init__(self, root: str, capacity_bytes: int):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.capacity = capacity_bytes
+        self.used = 0
+        # object hex -> (size, sealed, last_access)
+        self.meta: dict[str, list] = {}
+        self._maps: dict[str, tuple[mmap.mmap, memoryview]] = {}
+
+    def _path(self, oid_hex: str) -> str:
+        return os.path.join(self.root, oid_hex)
+
+    def create(self, oid_hex: str, size: int) -> memoryview:
+        if oid_hex in self.meta:
+            raise ValueError(f"object {oid_hex} already exists")
+        if self.used + size > self.capacity:
+            raise MemoryError(
+                f"object store over capacity: {self.used}+{size} > "
+                f"{self.capacity}"
+            )
+        path = self._path(oid_hex)
+        fd = os.open(path + ".tmp", os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, max(size, 1))
+            mm = mmap.mmap(fd, max(size, 1))
+        finally:
+            os.close(fd)
+        self.meta[oid_hex] = [size, False, time.monotonic()]
+        self.used += size
+        self._maps[oid_hex] = (mm, memoryview(mm)[:size])
+        return self._maps[oid_hex][1]
+
+    def seal(self, oid_hex: str) -> None:
+        entry = self.meta[oid_hex]
+        mm, view = self._maps[oid_hex]
+        mm.flush()
+        os.rename(self._path(oid_hex) + ".tmp", self._path(oid_hex))
+        entry[1] = True
+
+    def adopt(self, oid_hex: str, size: int) -> None:
+        """Account for a sealed object a local worker created directly in our
+        root (the worker wrote the file; we track capacity/eviction)."""
+        if oid_hex in self.meta:
+            return
+        self.meta[oid_hex] = [size, True, time.monotonic()]
+        self.used += size
+
+    def contains(self, oid_hex: str) -> bool:
+        return oid_hex in self.meta and self.meta[oid_hex][1]
+
+    def get(self, oid_hex: str) -> memoryview:
+        if not self.contains(oid_hex):
+            raise KeyError(oid_hex)
+        self.meta[oid_hex][2] = time.monotonic()
+        if oid_hex not in self._maps:
+            size = self.meta[oid_hex][0]
+            with open(self._path(oid_hex), "rb") as f:
+                mm = mmap.mmap(f.fileno(), max(size, 1), prot=mmap.PROT_READ)
+            self._maps[oid_hex] = (mm, memoryview(mm)[:size])
+        return self._maps[oid_hex][1]
+
+    def delete(self, oid_hex: str) -> None:
+        entry = self.meta.pop(oid_hex, None)
+        if entry is None:
+            return
+        self.used -= entry[0]
+        pair = self._maps.pop(oid_hex, None)
+        if pair is not None:
+            mm, view = pair
+            view.release()
+            mm.close()
+        for suffix in ("", ".tmp"):
+            try:
+                os.unlink(self._path(oid_hex) + suffix)
+            except FileNotFoundError:
+                pass
+
+    def close(self) -> None:
+        for oid in list(self.meta):
+            self.delete(oid)
+        try:
+            os.rmdir(self.root)
+        except OSError:
+            pass
+
+
+class ShmWriter:
+    """Worker-side creator of sealed blobs in the node's shm root.
+
+    The worker writes and seals the file itself (same-machine zero-copy),
+    then tells the node to adopt it for accounting ("node.object_created").
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def write(self, oid_hex: str, payload: bytes | memoryview) -> int:
+        tmp = os.path.join(self.root, oid_hex + ".tmp")
+        final = os.path.join(self.root, oid_hex)
+        if os.path.exists(final):
+            return len(payload)
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.rename(tmp, final)
+        return len(payload)
+
+
+class ShmReader:
+    """Read-only view of a node's shm store for worker processes."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._maps: dict[str, tuple[mmap.mmap, memoryview]] = {}
+
+    def contains(self, oid_hex: str) -> bool:
+        return oid_hex in self._maps or os.path.exists(
+            os.path.join(self.root, oid_hex)
+        )
+
+    def get(self, oid_hex: str) -> memoryview:
+        if oid_hex not in self._maps:
+            path = os.path.join(self.root, oid_hex)
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                mm = mmap.mmap(f.fileno(), max(size, 1), prot=mmap.PROT_READ)
+            self._maps[oid_hex] = (mm, memoryview(mm)[:size])
+        return self._maps[oid_hex][1]
+
+    def release(self, oid_hex: str) -> None:
+        pair = self._maps.pop(oid_hex, None)
+        if pair is not None:
+            mm, view = pair
+            view.release()
+            mm.close()
+
+
+# ---------------------------------------------------------------------------
+# Owner-side store
+# ---------------------------------------------------------------------------
+
+PENDING = "PENDING"
+READY = "READY"
+FAILED = "FAILED"
+
+
+@dataclass
+class OwnedObject:
+    """Owner's record of one object (reference_counter + memory_store entry)."""
+
+    state: str = PENDING
+    inline: Optional[bytes] = None  # serialized value, if small
+    locations: set = field(default_factory=set)  # node id hex strings
+    size: int = 0
+    error: Optional[Exception] = None
+    local_refs: int = 0
+    borrowers: int = 0
+    # task lineage for reconstruction (task spec dict) — set by submitter
+    producing_task: Any = None
+    waiters: list = field(default_factory=list)  # asyncio.Events
+
+
+class OwnerStore:
+    """The owner's table of objects it created. Lives on the endpoint loop."""
+
+    def __init__(self, loop):
+        self.loop = loop
+        self.objects: dict[str, OwnedObject] = {}
+
+    def ensure(self, oid_hex: str) -> OwnedObject:
+        obj = self.objects.get(oid_hex)
+        if obj is None:
+            obj = self.objects[oid_hex] = OwnedObject()
+        return obj
+
+    def put_inline(self, oid_hex: str, payload: bytes) -> None:
+        obj = self.ensure(oid_hex)
+        obj.inline = payload
+        obj.size = len(payload)
+        obj.state = READY
+        self._wake(obj)
+
+    def put_location(self, oid_hex: str, node_id_hex: str, size: int) -> None:
+        obj = self.ensure(oid_hex)
+        obj.locations.add(node_id_hex)
+        obj.size = size
+        obj.state = READY
+        self._wake(obj)
+
+    def put_error(self, oid_hex: str, error: Exception) -> None:
+        obj = self.ensure(oid_hex)
+        obj.error = error
+        obj.state = FAILED
+        self._wake(obj)
+
+    def _wake(self, obj: OwnedObject) -> None:
+        for ev in obj.waiters:
+            ev.set()
+        obj.waiters.clear()
+
+    async def wait_ready(self, oid_hex: str, timeout: float | None = None):
+        obj = self.ensure(oid_hex)
+        while obj.state == PENDING:
+            ev = asyncio.Event()
+            obj.waiters.append(ev)
+            if timeout is None:
+                await ev.wait()
+            else:
+                await asyncio.wait_for(ev.wait(), timeout)
+        return obj
+
+    def delete(self, oid_hex: str) -> Optional[OwnedObject]:
+        return self.objects.pop(oid_hex, None)

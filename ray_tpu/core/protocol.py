@@ -1,0 +1,313 @@
+"""Asyncio RPC fabric: every runtime process runs exactly one Endpoint.
+
+Plays the role of the reference's gRPC layer + client pools (reference:
+src/ray/rpc/, src/ray/core_worker_rpc_client/core_worker_client_pool.h) with
+one simplification the TPU design allows: a single event-loop thread per
+process carries *all* services that process hosts (GCS, node manager, core
+worker), and connections are dialed on demand and cached by address.
+
+Wire format: 4-byte big-endian length | pickled (msg_type, msg_id, reply_to,
+payload). A request carries msg_id; the reply echoes it in reply_to with type
+"$reply" (result) or "$error" (pickled exception, re-raised caller-side).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import threading
+import traceback
+from typing import Any, Awaitable, Callable, Optional
+
+Address = tuple  # (host: str, port: int)
+
+_REPLY = "$reply"
+_ERROR = "$error"
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class RemoteError(RpcError):
+    """Wraps a traceback string when the remote exception can't be unpickled."""
+
+
+class Connection:
+    """One framed, multiplexed duplex channel."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Callable[["Connection", str, Any], Awaitable[Any]],
+        on_close: Optional[Callable[["Connection"], None]] = None,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.on_close = on_close
+        self._next_id = 1
+        self._pending: dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._send_lock = asyncio.Lock()
+        self.peer: Any = None  # set by servers after registration
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    async def _send(self, msg_type: str, msg_id, reply_to, payload) -> None:
+        data = pickle.dumps((msg_type, msg_id, reply_to, payload), protocol=5)
+        async with self._send_lock:
+            self.writer.write(len(data).to_bytes(4, "big"))
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def request(self, msg_type: str, payload: Any = None) -> Any:
+        if self._closed:
+            raise ConnectionLost(f"connection closed (sending {msg_type})")
+        msg_id = self._next_id
+        self._next_id += 1
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        await self._send(msg_type, msg_id, None, payload)
+        return await fut
+
+    async def notify(self, msg_type: str, payload: Any = None) -> None:
+        if self._closed:
+            raise ConnectionLost(f"connection closed (sending {msg_type})")
+        await self._send(msg_type, None, None, payload)
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                header = await self.reader.readexactly(4)
+                length = int.from_bytes(header, "big")
+                data = await self.reader.readexactly(length)
+                msg_type, msg_id, reply_to, payload = pickle.loads(data)
+                if msg_type == _REPLY:
+                    fut = self._pending.pop(reply_to, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(payload)
+                elif msg_type == _ERROR:
+                    fut = self._pending.pop(reply_to, None)
+                    if fut is not None and not fut.done():
+                        exc = payload
+                        if isinstance(exc, str):
+                            exc = RemoteError(exc)
+                        fut.set_exception(exc)
+                else:
+                    asyncio.ensure_future(
+                        self._dispatch(msg_type, msg_id, payload)
+                    )
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            self._teardown()
+
+    async def _dispatch(self, msg_type: str, msg_id, payload) -> None:
+        try:
+            result = await self.handler(self, msg_type, payload)
+            if msg_id is not None:
+                await self._send(_REPLY, None, msg_id, result)
+        except Exception as e:  # noqa: BLE001 — must propagate to caller
+            if msg_id is not None:
+                try:
+                    await self._send(_ERROR, None, msg_id, e)
+                except Exception:
+                    tb = traceback.format_exc()
+                    try:
+                        await self._send(_ERROR, None, msg_id, tb)
+                    except Exception:
+                        pass
+
+    def _teardown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost("connection lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close is not None:
+            self.on_close(self)
+
+    def close(self) -> None:
+        self._teardown()
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+
+class Endpoint:
+    """Per-process RPC endpoint: one server socket + cached outbound conns.
+
+    Handlers: {msg_type: async fn(conn, payload) -> reply}. The same handler
+    table serves inbound server connections and inbound messages on outbound
+    connections (full duplex — an owner can receive requests on a connection
+    it dialed).
+    """
+
+    def __init__(self, name: str = "endpoint"):
+        self.name = name
+        self.handlers: dict[str, Callable] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: dict[Address, Connection] = {}
+        self._conn_locks: dict[Address, asyncio.Lock] = {}
+        self.address: Address | None = None
+        self._started = threading.Event()
+        self.on_connection_lost: Optional[Callable[[Connection], None]] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+        self._thread = threading.Thread(
+            target=self._run_loop, args=(host, port), name=f"rpc-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RpcError(f"endpoint {self.name} failed to start")
+        assert self.address is not None
+        return self.address
+
+    def _run_loop(self, host: str, port: int) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._accept, host=host, port=port
+            )
+            sock = self._server.sockets[0]
+            self.address = sock.getsockname()[:2]
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    def stop(self) -> None:
+        if self._loop is None or self._loop.is_closed():
+            return
+
+        async def shutdown():
+            for conn in list(self._conns.values()):
+                conn.close()
+            if self._server is not None:
+                self._server.close()
+            tasks = [
+                t
+                for t in asyncio.all_tasks()
+                if t is not asyncio.current_task()
+            ]
+            for t in tasks:
+                t.cancel()
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*tasks, return_exceptions=True), timeout=2
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(shutdown(), self._loop).result(
+                timeout=5
+            )
+        except Exception:
+            pass
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    # -- serving -------------------------------------------------------------
+
+    async def _accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        Connection(reader, writer, self._handle, on_close=self._conn_closed)
+
+    def _conn_closed(self, conn: Connection) -> None:
+        for addr, c in list(self._conns.items()):
+            if c is conn:
+                del self._conns[addr]
+        if self.on_connection_lost is not None:
+            self.on_connection_lost(conn)
+
+    async def _handle(self, conn: Connection, msg_type: str, payload: Any):
+        handler = self.handlers.get(msg_type)
+        if handler is None:
+            raise RpcError(f"{self.name}: no handler for {msg_type!r}")
+        return await handler(conn, payload)
+
+    def register(self, msg_type: str, handler: Callable) -> None:
+        self.handlers[msg_type] = handler
+
+    # -- dialing -------------------------------------------------------------
+
+    async def connect(self, addr: Address) -> Connection:
+        addr = tuple(addr)
+        conn = self._conns.get(addr)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+            reader, writer = await asyncio.open_connection(addr[0], addr[1])
+            conn = Connection(
+                reader, writer, self._handle, on_close=self._conn_closed
+            )
+            self._conns[addr] = conn
+            return conn
+
+    async def acall(self, addr: Address, msg_type: str, payload: Any = None):
+        conn = await self.connect(addr)
+        return await conn.request(msg_type, payload)
+
+    async def anotify(self, addr: Address, msg_type: str, payload: Any = None):
+        conn = await self.connect(addr)
+        await conn.notify(msg_type, payload)
+
+    # -- sync facade (for non-loop threads) ----------------------------------
+
+    def call(
+        self, addr: Address, msg_type: str, payload: Any = None,
+        timeout: float | None = None,
+    ) -> Any:
+        fut = asyncio.run_coroutine_threadsafe(
+            self.acall(addr, msg_type, payload), self._loop
+        )
+        return fut.result(timeout=timeout)
+
+    def notify_sync(self, addr: Address, msg_type: str, payload: Any = None):
+        asyncio.run_coroutine_threadsafe(
+            self.anotify(addr, msg_type, payload), self._loop
+        ).result(timeout=30)
+
+    def submit(self, coro) -> "asyncio.Future":
+        """Run a coroutine on the endpoint loop from any thread."""
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        assert self._loop is not None
+        return self._loop

@@ -1,0 +1,147 @@
+"""Resource model and scheduling policies.
+
+Reference parity: the resource set / cluster-resource-data model
+(src/ray/common/scheduling/resource_set.h, cluster_resource_data.h), the
+hybrid/spread/affinity policies (src/ray/raylet/scheduling/policy/), and
+label-based scheduling (src/ray/common/scheduling/label_selector.h) that the
+reference's TPU support rides on.
+
+Resources are float-valued named quantities ("CPU", "TPU", "memory", custom
+slice-head markers like "TPU-v5e-8-head"); labels are string key/values used
+by selectors (exact / in / not-in), which is how slice topology constraints
+are expressed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+EPS = 1e-9
+
+
+def fits(avail: Mapping[str, float], demand: Mapping[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + EPS >= v for k, v in demand.items())
+
+
+def feasible(total: Mapping[str, float], demand: Mapping[str, float]) -> bool:
+    return fits(total, demand)
+
+
+def subtract(avail: dict, demand: Mapping[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def add(avail: dict, demand: Mapping[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+# -- label selectors ---------------------------------------------------------
+# Selector format: {key: value} exact match, {key: ("in", [v1, v2])},
+# {key: ("not_in", [v1])}, {key: ("exists",)}.
+
+
+def labels_match(labels: Mapping[str, str], selector: Mapping[str, Any]) -> bool:
+    for key, cond in (selector or {}).items():
+        have = labels.get(key)
+        if isinstance(cond, tuple) or isinstance(cond, list):
+            op = cond[0]
+            if op == "in":
+                if have not in cond[1]:
+                    return False
+            elif op == "not_in":
+                if have in cond[1]:
+                    return False
+            elif op == "exists":
+                if have is None:
+                    return False
+            else:
+                raise ValueError(f"unknown label op {op!r}")
+        else:
+            if have != cond:
+                return False
+    return True
+
+
+@dataclass
+class NodeView:
+    """One node as seen by the cluster view (gossiped via GCS)."""
+
+    node_id: str
+    addr: tuple
+    total: dict = field(default_factory=dict)
+    available: dict = field(default_factory=dict)
+    labels: dict = field(default_factory=dict)
+    alive: bool = True
+
+
+@dataclass
+class SchedulingRequest:
+    resources: dict
+    label_selector: dict = field(default_factory=dict)
+    # "hybrid" (default: prefer local then best remote), "spread",
+    # "node_affinity:<node_id>", "strict_node_affinity:<node_id>"
+    policy: str = "hybrid"
+
+
+def pick_node(
+    req: SchedulingRequest,
+    local_node_id: str,
+    views: Mapping[str, NodeView],
+    rr_counter: int = 0,
+) -> Optional[str]:
+    """Choose a node id for the request, or None if nothing *fits now*.
+
+    Caller distinguishes "no fit now" from "never feasible" via
+    `any_feasible`.
+    """
+    if req.policy.startswith(("node_affinity:", "strict_node_affinity:")):
+        target = req.policy.split(":", 1)[1]
+        view = views.get(target)
+        if (
+            view is not None
+            and view.alive
+            and fits(view.available, req.resources)
+            and labels_match(view.labels, req.label_selector)
+        ):
+            return target
+        if req.policy.startswith("strict"):
+            return None
+        # soft affinity falls through to hybrid
+
+    candidates = [
+        v
+        for v in views.values()
+        if v.alive
+        and labels_match(v.labels, req.label_selector)
+        and fits(v.available, req.resources)
+    ]
+    if not candidates:
+        return None
+    if req.policy == "spread":
+        # Round-robin over feasible nodes to spread load.
+        candidates.sort(key=lambda v: v.node_id)
+        return candidates[rr_counter % len(candidates)].node_id
+    # hybrid: local first, else the node with the most available headroom
+    # (weighted by how much of the demand's primary resource remains).
+    for v in candidates:
+        if v.node_id == local_node_id:
+            return v.node_id
+
+    def headroom(v: NodeView) -> float:
+        return sum(
+            v.available.get(k, 0.0) - dem for k, dem in req.resources.items()
+        ) + sum(v.available.values()) * 1e-3
+
+    return max(candidates, key=headroom).node_id
+
+
+def any_feasible(req: SchedulingRequest, views: Mapping[str, NodeView]) -> bool:
+    return any(
+        v.alive
+        and labels_match(v.labels, req.label_selector)
+        and feasible(v.total, req.resources)
+        for v in views.values()
+    )

@@ -1,0 +1,87 @@
+"""Value serialization: pickle5 with ObjectRef tracking and device-array
+down-conversion.
+
+Two jobs beyond plain pickle (reference parity:
+python/ray/_private/serialization.py):
+
+1. Track contained ObjectRefs during both directions — submitters need the
+   dependency list, deserializers must register borrows.
+2. Never ship device arrays through the object store: jax.Array leaves are
+   converted to numpy on serialize. Device-to-device movement belongs to XLA
+   collectives (the whole point of the TPU-native design); the object store
+   is a host-memory plane.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class _Context(threading.local):
+    def __init__(self):
+        self.collecting: list[ObjectRef] | None = None
+
+
+_ctx = _Context()
+
+
+def _identity(x):
+    return x
+
+
+class _Pickler(pickle.Pickler):
+    def reducer_override(self, obj):
+        if isinstance(obj, ObjectRef):
+            if _ctx.collecting is not None:
+                _ctx.collecting.append(obj)
+            return NotImplemented  # fall through to ObjectRef.__reduce__
+        mod = type(obj).__module__ or ""
+        if mod.partition(".")[0] in ("jaxlib", "jax") and hasattr(
+            obj, "__array__"
+        ):
+            # Device array -> host numpy. Weakly-typed scalars survive fine.
+            return (_identity, (np.asarray(obj),))
+        return NotImplemented
+
+
+def dumps(value: Any) -> tuple[bytes, list[ObjectRef]]:
+    """Serialize; returns (payload, contained_refs)."""
+    buf = io.BytesIO()
+    prev = _ctx.collecting
+    _ctx.collecting = refs = []
+    try:
+        _Pickler(buf, protocol=5).dump(value)
+    finally:
+        _ctx.collecting = prev
+    return buf.getvalue(), refs
+
+
+def loads(data: bytes | memoryview) -> tuple[Any, list[ObjectRef]]:
+    """Deserialize; returns (value, contained_refs).
+
+    Ref collection happens via the ObjectRef deserialization hook, so nested
+    refs anywhere in the value are found.
+    """
+    collected: list[ObjectRef] = []
+    from ray_tpu.core import object_ref as _or
+
+    prev_hook = _or._on_ref_deserialized
+
+    def hook(ref):
+        collected.append(ref)
+        if prev_hook is not None:
+            prev_hook(ref)
+
+    _or._on_ref_deserialized = hook
+    try:
+        value = pickle.loads(data)
+    finally:
+        _or._on_ref_deserialized = prev_hook
+    return value, collected

@@ -1,0 +1,79 @@
+"""Worker process entrypoint (reference parity:
+python/ray/_private/workers/default_worker.py). Spawned by NodeManager;
+registers with the node, then serves task pushes until told to exit or the
+node dies."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-addr", required=True)
+    parser.add_argument("--gcs-addr", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--shm-root", required=True)
+    parser.add_argument("--session-id", required=True)
+    args = parser.parse_args()
+
+    import os
+
+    from ray_tpu.core.core_worker import CoreWorker
+
+    def parse(a: str) -> tuple:
+        host, _, port = a.rpartition(":")
+        return (host, int(port))
+
+    worker = CoreWorker(
+        gcs_addr=parse(args.gcs_addr),
+        node_addr=parse(args.node_addr),
+        kind="worker",
+        worker_id=os.environ.get("RAY_TPU_WORKER_ID"),
+    )
+    worker.start()
+
+    import ray_tpu.core.api as api
+
+    api._attach_existing_worker(worker)
+
+    stop = []
+
+    def on_term(signum, frame):
+        stop.append(1)
+
+    signal.signal(signal.SIGTERM, on_term)
+
+    # Fast exit when the connection to OUR node dies (node crash/shutdown) —
+    # other peers' connections come and go normally.
+    node_conn = worker.endpoint.submit(
+        worker.endpoint.connect(worker.node_addr)
+    ).result(timeout=30)
+    node_conn_lost = []
+
+    def on_lost(conn):
+        if conn is node_conn:
+            node_conn_lost.append(1)
+
+    worker.endpoint.on_connection_lost = on_lost
+    last_probe = time.monotonic()
+    while not stop and not node_conn_lost:
+        time.sleep(0.2)
+        # Belt-and-braces: probe the node periodically too.
+        if time.monotonic() - last_probe >= 2.0:
+            last_probe = time.monotonic()
+            try:
+                worker.endpoint.call(
+                    worker.node_addr, "node.get_info", {}, timeout=10
+                )
+            except Exception:
+                break
+    worker.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

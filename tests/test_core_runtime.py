@@ -1,0 +1,249 @@
+"""Single-node core runtime: tasks, objects, actors.
+
+Mirrors the reference's python/ray/tests/test_basic.py coverage tier.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.errors import ActorDiedError, TaskError
+
+
+@pytest.fixture(scope="module")
+def rt():
+    # Logical CPUs: actors hold theirs for the module's lifetime, so leave
+    # headroom (the box has 1 physical core; these are scheduling tokens).
+    ray_tpu.init(num_cpus=32)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_roundtrip(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_parallel_and_ref_args(rt):
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    refs = [mul.remote(i, 10) for i in range(8)]
+    assert ray_tpu.get(refs) == [i * 10 for i in range(8)]
+    # ObjectRef as argument is resolved before execution.
+    r = mul.remote(mul.remote(2, 3), 4)
+    assert ray_tpu.get(r) == 24
+
+
+def test_put_get_small_and_large(rt):
+    small = {"a": 1, "b": [1, 2, 3]}
+    assert ray_tpu.get(ray_tpu.put(small)) == small
+    big = np.arange(1_000_000, dtype=np.int64)  # 8 MB -> shm path
+    out = ray_tpu.get(ray_tpu.put(big))
+    np.testing.assert_array_equal(out, big)
+
+
+def test_large_task_return(rt):
+    @ray_tpu.remote
+    def make_big():
+        import numpy as np
+
+        return np.ones((512, 1024), dtype=np.float64)  # 4 MB
+
+    out = ray_tpu.get(make_big.remote())
+    assert out.shape == (512, 1024) and out[0, 0] == 1.0
+
+
+def test_task_error_propagates(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_num_returns(rt):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_wait(rt):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(1.5)
+        return "slow"
+
+    s, f = slow.remote(), fast.remote()
+    ready, not_ready = ray_tpu.wait([s, f], num_returns=1, timeout=10)
+    assert ready == [f] and not_ready == [s]
+    ready, not_ready = ray_tpu.wait([s, f], num_returns=2, timeout=10)
+    assert set(ready) == {s, f} and not_ready == []
+
+
+def test_nested_tasks(rt):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rr
+
+        return rr.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_actor_basic_and_state(rt):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.x = start
+
+        def incr(self, n=1):
+            self.x += n
+            return self.x
+
+        def value(self):
+            return self.x
+
+    c = Counter.remote(100)
+    results = ray_tpu.get([c.incr.remote() for _ in range(10)])
+    assert results == list(range(101, 111))  # strict ordering
+    assert ray_tpu.get(c.value.remote()) == 110
+
+
+def test_actor_error(rt):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return 42
+
+    b = Bad.remote()
+    with pytest.raises(TaskError, match="actor method failed"):
+        ray_tpu.get(b.fail.remote())
+    # Actor survives method errors.
+    assert ray_tpu.get(b.ok.remote()) == 42
+
+
+def test_named_actor(rt):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    s = Store.options(name="kv-store").remote()
+    ray_tpu.get(s.set.remote("k", "v"))
+    handle = ray_tpu.get_actor("kv-store")
+    assert ray_tpu.get(handle.get.remote("k")) == "v"
+
+
+def test_async_actor(rt):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.05)
+            return x + 1
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get([a.work.remote(i) for i in range(4)]) == [1, 2, 3, 4]
+
+
+def test_kill_actor(rt):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(v.ping.remote(), timeout=30)
+
+
+def test_actor_restart(rt):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def ping(self):
+            self.calls += 1
+            return self.calls
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.ping.remote()) == 1
+    try:
+        ray_tpu.get(p.die.remote(), timeout=10)
+    except Exception:
+        pass
+    # Restarted with fresh state.
+    deadline = time.time() + 60
+    while True:
+        try:
+            assert ray_tpu.get(p.ping.remote(), timeout=30) == 1
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+def test_actor_handle_passing(rt):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.v = 7
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def reader(handle):
+        import ray_tpu as rr
+
+        return rr.get(handle.get.remote())
+
+    h = Holder.remote()
+    assert ray_tpu.get(reader.remote(h)) == 7
+
+
+def test_runtime_context_and_nodes(rt):
+    ctx = ray_tpu.get_runtime_context().get()
+    assert ctx["worker_id"] and ctx["node_id"]
+    ns = ray_tpu.nodes()
+    assert len(ns) == 1 and ns[0]["Alive"]
+    assert ray_tpu.cluster_resources()["CPU"] == 32.0
