@@ -232,7 +232,7 @@ class RemoteFunction:
         opts = self._opts
         if self._payload is None:
             self._payload = cloudpickle.dumps(self._fn)
-        resources = _resources_from_opts(opts)
+        resources, label_selector, policy, pg = _scheduling_from_opts(opts)
         refs = worker.submit_task(
             self._fn,
             args,
@@ -241,9 +241,10 @@ class RemoteFunction:
             num_returns=opts.get("num_returns", 1),
             resources=resources,
             max_retries=opts.get("max_retries"),
-            label_selector=opts.get("label_selector"),
-            policy=_policy_from_opts(opts),
+            label_selector=label_selector,
+            policy=policy,
             func_payload=self._payload,
+            pg=pg,
         )
         return refs[0] if opts.get("num_returns", 1) == 1 else refs
 
@@ -265,13 +266,15 @@ def _resources_from_opts(opts: dict) -> dict:
     return resources
 
 
-def _policy_from_opts(opts: dict) -> str:
-    strategy = opts.get("scheduling_strategy")
-    if strategy is None:
-        return "hybrid"
-    if isinstance(strategy, str):
-        return strategy
-    return str(strategy)
+def _scheduling_from_opts(opts: dict) -> tuple[dict, dict, str, tuple | None]:
+    """(resources, label_selector, policy, pg_info) after strategy
+    translation — placement-group demands are rewritten onto formatted pg
+    resources; pg_info rides along so executing tasks know their group."""
+    from ray_tpu.util.scheduling_strategies import resolve_strategy
+
+    return resolve_strategy(
+        opts, _resources_from_opts(opts), opts.get("label_selector")
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -354,16 +357,18 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         worker = _require_worker()
         opts = self._opts
+        resources, label_selector, policy, pg = _scheduling_from_opts(opts)
         info = worker.create_actor(
             self._cls,
             args,
             kwargs,
             name=opts.get("name"),
-            resources=_resources_from_opts(opts),
+            resources=resources,
             max_restarts=opts.get("max_restarts", 0),
             max_concurrency=opts.get("max_concurrency", 1),
-            label_selector=opts.get("label_selector"),
-            policy=_policy_from_opts(opts),
+            label_selector=label_selector,
+            policy=policy,
+            pg=pg,
         )
         return ActorHandle(
             info["actor_id"],

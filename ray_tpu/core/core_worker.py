@@ -62,6 +62,7 @@ class TaskSpec:
     retries_left: int = 0
     label_selector: dict = field(default_factory=dict)
     policy: str = "hybrid"
+    pg: tuple | None = None  # (pg_id, capture_child_tasks)
     # actor fields
     actor_id: str | None = None
     method: str | None = None
@@ -115,6 +116,7 @@ class CoreWorker:
         self._executor: concurrent.futures.ThreadPoolExecutor | None = None
         self._actor_instance: Any = None
         self._actor_id: str | None = None
+        self._actor_pg: tuple | None = None
         self._actor_lock: threading.Lock = threading.Lock()
         self._actor_seq: dict[str, int] = {}  # caller -> next expected seq
         self._actor_buffer: dict[tuple, Any] = {}  # (caller, seq) -> pending
@@ -455,8 +457,11 @@ class CoreWorker:
         label_selector: dict | None = None,
         policy: str = "hybrid",
         func_payload: bytes | None = None,
+        pg: tuple | None = None,
     ) -> list[ObjectRef]:
-        resources = dict(resources or {"CPU": 1.0})
+        # NB: an explicitly empty dict means "no resource demand" (e.g.
+        # num_cpus=0 probes) — only None gets the 1-CPU default.
+        resources = dict(resources) if resources is not None else {"CPU": 1.0}
         if max_retries is None:
             max_retries = GLOBAL_CONFIG.default_max_retries
         task_id = TaskID.random().hex()
@@ -474,6 +479,7 @@ class CoreWorker:
             retries_left=max_retries,
             label_selector=dict(label_selector or {}),
             policy=policy,
+            pg=pg,
         )
         refs = [
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, name)
@@ -588,6 +594,7 @@ class CoreWorker:
             "kwargs": spec.kwargs,
             "return_ids": spec.return_ids,
             "owner_addr": tuple(self.endpoint.address),
+            "pg": spec.pg,
         }
         try:
             reply = await self.endpoint.acall(
@@ -650,6 +657,7 @@ class CoreWorker:
         max_concurrency: int = 1,
         label_selector: dict | None = None,
         policy: str = "hybrid",
+        pg: tuple | None = None,
     ) -> dict:
         actor_id = ActorID.random().hex()
         spec = {
@@ -657,12 +665,13 @@ class CoreWorker:
             "name": name,
             "class_payload": cloudpickle.dumps(cls),
             "args_payload": serialization.dumps((args, kwargs))[0],
-            "resources": dict(resources or {"CPU": 1.0}),
+            "resources": dict(resources) if resources is not None else {"CPU": 1.0},
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,
             "label_selector": dict(label_selector or {}),
             "policy": policy,
             "class_name": getattr(cls, "__name__", "Actor"),
+            "pg": pg,
         }
         info = self.gcs.call("create_actor", {"spec": spec}, timeout=120)
         return info
@@ -728,6 +737,7 @@ class CoreWorker:
 
         self._actor_instance = await loop.run_in_executor(self._executor, make)
         self._actor_id = p["actor_id"]
+        self._actor_pg = tuple(spec["pg"]) if spec.get("pg") else None
         return True
 
     async def _h_worker_push_task(self, conn, p):
@@ -736,16 +746,21 @@ class CoreWorker:
         return await self._execute_task(p)
 
     async def _execute_task(self, p) -> dict:
+        from ray_tpu.util.placement_group import _bind_ambient_pg
+
         func = cloudpickle.loads(p["func"])
         args, kwargs = await self._resolve_args(p)
         loop = asyncio.get_running_loop()
+        pginfo = p.get("pg")
 
         def run():
-            return func(*args, **kwargs)
+            with _bind_ambient_pg(pginfo):
+                return func(*args, **kwargs)
 
         try:
             if asyncio.iscoroutinefunction(func):
-                result = await func(*args, **kwargs)
+                with _bind_ambient_pg(pginfo):
+                    result = await func(*args, **kwargs)
             else:
                 result = await loop.run_in_executor(self._executor, run)
             results = self._encode_results(p, result)
@@ -763,16 +778,25 @@ class CoreWorker:
             self._actor_buffer[(caller, seq)] = ev
             await ev.wait()
         try:
+            from ray_tpu.util.placement_group import _bind_ambient_pg
+
             instance = self._actor_instance
             method = getattr(instance, p["method"])
             args, kwargs = await self._resolve_args(p)
             loop = asyncio.get_running_loop()
+            pginfo = self._actor_pg
+
+            def run_method():
+                with _bind_ambient_pg(pginfo):
+                    return method(*args, **kwargs)
+
             try:
                 if asyncio.iscoroutinefunction(method):
-                    result = await method(*args, **kwargs)
+                    with _bind_ambient_pg(pginfo):
+                        result = await method(*args, **kwargs)
                 else:
                     result = await loop.run_in_executor(
-                        self._executor, lambda: method(*args, **kwargs)
+                        self._executor, run_method
                     )
                 results = self._encode_results(p, result)
                 await self._flush_created(results)

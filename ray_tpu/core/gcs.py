@@ -30,6 +30,28 @@ PENDING = "PENDING"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
 
+# placement group states
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_RESCHEDULING = "RESCHEDULING"
+PG_REMOVED = "REMOVED"
+
+
+@dataclass
+class PgRecord:
+    """One placement group (reference: gcs_placement_group_manager.h)."""
+
+    pg_id: str
+    name: str | None
+    bundles: list  # list of resource dicts
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    label_selectors: list  # per-bundle label selectors ([] = none)
+    state: str = PG_PENDING
+    bundle_nodes: list = field(default_factory=list)  # node_id | None per bundle
+    error: str | None = None
+    waiters: list = field(default_factory=list)
+    scheduling: bool = False  # a _schedule_pg pass is in flight
+
 
 @dataclass
 class ActorRecord:
@@ -57,6 +79,10 @@ class GcsServer:
         self.actors: dict[str, ActorRecord] = {}
         self.named_actors: dict[str, str] = {}
         self.pending_actors: list[str] = []
+        self.pgs: dict[str, PgRecord] = {}
+        self.named_pgs: dict[str, str] = {}
+        self.pending_pgs: list[str] = []
+        self.pg_release_retries: list[tuple] = []  # (node_id, pg_id)
         self.subs: dict[str, list[Connection]] = {}
         self.internal_config: str = GLOBAL_CONFIG.to_json()
         self._health_task = None
@@ -134,6 +160,7 @@ class GcsServer:
         self.node_last_seen[p["node_id"]] = time.monotonic()
         await self._publish("nodes", {"node_id": p["node_id"], "state": ALIVE})
         await self._retry_pending_actors()
+        await self._retry_pending_pgs()
         return {"session_id": self.session_id, "config": self.internal_config}
 
     async def _h_node_heartbeat(self, conn, p):
@@ -141,9 +168,12 @@ class GcsServer:
         if view is None:
             return False
         view.available = dict(p["available"])
+        if "total" in p:
+            view.total = dict(p["total"])
         self.node_last_seen[p["node_id"]] = time.monotonic()
         if p.get("resources_freed"):
             await self._retry_pending_actors()
+            await self._retry_pending_pgs()
         return True
 
     async def _h_get_cluster_view(self, conn, p):
@@ -174,6 +204,25 @@ class GcsServer:
                 last = self.node_last_seen.get(nid, 0)
                 if now - last > cfg.node_death_timeout_s:
                     await self._mark_node_dead(nid, "heartbeat timeout")
+            # Drain work parked by transient failures: pending actors/groups
+            # (a failed RPC must not strand them until the next node event)
+            # and bundle releases whose return_pg RPC failed.
+            await self._retry_pending_actors()
+            await self._retry_pending_pgs()
+            await self._retry_pg_releases()
+
+    async def _retry_pg_releases(self):
+        retries, self.pg_release_retries = self.pg_release_retries, []
+        for nid, pg_id in retries:
+            view = self.nodes.get(nid)
+            if view is None or not view.alive:
+                continue  # node death resets its resources anyway
+            try:
+                await self.endpoint.acall(
+                    view.addr, "node.return_pg", {"pg_id": pg_id}
+                )
+            except Exception:
+                self.pg_release_retries.append((nid, pg_id))
 
     async def _mark_node_dead(self, node_id: str, reason: str):
         view = self.nodes.get(node_id)
@@ -188,6 +237,16 @@ class GcsServer:
         for rec in list(self.actors.values()):
             if rec.node_id == node_id and rec.state in (ALIVE, PENDING):
                 await self._on_actor_failure(rec, f"node {node_id} died")
+        # Reschedule placement-group bundles that were committed there.
+        for pg in list(self.pgs.values()):
+            if pg.state == PG_REMOVED or node_id not in pg.bundle_nodes:
+                continue
+            for i, nid in enumerate(pg.bundle_nodes):
+                if nid == node_id:
+                    pg.bundle_nodes[i] = None
+            pg.state = PG_RESCHEDULING
+            await self._publish("placement_groups", self._pg_info(pg))
+            await self._schedule_pg(pg)
 
     # -- actors --------------------------------------------------------------
 
@@ -345,7 +404,313 @@ class GcsServer:
             return self.actors.get(actor_id) if actor_id else None
         return None
 
-    def _wake(self, rec: ActorRecord):
+    # -- placement groups ----------------------------------------------------
+    # 2-phase prepare/commit of bundles onto nodes (reference:
+    # gcs_placement_group_scheduler.h:281 / CommitAllBundles :425).
+
+    async def _h_create_placement_group(self, conn, p):
+        spec = p["spec"]
+        rec = PgRecord(
+            pg_id=spec["pg_id"],
+            name=spec.get("name"),
+            bundles=[dict(b) for b in spec["bundles"]],
+            strategy=spec.get("strategy", "PACK"),
+            label_selectors=list(spec.get("label_selectors") or []),
+            bundle_nodes=[None] * len(spec["bundles"]),
+        )
+        if rec.name:
+            if rec.name in self.named_pgs:
+                raise ValueError(f"placement group name {rec.name!r} taken")
+            self.named_pgs[rec.name] = rec.pg_id
+        self.pgs[rec.pg_id] = rec
+        await self._schedule_pg(rec)
+        return self._pg_info(rec)
+
+    def _bundle_selector(self, rec: PgRecord, index: int) -> dict:
+        if index < len(rec.label_selectors):
+            return rec.label_selectors[index] or {}
+        return {}
+
+    def _place_bundles(self, rec: PgRecord, idxs: list) -> Optional[dict]:
+        """Choose a node for each unplaced bundle index, honoring the
+        strategy, against a working copy of current availabilities. Returns
+        {index: node_id} or None if no placement exists right now."""
+        from ray_tpu.core.scheduler import fits, labels_match, subtract
+
+        avail = {
+            nid: dict(v.available)
+            for nid, v in self.nodes.items()
+            if v.alive
+        }
+        if not avail:
+            return None
+        used_nodes = {n for n in rec.bundle_nodes if n is not None}
+        placement: dict = {}
+
+        def candidates(index):
+            sel = self._bundle_selector(rec, index)
+            res = rec.bundles[index]
+            return [
+                nid
+                for nid, a in avail.items()
+                if labels_match(self.nodes[nid].labels, sel)
+                and fits(a, res)
+            ]
+
+        if rec.strategy == "STRICT_PACK":
+            pool = used_nodes or set(avail)
+            for nid in sorted(pool):
+                trial = dict(avail.get(nid, {}))
+                ok = True
+                for i in idxs:
+                    sel = self._bundle_selector(rec, i)
+                    if not labels_match(self.nodes[nid].labels, sel):
+                        ok = False
+                        break
+                    if not fits(trial, rec.bundles[i]):
+                        ok = False
+                        break
+                    subtract(trial, rec.bundles[i])
+                if ok:
+                    return {i: nid for i in idxs}
+            return None
+
+        for i in idxs:
+            cands = candidates(i)
+            if not cands:
+                return None
+            if rec.strategy == "STRICT_SPREAD":
+                cands = [
+                    c
+                    for c in cands
+                    if c not in used_nodes and c not in placement.values()
+                ]
+                if not cands:
+                    return None
+                choice = sorted(cands)[0]
+            elif rec.strategy == "SPREAD":
+                fresh = [
+                    c
+                    for c in cands
+                    if c not in used_nodes and c not in placement.values()
+                ]
+                choice = sorted(fresh or cands)[0]
+            else:  # PACK: prefer nodes already holding bundles of this group
+                packed = [
+                    c
+                    for c in cands
+                    if c in used_nodes or c in placement.values()
+                ]
+                choice = sorted(packed or cands)[0]
+            placement[i] = choice
+            subtract(avail[choice], rec.bundles[i])
+        return placement
+
+    async def _schedule_pg(self, rec: PgRecord) -> None:
+        # One scheduling pass at a time per group; concurrent triggers
+        # (pending retry, node death) re-queue instead of racing the 2PC.
+        if rec.scheduling:
+            if rec.pg_id not in self.pending_pgs:
+                self.pending_pgs.append(rec.pg_id)
+            return
+        rec.scheduling = True
+        try:
+            await self._schedule_pg_once(rec)
+        finally:
+            rec.scheduling = False
+
+    async def _schedule_pg_once(self, rec: PgRecord) -> None:
+        if rec.state == PG_REMOVED:
+            return
+        idxs = [i for i, n in enumerate(rec.bundle_nodes) if n is None]
+        if not idxs:
+            rec.state = PG_CREATED
+            self._wake(rec)
+            return
+        placement = self._place_bundles(rec, idxs)
+        if placement is None:
+            if rec.pg_id not in self.pending_pgs:
+                self.pending_pgs.append(rec.pg_id)
+            return
+        by_node: dict[str, list] = {}
+        for i, nid in placement.items():
+            by_node.setdefault(nid, []).append(i)
+        # Phase 1: prepare (reserve) on every node, all-or-nothing. A node
+        # whose prepare RPC *failed* may still have applied it (lost reply),
+        # so it gets a cancel too — cancel_bundles is idempotent.
+        attempted: list[str] = []
+        ok = True
+        for nid, items in by_node.items():
+            attempted.append(nid)
+            try:
+                r = await self.endpoint.acall(
+                    self.nodes[nid].addr,
+                    "node.prepare_bundles",
+                    {
+                        "pg_id": rec.pg_id,
+                        "bundles": [
+                            {"index": i, "resources": rec.bundles[i]}
+                            for i in items
+                        ],
+                    },
+                )
+            except Exception:
+                r = False
+            if not r:
+                ok = False
+                break
+        if ok and rec.state == PG_REMOVED:
+            ok = False  # removed while we were preparing — roll back
+        if not ok:
+            for nid in attempted:
+                view = self.nodes.get(nid)
+                if view is None or not view.alive:
+                    continue
+                try:
+                    await self.endpoint.acall(
+                        view.addr,
+                        "node.cancel_bundles",
+                        {"pg_id": rec.pg_id},
+                    )
+                except Exception:
+                    pass
+            if rec.state != PG_REMOVED and rec.pg_id not in self.pending_pgs:
+                self.pending_pgs.append(rec.pg_id)
+            return
+        # Phase 2: commit. On a failed commit RPC the node may or may not
+        # have applied it (lost reply) — send return_pg so either outcome
+        # converges to "released"; node death converges via the death path.
+        from ray_tpu.util.placement_group import formatted_bundle_resources
+
+        for nid, items in by_node.items():
+            try:
+                await self.endpoint.acall(
+                    self.nodes[nid].addr,
+                    "node.commit_bundles",
+                    {"pg_id": rec.pg_id, "indexes": items},
+                )
+            except Exception:
+                view = self.nodes.get(nid)
+                if view is not None and view.alive:
+                    try:
+                        await self.endpoint.acall(
+                            view.addr,
+                            "node.return_pg",
+                            {"pg_id": rec.pg_id},
+                        )
+                    except Exception:
+                        pass
+                continue
+            view = self.nodes.get(nid)
+            for i in items:
+                rec.bundle_nodes[i] = nid
+                if view is not None:
+                    fmt = formatted_bundle_resources(
+                        rec.bundles[i], rec.pg_id, i
+                    )
+                    for k, v in fmt.items():
+                        view.total[k] = view.total.get(k, 0.0) + v
+                        view.available[k] = view.available.get(k, 0.0) + v
+        if rec.state == PG_REMOVED:
+            # Removed mid-commit: release everything we just placed.
+            await self._release_pg_bundles(rec)
+            return
+        if all(n is not None for n in rec.bundle_nodes):
+            rec.state = PG_CREATED
+            self._wake(rec)
+        elif rec.pg_id not in self.pending_pgs:
+            self.pending_pgs.append(rec.pg_id)
+        await self._publish("placement_groups", self._pg_info(rec))
+
+    async def _retry_pending_pgs(self):
+        pending, self.pending_pgs = self.pending_pgs, []
+        for pg_id in pending:
+            rec = self.pgs.get(pg_id)
+            if rec is not None and rec.state in (PG_PENDING, PG_RESCHEDULING):
+                await self._schedule_pg(rec)
+
+    async def _release_pg_bundles(self, rec: PgRecord) -> None:
+        from ray_tpu.util.placement_group import formatted_bundle_resources
+
+        for nid in {n for n in rec.bundle_nodes if n is not None}:
+            view = self.nodes.get(nid)
+            if view is None or not view.alive:
+                continue
+            try:
+                await self.endpoint.acall(
+                    view.addr, "node.return_pg", {"pg_id": rec.pg_id}
+                )
+            except Exception:
+                # Transient failure talking to a live node: park the release
+                # for the health loop so the bundle is not leaked.
+                self.pg_release_retries.append((nid, rec.pg_id))
+                continue
+            for i, bn in enumerate(rec.bundle_nodes):
+                if bn != nid:
+                    continue
+                fmt = formatted_bundle_resources(rec.bundles[i], rec.pg_id, i)
+                for k in fmt:
+                    view.total.pop(k, None)
+                    view.available.pop(k, None)
+        rec.bundle_nodes = [None] * len(rec.bundles)
+
+    async def _h_remove_placement_group(self, conn, p):
+        rec = self.pgs.get(p["pg_id"])
+        if rec is None or rec.state == PG_REMOVED:
+            return False
+        rec.state = PG_REMOVED
+        if rec.name:
+            self.named_pgs.pop(rec.name, None)
+        if rec.pg_id in self.pending_pgs:
+            self.pending_pgs.remove(rec.pg_id)
+        await self._release_pg_bundles(rec)
+        self._wake(rec)
+        await self._publish("placement_groups", self._pg_info(rec))
+        return True
+
+    async def _h_get_placement_group(self, conn, p):
+        rec = None
+        if p.get("pg_id"):
+            rec = self.pgs.get(p["pg_id"])
+        elif p.get("name"):
+            pg_id = self.named_pgs.get(p["name"])
+            rec = self.pgs.get(pg_id) if pg_id else None
+        return self._pg_info(rec) if rec else None
+
+    async def _h_list_placement_groups(self, conn, p):
+        return [self._pg_info(r) for r in self.pgs.values()]
+
+    async def _h_wait_pg_ready(self, conn, p):
+        rec = self.pgs.get(p["pg_id"])
+        if rec is None:
+            raise ValueError(f"no such placement group {p['pg_id']}")
+        deadline = time.monotonic() + p.get("timeout", 60.0)
+        while rec.state not in (PG_CREATED, PG_REMOVED):
+            ev = asyncio.Event()
+            rec.waiters.append(ev)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"pg {rec.pg_id} not ready in time")
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                raise TimeoutError(f"pg {rec.pg_id} not ready in time")
+        if rec.state == PG_REMOVED:
+            raise SchedulingError(f"placement group {rec.pg_id} was removed")
+        return self._pg_info(rec)
+
+    def _pg_info(self, rec: PgRecord) -> dict:
+        return {
+            "pg_id": rec.pg_id,
+            "name": rec.name,
+            "state": rec.state,
+            "strategy": rec.strategy,
+            "bundles": rec.bundles,
+            "bundle_nodes": rec.bundle_nodes,
+            "error": rec.error,
+        }
+
+    def _wake(self, rec):
         for ev in rec.waiters:
             ev.set()
         rec.waiters.clear()

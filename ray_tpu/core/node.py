@@ -42,6 +42,15 @@ ACTOR = "actor"
 STARTING = "starting"
 
 
+def _pg_of_demand(resources: dict) -> str | None:
+    """If the demand targets placement-group formatted resources, the pg id
+    (the last ``_``-separated token of a ``bundle_group*`` key)."""
+    for k in resources:
+        if k.startswith("bundle_group_"):
+            return k.rsplit("_", 1)[-1]
+    return None
+
+
 @dataclass
 class WorkerInfo:
     worker_id: str
@@ -57,6 +66,7 @@ class Lease:
     lease_id: str
     worker_id: str
     resources: dict
+    pg_id: str | None = None
 
 
 class NodeManager:
@@ -85,6 +95,10 @@ class NodeManager:
         self.workers: dict[str, WorkerInfo] = {}
         self.idle_workers: list[str] = []
         self.leases: dict[str, Lease] = {}
+        # placement-group bundles: (pg_id, index) -> original resources
+        self.bundle_reservations: dict[tuple, dict] = {}
+        self.committed_bundles: dict[tuple, dict] = {}
+        self._pg_state_cache: dict[str, tuple] = {}  # pg_id -> (ts, pending)
         self.cluster_view: dict[str, NodeView] = {}
         self.view_meta: dict[str, dict] = {}
         self._pending_leases: list = []  # (req, future, deadline)
@@ -158,33 +172,37 @@ class NodeManager:
                     {
                         "node_id": self.node_id,
                         "available": self.available,
+                        "total": self.total,
                         "resources_freed": freed,
                     },
                 )
             except Exception:
                 pass
-            try:
-                view = await self.endpoint.acall(
-                    self.gcs_addr, "gcs.get_cluster_view", {}
-                )
-                self.cluster_view = {
-                    nid: NodeView(
-                        node_id=nid,
-                        addr=tuple(v["addr"]),
-                        total=v["total"],
-                        available=v["available"],
-                        labels=v["labels"],
-                        alive=v["alive"],
-                    )
-                    for nid, v in view.items()
-                }
-                self.view_meta = {
-                    nid: {"shm_root": v.get("shm_root")}
-                    for nid, v in view.items()
-                }
-            except Exception:
-                pass
+            await self._refresh_cluster_view()
             await asyncio.sleep(GLOBAL_CONFIG.resource_report_interval_s)
+
+    async def _refresh_cluster_view(self):
+        try:
+            view = await self.endpoint.acall(
+                self.gcs_addr, "gcs.get_cluster_view", {}
+            )
+            self.cluster_view = {
+                nid: NodeView(
+                    node_id=nid,
+                    addr=tuple(v["addr"]),
+                    total=v["total"],
+                    available=v["available"],
+                    labels=v["labels"],
+                    alive=v["alive"],
+                )
+                for nid, v in view.items()
+            }
+            self.view_meta = {
+                nid: {"shm_root": v.get("shm_root")}
+                for nid, v in view.items()
+            }
+        except Exception:
+            pass
 
     async def _worker_monitor_loop(self):
         while not self._stopping:
@@ -314,6 +332,20 @@ class NodeManager:
 
     async def _lease_or_spill(self, req: SchedulingRequest, deadline: float):
         local_ok = labels_match(self.labels, req.label_selector)
+        if req.policy.startswith(("node_affinity:", "strict_node_affinity:")):
+            target = req.policy.split(":", 1)[1]
+            if target != self.node_id:
+                view = self.cluster_view.get(target)
+                if view is None:
+                    await self._refresh_cluster_view()
+                    view = self.cluster_view.get(target)
+                if view is not None and view.alive:
+                    return {"spill": tuple(view.addr)}
+                if req.policy.startswith("strict"):
+                    raise SchedulingError(
+                        f"node {target} for strict affinity is gone"
+                    )
+                # soft affinity: target gone — fall through to hybrid
         if req.policy == "spread":
             # Round-robin over all feasible nodes (including us).
             self._spread_rr += 1
@@ -325,12 +357,9 @@ class NodeManager:
         if local_ok and fits(self.available, req.resources):
             return await self._grant(req)
         # Not local: consult cluster view for a node that fits now.
-        views = dict(self.cluster_view)
-        views.pop(self.node_id, None)
-        self._spread_rr += 1
-        choice = pick_node(req, "", views, self._spread_rr)
-        if choice is not None:
-            return {"spill": tuple(self.cluster_view[choice].addr)}
+        spill = self._try_spill(req)
+        if spill is not None:
+            return spill
         # Feasible here eventually? queue. Feasible anywhere? tell caller to
         # retry later; else hard error.
         if local_ok and fits(self.total, req.resources):
@@ -344,12 +373,73 @@ class NodeManager:
                 raise SchedulingError(
                     f"lease timed out waiting for {req.resources}"
                 )
+        # Strict affinity never falls back: if the target node can never fit
+        # the demand, fail fast instead of spinning on retry_after.
+        if req.policy.startswith("strict_node_affinity:"):
+            target = req.policy.split(":", 1)[1]
+            view = self.cluster_view.get(target)
+            if target == self.node_id:
+                view = NodeView(self.node_id, (), self.total, {}, self.labels)
+            if (
+                view is None
+                or not view.alive
+                or not fits(view.total, req.resources)
+                or not labels_match(view.labels, req.label_selector)
+            ):
+                raise SchedulingError(
+                    f"strict affinity node {target} cannot ever fit "
+                    f"{req.resources}"
+                )
+            return {"retry_after": 0.2}
         if any_feasible(req, self.cluster_view):
+            return {"retry_after": 0.2}
+        # The gossiped view may be stale (e.g. a placement-group bundle was
+        # committed on a peer since our last heartbeat) — refresh once from
+        # the GCS before declaring the request infeasible.
+        await self._refresh_cluster_view()
+        spill = self._try_spill(req)
+        if spill is not None:
+            return spill
+        if any_feasible(req, self.cluster_view):
+            return {"retry_after": 0.2}
+        # A demand targeting a placement group that exists but is not yet
+        # CREATED stays pending (the reference queues such leases until the
+        # bundles commit) rather than failing hard. The verdict is cached
+        # briefly so a gang of pending tasks doesn't hammer the GCS.
+        pg_id = _pg_of_demand(req.resources)
+        if pg_id is not None and await self._pg_is_pending(pg_id):
             return {"retry_after": 0.2}
         raise SchedulingError(
             f"no feasible node: resources={req.resources} "
             f"selector={req.label_selector}"
         )
+
+    async def _pg_is_pending(self, pg_id: str) -> bool:
+        """True if the placement group exists and is not REMOVED (cached for
+        one report interval)."""
+        now = time.monotonic()
+        cached = self._pg_state_cache.get(pg_id)
+        if cached is not None and now - cached[0] < 1.0:
+            return cached[1]
+        try:
+            info = await self.endpoint.acall(
+                self.gcs_addr, "gcs.get_placement_group", {"pg_id": pg_id}
+            )
+        except Exception:
+            info = None
+        verdict = info is not None and info["state"] != "REMOVED"
+        self._pg_state_cache[pg_id] = (now, verdict)
+        return verdict
+
+    def _try_spill(self, req: SchedulingRequest) -> dict | None:
+        """Pick a peer that fits the request now, or None."""
+        views = dict(self.cluster_view)
+        views.pop(self.node_id, None)
+        self._spread_rr += 1
+        choice = pick_node(req, "", views, self._spread_rr)
+        if choice is not None:
+            return {"spill": tuple(self.cluster_view[choice].addr)}
+        return None
 
     async def _grant(self, req: SchedulingRequest):
         subtract(self.available, req.resources)
@@ -359,7 +449,12 @@ class NodeManager:
             add(self.available, req.resources)
             raise
         info.state = LEASED
-        lease = Lease(WorkerID.random().hex(), info.worker_id, req.resources)
+        lease = Lease(
+            WorkerID.random().hex(),
+            info.worker_id,
+            req.resources,
+            pg_id=_pg_of_demand(req.resources),
+        )
         self.leases[lease.lease_id] = lease
         return {
             "lease_id": lease.lease_id,
@@ -397,6 +492,81 @@ class NodeManager:
             else:
                 still.append((req, fut, deadline))
         self._pending_leases = still
+
+    # -- placement-group bundles ---------------------------------------------
+    # Node side of the GCS 2PC (reference:
+    # src/ray/raylet/placement_group_resource_manager.h): prepare reserves
+    # the original resources; commit converts the reservation into formatted
+    # pg resources added to this node's total/available.
+
+    async def _h_prepare_bundles(self, conn, p):
+        pg_id = p["pg_id"]
+        taken = []
+        for b in p["bundles"]:
+            if fits(self.available, b["resources"]):
+                subtract(self.available, b["resources"])
+                taken.append(b)
+            else:
+                for t in taken:
+                    add(self.available, t["resources"])
+                return False
+        for b in taken:
+            self.bundle_reservations[(pg_id, b["index"])] = dict(
+                b["resources"]
+            )
+        return True
+
+    async def _h_cancel_bundles(self, conn, p):
+        pg_id = p["pg_id"]
+        for key in [k for k in self.bundle_reservations if k[0] == pg_id]:
+            add(self.available, self.bundle_reservations.pop(key))
+        self._resources_freed = True
+        await self._drain_pending()
+        return True
+
+    async def _h_commit_bundles(self, conn, p):
+        from ray_tpu.util.placement_group import formatted_bundle_resources
+
+        pg_id = p["pg_id"]
+        for idx in p["indexes"]:
+            res = self.bundle_reservations.pop((pg_id, idx), None)
+            if res is None:
+                continue
+            self.committed_bundles[(pg_id, idx)] = res
+            fmt = formatted_bundle_resources(res, pg_id, idx)
+            for k, v in fmt.items():
+                self.total[k] = self.total.get(k, 0.0) + v
+                self.available[k] = self.available.get(k, 0.0) + v
+        self._resources_freed = True
+        await self._drain_pending()
+        return True
+
+    async def _h_return_pg(self, conn, p):
+        """Release every bundle of a placement group hosted here."""
+        from ray_tpu.util.placement_group import formatted_bundle_resources
+
+        pg_id = p["pg_id"]
+        for key in [k for k in self.bundle_reservations if k[0] == pg_id]:
+            add(self.available, self.bundle_reservations.pop(key))
+        # Kill workers leased against this group's formatted resources
+        # (reference semantics: removing a PG kills its tasks/actors).
+        for lid, lease in list(self.leases.items()):
+            if lease.pg_id == pg_id:
+                del self.leases[lid]
+                info = self.workers.get(lease.worker_id)
+                if info is not None and info.proc is not None:
+                    if info.proc.poll() is None:
+                        info.proc.kill()
+        for key in [k for k in self.committed_bundles if k[0] == pg_id]:
+            res = self.committed_bundles.pop(key)
+            fmt = formatted_bundle_resources(res, pg_id, key[1])
+            for k in fmt:
+                self.total.pop(k, None)
+                self.available.pop(k, None)
+            add(self.available, res)
+        self._resources_freed = True
+        await self._drain_pending()
+        return True
 
     # -- actors --------------------------------------------------------------
 

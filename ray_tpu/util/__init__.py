@@ -1,0 +1,30 @@
+"""ray_tpu.util — distributed ML primitives and ecosystem utilities.
+
+Reference parity: python/ray/util/ (placement groups, scheduling strategies,
+collective library, actor pool). Submodules import lazily so the pure-compute
+tier stays importable without the cluster runtime.
+"""
+
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    get_current_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroup",
+    "placement_group",
+    "remove_placement_group",
+    "placement_group_table",
+    "get_current_placement_group",
+    "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+]

@@ -1,0 +1,196 @@
+"""Placement groups: strategies, 2PC reservation, task/actor placement in
+bundles, removal, rescheduling (reference: python/ray/tests/
+test_placement_group*.py families)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=4, resources={"head_mark": 1.0})
+    node2 = runtime.add_node({"CPU": 4.0, "accel": 4.0}, labels={"zone": "b"})
+    node3 = runtime.add_node({"CPU": 4.0}, labels={"zone": "c"})
+    time.sleep(1.0)
+    yield runtime, node2, node3
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def where():
+    import ray_tpu as rr
+
+    return rr.get_runtime_context().node_id
+
+
+def test_pack_pg_create_and_place(cluster):
+    runtime, node2, node3 = cluster
+    pg = placement_group([{"CPU": 2}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+    info = placement_group_table(pg)
+    assert info["state"] == "CREATED"
+    # PACK puts both bundles on one node when possible.
+    assert len(set(info["bundle_nodes"])) == 1
+
+    nid = ray_tpu.get(
+        where.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=0
+            ),
+            num_cpus=1,
+        ).remote()
+    )
+    assert nid == info["bundle_nodes"][0]
+    remove_placement_group(pg)
+
+
+def test_strict_spread_distinct_nodes(cluster):
+    runtime, node2, node3 = cluster
+    pg = placement_group(
+        [{"CPU": 1}, {"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD"
+    )
+    assert pg.wait(30)
+    nodes = placement_group_table(pg)["bundle_nodes"]
+    assert len(set(nodes)) == 3
+    remove_placement_group(pg)
+
+
+def test_strict_pack_one_node(cluster):
+    runtime, node2, node3 = cluster
+    pg = placement_group(
+        [{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK"
+    )
+    assert pg.wait(30)
+    nodes = placement_group_table(pg)["bundle_nodes"]
+    assert len(set(nodes)) == 1
+    remove_placement_group(pg)
+
+
+def test_bundle_label_selector(cluster):
+    runtime, node2, node3 = cluster
+    pg = placement_group(
+        [{"CPU": 1}],
+        strategy="PACK",
+        bundle_label_selector=[{"zone": "c"}],
+    )
+    assert pg.wait(30)
+    assert placement_group_table(pg)["bundle_nodes"] == [node3.node_id]
+    remove_placement_group(pg)
+
+
+def test_wildcard_bundle_placement(cluster):
+    runtime, node2, node3 = cluster
+    pg = placement_group([{"CPU": 1, "accel": 2}], strategy="PACK")
+    assert pg.wait(30)
+    # Wildcard (-1) bundle index: any bundle of the group.
+    nid = ray_tpu.get(
+        where.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg),
+            num_cpus=1,
+        ).remote()
+    )
+    assert nid == node2.node_id  # only node2 has accel
+    remove_placement_group(pg)
+
+
+def test_actor_in_pg(cluster):
+    runtime, node2, node3 = cluster
+    pg = placement_group([{"CPU": 1}], bundle_label_selector=[{"zone": "b"}])
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    class A:
+        def node(self):
+            import ray_tpu as rr
+
+            return rr.get_runtime_context().node_id
+
+    a = A.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        ),
+        num_cpus=1,
+    ).remote()
+    assert ray_tpu.get(a.node.remote()) == node2.node_id
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_pg_pending_until_resources_free(cluster):
+    runtime, node2, node3 = cluster
+    # Grab all of node3's CPUs, then ask for a bundle needing 4 on zone c.
+    pg1 = placement_group([{"CPU": 4}], bundle_label_selector=[{"zone": "c"}])
+    assert pg1.wait(30)
+    pg2 = placement_group([{"CPU": 4}], bundle_label_selector=[{"zone": "c"}])
+    assert not pg2.wait(1.5)
+    assert placement_group_table(pg2)["state"] == "PENDING"
+    remove_placement_group(pg1)
+    assert pg2.wait(30)
+    remove_placement_group(pg2)
+
+
+def test_pg_ready_objectref(cluster):
+    runtime, node2, node3 = cluster
+    pg = placement_group([{"CPU": 1}])
+    assert ray_tpu.get(pg.ready(), timeout=30) is True
+    remove_placement_group(pg)
+
+
+def test_remove_pg_frees_resources(cluster):
+    runtime, node2, node3 = cluster
+    before = ray_tpu.cluster_resources().get("CPU", 0)
+    pg = placement_group([{"CPU": 2}])
+    assert pg.wait(30)
+    remove_placement_group(pg)
+    time.sleep(1.0)
+    after = ray_tpu.cluster_resources().get("CPU", 0)
+    assert after == before
+
+
+def test_capture_child_tasks(cluster):
+    runtime, node2, node3 = cluster
+    pg = placement_group([{"CPU": 2}], bundle_label_selector=[{"zone": "b"}])
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    def parent():
+        from ray_tpu.util.placement_group import get_current_placement_group
+
+        cur = get_current_placement_group()
+        child_nid = ray_tpu.get(where.options(num_cpus=1).remote())
+        return cur.id if cur else None, child_nid
+
+    cur_id, child_nid = ray_tpu.get(
+        parent.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_capture_child_tasks=True,
+            ),
+            num_cpus=1,
+        ).remote()
+    )
+    assert cur_id == pg.id
+    assert child_nid == node2.node_id  # child captured into the group
+    remove_placement_group(pg)
+
+
+def test_node_affinity_strategy(cluster):
+    runtime, node2, node3 = cluster
+    nid = ray_tpu.get(
+        where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node3.node_id, soft=False
+            )
+        ).remote()
+    )
+    assert nid == node3.node_id
