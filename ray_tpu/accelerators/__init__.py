@@ -1,0 +1,59 @@
+"""Accelerator managers (reference: python/ray/_private/accelerators/).
+
+TPU is the primary family; the registry exists so node bootstrap has one
+entry point (`detect_node_accelerators`) that fills resources + labels.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.accelerators.accelerator import (
+    AcceleratorManager,
+    CPUAcceleratorManager,
+)
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+_MANAGERS = {
+    "TPU": TPUAcceleratorManager,
+    "CPU": CPUAcceleratorManager,
+}
+
+__all__ = [
+    "AcceleratorManager",
+    "CPUAcceleratorManager",
+    "TPUAcceleratorManager",
+    "get_accelerator_manager",
+    "detect_node_accelerators",
+]
+
+
+def get_accelerator_manager(resource_name: str) -> type:
+    try:
+        return _MANAGERS[resource_name]
+    except KeyError:
+        raise ValueError(
+            f"no accelerator manager for {resource_name!r}"
+        ) from None
+
+
+def detect_node_accelerators() -> tuple:
+    """(resources, labels) the current node should advertise, from
+    autodetection. Empty dicts off-accelerator. This is the node-bootstrap
+    hook (reference: resource_and_label_spec.py calling AcceleratorManagers).
+    """
+    resources: dict = {}
+    labels: dict = {}
+    mgr = TPUAcceleratorManager
+    num = mgr.get_current_node_num_accelerators()
+    visible = mgr.get_current_process_visible_accelerator_ids()
+    if visible is not None:
+        num = min(num, len(visible)) if num else len(visible)
+    if num:
+        resources[mgr.get_resource_name()] = float(num)
+        extra = mgr.get_current_node_additional_resources()
+        if extra:
+            resources.update(extra)
+        acc_type = mgr.get_current_node_accelerator_type()
+        if acc_type:
+            resources.setdefault(f"accelerator_type:{acc_type}", 1.0)
+        labels.update(mgr.get_current_node_accelerator_labels())
+    return resources, labels

@@ -1,0 +1,91 @@
+"""Accelerator manager interface.
+
+Reference parity: python/ray/_private/accelerators/accelerator.py:18
+(AcceleratorManager ABC — detect chip count/type, visible-device env
+injection, extra node resources, node labels). Here the interface is
+TPU-first: the primary implementation is the TPU manager; a trivial CPU
+manager exists so nodes without accelerators share the same bootstrap path.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional
+
+
+class AcceleratorManager(ABC):
+    """Per-accelerator-family node bootstrap hooks.
+
+    All methods are static/classmethod-style queries about the *current
+    node*: how many chips exist, what family/generation they are, which env
+    vars scope a worker process to a subset of chips, what extra custom
+    resources and node labels the node should advertise to the scheduler.
+    """
+
+    @staticmethod
+    @abstractmethod
+    def get_resource_name() -> str:
+        """The scheduler resource name, e.g. ``"TPU"``."""
+
+    @staticmethod
+    @abstractmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        """Env var that scopes a process to a subset of chips."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_node_num_accelerators() -> int:
+        """Number of chips physically present on this node."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        """Family/type marker, e.g. ``"TPU-V4"`` (None if undetectable)."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[list]:
+        """Chip ids visible to this process per env, or None = all."""
+
+    @staticmethod
+    @abstractmethod
+    def set_current_process_visible_accelerator_ids(ids: list) -> None:
+        """Export env so child frameworks (JAX) see only ``ids``."""
+
+    @staticmethod
+    def get_current_node_additional_resources() -> Optional[dict]:
+        """Extra custom resources this node should advertise (or None)."""
+        return None
+
+    @staticmethod
+    def get_current_node_accelerator_labels() -> dict:
+        """Node labels this node should advertise (may be empty)."""
+        return {}
+
+
+class CPUAcceleratorManager(AcceleratorManager):
+    """Degenerate manager for accelerator-free nodes."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "CPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return ""
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        return 0
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        return None
+
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> Optional[list]:
+        return None
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(ids: list) -> None:
+        pass

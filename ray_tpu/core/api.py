@@ -232,7 +232,9 @@ class RemoteFunction:
         opts = self._opts
         if self._payload is None:
             self._payload = cloudpickle.dumps(self._fn)
-        resources, label_selector, policy, pg = _scheduling_from_opts(opts)
+        resources, label_selector, soft_sel, policy, pg = (
+            _scheduling_from_opts(opts)
+        )
         refs = worker.submit_task(
             self._fn,
             args,
@@ -242,6 +244,7 @@ class RemoteFunction:
             resources=resources,
             max_retries=opts.get("max_retries"),
             label_selector=label_selector,
+            soft_label_selector=soft_sel,
             policy=policy,
             func_payload=self._payload,
             pg=pg,
@@ -266,8 +269,11 @@ def _resources_from_opts(opts: dict) -> dict:
     return resources
 
 
-def _scheduling_from_opts(opts: dict) -> tuple[dict, dict, str, tuple | None]:
-    """(resources, label_selector, policy, pg_info) after strategy
+def _scheduling_from_opts(
+    opts: dict,
+) -> tuple[dict, dict, dict, str, tuple | None]:
+    """(resources, label_selector, soft_label_selector, policy, pg_info)
+    after strategy
     translation — placement-group demands are rewritten onto formatted pg
     resources; pg_info rides along so executing tasks know their group."""
     from ray_tpu.util.scheduling_strategies import resolve_strategy
@@ -357,7 +363,9 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         worker = _require_worker()
         opts = self._opts
-        resources, label_selector, policy, pg = _scheduling_from_opts(opts)
+        resources, label_selector, soft_sel, policy, pg = (
+            _scheduling_from_opts(opts)
+        )
         info = worker.create_actor(
             self._cls,
             args,
@@ -367,6 +375,7 @@ class ActorClass:
             max_restarts=opts.get("max_restarts", 0),
             max_concurrency=opts.get("max_concurrency", 1),
             label_selector=label_selector,
+            soft_label_selector=soft_sel,
             policy=policy,
             pg=pg,
         )

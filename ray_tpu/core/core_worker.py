@@ -61,6 +61,7 @@ class TaskSpec:
     resources: dict
     retries_left: int = 0
     label_selector: dict = field(default_factory=dict)
+    soft_label_selector: dict = field(default_factory=dict)
     policy: str = "hybrid"
     pg: tuple | None = None  # (pg_id, capture_child_tasks)
     # actor fields
@@ -455,6 +456,7 @@ class CoreWorker:
         resources: dict | None = None,
         max_retries: int | None = None,
         label_selector: dict | None = None,
+        soft_label_selector: dict | None = None,
         policy: str = "hybrid",
         func_payload: bytes | None = None,
         pg: tuple | None = None,
@@ -478,6 +480,7 @@ class CoreWorker:
             resources=resources,
             retries_left=max_retries,
             label_selector=dict(label_selector or {}),
+            soft_label_selector=dict(soft_label_selector or {}),
             policy=policy,
             pg=pg,
         )
@@ -502,7 +505,8 @@ class CoreWorker:
         self._task_specs[spec.task_id] = spec
         key = _SchedKey(
             tuple(sorted(spec.resources.items())),
-            tuple(sorted(map(str, spec.label_selector.items()))),
+            tuple(sorted(map(str, spec.label_selector.items())))
+            + tuple(sorted(map(str, spec.soft_label_selector.items()))),
             spec.policy,
         )
         qs = self._queues.setdefault(key, _QueueState())
@@ -561,6 +565,7 @@ class CoreWorker:
         payload = {
             "resources": spec.resources,
             "label_selector": spec.label_selector,
+            "soft_label_selector": spec.soft_label_selector,
             "policy": spec.policy,
         }
         node_addr = self.node_addr
@@ -573,6 +578,10 @@ class CoreWorker:
                 reply["node_addr"] = node_addr
                 return reply
             if "spill" in reply:
+                if time.monotonic() > deadline:
+                    raise asyncio.TimeoutError(
+                        "lease request timed out while spilling"
+                    )
                 node_addr = tuple(reply["spill"])
                 continue
             if "retry_after" in reply:
@@ -619,7 +628,8 @@ class CoreWorker:
     async def _enqueue_task_respec(self, spec: TaskSpec) -> None:
         key = _SchedKey(
             tuple(sorted(spec.resources.items())),
-            tuple(sorted(map(str, spec.label_selector.items()))),
+            tuple(sorted(map(str, spec.label_selector.items())))
+            + tuple(sorted(map(str, spec.soft_label_selector.items()))),
             spec.policy,
         )
         qs = self._queues.setdefault(key, _QueueState())
@@ -656,6 +666,7 @@ class CoreWorker:
         max_restarts: int = 0,
         max_concurrency: int = 1,
         label_selector: dict | None = None,
+        soft_label_selector: dict | None = None,
         policy: str = "hybrid",
         pg: tuple | None = None,
     ) -> dict:
@@ -669,6 +680,7 @@ class CoreWorker:
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,
             "label_selector": dict(label_selector or {}),
+            "soft_label_selector": dict(soft_label_selector or {}),
             "policy": policy,
             "class_name": getattr(cls, "__name__", "Actor"),
             "pg": pg,

@@ -267,6 +267,7 @@ class GcsServer:
         req = SchedulingRequest(
             resources=rec.spec.get("resources", {}),
             label_selector=rec.spec.get("label_selector", {}),
+            soft_label_selector=rec.spec.get("soft_label_selector", {}),
             policy=rec.spec.get("policy", "hybrid"),
         )
         node_id = pick_node(req, "", self.nodes)

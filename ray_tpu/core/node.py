@@ -104,6 +104,7 @@ class NodeManager:
         self._pending_leases: list = []  # (req, future, deadline)
         self._inflight_pulls: dict[str, asyncio.Future] = {}
         self._spread_rr = 0
+        self._last_view_refresh = 0.0
         self._tasks: list = []
         self._stopping = False
         self._resources_freed = False
@@ -178,10 +179,16 @@ class NodeManager:
                 )
             except Exception:
                 pass
-            await self._refresh_cluster_view()
+            await self._refresh_cluster_view(force=True)
             await asyncio.sleep(GLOBAL_CONFIG.resource_report_interval_s)
 
-    async def _refresh_cluster_view(self):
+    async def _refresh_cluster_view(self, force: bool = False):
+        # Throttled: a gang of pending lease retries must not turn into a
+        # full-cluster-view RPC per retry against the GCS.
+        now = time.monotonic()
+        if not force and now - self._last_view_refresh < 1.0:
+            return
+        self._last_view_refresh = now
         try:
             view = await self.endpoint.acall(
                 self.gcs_addr, "gcs.get_cluster_view", {}
@@ -325,6 +332,7 @@ class NodeManager:
         req = SchedulingRequest(
             resources=p.get("resources", {}),
             label_selector=p.get("label_selector", {}),
+            soft_label_selector=p.get("soft_label_selector", {}),
             policy=p.get("policy", "hybrid"),
         )
         deadline = time.monotonic() + GLOBAL_CONFIG.lease_request_timeout_s
@@ -332,20 +340,33 @@ class NodeManager:
 
     async def _lease_or_spill(self, req: SchedulingRequest, deadline: float):
         local_ok = labels_match(self.labels, req.label_selector)
+        soft_target_is_self = False
         if req.policy.startswith(("node_affinity:", "strict_node_affinity:")):
             target = req.policy.split(":", 1)[1]
+            strict = req.policy.startswith("strict")
+            soft_target_is_self = not strict and target == self.node_id
             if target != self.node_id:
                 view = self.cluster_view.get(target)
                 if view is None:
                     await self._refresh_cluster_view()
                     view = self.cluster_view.get(target)
-                if view is not None and view.alive:
+                alive = view is not None and view.alive
+                if strict:
+                    if not alive:
+                        raise SchedulingError(
+                            f"node {target} for strict affinity is gone"
+                        )
                     return {"spill": tuple(view.addr)}
-                if req.policy.startswith("strict"):
-                    raise SchedulingError(
-                        f"node {target} for strict affinity is gone"
-                    )
-                # soft affinity: target gone — fall through to hybrid
+                # Soft affinity: forward only if the target could ever take
+                # the demand — otherwise fall through to hybrid here, so the
+                # request doesn't ping-pong between us and a full target.
+                if (
+                    alive
+                    and fits(view.total, req.resources)
+                    and labels_match(view.labels, req.label_selector)
+                ):
+                    return {"spill": tuple(view.addr)}
+                # target gone or infeasible — fall through to hybrid
         if req.policy == "spread":
             # Round-robin over all feasible nodes (including us).
             self._spread_rr += 1
@@ -355,11 +376,26 @@ class NodeManager:
                 return {"spill": tuple(self.cluster_view[choice].addr)}
             # fall through: grant locally (or queue) below
         if local_ok and fits(self.available, req.resources):
+            # Soft label preference: if we don't match the preferred labels
+            # but a peer that does can take the work now, send it there.
+            if req.soft_label_selector and not labels_match(
+                self.labels, req.soft_label_selector
+            ):
+                preferred = self._try_spill(req, require_soft=True)
+                if preferred is not None:
+                    return preferred
             return await self._grant(req)
-        # Not local: consult cluster view for a node that fits now.
-        spill = self._try_spill(req)
-        if spill is not None:
-            return spill
+        # Not local: consult cluster view for a node that fits now. When we
+        # ARE a soft-affinity target that will eventually fit, prefer
+        # queueing here over spilling away (the point of the affinity).
+        if not (
+            soft_target_is_self
+            and local_ok
+            and fits(self.total, req.resources)
+        ):
+            spill = self._try_spill(req)
+            if spill is not None:
+                return spill
         # Feasible here eventually? queue. Feasible anywhere? tell caller to
         # retry later; else hard error.
         if local_ok and fits(self.total, req.resources):
@@ -431,10 +467,20 @@ class NodeManager:
         self._pg_state_cache[pg_id] = (now, verdict)
         return verdict
 
-    def _try_spill(self, req: SchedulingRequest) -> dict | None:
-        """Pick a peer that fits the request now, or None."""
+    def _try_spill(
+        self, req: SchedulingRequest, require_soft: bool = False
+    ) -> dict | None:
+        """Pick a peer that fits the request now, or None. With
+        ``require_soft``, only peers matching the soft label selector
+        qualify (used to honor the preference over a local grant)."""
         views = dict(self.cluster_view)
         views.pop(self.node_id, None)
+        if require_soft:
+            views = {
+                nid: v
+                for nid, v in views.items()
+                if labels_match(v.labels, req.soft_label_selector)
+            }
         self._spread_rr += 1
         choice = pick_node(req, "", views, self._spread_rr)
         if choice is not None:
@@ -516,10 +562,13 @@ class NodeManager:
             )
         return True
 
-    async def _h_cancel_bundles(self, conn, p):
-        pg_id = p["pg_id"]
+    def _release_reservations(self, pg_id: str) -> None:
+        """Return all uncommitted 2PC reservations of a group to the pool."""
         for key in [k for k in self.bundle_reservations if k[0] == pg_id]:
             add(self.available, self.bundle_reservations.pop(key))
+
+    async def _h_cancel_bundles(self, conn, p):
+        self._release_reservations(p["pg_id"])
         self._resources_freed = True
         await self._drain_pending()
         return True
@@ -546,8 +595,7 @@ class NodeManager:
         from ray_tpu.util.placement_group import formatted_bundle_resources
 
         pg_id = p["pg_id"]
-        for key in [k for k in self.bundle_reservations if k[0] == pg_id]:
-            add(self.available, self.bundle_reservations.pop(key))
+        self._release_reservations(pg_id)
         # Kill workers leased against this group's formatted resources
         # (reference semantics: removing a PG kills its tasks/actors).
         for lid, lease in list(self.leases.items()):

@@ -81,6 +81,9 @@ class NodeView:
 class SchedulingRequest:
     resources: dict
     label_selector: dict = field(default_factory=dict)
+    # Preferred (not required) labels: among fitting nodes, ones matching
+    # these win; falls back to any fitting node when none match.
+    soft_label_selector: dict = field(default_factory=dict)
     # "hybrid" (default: prefer local then best remote), "spread",
     # "node_affinity:<node_id>", "strict_node_affinity:<node_id>"
     policy: str = "hybrid"
@@ -120,6 +123,14 @@ def pick_node(
     ]
     if not candidates:
         return None
+    if req.soft_label_selector:
+        preferred = [
+            v
+            for v in candidates
+            if labels_match(v.labels, req.soft_label_selector)
+        ]
+        if preferred:
+            candidates = preferred
     if req.policy == "spread":
         # Round-robin over feasible nodes to spread load.
         candidates.sort(key=lambda v: v.node_id)

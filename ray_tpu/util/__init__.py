@@ -8,6 +8,7 @@ tier stays importable without the cluster runtime.
 from ray_tpu.util.placement_group import (
     PlacementGroup,
     get_current_placement_group,
+    get_placement_group,
     placement_group,
     placement_group_table,
     remove_placement_group,
@@ -24,6 +25,7 @@ __all__ = [
     "remove_placement_group",
     "placement_group_table",
     "get_current_placement_group",
+    "get_placement_group",
     "PlacementGroupSchedulingStrategy",
     "NodeAffinitySchedulingStrategy",
     "NodeLabelSchedulingStrategy",

@@ -154,9 +154,20 @@ def placement_group(
         )
     if not bundles:
         raise ValueError("placement group needs at least one bundle")
+    cleaned = []
     for b in bundles:
-        if not b or any(v < 0 for v in b.values()):
-            raise ValueError(f"invalid bundle {b!r}")
+        if any(v < 0 for v in b.values()):
+            raise ValueError(f"negative resource in bundle {b!r}")
+        # Zero-valued entries are stripped; a bundle with no positive demand
+        # would commit as an unusable no-op, so reject it outright
+        # (reference requires strictly positive bundle values).
+        c = {k: v for k, v in b.items() if v > 0}
+        if not c:
+            raise ValueError(
+                f"bundle {b!r} has no positive resource demand"
+            )
+        cleaned.append(c)
+    bundles = cleaned
     pg_id = uuid.uuid4().hex
     spec = {
         "pg_id": pg_id,

@@ -43,7 +43,8 @@ class NodeAffinitySchedulingStrategy:
 @dataclass
 class NodeLabelSchedulingStrategy:
     """Schedule only onto nodes whose labels match ``hard`` (exact /
-    ("in", [...]) / ("not_in", [...]) / ("exists",) conditions)."""
+    ("in", [...]) / ("not_in", [...]) / ("exists",) conditions); among those,
+    prefer nodes also matching ``soft`` (falls back when none fit)."""
 
     hard: dict = field(default_factory=dict)
     soft: dict = field(default_factory=dict)
@@ -53,9 +54,10 @@ def resolve_strategy(
     opts: dict,
     resources: dict,
     label_selector: Optional[dict],
-) -> tuple[dict, dict, str, Optional[tuple]]:
-    """Translate scheduling options into (resources, label_selector, policy,
-    pg_info) where pg_info is (pg_id, capture_child_tasks) or None. Accepts
+) -> tuple[dict, dict, dict, str, Optional[tuple]]:
+    """Translate scheduling options into (resources, label_selector,
+    soft_label_selector, policy, pg_info) where pg_info is
+    (pg_id, capture_child_tasks) or None. Accepts
     ``scheduling_strategy=`` objects or the legacy ``placement_group=`` /
     ``placement_group_bundle_index=`` options. With no explicit strategy, a
     task submitted from inside a capture_child_tasks placement group inherits
@@ -67,6 +69,7 @@ def resolve_strategy(
     )
 
     label_selector = dict(label_selector or {})
+    soft_label_selector: dict = {}
     policy = "hybrid"
     pg = opts.get("placement_group")
     bundle_index = opts.get("placement_group_bundle_index", -1)
@@ -83,6 +86,7 @@ def resolve_strategy(
         policy = strategy.to_policy()
     elif isinstance(strategy, NodeLabelSchedulingStrategy):
         label_selector = {**strategy.hard, **label_selector}
+        soft_label_selector = dict(strategy.soft)
 
     if pg is None and strategy is None:
         ambient = _ambient_pg()
@@ -94,4 +98,4 @@ def resolve_strategy(
         pg_id = pg.id if isinstance(pg, PlacementGroup) else str(pg)
         resources = translate_resources_for_pg(resources, pg_id, bundle_index)
         pg_info = (pg_id, capture)
-    return resources, label_selector, policy, pg_info
+    return resources, label_selector, soft_label_selector, policy, pg_info

@@ -1,0 +1,79 @@
+"""Test utilities: fake TPU hosts, chaos injection.
+
+Reference parity: python/ray/_private/test_utils.py (ResourceKiller
+hierarchy :1412) and python/ray/tests/accelerators/test_tpu.py (mocked
+GKE/GCE env to simulate TPU hosts without hardware). `fake_tpu_node`
+produces exactly the (resources, labels) a real slice host would advertise
+after accelerator detection, so multi-slice scheduling paths run on any
+machine.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.accelerators.tpu import (
+    TPU_POD_TYPE_LABEL,
+    TPU_SLICE_NAME_LABEL,
+    TPU_TOPOLOGY_LABEL,
+    TPU_WORKER_ID_LABEL,
+    chips_per_host,
+    num_chips_in_pod,
+    num_hosts_in_pod,
+    tpu_generation,
+)
+
+
+def fake_tpu_node(
+    pod_type: str,
+    slice_name: str,
+    worker_id: int,
+    topology: str | None = None,
+    num_cpus: float = 8.0,
+) -> tuple:
+    """(resources, labels) of host ``worker_id`` of slice ``slice_name``.
+
+    Matches what `detect_node_accelerators` yields on a real host with the
+    GKE env set: TPU chips, the slice-name resource on every host, the
+    ``TPU-<pod>-head`` singleton on worker 0, and the ray.io/tpu-* labels.
+    """
+    cph = chips_per_host(pod_type)
+    total = num_chips_in_pod(pod_type)
+    # Last host of a ragged slice holds the remainder.
+    n_hosts = num_hosts_in_pod(pod_type)
+    chips = cph if worker_id < n_hosts - 1 else total - cph * (n_hosts - 1)
+    resources = {
+        "CPU": num_cpus,
+        "TPU": float(chips),
+        slice_name: 1.0,
+        f"accelerator_type:TPU-{tpu_generation(pod_type).upper()}": 1.0,
+    }
+    if worker_id == 0:
+        resources[f"TPU-{pod_type}-head"] = 1.0
+    labels = {
+        TPU_SLICE_NAME_LABEL: slice_name,
+        TPU_WORKER_ID_LABEL: str(worker_id),
+        TPU_POD_TYPE_LABEL: pod_type,
+    }
+    if topology:
+        labels[TPU_TOPOLOGY_LABEL] = topology
+    return resources, labels
+
+
+def add_fake_tpu_slice(
+    runtime,
+    pod_type: str,
+    slice_name: str,
+    topology: str | None = None,
+    num_cpus: float = 8.0,
+) -> list:
+    """Add one full fake slice (all hosts) to a running local cluster."""
+    nodes = []
+    for wid in range(num_hosts_in_pod(pod_type)):
+        resources, labels = fake_tpu_node(
+            pod_type, slice_name, wid, topology, num_cpus
+        )
+        nodes.append(
+            runtime.add_node(
+                resources, labels=labels, name=f"{slice_name}-w{wid}"
+            )
+        )
+    return nodes

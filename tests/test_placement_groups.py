@@ -194,3 +194,40 @@ def test_node_affinity_strategy(cluster):
         ).remote()
     )
     assert nid == node3.node_id
+
+
+def test_soft_label_preference(cluster):
+    """NodeLabelSchedulingStrategy.soft steers to matching nodes when they
+    fit, and falls back (rather than failing) when none match."""
+    from ray_tpu.util import NodeLabelSchedulingStrategy
+
+    nid = ray_tpu.get(
+        where.options(
+            num_cpus=1,
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={}, soft={"zone": "b"}
+            ),
+        ).remote()
+    )
+    node_labels = {n["NodeID"]: n["Labels"] for n in ray_tpu.nodes()}
+    assert node_labels[nid].get("zone") == "b"
+    # Soft selector matching no node still schedules somewhere.
+    nid2 = ray_tpu.get(
+        where.options(
+            num_cpus=1,
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={}, soft={"zone": "nowhere"}
+            ),
+        ).remote()
+    )
+    assert nid2 in node_labels
+
+
+def test_zero_value_bundle_rejected(cluster):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 0}])
+    # Mixed bundles drop the zero entries but keep the positive demand.
+    pg = placement_group([{"CPU": 1, "accel": 0}])
+    assert pg.wait(30)
+    assert pg.bundle_specs == [{"CPU": 1}]
+    remove_placement_group(pg)
