@@ -118,6 +118,8 @@ class CoreWorker:
         self._actor_instance: Any = None
         self._actor_id: str | None = None
         self._actor_pg: tuple | None = None
+        self._actor_ready = asyncio.Event()
+        self._actor_init_error: Exception | None = None
         self._actor_lock: threading.Lock = threading.Lock()
         self._actor_seq: dict[str, int] = {}  # caller -> next expected seq
         self._actor_buffer: dict[tuple, Any] = {}  # (caller, seq) -> pending
@@ -235,6 +237,16 @@ class CoreWorker:
     async def _h_owner_get_object(self, conn, p):
         oid = p["oid"]
         timeout = p.get("timeout")
+        if oid not in self.owner_store.objects:
+            # Every owned object is registered before its ref can escape this
+            # process, so unknown here means the owner already freed it (all
+            # known refs were dropped). Waiting would hang forever.
+            return {
+                "error": ObjectLostError(
+                    f"object {oid} was freed by its owner (all references "
+                    f"dropped before this fetch)"
+                )
+            }
         obj = await self.owner_store.wait_ready(oid, timeout)
         if obj.state == FAILED:
             return {"error": obj.error}
@@ -254,6 +266,8 @@ class CoreWorker:
         }
 
     async def _h_owner_wait_ready(self, conn, p):
+        if p["oid"] not in self.owner_store.objects:
+            return {"ready": True, "failed": True}  # freed (see get_object)
         try:
             obj = await self.owner_store.wait_ready(p["oid"], p.get("timeout"))
         except asyncio.TimeoutError:
@@ -734,6 +748,11 @@ class CoreWorker:
     # -- execution side (worker role) ---------------------------------------
 
     async def _h_worker_start_actor(self, conn, p):
+        """Begin actor construction and reply immediately (async creation, as
+        the reference's CreateActor: the creation task runs on the worker and
+        method calls queue behind it). Required for actors whose __init__
+        blocks on peers — e.g. collective rendezvous: rank 0's __init__ waits
+        for rank 1, which only gets created after rank 0's RPC returns."""
         spec = p["spec"]
         cls = cloudpickle.loads(spec["class_payload"])
         (args, kwargs), _ = serialization.loads(spec["args_payload"])
@@ -743,13 +762,43 @@ class CoreWorker:
                 max_workers=max_conc, thread_name_prefix="actor-exec"
             )
         loop = asyncio.get_running_loop()
+        self._actor_id = p["actor_id"]
+        self._actor_pg = tuple(spec["pg"]) if spec.get("pg") else None
+        self._actor_ready = asyncio.Event()
+        self._actor_init_error = None
 
         def make():
             return cls(*args, **kwargs)
 
-        self._actor_instance = await loop.run_in_executor(self._executor, make)
-        self._actor_id = p["actor_id"]
-        self._actor_pg = tuple(spec["pg"]) if spec.get("pg") else None
+        async def construct():
+            try:
+                self._actor_instance = await loop.run_in_executor(
+                    self._executor, make
+                )
+            except Exception as e:  # noqa: BLE001
+                self._actor_init_error = TaskError(
+                    f"actor {spec.get('class_name', 'Actor')}.__init__ "
+                    f"failed: {e!r}",
+                    traceback.format_exc(),
+                )
+                # Tell our node so the GCS can restart or mark the actor dead
+                # with the real error; the node then retires this process.
+                try:
+                    await self.endpoint.acall(
+                        self.node_addr,
+                        "node.actor_init_failed",
+                        {
+                            "worker_id": self.worker_id,
+                            "actor_id": self._actor_id,
+                            "reason": str(self._actor_init_error),
+                        },
+                    )
+                except Exception:
+                    pass
+            finally:
+                self._actor_ready.set()
+
+        self.endpoint.submit(construct())
         return True
 
     async def _h_worker_push_task(self, conn, p):
@@ -792,6 +841,13 @@ class CoreWorker:
         try:
             from ray_tpu.util.placement_group import _bind_ambient_pg
 
+            await self._actor_ready.wait()
+            if self._actor_init_error is not None:
+                return {
+                    "results": self._error_results(
+                        p, self._actor_init_error
+                    )
+                }
             instance = self._actor_instance
             method = getattr(instance, p["method"])
             args, kwargs = await self._resolve_args(p)

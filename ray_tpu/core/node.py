@@ -655,6 +655,16 @@ class NodeManager:
             "lease_id": grant["lease_id"],
         }
 
+    async def _h_actor_init_failed(self, conn, p):
+        """The worker's actor __init__ raised (async creation). Retire the
+        process; _on_worker_death reports the actors to the GCS with the real
+        error so restart/DEAD handling sees the creation failure."""
+        info = self.workers.get(p["worker_id"])
+        if info is not None and info.proc is not None and info.proc.poll() is None:
+            info.proc.kill()
+        await self._on_worker_death(p["worker_id"], p.get("reason", "init failed"))
+        return True
+
     # -- object plane --------------------------------------------------------
 
     async def _h_object_created(self, conn, p):

@@ -19,6 +19,7 @@ import pickle
 import threading
 from typing import Any
 
+import cloudpickle
 import numpy as np
 
 from ray_tpu.core.object_ref import ObjectRef
@@ -36,7 +37,11 @@ def _identity(x):
     return x
 
 
-class _Pickler(pickle.Pickler):
+class _Pickler(cloudpickle.Pickler):
+    """cloudpickle base (closures/lambdas in args must travel — e.g. user
+    transform fns inside data-plan ops) + ref tracking and device-array
+    down-conversion on top."""
+
     def reducer_override(self, obj):
         if isinstance(obj, ObjectRef):
             if _ctx.collecting is not None:
@@ -48,7 +53,8 @@ class _Pickler(pickle.Pickler):
         ):
             # Device array -> host numpy. Weakly-typed scalars survive fine.
             return (_identity, (np.asarray(obj),))
-        return NotImplemented
+        # cloudpickle's own reducer_override handles functions/classes.
+        return super().reducer_override(obj)
 
 
 def dumps(value: Any) -> tuple[bytes, list[ObjectRef]]:

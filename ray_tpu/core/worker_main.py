@@ -12,6 +12,12 @@ import time
 
 
 def main() -> None:
+    # SIGUSR1 dumps all thread stacks to stderr — the debugging hook for
+    # hung workers (reference analog: py-spy via the dashboard reporter).
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--node-addr", required=True)
     parser.add_argument("--gcs-addr", required=True)

@@ -1,0 +1,45 @@
+"""ray_tpu.util.collective — collective communication for tasks & actors.
+
+Reference parity: python/ray/util/collective/. Backends: "xla" (device
+collectives over ICI/DCN via a jax mesh) and "cpu" (coordinator-actor data
+plane for tests and host arrays).
+"""
+
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective.communicator import Communicator
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+__all__ = [
+    "Backend",
+    "Communicator",
+    "ReduceOp",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_collective_group_size",
+    "get_rank",
+    "init_collective_group",
+    "is_group_initialized",
+    "recv",
+    "reduce",
+    "reducescatter",
+    "send",
+]

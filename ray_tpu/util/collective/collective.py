@@ -1,0 +1,312 @@
+"""Collective API — group management + collective calls from tasks/actors.
+
+Reference parity: python/ray/util/collective/collective.py
+(init_collective_group :171, create_collective_group :211, declare via KV,
+allreduce :328, barrier :368, reduce :381, broadcast :443, allgather :493,
+reducescatter :542, send :601, recv :664) and the per-process GroupManager
+(:71). Differences, TPU-first: the API is functional (returns results rather
+than mutating tensors in place — the natural calling convention for JAX
+arrays), and the accelerator backend is XLA over a device mesh instead of
+NCCL. The *_multigpu variants are deliberately absent: "multiple GPUs per
+process" is a CUDA notion; on TPU the same capability is a mesh axis over
+local devices (see ray_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, List, Optional
+
+from ray_tpu.util.collective.communicator import Communicator
+from ray_tpu.util.collective.types import (
+    DEFAULT_GROUP_NAME,
+    DEFAULT_TIMEOUT_S,
+    Backend,
+    ReduceOp,
+)
+
+_KV_NS = "collective"
+
+
+class GroupManager:
+    """Per-process registry of collective group memberships
+    (reference: collective.py:71)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._groups: dict[str, Communicator] = {}
+
+    def get(self, group_name: str) -> Optional[Communicator]:
+        with self._lock:
+            comm = self._groups.get(group_name)
+        if comm is None:
+            comm = self._try_declared_init(group_name)
+        return comm
+
+    def require(self, group_name: str) -> Communicator:
+        comm = self.get(group_name)
+        if comm is None:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized in this "
+                f"process; call init_collective_group() or declare it with "
+                f"create_collective_group()"
+            )
+        return comm
+
+    def add(self, comm: Communicator) -> None:
+        with self._lock:
+            if comm.group_name in self._groups:
+                raise ValueError(
+                    f"group {comm.group_name!r} already initialized here"
+                )
+            self._groups[comm.group_name] = comm
+
+    def remove(self, group_name: str) -> Optional[Communicator]:
+        with self._lock:
+            return self._groups.pop(group_name, None)
+
+    def _try_declared_init(self, group_name: str) -> Optional[Communicator]:
+        """Auto-join a group declared via create_collective_group: my rank is
+        looked up by actor id in the declaration stored in the GCS KV."""
+        import ray_tpu
+        from ray_tpu.core import api as core_api
+
+        if not ray_tpu.is_initialized():
+            return None
+        worker = core_api._require_worker(auto_init=False)
+        raw = worker.gcs.kv_get(f"decl::{group_name}", ns=_KV_NS)
+        if raw is None:
+            return None
+        decl = json.loads(raw)
+        my_actor = worker._actor_id
+        if my_actor is None or my_actor not in decl["actor_ranks"]:
+            return None
+        return init_collective_group(
+            decl["world_size"],
+            decl["actor_ranks"][my_actor],
+            backend=decl["backend"],
+            group_name=group_name,
+            timeout_s=decl.get("timeout_s", DEFAULT_TIMEOUT_S),
+        )
+
+
+_group_mgr = GroupManager()
+
+_COORD_NAME_PREFIX = "ray_tpu::collective::"
+
+
+def _coordinator_handle(
+    group_name: str,
+    world_size: int,
+    rank: int,
+    timeout_s: float,
+):
+    """Rank 0 creates the named coordinator actor; other ranks poll for it
+    (the NCCLUniqueIDStore rendezvous pattern,
+    reference nccl_collective_group.py Rendezvous.meet :55)."""
+    import ray_tpu
+    from ray_tpu.util.collective.coordinator import CollectiveCoordinator
+
+    name = _COORD_NAME_PREFIX + group_name
+    if rank == 0:
+        # A coordinator left over from a previous generation (worker died
+        # mid-collective, gang rebuilt with the same group name) holds stale
+        # op state — retire it before creating the new one.
+        try:
+            stale = ray_tpu.get_actor(name)
+            ray_tpu.kill(stale)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                try:
+                    ray_tpu.get_actor(name)
+                    time.sleep(0.02)
+                except ValueError:
+                    break
+        except ValueError:
+            pass
+        coord_cls = ray_tpu.remote(CollectiveCoordinator)
+        return coord_cls.options(
+            name=name,
+            num_cpus=0,
+            # Every rank blocks inside the actor during a collective, plus
+            # headroom for concurrent P2P and rendezvous calls.
+            max_concurrency=4 * world_size + 4,
+        ).remote(world_size, timeout_s)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            return ray_tpu.get_actor(name)
+        except ValueError:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rank {rank} timed out waiting for rank 0 to create "
+                    f"collective group {group_name!r}"
+                )
+            time.sleep(0.05)
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: "Backend | str" = Backend.CPU,
+    group_name: str = DEFAULT_GROUP_NAME,
+    *,
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+) -> Communicator:
+    """Join collective group ``group_name`` as ``rank`` of ``world_size``.
+
+    Must be called by every member (inside its own process) before any
+    collective call, unless the group was declared with
+    create_collective_group (then the first collective auto-joins).
+
+    Failure semantics match communicator libraries (NCCL included): a group
+    is one generation of processes. If any member dies mid-run, the whole
+    gang must re-init the group (rank 0's re-init retires the old
+    coordinator) — a lone restarted member cannot rejoin an in-flight
+    generation, because its op sequence numbers restart from zero.
+    """
+    backend = Backend.parse(backend)
+    coord = _coordinator_handle(group_name, world_size, rank, timeout_s)
+    if backend == Backend.CPU:
+        from ray_tpu.util.collective.cpu_group import CpuGroup
+
+        comm: Communicator = CpuGroup(
+            group_name, world_size, rank, coord, timeout_s
+        )
+    else:
+        from ray_tpu.util.collective.xla_group import XlaGroup
+
+        comm = XlaGroup(group_name, world_size, rank, coord, timeout_s)
+    _group_mgr.add(comm)
+    return comm
+
+
+def create_collective_group(
+    actors: list,
+    world_size: int,
+    ranks: List[int],
+    backend: "Backend | str" = Backend.CPU,
+    group_name: str = DEFAULT_GROUP_NAME,
+    *,
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+) -> None:
+    """Declare a collective group over ``actors`` (reference
+    collective.py:211): stores {actor_id: rank} in the GCS KV; each actor
+    auto-joins on its first collective call."""
+    from ray_tpu.core import api as core_api
+
+    backend = Backend.parse(backend)
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must have equal length")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(
+            f"ranks must be a permutation of range({world_size}), got {ranks}"
+        )
+    worker = core_api._require_worker()
+    decl = {
+        "world_size": world_size,
+        "backend": backend.value,
+        "timeout_s": timeout_s,
+        "actor_ranks": {
+            a._actor_id: r for a, r in zip(actors, ranks)
+        },
+    }
+    ok = worker.gcs.kv_put(
+        f"decl::{group_name}",
+        json.dumps(decl).encode(),
+        ns=_KV_NS,
+        overwrite=False,
+    )
+    if not ok:
+        raise ValueError(f"collective group {group_name!r} already declared")
+
+
+def is_group_initialized(group_name: str = DEFAULT_GROUP_NAME) -> bool:
+    return _group_mgr.get(group_name) is not None
+
+
+def get_rank(group_name: str = DEFAULT_GROUP_NAME) -> int:
+    comm = _group_mgr.get(group_name)
+    return comm.rank if comm is not None else -1
+
+
+def get_collective_group_size(group_name: str = DEFAULT_GROUP_NAME) -> int:
+    comm = _group_mgr.get(group_name)
+    return comm.world_size if comm is not None else -1
+
+
+def destroy_collective_group(group_name: str = DEFAULT_GROUP_NAME) -> None:
+    """Leave the group locally; rank 0 (or a non-member, e.g. the driver that
+    declared the group) also tears down the shared state (coordinator actor,
+    KV declaration). Non-zero ranks only leave — the coordinator doubles as
+    the P2P mailbox, so killing it from any rank could drop in-flight
+    messages other ranks have yet to recv. Drain P2P before destroying."""
+    import ray_tpu
+    from ray_tpu.core import api as core_api
+
+    comm = _group_mgr.remove(group_name)
+    if comm is not None:
+        comm.destroy()
+    if comm is not None and comm.rank != 0:
+        return
+    try:
+        worker = core_api._require_worker(auto_init=False)
+        worker.gcs.kv_del(f"decl::{group_name}", ns=_KV_NS)
+        coord = ray_tpu.get_actor(_COORD_NAME_PREFIX + group_name)
+        ray_tpu.kill(coord)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Collective calls (functional: return the result)
+# ---------------------------------------------------------------------------
+
+
+def allreduce(
+    tensor,
+    group_name: str = DEFAULT_GROUP_NAME,
+    op: ReduceOp = ReduceOp.SUM,
+):
+    return _group_mgr.require(group_name).allreduce(tensor, op)
+
+
+def barrier(group_name: str = DEFAULT_GROUP_NAME) -> None:
+    _group_mgr.require(group_name).barrier()
+
+
+def reduce(
+    tensor,
+    dst_rank: int = 0,
+    group_name: str = DEFAULT_GROUP_NAME,
+    op: ReduceOp = ReduceOp.SUM,
+):
+    return _group_mgr.require(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(
+    tensor, src_rank: int = 0, group_name: str = DEFAULT_GROUP_NAME
+):
+    return _group_mgr.require(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = DEFAULT_GROUP_NAME) -> List[Any]:
+    return _group_mgr.require(group_name).allgather(tensor)
+
+
+def reducescatter(
+    tensor,
+    group_name: str = DEFAULT_GROUP_NAME,
+    op: ReduceOp = ReduceOp.SUM,
+):
+    return _group_mgr.require(group_name).reducescatter(tensor, op)
+
+
+def send(tensor, dst_rank: int, group_name: str = DEFAULT_GROUP_NAME) -> None:
+    _group_mgr.require(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = DEFAULT_GROUP_NAME):
+    return _group_mgr.require(group_name).recv(src_rank)

@@ -1,0 +1,71 @@
+"""Communicator ABC — the pluggable collective backend interface.
+
+Reference parity: python/ray/experimental/channel/communicator.py:18 (the
+Communicator ABC behind NCCL/CPU channel transports) and the BaseGroup in
+python/ray/util/collective/collective_group/base_collective_group.py. One
+interface serves both the explicit collective API (ray_tpu.util.collective)
+and compiled-graph channels.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List
+
+from ray_tpu.util.collective.types import ReduceOp
+
+
+class Communicator(abc.ABC):
+    """A process's membership in one collective group."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self._group_name = group_name
+        self._world_size = int(world_size)
+        self._rank = int(rank)
+        if not (0 <= self._rank < self._world_size):
+            raise ValueError(
+                f"rank {rank} out of range for world size {world_size}"
+            )
+
+    @property
+    def group_name(self) -> str:
+        return self._group_name
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    @abc.abstractmethod
+    def backend(self) -> str: ...
+
+    @abc.abstractmethod
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM): ...
+
+    @abc.abstractmethod
+    def barrier(self) -> None: ...
+
+    @abc.abstractmethod
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM): ...
+
+    @abc.abstractmethod
+    def broadcast(self, tensor, src_rank: int = 0): ...
+
+    @abc.abstractmethod
+    def allgather(self, tensor) -> List[Any]: ...
+
+    @abc.abstractmethod
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM): ...
+
+    @abc.abstractmethod
+    def send(self, tensor, dst_rank: int) -> None: ...
+
+    @abc.abstractmethod
+    def recv(self, src_rank: int): ...
+
+    def destroy(self) -> None:  # optional backend cleanup
+        pass

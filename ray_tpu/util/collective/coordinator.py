@@ -1,0 +1,183 @@
+"""Coordinator actor: rendezvous point + CPU-backend data plane.
+
+Reference parity: the NCCLUniqueIDStore named actor used for rendezvous
+(reference: python/ray/util/collective/collective_group/nccl_collective_group.py
+Rendezvous.meet :55, _generate_nccl_uid :548). Here the same named-actor
+pattern carries the whole CPU data plane too: ranks post contributions and
+block until the group is complete, so collective semantics hold across actor
+and task processes without any native transport.
+
+The actor runs with max_concurrency >= world_size: every rank's call blocks
+inside the actor (condition variables) until the collective completes.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.util.collective.types import ReduceOp, numpy_reduce
+
+
+class CollectiveCoordinator:
+    """One instance per collective group, named ``ray_tpu::collective::<name>``."""
+
+    def __init__(self, world_size: int, timeout_s: float = 120.0):
+        if world_size < 1:
+            raise ValueError("world_size must be >= 1")
+        self._world = int(world_size)
+        self._timeout = float(timeout_s)
+        self._cv = threading.Condition()
+        # (seq) -> op state. Collectives must be issued in the same order by
+        # every rank (standard communicator contract), so seq alone keys the
+        # op; `kind` is cross-checked to catch divergent programs early.
+        self._ops: dict[int, dict] = {}
+        # (src, dst, tag) -> list of pending payloads (ordered)
+        self._mail: dict[tuple, list] = {}
+        # small KV for backend-specific rendezvous (e.g. XLA coordinator addr)
+        self._meta: dict[str, bytes] = {}
+
+    # -- introspection -------------------------------------------------------
+
+    def world_size(self) -> int:
+        return self._world
+
+    def ping(self) -> bool:
+        return True
+
+    # -- rendezvous metadata -------------------------------------------------
+
+    def put_meta(self, key: str, value) -> bool:
+        with self._cv:
+            self._meta[key] = value
+            self._cv.notify_all()
+        return True
+
+    def get_meta(self, key: str, wait: bool = True):
+        deadline = self._deadline()
+        with self._cv:
+            while key not in self._meta:
+                if not wait:
+                    return None
+                self._wait(deadline, f"meta key {key!r}")
+            return self._meta[key]
+
+    # -- collectives ---------------------------------------------------------
+
+    def collective(self, kind: str, seq: int, rank: int, payload, extra=None):
+        """Contribute ``payload`` for op ``seq`` and block until every rank
+        has; returns this rank's share of the result."""
+        deadline = self._deadline()
+        with self._cv:
+            st = self._ops.get(seq)
+            if st is None:
+                st = self._ops[seq] = {
+                    "kind": kind,
+                    "extra": extra,
+                    "contrib": {},
+                    "result": None,
+                    "error": None,
+                    "done": 0,
+                }
+            if st["kind"] != kind:
+                st["error"] = (
+                    f"collective mismatch at seq {seq}: rank {rank} called "
+                    f"{kind!r} but another rank called {st['kind']!r}"
+                )
+                self._cv.notify_all()
+            if rank in st["contrib"]:
+                st["error"] = f"rank {rank} contributed twice at seq {seq}"
+                self._cv.notify_all()
+            st["contrib"][rank] = payload
+            if len(st["contrib"]) == self._world and st["error"] is None:
+                try:
+                    st["result"] = self._compute(st)
+                except Exception as e:  # shape/dtype mismatch etc.
+                    st["error"] = f"{type(e).__name__}: {e}"
+                self._cv.notify_all()
+            while (
+                st["result"] is None
+                and st["error"] is None
+            ):
+                self._wait(
+                    deadline,
+                    f"collective {kind!r} seq {seq} "
+                    f"({len(st['contrib'])}/{self._world} ranks arrived)",
+                )
+            try:
+                if st["error"] is not None:
+                    raise RuntimeError(st["error"])
+                return self._share(st, rank)
+            finally:
+                st["done"] += 1
+                if st["done"] == self._world:
+                    del self._ops[seq]
+
+    def _compute(self, st: dict):
+        kind = st["kind"]
+        by_rank = st["contrib"]
+        ordered = [by_rank[r] for r in range(self._world)]
+        if kind == "barrier":
+            return True
+        if kind in ("allreduce", "reduce"):
+            return numpy_reduce(ordered, ReduceOp(st["extra"]["op"]))
+        if kind == "broadcast":
+            return by_rank[st["extra"]["src_rank"]]
+        if kind == "allgather":
+            return ordered
+        if kind == "reducescatter":
+            reduced = numpy_reduce(ordered, ReduceOp(st["extra"]["op"]))
+            if reduced.shape[0] % self._world != 0:
+                raise ValueError(
+                    f"reducescatter dim0 {reduced.shape[0]} not divisible "
+                    f"by world size {self._world}"
+                )
+            import numpy as np
+
+            return np.split(reduced, self._world, axis=0)
+        raise ValueError(f"unknown collective kind {kind!r}")
+
+    def _share(self, st: dict, rank: int):
+        kind = st["kind"]
+        if kind == "reduce":
+            return st["result"] if rank == st["extra"]["dst_rank"] else None
+        if kind == "reducescatter":
+            return st["result"][rank]
+        return st["result"]
+
+    # -- point-to-point ------------------------------------------------------
+
+    def post(self, src: int, dst: int, tag: int, payload) -> bool:
+        with self._cv:
+            self._mail.setdefault((src, dst, tag), []).append(payload)
+            self._cv.notify_all()
+        return True
+
+    def take(self, src: int, dst: int, tag: int):
+        deadline = self._deadline()
+        key = (src, dst, tag)
+        with self._cv:
+            while not self._mail.get(key):
+                self._wait(deadline, f"recv from rank {src} (tag {tag})")
+            box = self._mail[key]
+            payload = box.pop(0)
+            if not box:
+                del self._mail[key]
+            return payload
+
+    # -- internals -----------------------------------------------------------
+
+    def _deadline(self) -> float:
+        import time
+
+        return time.monotonic() + self._timeout
+
+    def _wait(self, deadline: float, what: str) -> None:
+        import time
+
+        remaining = deadline - time.monotonic()
+        if remaining <= 0 or not self._cv.wait(timeout=remaining):
+            if deadline - time.monotonic() <= 0:
+                raise TimeoutError(
+                    f"collective timed out after {self._timeout}s "
+                    f"waiting for {what}"
+                )

@@ -1,0 +1,98 @@
+"""CPU collective group — host arrays through the coordinator actor.
+
+Reference parity: the gloo-backed group
+(python/ray/util/collective/collective_group/torch_gloo_collective_group.py:229)
+— the backend that makes collective logic testable without accelerator
+hardware. Data rides the task RPC path to the named coordinator actor, which
+reduces with numpy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ray_tpu.util.collective.communicator import Communicator
+from ray_tpu.util.collective.types import ReduceOp, like_input, to_numpy
+
+
+class CpuGroup(Communicator):
+    def __init__(
+        self,
+        group_name: str,
+        world_size: int,
+        rank: int,
+        coordinator,  # ActorHandle of CollectiveCoordinator
+        timeout_s: float = 120.0,
+    ):
+        super().__init__(group_name, world_size, rank)
+        self._coord = coordinator
+        self._timeout = timeout_s
+        self._seq = 0
+        self._send_tags: dict[int, int] = {}
+        self._recv_tags: dict[int, int] = {}
+
+    @property
+    def backend(self) -> str:
+        return "cpu"
+
+    def _call(self, kind: str, payload, extra=None):
+        import ray_tpu
+
+        self._seq += 1
+        return ray_tpu.get(
+            self._coord.collective.remote(
+                kind, self._seq, self._rank, payload, extra
+            ),
+            timeout=self._timeout * 2,
+        )
+
+    def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        out = self._call("allreduce", to_numpy(tensor), {"op": ReduceOp(op)})
+        return like_input(tensor, out)
+
+    def barrier(self) -> None:
+        self._call("barrier", None)
+
+    def reduce(self, tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        out = self._call(
+            "reduce",
+            to_numpy(tensor),
+            {"op": ReduceOp(op), "dst_rank": int(dst_rank)},
+        )
+        return like_input(tensor, out) if out is not None else tensor
+
+    def broadcast(self, tensor, src_rank: int = 0):
+        out = self._call(
+            "broadcast", to_numpy(tensor), {"src_rank": int(src_rank)}
+        )
+        return like_input(tensor, out)
+
+    def allgather(self, tensor) -> List[Any]:
+        outs = self._call("allgather", to_numpy(tensor))
+        return [like_input(tensor, o) for o in outs]
+
+    def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
+        out = self._call("reducescatter", to_numpy(tensor), {"op": ReduceOp(op)})
+        return like_input(tensor, out)
+
+    def send(self, tensor, dst_rank: int) -> None:
+        import ray_tpu
+
+        tag = self._send_tags.get(dst_rank, 0)
+        self._send_tags[dst_rank] = tag + 1
+        ray_tpu.get(
+            self._coord.post.remote(
+                self._rank, int(dst_rank), tag, to_numpy(tensor)
+            ),
+            timeout=self._timeout,
+        )
+
+    def recv(self, src_rank: int):
+        import ray_tpu
+
+        tag = self._recv_tags.get(src_rank, 0)
+        self._recv_tags[src_rank] = tag + 1
+        return ray_tpu.get(
+            self._coord.take.remote(int(src_rank), self._rank, tag),
+            timeout=self._timeout * 2,
+        )

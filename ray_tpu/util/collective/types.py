@@ -1,0 +1,84 @@
+"""Collective types: backends, reduce ops, tensor helpers.
+
+Reference parity: python/ray/util/collective/types.py (Backend enum :34,
+ReduceOp, option dataclasses). The NCCL/GLOO backends are replaced by an
+XLA backend (device collectives compiled onto ICI/DCN) and a CPU backend
+(coordinator-actor data plane) for tests and host arrays.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+import numpy as np
+
+DEFAULT_GROUP_NAME = "default"
+DEFAULT_TIMEOUT_S = 120.0
+
+
+class Backend(str, enum.Enum):
+    """Available collective backends.
+
+    XLA: device collectives over a jax mesh (ICI within a slice, DCN across
+         slices); multi-controller rendezvous via the internal KV.
+    CPU: host-array collectives through a coordinator actor — the testable
+         stand-in, like the reference's gloo backend
+         (torch_gloo_collective_group.py).
+    """
+
+    XLA = "xla"
+    CPU = "cpu"
+
+    @classmethod
+    def parse(cls, value: "Backend | str") -> "Backend":
+        if isinstance(value, Backend):
+            return value
+        try:
+            return cls(str(value).lower())
+        except ValueError:
+            raise ValueError(
+                f"unknown collective backend {value!r}; "
+                f"available: {[b.value for b in cls]}"
+            ) from None
+
+
+class ReduceOp(str, enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_NUMPY_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+
+def numpy_reduce(arrays: list, op: "ReduceOp | str") -> np.ndarray:
+    return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(arrays, axis=0))
+
+
+def to_numpy(tensor: Any) -> np.ndarray:
+    """Host copy of a tensor (numpy / jax array / python scalar / list)."""
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    # jax arrays expose __array__; so do torch CPU tensors.
+    return np.asarray(tensor)
+
+
+def like_input(template: Any, value: np.ndarray):
+    """Return ``value`` in the array namespace of ``template``."""
+    mod = type(template).__module__
+    if mod.startswith("jax"):
+        import jax.numpy as jnp
+
+        return jnp.asarray(value)
+    if mod.startswith("torch"):
+        import torch
+
+        return torch.from_numpy(np.ascontiguousarray(value))
+    return value

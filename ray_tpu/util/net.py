@@ -1,0 +1,45 @@
+"""Network helpers shared by rendezvous paths (collective, train backend)."""
+
+from __future__ import annotations
+
+import os
+import socket
+
+
+def local_ip() -> str:
+    """Best-effort reachable IP of this host. RAY_TPU_HOST_IP wins (the
+    operator knows best on multi-host); then hostname resolution — rejecting
+    the Debian-style 127.0.1.1 mapping unless nothing better exists; then the
+    UDP-connect trick (which egress-less environments can route to a
+    blackhole, hence last)."""
+    override = os.environ.get("RAY_TPU_HOST_IP")
+    if override:
+        return override
+    host_ip = None
+    try:
+        host_ip = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        pass
+    if host_ip and not host_ip.startswith("127."):
+        return host_ip
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            ip = s.getsockname()[0]
+            # TEST-NET (192.0.2.0/24) means a blackhole default route.
+            if not ip.startswith("192.0.2."):
+                return ip
+        finally:
+            s.close()
+    except OSError:
+        pass
+    return host_ip or "127.0.0.1"
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
