@@ -1,0 +1,101 @@
+"""ray_tpu.data — distributed datasets with streaming execution.
+
+Reference parity: python/ray/data/ (read_* constructors, Dataset transforms,
+streaming executor, iter_batches). Blocks are pyarrow Tables flowing through
+the object store; the batch formats feed numpy (and torch) host batches to
+the TPU input pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.plan import DataPlan
+
+
+def _from_source(source, parallelism: int) -> Dataset:
+    if parallelism in (None, -1):
+        parallelism = DataContext.get_current().default_parallelism
+    return Dataset(DataPlan(read_tasks=source.get_read_tasks(parallelism)))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    from ray_tpu.data.datasource import RangeDatasource
+
+    return _from_source(RangeDatasource(n), parallelism)
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasource import ItemsDatasource
+
+    return _from_source(ItemsDatasource(items), parallelism)
+
+
+def from_numpy(arrays, column: str = "data") -> Dataset:
+    from ray_tpu.data.datasource import NumpyDatasource
+
+    return _from_source(NumpyDatasource(arrays, column), 1)
+
+
+def from_pandas(dfs) -> Dataset:
+    import pyarrow as pa
+
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    blocks = [pa.Table.from_pandas(df, preserve_index=False) for df in dfs]
+    return _from_source(BlocksDatasource(blocks), len(blocks))
+
+
+def from_arrow(tables) -> Dataset:
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _from_source(BlocksDatasource(tables), len(tables))
+
+
+def read_parquet(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import ParquetDatasource
+
+    return _from_source(ParquetDatasource(paths, **kwargs), parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import CSVDatasource
+
+    return _from_source(CSVDatasource(paths, **kwargs), parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    from ray_tpu.data.datasource import JSONDatasource
+
+    return _from_source(JSONDatasource(paths, **kwargs), parallelism)
+
+
+def read_datasource(source, *, parallelism: int = -1) -> Dataset:
+    return _from_source(source, parallelism)
+
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "DataContext",
+    "DataIterator",
+    "Dataset",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_parquet",
+]

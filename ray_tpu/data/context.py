@@ -1,0 +1,32 @@
+"""DataContext — per-process execution configuration.
+
+Reference parity: python/ray/data/context.py (DataContext.get_current with
+target block sizes, parallelism defaults).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DataContext:
+    default_parallelism: int = field(
+        default_factory=lambda: max(2, (os.cpu_count() or 1))
+    )
+    target_max_block_size: int = 128 * 1024 * 1024
+    max_in_flight_blocks: int = field(
+        default_factory=lambda: max(4, 2 * (os.cpu_count() or 1))
+    )
+
+    _local = threading.local()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        ctx = getattr(cls._local, "ctx", None)
+        if ctx is None:
+            ctx = cls()
+            cls._local.ctx = ctx
+        return ctx

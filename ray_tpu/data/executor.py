@@ -1,0 +1,249 @@
+"""Streaming executor — runs a DataPlan as a windowed task pipeline.
+
+Reference parity: python/ray/data/_internal/execution/streaming_executor.py:72
+(pull-based streaming with backpressure) in a compact form: each stage fuses
+its transform chain into one task per block; at most ``max_in_flight`` block
+tasks run at once, and new tasks are only submitted as the consumer drains
+outputs — blocks stream through the object store without ever materializing
+the whole dataset in one process. Barrier ops (repartition/shuffle/sort)
+materialize the stage boundary's refs.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, concat_blocks
+from ray_tpu.data.plan import (
+    DataPlan,
+    RandomShuffleOp,
+    RepartitionOp,
+    SortOp,
+    apply_chain_op,
+)
+
+
+def _default_in_flight() -> int:
+    from ray_tpu.data.context import DataContext
+
+    return DataContext.get_current().max_in_flight_blocks
+
+
+# -- remote task bodies ------------------------------------------------------
+
+
+def _run_chain(chain_payload: bytes, source, is_read_task: bool):
+    """One block through one fused stage. Returns (block, num_rows)."""
+    chain = cloudpickle.loads(chain_payload)
+    block = source() if is_read_task else source
+    for op in chain:
+        block = apply_chain_op(op, block)
+    return block, block.num_rows
+
+
+def _slice_rows(all_meta, start: int, end: int, *blocks):
+    """Rows [start, end) of the concatenation of ``blocks`` (used by
+    repartition). all_meta = row counts per block."""
+    out = []
+    offset = 0
+    for meta, block in zip(all_meta, blocks):
+        lo, hi = max(start - offset, 0), min(end - offset, meta)
+        if hi > lo:
+            out.append(BlockAccessor(block).slice(lo, hi))
+        offset += meta
+    return concat_blocks(out) if out else blocks[0].slice(0, 0)
+
+
+def _shuffle_split(block, n: int, seed):
+    rng = np.random.default_rng(seed)
+    nrows = block.num_rows
+    perm = rng.permutation(nrows)
+    targets = rng.integers(0, n, nrows)
+    acc = BlockAccessor(block)
+    parts = []
+    for j in range(n):
+        idx = perm[targets[perm] == j]
+        parts.append(block.take(idx) if len(idx) else block.slice(0, 0))
+    return tuple(parts) if n > 1 else parts[0]
+
+
+def _concat_task(*blocks):
+    block = concat_blocks(list(blocks))
+    return block, block.num_rows
+
+
+def _sort_task(key: str, descending: bool, *blocks):
+    block = concat_blocks(list(blocks))
+    order = "descending" if descending else "ascending"
+    block = block.sort_by([(key, order)])
+    return block, block.num_rows
+
+
+def _trim_task(block, n: int):
+    out = BlockAccessor(block).slice(0, n)
+    return out, out.num_rows
+
+
+class StreamingExecutor:
+    def __init__(
+        self,
+        plan: DataPlan,
+        max_in_flight: Optional[int] = None,
+        shard: Optional[tuple] = None,  # (world, rank) over final-stage blocks
+        limit: Optional[int] = None,
+    ):
+        self._plan = plan
+        self._window = max_in_flight or _default_in_flight()
+        self._shard = shard
+        self._limit = limit
+
+    # Each yielded item is (block_ref, num_rows).
+    def iter_blocks(self) -> Iterator[tuple]:
+        stages = self._plan.stages()
+        # Sources for stage 0.
+        if self._plan.read_tasks is not None:
+            sources = list(self._plan.read_tasks)
+            is_read = True
+        else:
+            sources = list(self._plan.input_refs)
+            is_read = False
+
+        for i, stage in enumerate(stages):
+            final = i == len(stages) - 1
+            if stage.barrier is not None:
+                sources = self._apply_barrier(stage.barrier, sources)
+                is_read = False
+            if final:
+                if self._shard is not None and len(sources) < self._shard[0]:
+                    # Fewer blocks than shards: a block-granular shard would
+                    # starve most ranks (and deadlock their collectives).
+                    # Run the stage, split rows evenly, then shard.
+                    refs = [
+                        ref
+                        for ref, _ in self._stream_stage(
+                            stage.chain, sources, is_read,
+                            apply_shard_and_limit=False,
+                        )
+                    ]
+                    sources = self._apply_barrier(
+                        RepartitionOp(self._shard[0]), refs
+                    )
+                    yield from self._stream_stage(
+                        [], sources, False, apply_shard_and_limit=True
+                    )
+                    return
+                yield from self._stream_stage(
+                    stage.chain, sources, is_read, apply_shard_and_limit=True
+                )
+                return
+            # Interior stage before a barrier: run it fully (the barrier
+            # needs every block anyway), windowed.
+            sources = [
+                ref
+                for ref, _ in self._stream_stage(
+                    stage.chain, sources, is_read, apply_shard_and_limit=False
+                )
+            ]
+            is_read = False
+
+    def _stream_stage(self, chain, sources, is_read, apply_shard_and_limit):
+        remote_chain = ray_tpu.remote(_run_chain)
+        payload = cloudpickle.dumps(chain)
+        if apply_shard_and_limit and self._shard is not None:
+            world, rank = self._shard
+            sources = [s for j, s in enumerate(sources) if j % world == rank]
+        pending: list = []  # [(block_ref, meta_ref)] in submission order
+        produced_rows = 0
+        src_iter = iter(sources)
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < self._window:
+                try:
+                    src = next(src_iter)
+                except StopIteration:
+                    exhausted = True
+                    break
+                block_ref, meta_ref = remote_chain.options(
+                    num_returns=2
+                ).remote(payload, src, is_read)
+                pending.append((block_ref, meta_ref))
+            if not pending:
+                return
+            block_ref, meta_ref = pending.pop(0)
+            num_rows = ray_tpu.get(meta_ref)
+            if (
+                apply_shard_and_limit
+                and self._limit is not None
+                and produced_rows + num_rows > self._limit
+            ):
+                keep = self._limit - produced_rows
+                trim = ray_tpu.remote(_trim_task)
+                block_ref, meta_ref = trim.options(num_returns=2).remote(
+                    block_ref, keep
+                )
+                yield block_ref, keep
+                return
+            produced_rows += num_rows
+            yield block_ref, num_rows
+            if (
+                apply_shard_and_limit
+                and self._limit is not None
+                and produced_rows >= self._limit
+            ):
+                return
+
+    # -- barriers ------------------------------------------------------------
+
+    def _apply_barrier(self, op, sources) -> list:
+        """sources: block refs (interior stages always materialize to refs).
+        Returns new list of block refs."""
+        refs = list(sources)
+        if isinstance(op, RepartitionOp):
+            rows = ray_tpu.remote(_block_rows)
+            metas = ray_tpu.get([rows.remote(r) for r in refs])
+            total = sum(metas)
+            n = max(1, op.num_blocks)
+            step = -(-total // n) if total else 0
+            out = []
+            sl = ray_tpu.remote(_slice_rows)
+            for j in range(n):
+                start, end = j * step, min((j + 1) * step, total)
+                out.append(sl.remote(metas, start, end, *refs))
+            return out
+        if isinstance(op, RandomShuffleOp):
+            n = len(refs)
+            split = ray_tpu.remote(_shuffle_split)
+            parts = [
+                split.options(num_returns=n).remote(
+                    r,
+                    n,
+                    None if op.seed is None else op.seed + i,
+                )
+                for i, r in enumerate(refs)
+            ]
+            if n == 1:
+                return [parts[0]] if not isinstance(parts[0], list) else parts[0]
+            concat = ray_tpu.remote(_concat_blocks_only)
+            return [
+                concat.remote(*[parts[i][j] for i in range(n)])
+                for j in range(n)
+            ]
+        if isinstance(op, SortOp):
+            srt = ray_tpu.remote(_sort_task)
+            block_ref, _ = srt.options(num_returns=2).remote(
+                op.key, op.descending, *refs
+            )
+            return [block_ref]
+        raise TypeError(f"unknown barrier {op}")
+
+
+def _block_rows(block):
+    return block.num_rows
+
+
+def _concat_blocks_only(*blocks):
+    return concat_blocks(list(blocks))

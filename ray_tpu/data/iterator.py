@@ -1,0 +1,66 @@
+"""Batch iteration: re-batching a block stream to a fixed batch size.
+
+Reference parity: python/ray/data/iterator.py (iter_batches /
+iter_torch_batches; DataIterator returned by streaming_split).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from ray_tpu.data.block import BlockAccessor, concat_blocks
+
+
+def iter_batches_from_blocks(
+    blocks,
+    *,
+    batch_size: Optional[int] = 256,
+    batch_format: str = "numpy",
+    drop_last: bool = False,
+) -> Iterator:
+    """Slice a stream of blocks into uniform batches, carrying remainders
+    across block boundaries."""
+    carry = None
+    for block in blocks:
+        if block.num_rows == 0:
+            continue
+        if carry is not None:
+            block = concat_blocks([carry, block])
+            carry = None
+        if batch_size is None:
+            yield BlockAccessor(block).to_batch(batch_format)
+            continue
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        start = 0
+        while n - start >= batch_size:
+            yield BlockAccessor(
+                acc.slice(start, start + batch_size)
+            ).to_batch(batch_format)
+            start += batch_size
+        if start < n:
+            carry = acc.slice(start, n)
+    if carry is not None and not drop_last:
+        yield BlockAccessor(carry).to_batch(batch_format)
+
+
+class DataIterator:
+    """One consumer's view of a (sharded) dataset."""
+
+    def __init__(self, dataset):
+        self._dataset = dataset
+
+    def iter_batches(self, **kwargs) -> Iterator:
+        return self._dataset.iter_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[dict]:
+        return self._dataset.iter_rows()
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[dict]:
+        return self._dataset.iter_torch_batches(**kwargs)
+
+    def count(self) -> int:
+        return self._dataset.count()
+
+    def materialize(self):
+        return self._dataset.materialize()

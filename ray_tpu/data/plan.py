@@ -1,0 +1,170 @@
+"""Logical plan: operator list + fusion into physical stages.
+
+Reference parity: python/ray/data/_internal/logical/ (logical operators) and
+_internal/planner/ (lowering). The optimizer here does the one transformation
+that dominates performance: fusing consecutive per-block transforms into a
+single task per block, so a read→map→filter chain costs one task round-trip
+per block instead of three. Barrier ops (repartition / shuffle / sort) cut
+the chain into stages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks, rows_to_block
+
+
+# -- logical ops -------------------------------------------------------------
+
+
+@dataclass
+class MapBatchesOp:
+    fn: Callable
+    batch_size: Optional[int] = None  # None = whole block
+    batch_format: str = "numpy"
+    fn_kwargs: dict = field(default_factory=dict)
+
+
+@dataclass
+class MapRowsOp:
+    fn: Callable
+
+
+@dataclass
+class FlatMapOp:
+    fn: Callable
+
+
+@dataclass
+class FilterOp:
+    fn: Callable
+
+
+@dataclass
+class AddColumnOp:
+    name: str
+    fn: Callable  # batch(dict of np arrays) -> np array
+
+
+@dataclass
+class DropColumnsOp:
+    cols: list
+
+
+@dataclass
+class SelectColumnsOp:
+    cols: list
+
+
+@dataclass
+class RenameColumnsOp:
+    mapping: dict
+
+
+@dataclass
+class RepartitionOp:  # barrier
+    num_blocks: int
+
+
+@dataclass
+class RandomShuffleOp:  # barrier
+    seed: Optional[int] = None
+
+
+@dataclass
+class SortOp:  # barrier
+    key: str
+    descending: bool = False
+
+
+BARRIER_OPS = (RepartitionOp, RandomShuffleOp, SortOp)
+CHAIN_OPS = (
+    MapBatchesOp,
+    MapRowsOp,
+    FlatMapOp,
+    FilterOp,
+    AddColumnOp,
+    DropColumnsOp,
+    SelectColumnsOp,
+    RenameColumnsOp,
+)
+
+
+def apply_chain_op(op, block: Block) -> Block:
+    acc = BlockAccessor(block)
+    if isinstance(op, MapBatchesOp):
+        out_blocks = []
+        n = acc.num_rows()
+        size = op.batch_size or max(n, 1)
+        for start in range(0, max(n, 1), size):
+            sub = acc.slice(start, min(start + size, n)) if n else block
+            batch = BlockAccessor(sub).to_batch(op.batch_format)
+            result = op.fn(batch, **op.fn_kwargs)
+            out_blocks.append(BlockAccessor.batch_to_block(result))
+            if n == 0:
+                break
+        return concat_blocks(out_blocks)
+    if isinstance(op, MapRowsOp):
+        return rows_to_block([op.fn(r) for r in acc.iter_rows()])
+    if isinstance(op, FlatMapOp):
+        out = []
+        for r in acc.iter_rows():
+            out.extend(op.fn(r))
+        return rows_to_block(out)
+    if isinstance(op, FilterOp):
+        return rows_to_block([r for r in acc.iter_rows() if op.fn(r)])
+    if isinstance(op, AddColumnOp):
+        batch = acc.to_numpy_batch()
+        col = op.fn(batch)
+        from ray_tpu.data.block import _column_to_arrow
+
+        return block.append_column(op.name, _column_to_arrow(col))
+    if isinstance(op, DropColumnsOp):
+        return block.drop_columns(op.cols)
+    if isinstance(op, SelectColumnsOp):
+        return block.select(op.cols)
+    if isinstance(op, RenameColumnsOp):
+        names = [op.mapping.get(n, n) for n in block.column_names]
+        return block.rename_columns(names)
+    raise TypeError(f"not a chain op: {op}")
+
+
+# -- physical plan -----------------------------------------------------------
+
+
+@dataclass
+class Stage:
+    """A fused pipeline stage: per-input chain of transforms, preceded by an
+    optional barrier op that redistributes the previous stage's blocks."""
+
+    barrier: Optional[Any]  # None for the first stage
+    chain: list  # CHAIN_OPS applied per block
+
+
+@dataclass
+class DataPlan:
+    """Input (read tasks OR in-flight block refs) + logical op list."""
+
+    read_tasks: Optional[list] = None
+    input_refs: Optional[list] = None
+    ops: list = field(default_factory=list)
+
+    def with_op(self, op) -> "DataPlan":
+        return DataPlan(
+            read_tasks=self.read_tasks,
+            input_refs=self.input_refs,
+            ops=[*self.ops, op],
+        )
+
+    def stages(self) -> list[Stage]:
+        stages = [Stage(barrier=None, chain=[])]
+        for op in self.ops:
+            if isinstance(op, BARRIER_OPS):
+                stages.append(Stage(barrier=op, chain=[]))
+            elif isinstance(op, CHAIN_OPS):
+                stages[-1].chain.append(op)
+            else:
+                raise TypeError(f"unknown op {op}")
+        return stages

@@ -3,8 +3,25 @@
 The SPMD step machinery (:mod:`ray_tpu.train.spmd`) is importable without the
 cluster runtime; the trainer/controller/worker-group stack builds on
 :mod:`ray_tpu.core`.
+
+Reference parity: python/ray/train/ (v2 API surface — Checkpoint, report,
+get_context, ScalingConfig/RunConfig/FailureConfig/CheckpointConfig,
+DataParallelTrainer, JaxTrainer, Result).
 """
 
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from ray_tpu.train.spmd import (
     TrainState,
     make_train_state,
@@ -13,8 +30,40 @@ from ray_tpu.train.spmd import (
 )
 
 __all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
     "TrainState",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
     "make_train_state",
     "make_train_step",
+    "report",
     "state_shardings",
+    # lazy (import the runtime stack only when asked for)
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "JaxConfig",
+    "Result",
+    "TrainingFailedError",
 ]
+
+_LAZY = {
+    "DataParallelTrainer": "ray_tpu.train.trainer",
+    "JaxTrainer": "ray_tpu.train.trainer",
+    "Result": "ray_tpu.train.controller",
+    "TrainingFailedError": "ray_tpu.train.controller",
+    "JaxConfig": "ray_tpu.train.jax_backend",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'ray_tpu.train' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,21 @@
+"""Backend ABC — per-framework worker-group setup hooks.
+
+Reference parity: python/ray/train/backend.py (Backend/BackendConfig) —
+on_start wires up the framework's distributed runtime across the worker
+group before the train loop runs.
+"""
+
+from __future__ import annotations
+
+
+class BackendConfig:
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config) -> None:
+        pass
+
+    def on_shutdown(self, worker_group, backend_config) -> None:
+        pass

@@ -1,0 +1,73 @@
+"""Train-tier user configs.
+
+Reference parity: python/ray/train/v2/api/config.py (ScalingConfig,
+RunConfig, FailureConfig, CheckpointConfig) with the TPU fields of the
+JaxTrainer path (use_tpu/topology/num_slices — reference
+train/v2/jax/jax_trainer.py:19 and worker_group.py:467-484).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one reserves.
+
+    With ``use_tpu`` and a ``topology``, the worker group reserves whole TPU
+    slices through SlicePlacementGroup and derives num_workers/resources from
+    the slice shape (one worker per host by default) — the slice is the
+    scheduling unit, not the chip.
+    """
+
+    num_workers: Optional[int] = None
+    resources_per_worker: Optional[dict] = None
+    use_tpu: bool = False
+    topology: Optional[str] = None
+    accelerator_version: str = "v4"
+    num_slices: int = 1
+    placement_strategy: str = "PACK"
+
+    def __post_init__(self):
+        if not self.use_tpu and self.num_workers is None:
+            raise ValueError("num_workers is required when use_tpu=False")
+        if self.use_tpu and not self.topology and self.num_workers is None:
+            raise ValueError("use_tpu needs a topology (or num_workers)")
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: worker-group rebuilds before giving up (-1 = unlimited).
+    Reference: train/v2/_internal/execution/failure_handling/."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """num_to_keep: retain the N most recent persisted checkpoints
+    (None = all)."""
+
+    num_to_keep: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig
+    )
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig
+    )
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.environ.get(
+                "RAY_TPU_STORAGE_PATH",
+                os.path.expanduser("~/ray_tpu_results"),
+            )

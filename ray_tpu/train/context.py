@@ -1,0 +1,122 @@
+"""Per-worker train context and the ray_tpu.train.report() API.
+
+Reference parity: python/ray/train/v2/api/train_fn_utils.py (report/
+get_context/get_checkpoint) and the TrainContext of
+train/v2/_internal/execution/context.py. The context is installed by the
+TrainWorker before the user's train loop runs on its thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.storage import StorageContext
+
+_ctx_local = threading.local()
+
+
+@dataclass
+class TrainContext:
+    experiment_name: str
+    world_size: int
+    world_rank: int
+    local_rank: int
+    local_world_size: int
+    node_rank: int
+    storage: Optional[StorageContext] = None
+    latest_checkpoint: Optional[Checkpoint] = None
+    # reports buffered here; the controller polls them off the worker
+    _reports: list = field(default_factory=list)
+    _report_index: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    # -- user API ------------------------------------------------------------
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.latest_checkpoint
+
+    def report(
+        self,
+        metrics: dict,
+        checkpoint: Optional[Checkpoint] = None,
+    ) -> None:
+        with self._lock:
+            index = self._report_index
+            self._report_index += 1
+        # Persist OUTSIDE the lock: a multi-GB copytree must not block the
+        # controller's status() polls (it would read as a dead worker).
+        persisted = None
+        if checkpoint is not None and self.storage is not None:
+            persisted = self.storage.persist_checkpoint(checkpoint, index)
+        with self._lock:
+            if persisted is not None:
+                self.latest_checkpoint = persisted
+            self._reports.append(
+                {
+                    "index": index,
+                    "metrics": dict(metrics),
+                    "checkpoint_path": persisted.path if persisted else None,
+                    "world_rank": self.world_rank,
+                }
+            )
+
+    def drain_reports(self) -> list:
+        with self._lock:
+            out, self._reports = self._reports, []
+            return out
+
+
+def set_context(ctx: Optional[TrainContext]) -> None:
+    _ctx_local.ctx = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_ctx_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError(
+            "ray_tpu.train.get_context() called outside a train worker"
+        )
+    return ctx
+
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ optional checkpoint) from the train loop
+    (reference: ray.train.report)."""
+    get_context().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the trainer via
+    ``datasets=`` (reference: ray.train.get_dataset_shard)."""
+    shards = getattr(get_context(), "dataset_shards", None)
+    if not shards or name not in shards:
+        raise KeyError(
+            f"no dataset shard named {name!r}; pass datasets={{'{name}': ds}} "
+            f"to the trainer"
+        )
+    return shards[name]

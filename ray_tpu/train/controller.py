@@ -1,0 +1,187 @@
+"""TrainController — drives the worker group through the run state machine.
+
+Reference parity: python/ray/train/v2/_internal/execution/controller/
+controller.py:103 (TrainController; async run loop :542 with
+INITIALIZING→SCHEDULING→RUNNING→[RESTARTING|ERRORED|FINISHED] transitions,
+ScalingPolicy/FailurePolicy). Here the loop runs in the fit() process and
+polls worker status; a worker failure tears the group down and rebuilds it,
+resuming from the latest persisted checkpoint, until FailureConfig.
+max_failures is exhausted.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train.backend import BackendConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.storage import StorageContext
+from ray_tpu.train.worker_group import WorkerGroup
+
+INITIALIZING = "INITIALIZING"
+SCHEDULING = "SCHEDULING"
+RUNNING = "RUNNING"
+RESTARTING = "RESTARTING"
+ERRORED = "ERRORED"
+FINISHED = "FINISHED"
+
+POLL_INTERVAL_S = 0.2
+
+
+@dataclass
+class Result:
+    """What fit() returns (reference: ray.train.Result)."""
+
+    metrics: Optional[dict]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[Exception] = None
+    metrics_history: list = field(default_factory=list)
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class TrainController:
+    def __init__(
+        self,
+        train_fn: Callable,
+        train_loop_config: Optional[dict],
+        scaling_config: ScalingConfig,
+        run_config: RunConfig,
+        backend_config: BackendConfig,
+    ):
+        # Keep the callable itself alive too: the closure may be the only
+        # holder of ObjectRefs (e.g. materialized dataset blocks) — dropping
+        # it after pickling would let the driver free those objects while
+        # workers still need them.
+        self._train_fn = train_fn
+        self._fn_payload = cloudpickle.dumps(train_fn)
+        self._config = train_loop_config
+        self._scaling = scaling_config
+        self._run = run_config
+        self._backend_config = backend_config
+        self._backend = backend_config.backend_cls()()
+        self._state = INITIALIZING
+        self._experiment = run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        # Controller-side storage view (workers persist; we resolve latest).
+        self._storage = StorageContext(run_config.storage_path, self._experiment)
+        self._metrics_history: list[dict] = []
+        self._latest_metrics: Optional[dict] = None
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def run(self) -> Result:
+        max_failures = self._run.failure_config.max_failures
+        failures = 0
+        last_error: Optional[str] = None
+        while True:
+            self._state = SCHEDULING
+            # Group build and backend bootstrap failures count against the
+            # failure policy too (transient resource shortages / rendezvous
+            # hiccups during a restart must not abort a retryable run).
+            group = None
+            try:
+                group = WorkerGroup.create(self._scaling)
+                self._backend.on_start(group, self._backend_config)
+                outcome, error = self._run_once(group)
+            except Exception as e:  # noqa: BLE001
+                outcome, error = "failed", f"{type(e).__name__}: {e}"
+            finally:
+                if group is not None:
+                    try:
+                        self._backend.on_shutdown(group, self._backend_config)
+                    finally:
+                        group.shutdown()
+            if outcome == "finished":
+                self._state = FINISHED
+                return Result(
+                    metrics=self._latest_metrics,
+                    checkpoint=self._storage.latest_checkpoint(),
+                    path=self._storage.experiment_dir,
+                    metrics_history=self._metrics_history,
+                )
+            last_error = error
+            failures += 1
+            if max_failures != -1 and failures > max_failures:
+                self._state = ERRORED
+                return Result(
+                    metrics=self._latest_metrics,
+                    checkpoint=self._storage.latest_checkpoint(),
+                    path=self._storage.experiment_dir,
+                    error=TrainingFailedError(
+                        f"training failed after {failures} failure(s); "
+                        f"last error:\n{error}"
+                    ),
+                    metrics_history=self._metrics_history,
+                )
+            self._state = RESTARTING
+
+    def _run_once(self, group: WorkerGroup) -> tuple[str, Optional[str]]:
+        """One worker-group generation. Returns ("finished", None) or
+        ("failed", error)."""
+        latest = self._storage.latest_checkpoint()
+        start_index = 0
+        if latest is not None:
+            # .../checkpoint_000004 → next report index is 5.
+            start_index = int(latest.path.rsplit("_", 1)[-1]) + 1
+        specs = group.context_specs(
+            self._experiment,
+            self._run.storage_path,
+            num_to_keep=self._run.checkpoint_config.num_to_keep,
+        )
+        for spec in specs:
+            spec["start_report_index"] = start_index
+        start_refs = [
+            w.actor.start_run.remote(
+                self._fn_payload,
+                self._config,
+                spec,
+                latest.path if latest else None,
+            )
+            for w, spec in zip(group.workers, specs)
+        ]
+        try:
+            ray_tpu.get(start_refs, timeout=120)
+        except Exception as e:  # noqa: BLE001
+            return "failed", f"worker start failed: {e!r}"
+        self._state = RUNNING
+        done = [False] * len(group)
+        while True:
+            try:
+                statuses = ray_tpu.get(
+                    [
+                        w.actor.status.remote()
+                        for i, w in enumerate(group.workers)
+                        if not done[i]
+                    ],
+                    timeout=60,
+                )
+            except Exception as e:  # noqa: BLE001
+                return "failed", f"lost contact with workers: {e!r}"
+            live = [i for i in range(len(group)) if not done[i]]
+            for i, st in zip(live, statuses):
+                for rep in st["reports"]:
+                    self._record_report(rep)
+                if st["state"] == "failed":
+                    return "failed", st["error"]
+                if st["state"] == "finished":
+                    done[i] = True
+            if all(done):
+                return "finished", None
+            time.sleep(POLL_INTERVAL_S)
+
+    def _record_report(self, rep: dict) -> None:
+        if rep["world_rank"] == 0:
+            self._latest_metrics = rep["metrics"]
+            self._metrics_history.append(rep["metrics"])

@@ -1,0 +1,79 @@
+"""StorageContext — where a run's checkpoints and artifacts persist.
+
+Reference parity: python/ray/train/v2/_internal/execution/storage.py (and
+legacy train/_internal/storage.py:358). Round 1: local/NFS paths with
+atomic-rename persistence; the same interface takes a pyarrow.fs for cloud
+backends.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import uuid
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class StorageContext:
+    def __init__(
+        self,
+        storage_path: str,
+        experiment_name: str | None = None,
+        num_to_keep: int | None = None,
+    ):
+        self.storage_path = os.path.abspath(os.path.expanduser(storage_path))
+        self.experiment_name = experiment_name or (
+            f"run_{time.strftime('%Y%m%d-%H%M%S')}_{uuid.uuid4().hex[:6]}"
+        )
+        self.num_to_keep = num_to_keep
+        self.experiment_dir = os.path.join(
+            self.storage_path, self.experiment_name
+        )
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        self._persisted: list[tuple[int, str]] = []
+
+    def checkpoint_dir(self, index: int) -> str:
+        return os.path.join(self.experiment_dir, f"checkpoint_{index:06d}")
+
+    def persist_checkpoint(self, local: Checkpoint, index: int) -> Checkpoint:
+        """Copy a worker-local checkpoint into the run dir (write to a temp
+        sibling, rename into place so readers never see partial state)."""
+        final = self.checkpoint_dir(index)
+        if os.path.exists(final):  # another rank already persisted this step
+            return Checkpoint(final)
+        tmp = final + f".tmp_{uuid.uuid4().hex[:6]}"
+        shutil.copytree(local.path, tmp)
+        try:
+            os.rename(tmp, final)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.exists(final):
+                raise
+        self._persisted.append((index, final))
+        self._apply_retention()
+        return Checkpoint(final)
+
+    def _apply_retention(self) -> None:
+        if self.num_to_keep is None:
+            return
+        while len(self._persisted) > self.num_to_keep:
+            _, path = self._persisted.pop(0)
+            shutil.rmtree(path, ignore_errors=True)
+
+    def latest_checkpoint(self) -> Checkpoint | None:
+        import re
+
+        # Only complete checkpoints: rename is atomic, so anything matching
+        # the final name pattern is whole (tmp dirs carry a .tmp_ suffix).
+        pat = re.compile(r"^checkpoint_\d{6}$")
+        dirs = sorted(
+            d
+            for d in os.listdir(self.experiment_dir)
+            if pat.match(d)
+            and os.path.isdir(os.path.join(self.experiment_dir, d))
+        )
+        if not dirs:
+            return None
+        return Checkpoint(os.path.join(self.experiment_dir, dirs[-1]))

@@ -1,0 +1,107 @@
+"""Trainers — the user-facing entry points of the train tier.
+
+Reference parity: python/ray/train/v2/api/data_parallel_trainer.py
+(DataParallelTrainer, fit :154) and python/ray/train/v2/jax/jax_trainer.py:19
+(JaxTrainer). The accelerator data plane inside the train loop is the user's
+jitted JAX program (SPMD over a mesh — see ray_tpu.train.spmd); the trainer
+does placement, process bootstrap, health/failure handling, and
+checkpoint/report plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.controller import Result, TrainController, TrainingFailedError
+from ray_tpu.train.jax_backend import JaxConfig
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[dict] = None,
+    ):
+        self._train_fn = train_loop_per_worker
+        self._train_loop_config = train_loop_config
+        self._backend_config = backend_config or BackendConfig()
+        self._scaling_config = scaling_config or ScalingConfig(num_workers=1)
+        self._run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+
+    def fit(self) -> Result:
+        """Run to completion; raises TrainingFailedError on unrecovered
+        failure (after FailureConfig.max_failures group rebuilds)."""
+        controller = TrainController(
+            self._wrapped_train_fn(),
+            self._train_loop_config,
+            self._scaling_config,
+            self._run_config,
+            self._backend_config,
+        )
+        result = controller.run()
+        if result.error is not None:
+            raise result.error
+        return result
+
+    def _wrapped_train_fn(self):
+        train_fn = self._train_fn
+        if not self._datasets:
+            return train_fn
+        # Materialize to object refs before closure capture: the train fn is
+        # cloudpickled to every worker, and in-memory datasets (from_numpy /
+        # from_pandas) would otherwise ship N full copies of the data through
+        # the actor-call path instead of block refs through the object store.
+        datasets = {
+            name: ds.materialize() for name, ds in self._datasets.items()
+        }
+
+        from ray_tpu.train.context import get_context
+
+        def with_datasets(*maybe_config):
+            # Per-worker dataset shards land in the context before the loop
+            # (reference: streaming_split feeding RayTrainWorkers).
+            from ray_tpu.data.iterator import DataIterator
+
+            ctx = get_context()
+            ctx.dataset_shards = {
+                name: DataIterator(
+                    ds.shard(ctx.get_world_size(), ctx.get_world_rank())
+                )
+                for name, ds in datasets.items()
+            }
+            return train_fn(*maybe_config)
+
+        return with_datasets
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer whose backend forms one multi-controller JAX
+    runtime over the group (reference: train/v2/jax/jax_trainer.py:19)."""
+
+    def __init__(self, train_loop_per_worker, **kwargs):
+        scaling = kwargs.get("scaling_config")
+        backend = kwargs.pop("jax_config", None) or kwargs.pop(
+            "backend_config", None
+        )
+        if backend is None:
+            backend = JaxConfig(
+                num_slices=getattr(scaling, "num_slices", 1) if scaling else 1
+            )
+        kwargs["backend_config"] = backend
+        super().__init__(train_loop_per_worker, **kwargs)
+
+
+__all__ = [
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "Result",
+    "TrainingFailedError",
+]

@@ -1,0 +1,324 @@
+"""WorkerGroup — the gang of train-worker actors.
+
+Reference parity: python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py — TPU-aware creation (reserves slices via
+SlicePlacementGroup :467-484) and the stable rank assignment that sorts
+workers by (slice name, host worker id) so jax process indices are
+deterministic across restarts (:791-825) — getting this wrong deadlocks ICI
+collectives.
+
+The user train fn runs on a thread inside each worker actor; the controller
+polls `status()` (actor calls from one caller are ordered, so a blocking
+`run()` method would starve the polls).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.accelerators.tpu import TPU_SLICE_NAME_LABEL, TPU_WORKER_ID_LABEL
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.context import TrainContext, set_context
+from ray_tpu.train.storage import StorageContext
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """One training process. Runs the user fn on a private thread."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._state = "idle"  # idle | running | finished | failed
+        self._error: Optional[str] = None
+        self._ctx: Optional[TrainContext] = None
+
+    # -- metadata / env ------------------------------------------------------
+
+    def get_metadata(self) -> dict:
+        from ray_tpu.util.net import local_ip
+
+        rtc = ray_tpu.get_runtime_context()
+        node_id = rtc.node_id
+        labels = {}
+        for n in ray_tpu.nodes():
+            if n["NodeID"] == node_id:
+                labels = n.get("Labels", {})
+                break
+        return {
+            "node_id": node_id,
+            "slice_name": labels.get(TPU_SLICE_NAME_LABEL, ""),
+            "tpu_worker_id": int(labels.get(TPU_WORKER_ID_LABEL, -1)),
+            "hostname": socket.gethostname(),
+            "ip": local_ip(),
+        }
+
+    def free_port(self) -> int:
+        from ray_tpu.util.net import free_port
+
+        return free_port()
+
+    def set_env(self, env: dict) -> bool:
+        import os
+
+        os.environ.update({k: str(v) for k, v in env.items()})
+        return True
+
+    def execute(self, fn_payload: bytes, *args, **kwargs):
+        """Run an arbitrary function in this worker process (backend setup
+        hook: jax.distributed.initialize etc.)."""
+        fn = cloudpickle.loads(fn_payload)
+        return fn(*args, **kwargs)
+
+    # -- train loop ----------------------------------------------------------
+
+    def start_run(
+        self,
+        fn_payload: bytes,
+        config: Optional[dict],
+        context_spec: dict,
+        latest_checkpoint_path: Optional[str],
+    ) -> bool:
+        if self._state == "running":
+            raise RuntimeError("already running")
+        storage = StorageContext(
+            context_spec["storage_path"],
+            context_spec["experiment_name"],
+            num_to_keep=context_spec.get("num_to_keep"),
+        )
+        self._ctx = TrainContext(
+            experiment_name=context_spec["experiment_name"],
+            world_size=context_spec["world_size"],
+            world_rank=context_spec["world_rank"],
+            local_rank=context_spec["local_rank"],
+            local_world_size=context_spec["local_world_size"],
+            node_rank=context_spec["node_rank"],
+            storage=storage,
+            latest_checkpoint=(
+                Checkpoint(latest_checkpoint_path)
+                if latest_checkpoint_path
+                else None
+            ),
+            # Resume numbering after the last persisted checkpoint: a fresh
+            # generation restarting at index 0 would collide with generation-1
+            # directories and silently keep stale state.
+            _report_index=context_spec.get("start_report_index", 0),
+        )
+        fn = cloudpickle.loads(fn_payload)
+        takes_config = config is not None
+        self._state = "running"
+        self._error = None
+
+        def run():
+            set_context(self._ctx)
+            try:
+                if takes_config:
+                    fn(config)
+                else:
+                    fn()
+                self._state = "finished"
+            except BaseException as e:  # noqa: BLE001
+                self._error = (
+                    f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+                )
+                self._state = "failed"
+            finally:
+                set_context(None)
+
+        self._thread = threading.Thread(
+            target=run, name="train-loop", daemon=True
+        )
+        self._thread.start()
+        return True
+
+    def status(self) -> dict:
+        reports = self._ctx.drain_reports() if self._ctx else []
+        return {
+            "state": self._state,
+            "error": self._error,
+            "reports": reports,
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+@dataclass
+class WorkerInfo:
+    actor: Any
+    metadata: dict
+    world_rank: int
+
+
+class WorkerGroup:
+    """Creates, ranks, and tears down the gang of TrainWorker actors."""
+
+    def __init__(self, workers: list, slice_pg=None, pg=None):
+        self.workers = workers  # rank-ordered WorkerInfo
+        self._slice_pg = slice_pg
+        self._pg = pg
+
+    @classmethod
+    def create(cls, scaling: ScalingConfig, timeout: float = 120.0):
+        slice_pg = None
+        pg = None
+        if scaling.use_tpu and scaling.topology:
+            from ray_tpu.accelerators.tpu import valid_pod_type
+            from ray_tpu.util.tpu import SlicePlacementGroup
+
+            # topology accepts both forms users have in hand: a mesh shape
+            # ("2x2x2") or a pod type ("v4-16").
+            if valid_pod_type(scaling.topology):
+                kw = {"pod_type": scaling.topology}
+            else:
+                kw = {
+                    "topology": scaling.topology,
+                    "accelerator_version": scaling.accelerator_version,
+                }
+            slice_pg = SlicePlacementGroup(
+                num_slices=scaling.num_slices, timeout=timeout, **kw
+            )
+            pg = slice_pg.placement_group
+            n = slice_pg.num_bundles
+            resources = dict(
+                scaling.resources_per_worker
+                or {"TPU": float(slice_pg.chips_per_host)}
+            )
+            actors = [
+                TrainWorker.options(
+                    num_cpus=0,
+                    resources=resources,
+                    placement_group=pg,
+                    placement_group_bundle_index=i,
+                ).remote()
+                for i in range(n)
+            ]
+        else:
+            n = scaling.num_workers
+            resources = dict(scaling.resources_per_worker or {})
+            num_cpus = resources.pop("CPU", 1)
+            bundle = {**resources, "CPU": num_cpus}
+            from ray_tpu.util.placement_group import placement_group
+
+            pg = placement_group(
+                [dict(bundle) for _ in range(n)],
+                strategy=scaling.placement_strategy,
+            )
+            if not pg.wait(timeout):
+                from ray_tpu.util.placement_group import (
+                    remove_placement_group,
+                )
+
+                remove_placement_group(pg)
+                raise TimeoutError(
+                    f"worker placement group ({n} x {bundle}, "
+                    f"{scaling.placement_strategy}) not ready in {timeout}s"
+                )
+            actors = [
+                TrainWorker.options(
+                    num_cpus=num_cpus,
+                    resources=resources,
+                    placement_group=pg,
+                    placement_group_bundle_index=i,
+                ).remote()
+                for i in range(n)
+            ]
+        try:
+            metas = ray_tpu.get(
+                [a.get_metadata.remote() for a in actors], timeout=timeout
+            )
+        except Exception:
+            # Don't leak the gang: a failed/slow worker must release the
+            # slice/PG resources or every controller retry times out on them.
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+            if slice_pg is not None:
+                slice_pg.shutdown()
+            elif pg is not None:
+                from ray_tpu.util.placement_group import (
+                    remove_placement_group,
+                )
+
+                remove_placement_group(pg)
+            raise
+        # Stable global ranks: sort by (slice name, in-slice worker id,
+        # node id) — reference worker_group.py:791-825.
+        order = sorted(
+            range(n),
+            key=lambda i: (
+                metas[i]["slice_name"],
+                metas[i]["tpu_worker_id"],
+                metas[i]["node_id"],
+            ),
+        )
+        infos = [
+            WorkerInfo(actor=actors[i], metadata=metas[i], world_rank=r)
+            for r, i in enumerate(order)
+        ]
+        return cls(infos, slice_pg=slice_pg, pg=pg)
+
+    def __len__(self):
+        return len(self.workers)
+
+    @property
+    def actors(self) -> list:
+        return [w.actor for w in self.workers]
+
+    def context_specs(self, experiment_name, storage_path, num_to_keep=None):
+        """Per-worker context dicts: local/node ranks derived from node_id
+        grouping in rank order."""
+        node_order: list[str] = []
+        local_counts: dict[str, int] = {}
+        specs = []
+        for w in self.workers:
+            nid = w.metadata["node_id"]
+            if nid not in node_order:
+                node_order.append(nid)
+            local_rank = local_counts.get(nid, 0)
+            local_counts[nid] = local_rank + 1
+            specs.append(
+                {
+                    "experiment_name": experiment_name,
+                    "storage_path": storage_path,
+                    "num_to_keep": num_to_keep,
+                    "world_size": len(self.workers),
+                    "world_rank": w.world_rank,
+                    "local_rank": local_rank,
+                    "node_rank": node_order.index(nid),
+                }
+            )
+        for i, spec in enumerate(specs):
+            spec["local_world_size"] = local_counts[
+                self.workers[i].metadata["node_id"]
+            ]
+        return specs
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w.actor)
+            except Exception:
+                pass
+        if self._slice_pg is not None:
+            try:
+                self._slice_pg.shutdown()
+            except Exception:
+                pass
+        elif self._pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass
+        self.workers = []

@@ -128,3 +128,36 @@ def test_actor_on_labeled_node_and_node_death(cluster):
             time.sleep(1.0)
     dead = [n for n in ray_tpu.nodes() if not n["Alive"]]
     assert len(dead) == 1 and dead[0]["NodeID"] == node3.node_id
+
+
+def test_freed_object_fetch_errors_not_hangs(cluster):
+    """A ref whose owner already freed the object must fail fast with
+    ObjectLostError when fetched elsewhere — not hang (regression: the train
+    controller once dropped the only closure holding dataset block refs,
+    and workers hung forever fetching the freed blocks)."""
+    import gc
+
+    import cloudpickle as cp
+    import numpy as np
+
+    from ray_tpu.core.errors import ObjectLostError
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class Fetcher:
+        def fetch(self, payload):
+            ref = cp.loads(payload)
+            try:
+                ray_tpu.get(ref, timeout=20)
+                return "got"
+            except Exception as e:
+                return f"{type(e).__name__}: {e}"
+
+    ref = ray_tpu.put(np.arange(4))
+    payload = cp.dumps(ref)  # smuggled past ref accounting, like a closure
+    f = Fetcher.remote()
+    del ref
+    gc.collect()
+    time.sleep(0.5)  # let the owner process the free
+    out = ray_tpu.get(f.fetch.remote(payload), timeout=30)
+    assert "ObjectLostError" in out or "freed" in out, out
+    ray_tpu.kill(f)
