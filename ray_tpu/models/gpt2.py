@@ -27,7 +27,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops.attention import causal_attention
+from ray_tpu.ops.attention import causal_attention, uses_flash_kernel
 
 Params = dict
 
@@ -43,7 +43,16 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16  # activation dtype
     param_dtype: Any = jnp.float32
     attn_impl: str = "auto"  # "auto" | "pallas" | "reference"
-    remat: bool = True
+    # Rematerialization policy for the per-layer scan:
+    #   "full"  — recompute the whole block in backward (min memory, +FLOPs)
+    #   "dots"  — save weight-matmul outputs, recompute attention/gelu/norms
+    #             (jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    #   "mlp"   — attention sublayer not checkpointed (its flash-kernel
+    #             residuals are saved, so backward never re-runs the forward
+    #             kernel); MLP checkpointed with the dots policy
+    #   "none"  — save everything XLA wants (max memory)
+    # bools accepted for back-compat: True == "full", False == "none".
+    remat: bool | str = "mlp"
 
     @property
     def head_dim(self) -> int:
@@ -139,8 +148,7 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _block(x, p, cfg: GPT2Config):
-    """One transformer block. x: [B, S, D]; p: single layer's params."""
+def _attn_sublayer(x, p, cfg: GPT2Config):
     B, S, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
@@ -152,13 +160,19 @@ def _block(x, p, cfg: GPT2Config):
 
     attn = causal_attention(heads(q), heads(k_), heads(v), impl=cfg.attn_impl)
     attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + attn @ p["proj_w"].astype(cfg.dtype) + p["proj_b"].astype(cfg.dtype)
+    return x + attn @ p["proj_w"].astype(cfg.dtype) + p["proj_b"].astype(cfg.dtype)
 
+
+def _mlp_sublayer(x, p, cfg: GPT2Config):
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     h = h @ p["fc_w"].astype(cfg.dtype) + p["fc_b"].astype(cfg.dtype)
     h = jax.nn.gelu(h, approximate=True)
-    x = x + h @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
-    return x
+    return x + h @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
+
+
+def _block(x, p, cfg: GPT2Config):
+    """One transformer block. x: [B, S, D]; p: single layer's params."""
+    return _mlp_sublayer(_attn_sublayer(x, p, cfg), p, cfg)
 
 
 def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
@@ -167,9 +181,35 @@ def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
     x = params["wte"].astype(cfg.dtype)[tokens]
     x = x + params["wpe"].astype(cfg.dtype)[:S][None]
 
-    block_fn = functools.partial(_block, cfg=cfg)
-    if cfg.remat:
-        block_fn = jax.checkpoint(block_fn)
+    remat = {True: "full", False: "none"}.get(cfg.remat, cfg.remat)
+    if remat == "mlp" and not uses_flash_kernel(S, impl=cfg.attn_impl):
+        # "mlp" exists to preserve the flash kernel's o/lse residuals. On the
+        # jnp reference path there is no kernel, and leaving attention
+        # un-checkpointed would stack O(L*B*H*S^2) softmax residuals.
+        remat = "dots"
+    dots_policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if remat == "full":
+        block_fn = jax.checkpoint(functools.partial(_block, cfg=cfg))
+    elif remat == "dots":
+        block_fn = jax.checkpoint(
+            functools.partial(_block, cfg=cfg), policy=dots_policy
+        )
+    elif remat == "mlp":
+        # Attention stays outside the checkpoint so the flash kernel's saved
+        # residuals (o, lse) survive to backward — custom_vjp residuals are
+        # invisible to checkpoint policies, so any checkpoint around the
+        # attention call forces a forward-kernel re-run in backward.
+        mlp_ckpt = jax.checkpoint(
+            functools.partial(_mlp_sublayer, cfg=cfg), policy=dots_policy
+        )
+
+        def block_fn(x, layer_params):
+            return mlp_ckpt(_attn_sublayer(x, layer_params, cfg), layer_params)
+
+    elif remat == "none":
+        block_fn = functools.partial(_block, cfg=cfg)
+    else:
+        raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
     def scan_body(x, layer_params):
         return block_fn(x, layer_params), None
@@ -192,9 +232,12 @@ def loss_fn(
     else:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = forward(params, inputs, cfg).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    # Cross-entropy as logsumexp - target_logit: both reduce over vocab, so
+    # XLA fuses the f32 upcast into the reductions and never materializes an
+    # f32 [B, S, vocab] log-prob tensor (log_softmax + take_along_axis would).
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    loss = jnp.mean(lse - tgt)
     return loss, {"loss": loss, "tokens": jnp.array(targets.size, jnp.int32)}
 
 

@@ -59,6 +59,27 @@ def test_flash_gradients_match_reference():
         )
 
 
+def test_flash_gradients_uneven_diag_blocks():
+    # block_q != block_k exercises the straddling mask in both bwd kernels.
+    q, k, v = _qkv(jax.random.key(4), B=1, H=1, S=96, D=16)
+
+    def loss(impl, **kw):
+        def f(q, k, v):
+            return jnp.sum(causal_attention(q, k, v, impl=impl, **kw) ** 2)
+
+        return f
+
+    g_ref = jax.grad(loss("reference"), argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(
+        loss("pallas", block_q=32, block_k=48, interpret=True),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+        )
+
+
 def test_explicit_pallas_rejects_indivisible_seq():
     q, k, v = _qkv(jax.random.key(3), S=100, D=16)
     with pytest.raises(ValueError, match="divisible"):
