@@ -118,26 +118,36 @@ class StreamingExecutor:
                 sources = self._apply_barrier(stage.barrier, sources)
                 is_read = False
             if final:
-                if self._shard is not None and len(sources) < self._shard[0]:
+                needs_reshard = self._shard is not None and (
                     # Fewer blocks than shards: a block-granular shard would
                     # starve most ranks (and deadlock their collectives).
-                    # Run the stage, split rows evenly, then shard.
+                    len(sources) < self._shard[0]
+                    # limit + shard: the limit truncates the WHOLE dataset
+                    # before splitting (reference semantics) — applying it
+                    # per-shard would yield up to n rows per split. Trim
+                    # globally first, then split rows evenly.
+                    or self._limit is not None
+                )
+                if needs_reshard:
                     refs = [
                         ref
                         for ref, _ in self._stream_stage(
                             stage.chain, sources, is_read,
-                            apply_shard_and_limit=False,
+                            apply_shard=False,
+                            apply_limit=self._limit is not None,
                         )
                     ]
                     sources = self._apply_barrier(
                         RepartitionOp(self._shard[0]), refs
                     )
                     yield from self._stream_stage(
-                        [], sources, False, apply_shard_and_limit=True
+                        [], sources, False,
+                        apply_shard=True, apply_limit=False,
                     )
                     return
                 yield from self._stream_stage(
-                    stage.chain, sources, is_read, apply_shard_and_limit=True
+                    stage.chain, sources, is_read,
+                    apply_shard=True, apply_limit=True,
                 )
                 return
             # Interior stage before a barrier: run it fully (the barrier
@@ -145,15 +155,16 @@ class StreamingExecutor:
             sources = [
                 ref
                 for ref, _ in self._stream_stage(
-                    stage.chain, sources, is_read, apply_shard_and_limit=False
+                    stage.chain, sources, is_read,
+                    apply_shard=False, apply_limit=False,
                 )
             ]
             is_read = False
 
-    def _stream_stage(self, chain, sources, is_read, apply_shard_and_limit):
+    def _stream_stage(self, chain, sources, is_read, apply_shard, apply_limit):
         remote_chain = ray_tpu.remote(_run_chain)
         payload = cloudpickle.dumps(chain)
-        if apply_shard_and_limit and self._shard is not None:
+        if apply_shard and self._shard is not None:
             world, rank = self._shard
             sources = [s for j, s in enumerate(sources) if j % world == rank]
         pending: list = []  # [(block_ref, meta_ref)] in submission order
@@ -176,7 +187,7 @@ class StreamingExecutor:
             block_ref, meta_ref = pending.pop(0)
             num_rows = ray_tpu.get(meta_ref)
             if (
-                apply_shard_and_limit
+                apply_limit
                 and self._limit is not None
                 and produced_rows + num_rows > self._limit
             ):
@@ -190,7 +201,7 @@ class StreamingExecutor:
             produced_rows += num_rows
             yield block_ref, num_rows
             if (
-                apply_shard_and_limit
+                apply_limit
                 and self._limit is not None
                 and produced_rows >= self._limit
             ):

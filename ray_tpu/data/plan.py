@@ -95,16 +95,26 @@ CHAIN_OPS = (
 def apply_chain_op(op, block: Block) -> Block:
     acc = BlockAccessor(block)
     if isinstance(op, MapBatchesOp):
-        out_blocks = []
         n = acc.num_rows()
-        size = op.batch_size or max(n, 1)
-        for start in range(0, max(n, 1), size):
-            sub = acc.slice(start, min(start + size, n)) if n else block
+        if n == 0:
+            # Legitimately empty block (e.g. a filter removed every row). Try
+            # the fn on the empty batch so the OUTPUT schema propagates to
+            # downstream schema-dependent ops (sort/concat); fns that assume
+            # non-empty arrays are skipped instead of crashing (the reference
+            # drops zero-row bundles).
+            try:
+                batch = acc.to_batch(op.batch_format)
+                result = op.fn(batch, **op.fn_kwargs)
+                return BlockAccessor.batch_to_block(result)
+            except Exception:
+                return block
+        out_blocks = []
+        size = op.batch_size or n
+        for start in range(0, n, size):
+            sub = acc.slice(start, min(start + size, n))
             batch = BlockAccessor(sub).to_batch(op.batch_format)
             result = op.fn(batch, **op.fn_kwargs)
             out_blocks.append(BlockAccessor.batch_to_block(result))
-            if n == 0:
-                break
         return concat_blocks(out_blocks)
     if isinstance(op, MapRowsOp):
         return rows_to_block([op.fn(r) for r in acc.iter_rows()])

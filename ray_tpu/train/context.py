@@ -68,7 +68,12 @@ class TrainContext:
         # controller's status() polls (it would read as a dead worker).
         persisted = None
         if checkpoint is not None and self.storage is not None:
-            persisted = self.storage.persist_checkpoint(checkpoint, index)
+            persisted = self.storage.persist_checkpoint(
+                checkpoint,
+                index,
+                world_rank=self.world_rank,
+                world_size=self.world_size,
+            )
         with self._lock:
             if persisted is not None:
                 self.latest_checkpoint = persisted

@@ -76,6 +76,9 @@ class TrainController:
         self._storage = StorageContext(run_config.storage_path, self._experiment)
         self._metrics_history: list[dict] = []
         self._latest_metrics: Optional[dict] = None
+        # index -> {"ranks": set, "has_ckpt": bool} for in-flight report
+        # rounds (checkpoint commit protocol, see _record_report)
+        self._report_rounds: dict[int, dict] = {}
 
     @property
     def state(self) -> str:
@@ -130,6 +133,8 @@ class TrainController:
     def _run_once(self, group: WorkerGroup) -> tuple[str, Optional[str]]:
         """One worker-group generation. Returns ("finished", None) or
         ("failed", error)."""
+        self._report_rounds.clear()  # rounds never span generations
+        self._storage.prune_incomplete()
         latest = self._storage.latest_checkpoint()
         start_index = 0
         if latest is not None:
@@ -172,7 +177,7 @@ class TrainController:
             live = [i for i in range(len(group)) if not done[i]]
             for i, st in zip(live, statuses):
                 for rep in st["reports"]:
-                    self._record_report(rep)
+                    self._record_report(rep, len(group))
                 if st["state"] == "failed":
                     return "failed", st["error"]
                 if st["state"] == "finished":
@@ -181,7 +186,21 @@ class TrainController:
                 return "finished", None
             time.sleep(POLL_INTERVAL_S)
 
-    def _record_report(self, rep: dict) -> None:
+    def _record_report(self, rep: dict, world_size: int) -> None:
         if rep["world_rank"] == 0:
             self._latest_metrics = rep["metrics"]
             self._metrics_history.append(rep["metrics"])
+        # Controller-side checkpoint commit: once every rank's report for
+        # this index arrived (so no rank is still merging shard files into
+        # the dir) and at least one rank persisted, stamp `.complete` —
+        # only then does latest_checkpoint() surface it for restore.
+        idx = rep["index"]
+        round_ = self._report_rounds.setdefault(
+            idx, {"ranks": set(), "has_ckpt": False}
+        )
+        round_["ranks"].add(rep["world_rank"])
+        if rep.get("checkpoint_path"):
+            round_["has_ckpt"] = True
+        if len(round_["ranks"]) >= world_size and round_["has_ckpt"]:
+            self._storage.finalize_checkpoint(idx)
+            del self._report_rounds[idx]

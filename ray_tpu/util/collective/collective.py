@@ -97,6 +97,14 @@ _group_mgr = GroupManager()
 _COORD_NAME_PREFIX = "ray_tpu::collective::"
 
 
+def _gen_key(group_name: str) -> str:
+    return f"gen::{group_name}"
+
+
+def _coord_name(group_name: str, token: str) -> str:
+    return f"{_COORD_NAME_PREFIX}{group_name}::{token}"
+
+
 def _coordinator_handle(
     group_name: str,
     world_size: int,
@@ -105,45 +113,82 @@ def _coordinator_handle(
 ):
     """Rank 0 creates the named coordinator actor; other ranks poll for it
     (the NCCLUniqueIDStore rendezvous pattern,
-    reference nccl_collective_group.py Rendezvous.meet :55)."""
+    reference nccl_collective_group.py Rendezvous.meet :55).
+
+    The coordinator's identity is versioned per *generation*: its actor name
+    carries a fresh token that rank 0 publishes to the GCS KV only after the
+    actor exists. Every rank then joins an all-ranks barrier on the actor it
+    bound. A rank that raced rank 0's re-init and bound the previous
+    generation's coordinator can never complete that barrier (rank 0 only
+    joins the new generation), so it either sees the stale actor die
+    (ActorDiedError) or times out locally — both re-poll the KV and converge
+    on the new generation without losing contributions.
+    """
+    import uuid
+
     import ray_tpu
+    from ray_tpu.core import api as core_api
+    from ray_tpu.core.errors import (
+        ActorDiedError,
+        ActorUnavailableError,
+        TaskError,
+    )
     from ray_tpu.util.collective.coordinator import CollectiveCoordinator
 
-    name = _COORD_NAME_PREFIX + group_name
+    worker = core_api._require_worker()
     if rank == 0:
-        # A coordinator left over from a previous generation (worker died
-        # mid-collective, gang rebuilt with the same group name) holds stale
-        # op state — retire it before creating the new one.
-        try:
-            stale = ray_tpu.get_actor(name)
-            ray_tpu.kill(stale)
-            deadline = time.monotonic() + 10.0
-            while time.monotonic() < deadline:
-                try:
-                    ray_tpu.get_actor(name)
-                    time.sleep(0.02)
-                except ValueError:
-                    break
-        except ValueError:
-            pass
+        # Retire any coordinator left over from a previous generation (worker
+        # died mid-collective, gang rebuilt with the same group name): unlink
+        # the KV pointer first so no rank can newly bind it, then kill it.
+        old = worker.gcs.kv_get(_gen_key(group_name), ns=_KV_NS)
+        if old is not None:
+            worker.gcs.kv_del(_gen_key(group_name), ns=_KV_NS)
+            try:
+                stale = ray_tpu.get_actor(
+                    _coord_name(group_name, old.decode())
+                )
+                ray_tpu.kill(stale)
+            except ValueError:
+                pass
+        token = uuid.uuid4().hex[:12]
         coord_cls = ray_tpu.remote(CollectiveCoordinator)
-        return coord_cls.options(
-            name=name,
+        coord = coord_cls.options(
+            name=_coord_name(group_name, token),
             num_cpus=0,
             # Every rank blocks inside the actor during a collective, plus
             # headroom for concurrent P2P and rendezvous calls.
             max_concurrency=4 * world_size + 4,
         ).remote(world_size, timeout_s)
+        ray_tpu.get(coord.ping.remote())  # actor exists before we publish
+        worker.gcs.kv_put(
+            _gen_key(group_name), token.encode(), ns=_KV_NS, overwrite=True
+        )
+        ray_tpu.get(coord.join.remote(rank))
+        return coord
     deadline = time.monotonic() + timeout_s
     while True:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"rank {rank} timed out waiting for rank 0 to create "
+                f"collective group {group_name!r}"
+            )
+        raw = worker.gcs.kv_get(_gen_key(group_name), ns=_KV_NS)
+        if raw is None:
+            time.sleep(0.05)
+            continue
         try:
-            return ray_tpu.get_actor(name)
-        except ValueError:
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"rank {rank} timed out waiting for rank 0 to create "
-                    f"collective group {group_name!r}"
-                )
+            coord = ray_tpu.get_actor(_coord_name(group_name, raw.decode()))
+            # All-ranks barrier pins this rank to a generation rank 0 is
+            # also in; a stale generation dies under us and we re-poll.
+            ray_tpu.get(coord.join.remote(rank))
+            return coord
+        except (
+            ValueError,  # not registered yet / already deregistered
+            ActorDiedError,  # stale generation killed under us
+            ActorUnavailableError,
+            TaskError,  # coordinator-side join error (e.g. its timeout)
+            TimeoutError,
+        ):
             time.sleep(0.05)
 
 
@@ -254,8 +299,11 @@ def destroy_collective_group(group_name: str = DEFAULT_GROUP_NAME) -> None:
     try:
         worker = core_api._require_worker(auto_init=False)
         worker.gcs.kv_del(f"decl::{group_name}", ns=_KV_NS)
-        coord = ray_tpu.get_actor(_COORD_NAME_PREFIX + group_name)
-        ray_tpu.kill(coord)
+        token = worker.gcs.kv_get(_gen_key(group_name), ns=_KV_NS)
+        if token is not None:
+            worker.gcs.kv_del(_gen_key(group_name), ns=_KV_NS)
+            coord = ray_tpu.get_actor(_coord_name(group_name, token.decode()))
+            ray_tpu.kill(coord)
     except Exception:
         pass
 

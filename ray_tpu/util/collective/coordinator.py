@@ -33,6 +33,8 @@ class CollectiveCoordinator:
         self._ops: dict[int, dict] = {}
         # (src, dst, tag) -> list of pending payloads (ordered)
         self._mail: dict[tuple, list] = {}
+        # ranks that completed the init-time join barrier (idempotent)
+        self._joined: set[int] = set()
         # small KV for backend-specific rendezvous (e.g. XLA coordinator addr)
         self._meta: dict[str, bytes] = {}
 
@@ -43,6 +45,27 @@ class CollectiveCoordinator:
 
     def ping(self) -> bool:
         return True
+
+    def join(self, rank: int) -> bool:
+        """All-ranks barrier that binds a rank to THIS coordinator generation
+        at init time (see collective._coordinator_handle): a rank that bound
+        a stale generation blocks here forever instead of leaking collective
+        contributions into an actor about to be killed.
+
+        Idempotent per rank (set-based): a rank whose join RPC was delivered
+        but whose reply was lost may safely retry, and a re-join after the
+        barrier completed returns immediately.
+        """
+        deadline = self._deadline()
+        with self._cv:
+            self._joined.add(int(rank))
+            self._cv.notify_all()
+            while len(self._joined) < self._world:
+                self._wait(
+                    deadline,
+                    f"join ({len(self._joined)}/{self._world} ranks)",
+                )
+            return True
 
     # -- rendezvous metadata -------------------------------------------------
 
@@ -79,38 +102,68 @@ class CollectiveCoordinator:
                     "done": 0,
                 }
             if st["kind"] != kind:
-                st["error"] = (
+                self._fail_op(
+                    st,
                     f"collective mismatch at seq {seq}: rank {rank} called "
-                    f"{kind!r} but another rank called {st['kind']!r}"
+                    f"{kind!r} but another rank called {st['kind']!r}",
                 )
-                self._cv.notify_all()
             if rank in st["contrib"]:
-                st["error"] = f"rank {rank} contributed twice at seq {seq}"
-                self._cv.notify_all()
-            st["contrib"][rank] = payload
+                self._fail_op(
+                    st, f"rank {rank} contributed twice at seq {seq}"
+                )
+            st["contrib"][rank] = payload if st["error"] is None else None
             if len(st["contrib"]) == self._world and st["error"] is None:
                 try:
                     st["result"] = self._compute(st)
                 except Exception as e:  # shape/dtype mismatch etc.
-                    st["error"] = f"{type(e).__name__}: {e}"
+                    self._fail_op(st, f"{type(e).__name__}: {e}")
                 self._cv.notify_all()
-            while (
-                st["result"] is None
-                and st["error"] is None
-            ):
-                self._wait(
-                    deadline,
-                    f"collective {kind!r} seq {seq} "
-                    f"({len(st['contrib'])}/{self._world} ranks arrived)",
-                )
             try:
+                while (
+                    st["result"] is None
+                    and st["error"] is None
+                ):
+                    try:
+                        self._wait(
+                            deadline,
+                            f"collective {kind!r} seq {seq} "
+                            f"({len(st['contrib'])}/{self._world} ranks "
+                            f"arrived)",
+                        )
+                    except TimeoutError:
+                        # One rank timing out means the op can never
+                        # complete; fail the stragglers fast too.
+                        self._fail_op(
+                            st,
+                            f"collective {kind!r} seq {seq} timed out "
+                            f"with {len(st['contrib'])}/{self._world} "
+                            f"ranks arrived",
+                        )
+                        raise
                 if st["error"] is not None:
                     raise RuntimeError(st["error"])
                 return self._share(st, rank)
             finally:
+                # Reap the op when everyone is done. Errored ops stay as
+                # tombstones (payloads already freed by _fail_op) so a
+                # late-arriving rank observes the original error immediately
+                # instead of resurrecting the seq and blocking a full
+                # timeout; tombstones are bounded because a failed gang
+                # re-inits with a NEW coordinator generation.
                 st["done"] += 1
                 if st["done"] == self._world:
-                    del self._ops[seq]
+                    self._ops.pop(seq, None)
+
+    def _fail_op(self, st: dict, msg: str) -> None:
+        """Mark an op failed (first error wins) and free its payload memory;
+        the entry itself survives as a tombstone until every rank observed
+        the error. Callers hold self._cv."""
+        if st["error"] is None:
+            st["error"] = msg
+        for r in st["contrib"]:
+            st["contrib"][r] = None
+        st["result"] = None
+        self._cv.notify_all()
 
     def _compute(self, st: dict):
         kind = st["kind"]

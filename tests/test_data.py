@@ -140,6 +140,40 @@ def test_shard_and_split(cluster):
     assert total == 40
 
 
+def test_limit_with_shard_is_dataset_level(cluster):
+    # ds.limit(n) truncates the WHOLE dataset before sharding — n rows total
+    # across all shards, not n per shard (ADVICE r1: executor.py limit).
+    ds = rd.range(100, parallelism=8).limit(20)
+    a = ds.shard(2, 0).take_all()
+    b = ds.shard(2, 1).take_all()
+    assert len(a) + len(b) == 20
+    assert {r["id"] for r in a} | {r["id"] for r in b} == set(range(20))
+
+
+def test_map_batches_skips_empty_blocks(cluster):
+    # A filter that empties some blocks must not invoke the map fn on
+    # zero-row batches (ADVICE r1: plan.py map_batches empty batch).
+    ds = rd.range(40, parallelism=4).filter(lambda r: r["id"] < 10)
+
+    def strict_fn(batch):
+        assert len(batch["id"]) > 0
+        return {"id": batch["id"] * 2}
+
+    out = sorted(r["id"] for r in ds.map_batches(strict_fn).take_all())
+    assert out == [2 * i for i in range(10)]
+
+    # An empty-tolerant fn still propagates its OUTPUT schema through empty
+    # blocks, so schema-dependent downstream ops (sort) keep working even
+    # when a whole block was filtered away.
+    ds2 = (
+        rd.range(20, parallelism=4)
+        .filter(lambda r: r["id"] < 5)
+        .map_batches(lambda b: {"x": b["id"] * 2})
+        .sort("x")
+    )
+    assert [r["x"] for r in ds2.take_all()] == [0, 2, 4, 6, 8]
+
+
 def test_union_zip(cluster):
     a = rd.range(5)
     b = rd.range(5).map_batches(lambda x: {"id": x["id"] + 5})

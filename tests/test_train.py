@@ -176,6 +176,69 @@ def test_checkpoint_retention(cluster, tmp_path_factory):
     assert names == ["checkpoint_000002", "checkpoint_000003"]
 
 
+def test_persist_checkpoint_merges_ranks(cluster, tmp_path_factory):
+    """Per-rank sharded checkpoint files all land in the final checkpoint dir
+    — later ranks merge instead of being dropped (ADVICE r1: storage.py)."""
+    import tempfile
+
+    from ray_tpu.train.storage import StorageContext
+
+    storage = StorageContext(
+        str(tmp_path_factory.mktemp("merge")), experiment_name="exp"
+    )
+    for rank in range(3):
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, f"shard_{rank}.bin"), "w") as f:
+                f.write(f"rank{rank}")
+            with open(os.path.join(d, "meta.json"), "w") as f:
+                f.write("{}")
+            storage.persist_checkpoint(Checkpoint(d), index=0)
+    final = storage.checkpoint_dir(0)
+    files = sorted(os.listdir(final))
+    assert files == ["meta.json", "shard_0.bin", "shard_1.bin", "shard_2.bin"]
+    for rank in range(3):
+        with open(os.path.join(final, f"shard_{rank}.bin")) as f:
+            assert f.read() == f"rank{rank}"
+
+
+def test_checkpoint_restorable_only_when_finalized(cluster, tmp_path_factory):
+    """A sharded (rank-marked) checkpoint is not restorable until the
+    controller finalizes the report round; prune_incomplete clears partial
+    dirs left by a gang that died mid-round."""
+    import tempfile
+
+    from ray_tpu.train.storage import StorageContext
+
+    storage = StorageContext(
+        str(tmp_path_factory.mktemp("commit")), experiment_name="exp"
+    )
+    world = 2
+    for rank in range(world):
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, f"shard_{rank}.bin"), "w") as f:
+                f.write("x")
+            storage.persist_checkpoint(
+                Checkpoint(d), index=0, world_rank=rank, world_size=world
+            )
+    assert storage.latest_checkpoint() is None  # not finalized yet
+    storage.finalize_checkpoint(0)
+    ckpt = storage.latest_checkpoint()
+    assert ckpt is not None and ckpt.path == storage.checkpoint_dir(0)
+
+    # A later, never-finalized round (gang died mid-merge) is ignored by
+    # latest_checkpoint and removed by prune_incomplete.
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "shard_0.bin"), "w") as f:
+            f.write("x")
+        storage.persist_checkpoint(
+            Checkpoint(d), index=1, world_rank=0, world_size=world
+        )
+    assert storage.latest_checkpoint().path == storage.checkpoint_dir(0)
+    storage.prune_incomplete()
+    assert not os.path.exists(storage.checkpoint_dir(1))
+    assert os.path.exists(storage.checkpoint_dir(0))
+
+
 def test_tpu_slice_rank_ordering(cluster, tmp_path_factory):
     """Workers on a fake TPU slice get world ranks sorted by in-slice worker
     id (reference worker_group.py:791-825) — stable jax process indices."""
