@@ -457,11 +457,15 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    # Round 1: queued-task cancellation only (running tasks run to completion
-    # unless force, which is not yet supported).
-    raise NotImplementedError(
-        "cancel() lands with the task-cancellation protocol"
-    )
+    """Cancel the task producing ``ref`` (reference: worker.py:3302).
+
+    Queued tasks are removed from the submission queue; running tasks get a
+    best-effort interrupt (TaskCancelledError raised in the executing
+    thread). ``force=True`` kills the executing worker process instead.
+    ``get()`` on the ref then raises TaskCancelledError. Cancelling an
+    already-finished task is a no-op; actor tasks are not cancellable (kill
+    the actor instead)."""
+    _require_worker().cancel(ref, force=force)
 
 
 def get_actor(name: str) -> ActorHandle:

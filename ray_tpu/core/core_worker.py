@@ -33,6 +33,7 @@ from ray_tpu.core.errors import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -64,6 +65,7 @@ class TaskSpec:
     soft_label_selector: dict = field(default_factory=dict)
     policy: str = "hybrid"
     pg: tuple | None = None  # (pg_id, capture_child_tasks)
+    cancelled: bool = False  # set by cancel(); suppresses push and retries
     # actor fields
     actor_id: str | None = None
     method: str | None = None
@@ -112,6 +114,15 @@ class CoreWorker:
 
         self._queues: dict[Any, _QueueState] = {}
         self._task_specs: dict[str, TaskSpec] = {}  # task_id -> spec (lineage)
+        # owner side: task_id -> worker addr while a push RPC is in flight
+        self._inflight_push: dict[str, tuple] = {}
+        # executor side (all guarded by _cancel_lock):
+        self._cancel_lock = threading.Lock()
+        self._running_tasks: dict[str, int] = {}  # task_id -> thread ident
+        self._cancelled_tasks: set[str] = set()  # cancel arrived (any time)
+        self._interrupt_sent: str | None = None  # async exc in flight for id
+        # executor side: task_id -> asyncio.Task for coroutine task fns
+        self._running_async: dict[str, asyncio.Future] = {}
 
         # executor side
         self._executor: concurrent.futures.ThreadPoolExecutor | None = None
@@ -511,18 +522,22 @@ class CoreWorker:
         payload, _refs = serialization.dumps(value)
         return ("v", payload)
 
+    @staticmethod
+    def _sched_key_of(spec: TaskSpec) -> _SchedKey:
+        return _SchedKey(
+            tuple(sorted(spec.resources.items())),
+            tuple(sorted(map(str, spec.label_selector.items())))
+            + tuple(sorted(map(str, spec.soft_label_selector.items()))),
+            spec.policy,
+        )
+
     async def _enqueue_task(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids:
             obj = self.owner_store.ensure(oid)
             obj.local_refs += 1
             obj.producing_task = spec.task_id
         self._task_specs[spec.task_id] = spec
-        key = _SchedKey(
-            tuple(sorted(spec.resources.items())),
-            tuple(sorted(map(str, spec.label_selector.items())))
-            + tuple(sorted(map(str, spec.soft_label_selector.items()))),
-            spec.policy,
-        )
+        key = self._sched_key_of(spec)
         qs = self._queues.setdefault(key, _QueueState())
         qs.queue.append(spec)
         self._pump_queue(key, qs)
@@ -609,6 +624,12 @@ class CoreWorker:
     async def _push_to_worker(self, spec: TaskSpec, grant: dict) -> bool:
         """Push one task; on worker death retry or fail. Returns False if the
         lease's worker is gone."""
+        if spec.cancelled:
+            await self._fail_task(
+                spec,
+                TaskCancelledError(f"task {spec.name} was cancelled"),
+            )
+            return True  # lease is fine; continue with the next queued task
         payload = {
             "task_id": spec.task_id,
             "name": spec.name,
@@ -619,12 +640,30 @@ class CoreWorker:
             "owner_addr": tuple(self.endpoint.address),
             "pg": spec.pg,
         }
+        self._inflight_push[spec.task_id] = tuple(grant["worker_addr"])
         try:
             reply = await self.endpoint.acall(
                 tuple(grant["worker_addr"]), "worker.push_task", payload
             )
         except (ConnectionLost, ConnectionError, OSError):
-            if spec.retries_left > 0:
+            # Let the node reap the dead worker NOW so a retry doesn't get
+            # handed the same corpse from the idle pool.
+            try:
+                await self.endpoint.acall(
+                    tuple(grant["node_addr"]),
+                    "node.worker_unreachable",
+                    {"worker_id": grant["worker_id"]},
+                )
+            except Exception:
+                pass
+            if spec.cancelled:
+                # force-cancel kills the worker; report cancellation, not a
+                # crash, and never retry a cancelled task.
+                await self._fail_task(
+                    spec,
+                    TaskCancelledError(f"task {spec.name} was cancelled"),
+                )
+            elif spec.retries_left > 0:
                 spec.retries_left -= 1
                 await self._enqueue_task_respec(spec)
             else:
@@ -636,16 +675,13 @@ class CoreWorker:
                     ),
                 )
             return False
+        finally:
+            self._inflight_push.pop(spec.task_id, None)
         self._apply_task_reply(spec, reply)
         return True
 
     async def _enqueue_task_respec(self, spec: TaskSpec) -> None:
-        key = _SchedKey(
-            tuple(sorted(spec.resources.items())),
-            tuple(sorted(map(str, spec.label_selector.items())))
-            + tuple(sorted(map(str, spec.soft_label_selector.items()))),
-            spec.policy,
-        )
+        key = self._sched_key_of(spec)
         qs = self._queues.setdefault(key, _QueueState())
         qs.queue.append(spec)
         self._pump_queue(key, qs)
@@ -666,6 +702,64 @@ class CoreWorker:
         for oid in spec.return_ids:
             self.owner_store.put_error(oid, error)
         self._task_specs.pop(spec.task_id, None)
+
+    # -- cancellation --------------------------------------------------------
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        """Cancel the task producing ``ref`` (reference: worker.py:3302).
+
+        Queued tasks are removed and fail with TaskCancelledError; running
+        tasks get a best-effort interrupt raised in their executing thread
+        (``force`` kills the worker process instead). Cancelling a finished
+        task is a no-op. Only the owner can cancel."""
+        self.endpoint.submit(self._cancel_async(ref, force)).result(
+            timeout=30
+        )
+
+    async def _cancel_async(self, ref: ObjectRef, force: bool) -> None:
+        if not self._is_owner(ref):
+            raise ValueError(
+                "cancel() must be called by the owner of the ObjectRef"
+            )
+        obj = self.owner_store.objects.get(ref.hex())
+        if obj is not None and obj.actor_task:
+            raise ValueError("cancel() does not support actor tasks; use "
+                             "kill() on the actor instead")
+        task_id = obj.producing_task if obj else None
+        if task_id is None:
+            return  # put() object or unknown — nothing to cancel
+        spec = self._task_specs.get(task_id)
+        if spec is None:
+            return  # already finished (or already cancelled/failed)
+        spec.cancelled = True
+        # Queued and not yet pushed: remove + fail here (identity scan in
+        # this spec's own scheduling-class queue; dataclass equality would
+        # compare pickled payloads against every queued task).
+        qs = self._queues.get(self._sched_key_of(spec))
+        if qs is not None:
+            for i, s in enumerate(qs.queue):
+                if s is spec:
+                    del qs.queue[i]
+                    await self._fail_task(
+                        spec,
+                        TaskCancelledError(
+                            f"task {spec.name} was cancelled"
+                        ),
+                    )
+                    return
+        # In flight on a worker: best-effort interrupt (or force-kill).
+        addr = self._inflight_push.get(task_id)
+        if addr is not None:
+            try:
+                await self.endpoint.acall(
+                    addr,
+                    "worker.cancel_task",
+                    {"task_id": task_id, "force": force},
+                )
+            except (ConnectionLost, ConnectionError, OSError):
+                pass  # worker already gone; push path will fail the task
+        # Not queued and not in flight: between queue-pop and push — the
+        # spec.cancelled flag makes _push_to_worker fail it before pushing.
 
     # -- actor client --------------------------------------------------------
 
@@ -738,6 +832,7 @@ class CoreWorker:
         for oid in spec.return_ids:
             obj = self.owner_store.ensure(oid)
             obj.local_refs += 1
+            obj.actor_task = True  # cancel() rejects actor-task refs
         sub = self._actor_submitters.get(spec.actor_id)
         if sub is None:
             sub = self._actor_submitters[spec.actor_id] = _ActorSubmitter(
@@ -813,15 +908,55 @@ class CoreWorker:
         args, kwargs = await self._resolve_args(p)
         loop = asyncio.get_running_loop()
         pginfo = p.get("pg")
+        task_id = p.get("task_id")
 
         def run():
-            with _bind_ambient_pg(pginfo):
-                return func(*args, **kwargs)
+            with self._cancel_lock:
+                if task_id in self._cancelled_tasks:
+                    # cancel arrived before execution started (e.g. during
+                    # the arg-resolve window) — never run the fn.
+                    raise TaskCancelledError(f"task {p['name']} cancelled")
+                self._running_tasks[task_id] = threading.get_ident()
+            try:
+                with _bind_ambient_pg(pginfo):
+                    return func(*args, **kwargs)
+            finally:
+                with self._cancel_lock:
+                    self._running_tasks.pop(task_id, None)
+                    absorb = self._interrupt_sent == task_id
+                    if absorb:
+                        self._interrupt_sent = None
+                if absorb:
+                    # An async exception was sent for THIS task but may not
+                    # have fired inside the fn (it races completion). Absorb
+                    # it here — if it escaped run(), it would kill the
+                    # executor pool thread or poison the next task.
+                    try:
+                        for _ in range(200_000):
+                            pass
+                    except TaskCancelledError:
+                        pass
 
         try:
             if asyncio.iscoroutinefunction(func):
-                with _bind_ambient_pg(pginfo):
-                    result = await func(*args, **kwargs)
+                with self._cancel_lock:
+                    if task_id in self._cancelled_tasks:
+                        raise TaskCancelledError(
+                            f"task {p['name']} cancelled"
+                        )
+                    with _bind_ambient_pg(pginfo):
+                        coro_task = asyncio.ensure_future(
+                            func(*args, **kwargs)
+                        )
+                    self._running_async[task_id] = coro_task
+                try:
+                    result = await coro_task
+                except asyncio.CancelledError:
+                    raise TaskCancelledError(
+                        f"task {p['name']} cancelled"
+                    ) from None
+                finally:
+                    self._running_async.pop(task_id, None)
             else:
                 result = await loop.run_in_executor(self._executor, run)
             results = self._encode_results(p, result)
@@ -829,6 +964,9 @@ class CoreWorker:
             return {"results": results}
         except Exception as e:  # noqa: BLE001
             return {"results": self._error_results(p, e)}
+        finally:
+            with self._cancel_lock:
+                self._cancelled_tasks.discard(task_id)
 
     async def _execute_actor_task(self, p) -> dict:
         # Per-caller ordering: execute in sequence-number order.
@@ -926,9 +1064,50 @@ class CoreWorker:
                 )
 
     def _error_results(self, p, exc: Exception) -> list:
-        tb = traceback.format_exc()
-        err = TaskError(p["name"], tb, cause=_safe_exc(exc))
+        if isinstance(exc, TaskCancelledError):
+            # Surface cancellation as-is (get() raises TaskCancelledError,
+            # not a generic task failure).
+            err: Exception = TaskCancelledError(
+                f"task {p['name']} was cancelled"
+            )
+        else:
+            tb = traceback.format_exc()
+            err = TaskError(p["name"], tb, cause=_safe_exc(exc))
         return [("error", err) for _ in p["return_ids"]]
+
+    async def _h_worker_cancel_task(self, conn, p):
+        """Best-effort interrupt of a running task (reference:
+        core_worker.proto CancelTask). The task id is always recorded as
+        cancelled, so a task still in its arg-resolve window aborts at
+        execution start. A sync fn already running gets TaskCancelledError
+        raised in its executing thread via the CPython async-exception
+        mechanism (fires at the next bytecode boundary — a task blocked in
+        native code is interrupted only when it returns to Python); a
+        coroutine fn gets its asyncio task cancelled. Force exits the worker
+        process — but only if the target task is actually still here (a
+        cancel racing completion must not kill a healthy worker that may
+        already run someone else's task)."""
+        task_id = p["task_id"]
+        coro_task = self._running_async.get(task_id)
+        with self._cancel_lock:
+            self._cancelled_tasks.add(task_id)
+            tid = self._running_tasks.get(task_id)
+            if tid is not None and not p.get("force"):
+                import ctypes
+
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid), ctypes.py_object(TaskCancelledError)
+                )
+                self._interrupt_sent = task_id
+        if p.get("force"):
+            if tid is None and coro_task is None:
+                return {"cancelled": False}  # not here (anymore)
+            asyncio.get_running_loop().call_later(0.05, os._exit, 1)
+            return {"cancelled": True, "forced": True}
+        if coro_task is not None:
+            coro_task.cancel()
+            return {"cancelled": True}
+        return {"cancelled": tid is not None}
 
     async def _h_worker_shutdown(self, conn, p):
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)

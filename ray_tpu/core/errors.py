@@ -46,6 +46,11 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via cancel(); raised at get() on its outputs
+    (reference: python/ray/exceptions.py TaskCancelledError)."""
+
+
 class SchedulingError(RayTpuError):
     """No feasible node for the requested resources."""
 

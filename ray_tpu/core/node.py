@@ -318,6 +318,20 @@ class NodeManager:
             "session_id": self.session_id,
         }
 
+    async def _h_worker_unreachable(self, conn, p):
+        """An owner's push RPC to this node's worker failed (connection
+        lost). If the process is really dead, reap it immediately instead of
+        waiting for the monitor poll — otherwise the idle pool keeps handing
+        the dead worker to retries."""
+        info = self.workers.get(p["worker_id"])
+        if info is not None and info.proc is not None:
+            if info.proc.poll() is not None:
+                await self._on_worker_death(
+                    p["worker_id"], f"exit {info.proc.returncode}"
+                )
+                return True
+        return False
+
     async def _h_kill_worker(self, conn, p):
         info = self.workers.get(p["worker_id"])
         if info is None or info.proc is None:

@@ -194,6 +194,7 @@ class OwnedObject:
     borrowers: int = 0
     # task lineage for reconstruction (task spec dict) — set by submitter
     producing_task: Any = None
+    actor_task: bool = False  # produced by an actor method (not cancellable)
     waiters: list = field(default_factory=list)  # asyncio.Events
 
 

@@ -247,3 +247,114 @@ def test_runtime_context_and_nodes(rt):
     ns = ray_tpu.nodes()
     assert len(ns) == 1 and ns[0]["Alive"]
     assert ray_tpu.cluster_resources()["CPU"] == 32.0
+
+
+def test_cancel_queued_task(rt):
+    from ray_tpu.core.errors import TaskCancelledError
+
+    @ray_tpu.remote
+    def hog():
+        time.sleep(8)
+        return "done"
+
+    @ray_tpu.remote
+    def victim():
+        return "ran"
+
+    # Saturate the cluster so the victim stays queued, then cancel it.
+    blocker = hog.options(num_cpus=32).remote()
+    ref = victim.options(num_cpus=32).remote()
+    time.sleep(0.5)
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+    # Cancellation must not wait for the blocker to finish.
+    assert time.monotonic() - t0 < 5
+    # Clean up the blocker too (it may still be queued if module-scoped
+    # actors hold CPUs, or running otherwise — cancel handles both).
+    ray_tpu.cancel(blocker, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(blocker, timeout=30)
+
+
+def test_cancel_running_task(rt):
+    from ray_tpu.core.errors import TaskCancelledError
+
+    @ray_tpu.remote
+    def spin():
+        # Yields to the interpreter every iteration so the async-exception
+        # interrupt can land.
+        for _ in range(600):
+            time.sleep(0.05)
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it start
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+
+
+def test_cancel_running_task_force(rt):
+    from ray_tpu.core.errors import TaskCancelledError
+
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(60)  # blocked in native code: only force can stop it
+        return "finished"
+
+    ref = sleeper.remote()
+    time.sleep(1.0)
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+    assert time.monotonic() - t0 < 10
+
+
+def test_cancel_async_task(rt):
+    import asyncio
+
+    from ray_tpu.core.errors import TaskCancelledError
+
+    @ray_tpu.remote
+    def _noop():
+        return None
+
+    @ray_tpu.remote
+    async def snooze():
+        await asyncio.sleep(60)
+        return "finished"
+
+    ref = snooze.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+
+
+def test_cancel_actor_task_rejected(rt):
+    @ray_tpu.remote
+    class A:
+        def slow(self):
+            time.sleep(5)
+            return 1
+
+    a = A.remote()
+    ref = a.slow.remote()
+    with pytest.raises(ValueError):
+        ray_tpu.cancel(ref)
+    assert ray_tpu.get(ref, timeout=30) == 1
+    ray_tpu.kill(a)
+
+
+def test_cancel_finished_task_is_noop(rt):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=10) == 7
+    ray_tpu.cancel(ref)  # no-op
+    assert ray_tpu.get(ref, timeout=10) == 7
