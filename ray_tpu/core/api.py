@@ -118,8 +118,56 @@ def _default_labels() -> dict:
         return {}
 
 
+class _AttachedRuntime:
+    """Driver's view of a cluster it joined via ``init(address=...)``:
+    shutdown() disconnects this driver but never tears the cluster down
+    (it is owned by the `raytpu start` daemons)."""
+
+    def __init__(self, gcs_addr: tuple, head_addr: tuple):
+        self.gcs_addr = tuple(gcs_addr)
+        self.head_addr = tuple(head_addr)
+        self.nodes: list = []
+
+    def stop(self) -> None:
+        pass
+
+
+def _parse_address(address: str) -> tuple:
+    host, _, port = address.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(
+            f"address must look like 'host:port', got {address!r}"
+        )
+    return (host, int(port))
+
+
+def _find_local_node(gcs_addr: tuple) -> tuple:
+    """The address of an alive node daemon on THIS machine (the driver
+    attaches to it for leases and shared-memory object access)."""
+    import socket
+
+    from ray_tpu.core.protocol import Endpoint
+
+    probe = Endpoint("driver-probe")
+    probe.start()
+    try:
+        view = probe.call(gcs_addr, "gcs.get_cluster_view", {}, timeout=30)
+    finally:
+        probe.stop()
+    me = socket.gethostname()
+    for info in view.values():
+        if info.get("alive") and info.get("hostname") == me:
+            return tuple(info["addr"])
+    raise RayTpuError(
+        f"no alive node on this host ({me}) in the cluster at "
+        f"{gcs_addr[0]}:{gcs_addr[1]} — run `raytpu start "
+        f"--address={gcs_addr[0]}:{gcs_addr[1]}` here first"
+    )
+
+
 def init(
     *,
+    address: str | None = None,
     num_cpus: float | None = None,
     resources: dict | None = None,
     labels: dict | None = None,
@@ -127,18 +175,35 @@ def init(
     _system_config: dict | None = None,
 ) -> "Runtime":
     """Start a local cluster (GCS + head node) and connect this process as
-    the driver."""
+    the driver — or, with ``address="host:port"``, join an existing cluster
+    started with the `raytpu start` CLI (reference: worker.py:1407
+    init(address=...))."""
     global _runtime, _worker
     with _lock:
         if _runtime is not None:
             if ignore_reinit_error:
                 return _runtime
             raise RayTpuError("ray_tpu already initialized")
-        total = _default_resources(num_cpus)
-        total.update(resources or {})
-        node_labels = _default_labels()
-        node_labels.update(labels or {})
-        runtime = Runtime(total, labels=node_labels)
+        if address is not None:
+            if (
+                num_cpus is not None
+                or resources is not None
+                or labels is not None
+            ):
+                raise ValueError(
+                    "num_cpus/resources/labels cannot be combined with "
+                    "address=: a joining driver contributes no resources — "
+                    "set them on the node daemon (`raytpu start`) instead"
+                )
+            gcs_addr = _parse_address(address)
+            node_addr = _find_local_node(gcs_addr)
+            runtime: Any = _AttachedRuntime(gcs_addr, node_addr)
+        else:
+            total = _default_resources(num_cpus)
+            total.update(resources or {})
+            node_labels = _default_labels()
+            node_labels.update(labels or {})
+            runtime = Runtime(total, labels=node_labels)
         worker = CoreWorker(
             runtime.gcs_addr, runtime.head_addr, kind="driver"
         )

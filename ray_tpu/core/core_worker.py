@@ -173,6 +173,18 @@ class CoreWorker:
     def stop(self) -> None:
         self._stopped = True
         object_ref_mod.clear_hooks()
+        if self.kind == "driver":
+            # Leave the node's registry (long-lived `raytpu start` daemons
+            # would otherwise keep one dead driver entry per session).
+            try:
+                self.endpoint.call(
+                    self.node_addr,
+                    "node.unregister_worker",
+                    {"worker_id": self.worker_id},
+                    timeout=5,
+                )
+            except Exception:
+                pass
         if self._executor is not None:
             self._executor.shutdown(wait=False, cancel_futures=True)
         self.endpoint.stop()

@@ -89,8 +89,8 @@ class GcsServer:
         for name in [n for n in dir(self) if n.startswith("_h_")]:
             self.endpoint.register("gcs." + name[3:], getattr(self, name))
 
-    def start(self) -> tuple:
-        addr = self.endpoint.start()
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple:
+        addr = self.endpoint.start(host=host, port=port)
         self._health_task = self.endpoint.submit(self._health_loop())
         return addr
 
@@ -141,6 +141,13 @@ class GcsServer:
 
     async def _h_get_internal_config(self, conn, p):
         return self.internal_config
+
+    async def _h_get_session(self, conn, p):
+        """Bootstrap info for a node joining an existing cluster (reference:
+        services.py get_ray_address_from_environment + GetInternalConfig):
+        the session id keys the node's shm namespace and must match
+        cluster-wide."""
+        return {"session_id": self.session_id, "config": self.internal_config}
 
     # -- nodes ---------------------------------------------------------------
 

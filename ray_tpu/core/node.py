@@ -75,12 +75,15 @@ class NodeManager:
         gcs_addr: tuple,
         resources: dict,
         labels: dict | None = None,
-        session_id: str = "session",
+        session_id: str | None = "session",
         name: str = "node",
         env: dict | None = None,
     ):
         self.node_id = NodeID.random().hex()
         self.gcs_addr = tuple(gcs_addr)
+        # session_id=None means "join an existing cluster": the session is
+        # fetched from the GCS in start() (reference: ray start --address,
+        # scripts.py:682) and the shm store is created then.
         self.session_id = session_id
         self.total = dict(resources)
         self.available = dict(resources)
@@ -88,10 +91,10 @@ class NodeManager:
         self.name = name
         self.extra_env = dict(env or {})
         self.endpoint = Endpoint(f"node-{name}")
-        self.shm_root = default_shm_root(session_id, self.node_id)
-        self.store = ShmObjectStore(
-            self.shm_root, GLOBAL_CONFIG.object_store_bytes
-        )
+        self.shm_root: str | None = None
+        self.store: ShmObjectStore | None = None
+        if session_id is not None:
+            self._make_store()
         self.workers: dict[str, WorkerInfo] = {}
         self.idle_workers: list[str] = []
         self.leases: dict[str, Lease] = {}
@@ -113,8 +116,20 @@ class NodeManager:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _make_store(self) -> None:
+        self.shm_root = default_shm_root(self.session_id, self.node_id)
+        self.store = ShmObjectStore(
+            self.shm_root, GLOBAL_CONFIG.object_store_bytes
+        )
+
     def start(self) -> tuple:
         addr = self.endpoint.start()
+        if self.session_id is None:
+            info = self.endpoint.call(
+                self.gcs_addr, "gcs.get_session", {}, timeout=30
+            )
+            self.session_id = info["session_id"]
+            self._make_store()
         reply = self.endpoint.call(
             self.gcs_addr,
             "gcs.register_node",
@@ -128,7 +143,13 @@ class NodeManager:
             },
             timeout=30,
         )
-        assert reply["session_id"] == self.session_id or True
+        if reply["session_id"] != self.session_id:
+            raise RuntimeError(
+                f"node joined GCS from a different session "
+                f"({reply['session_id']} != {self.session_id}) — stale "
+                f"address reused after a head restart? Restart this node "
+                f"without an explicit session."
+            )
         self._tasks.append(self.endpoint.submit(self._heartbeat_loop()))
         self._tasks.append(self.endpoint.submit(self._worker_monitor_loop()))
         return addr
@@ -148,7 +169,8 @@ class NodeManager:
                     except Exception:
                         pass
         self.endpoint.stop()
-        self.store.close()
+        if self.store is not None:  # join-mode node that never started
+            self.store.close()
 
     def die_silently(self) -> None:
         """Simulate abrupt node death (for FT tests): stop everything without
@@ -317,6 +339,17 @@ class NodeManager:
             "shm_root": self.shm_root,
             "session_id": self.session_id,
         }
+
+    async def _h_unregister_worker(self, conn, p):
+        """Remove a registration we did not spawn (drivers connecting via
+        init(address=...)). Long-lived daemons would otherwise accumulate a
+        dead WorkerInfo per driver session forever; spawned workers are NOT
+        removable this way — their lifecycle belongs to the pool."""
+        info = self.workers.get(p["worker_id"])
+        if info is not None and info.proc is None and info.state == "driver":
+            del self.workers[p["worker_id"]]
+            return True
+        return False
 
     async def _h_worker_unreachable(self, conn, p):
         """An owner's push RPC to this node's worker failed (connection

@@ -175,7 +175,15 @@ class Endpoint:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+    def start(self, host: str | None = None, port: int = 0) -> Address:
+        """Bind and serve. ``host=None`` uses $RAY_TPU_BIND_HOST (default
+        127.0.0.1). Binding a wildcard address advertises
+        $RAY_TPU_ADVERTISE_HOST (or this host's resolved IP) instead, since
+        a wildcard is not dialable by peers."""
+        import os
+
+        if host is None:
+            host = os.environ.get("RAY_TPU_BIND_HOST", "127.0.0.1")
         self._thread = threading.Thread(
             target=self._run_loop, args=(host, port), name=f"rpc-{self.name}",
             daemon=True,
@@ -186,6 +194,21 @@ class Endpoint:
         assert self.address is not None
         return self.address
 
+    @staticmethod
+    def _advertise_host(bind_host: str) -> str:
+        import os
+        import socket
+
+        if bind_host not in ("0.0.0.0", "::"):
+            return bind_host
+        adv = os.environ.get("RAY_TPU_ADVERTISE_HOST")
+        if adv:
+            return adv
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
     def _run_loop(self, host: str, port: int) -> None:
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
@@ -195,7 +218,8 @@ class Endpoint:
                 self._accept, host=host, port=port
             )
             sock = self._server.sockets[0]
-            self.address = sock.getsockname()[:2]
+            bound_port = sock.getsockname()[1]
+            self.address = (self._advertise_host(host), bound_port)
             self._started.set()
 
         self._loop.run_until_complete(boot())

@@ -1,0 +1,139 @@
+"""`raytpu` CLI — assemble and inspect multi-host clusters.
+
+Reference parity: python/ray/scripts/scripts.py:682 (`ray start`), stop,
+status. A cluster is one `raytpu start --head` daemon (GCS + head node
+manager) plus any number of `raytpu start --address=host:port` daemons (one
+node manager each); drivers join with `ray_tpu.init(address=...)`.
+
+Invoke as `python -m ray_tpu <cmd>` or `python -m ray_tpu.scripts.cli <cmd>`.
+
+On startup the daemon prints ONE JSON line to stdout:
+  {"gcs_address": "host:port", "node_id": "...", "node_address": "host:port"}
+so launchers (and tests) can discover the bound port, then it blocks until
+SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import uuid
+
+
+def _resources_from_args(args) -> tuple:
+    from ray_tpu.core.api import _default_labels, _default_resources
+
+    resources = _default_resources(args.num_cpus)
+    if args.resources:
+        resources.update(json.loads(args.resources))
+    labels = _default_labels()
+    if args.labels:
+        labels.update(json.loads(args.labels))
+    return resources, labels
+
+
+def cmd_start(args) -> int:
+    from ray_tpu.core.api import _parse_address
+    from ray_tpu.core.gcs import GcsServer
+    from ray_tpu.core.node import NodeManager
+
+    # Every endpoint this daemon creates — node manager AND the worker
+    # processes it spawns (they inherit the env) — must bind the same
+    # interface, or peers on other hosts dial an unreachable loopback addr.
+    os.environ["RAY_TPU_BIND_HOST"] = args.host
+
+    resources, labels = _resources_from_args(args)
+    gcs = None
+    if args.head:
+        session = uuid.uuid4().hex[:12]
+        gcs = GcsServer(session)
+        gcs_addr = gcs.start(host=args.host, port=args.port)
+        node = NodeManager(
+            gcs_addr,
+            resources,
+            labels=labels,
+            session_id=session,
+            name=args.node_name or "head",
+        )
+    else:
+        if not args.address:
+            print("error: need --head or --address=host:port", file=sys.stderr)
+            return 2
+        gcs_addr = _parse_address(args.address)
+        node = NodeManager(
+            gcs_addr,
+            resources,
+            labels=labels,
+            session_id=None,  # fetched from the GCS on start
+            name=args.node_name or f"node-{uuid.uuid4().hex[:6]}",
+        )
+    node_addr = node.start()
+    print(
+        json.dumps(
+            {
+                "gcs_address": f"{gcs_addr[0]}:{gcs_addr[1]}",
+                "node_id": node.node_id,
+                "node_address": f"{node_addr[0]}:{node_addr[1]}",
+            }
+        ),
+        flush=True,
+    )
+
+    stop_ev = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop_ev.set())
+    stop_ev.wait()
+    try:
+        node.stop()
+    finally:
+        if gcs is not None:
+            gcs.stop()
+    return 0
+
+
+def cmd_status(args) -> int:
+    from ray_tpu.core.api import _parse_address
+    from ray_tpu.core.protocol import Endpoint
+
+    probe = Endpoint("cli-status")
+    probe.start()
+    try:
+        view = probe.call(
+            _parse_address(args.address), "gcs.get_cluster_view", {},
+            timeout=30,
+        )
+    finally:
+        probe.stop()
+    print(json.dumps(view, indent=2, default=str))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="raytpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_start = sub.add_parser("start", help="start a head or worker daemon")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--address", help="GCS address of the head to join")
+    p_start.add_argument("--host", default="127.0.0.1", help="bind host")
+    p_start.add_argument("--port", type=int, default=0, help="GCS port (head)")
+    p_start.add_argument("--num-cpus", type=float, default=None)
+    p_start.add_argument("--resources", help="JSON dict of extra resources")
+    p_start.add_argument("--labels", help="JSON dict of node labels")
+    p_start.add_argument("--node-name", default=None)
+    p_start.set_defaults(fn=cmd_start)
+
+    p_status = sub.add_parser("status", help="print the cluster view")
+    p_status.add_argument("--address", required=True)
+    p_status.set_defaults(fn=cmd_status)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,124 @@
+"""Multi-host bootstrap: `raytpu start` daemons + init(address=).
+
+Two separate OS processes each run a node daemon (one also hosts the GCS);
+the test process joins as a driver and runs work across both "hosts"
+(reference: python/ray/scripts/scripts.py:682 `ray start`,
+python/ray/_private/worker.py:1407 init(address=...)).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+def _spawn_daemon(*args):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("daemon produced no address line")
+    return proc, json.loads(line)
+
+
+@pytest.fixture()
+def two_host_cluster():
+    head, head_info = _spawn_daemon(
+        "--head", "--num-cpus", "3", "--node-name", "hostA"
+    )
+    addr = head_info["gcs_address"]
+    worker, worker_info = _spawn_daemon(
+        "--address", addr, "--num-cpus", "3", "--node-name", "hostB"
+    )
+    try:
+        yield addr, head_info, worker_info
+    finally:
+        ray_tpu.shutdown()
+        for p in (worker, head):
+            p.terminate()
+        for p in (worker, head):
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_cli_cluster_forms_and_runs_tasks(two_host_cluster, tmp_path):
+    addr, head_info, worker_info = two_host_cluster
+    ray_tpu.init(address=addr)
+
+    # Both nodes visible.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        ns = ray_tpu.nodes()
+        if len(ns) == 2 and all(n["Alive"] for n in ns):
+            break
+        time.sleep(0.2)
+    ids = {n["NodeID"] for n in ray_tpu.nodes()}
+    assert ids == {head_info["node_id"], worker_info["node_id"]}
+    assert ray_tpu.cluster_resources()["CPU"] == 6.0
+
+    # Tasks land on both hosts (2 CPUs each forces one per node).
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    refs = [where.options(num_cpus=2).remote() for _ in range(2)]
+    assert set(ray_tpu.get(refs, timeout=60)) == ids
+
+    # A 2-worker JaxTrainer spans the two daemons: real jax.distributed
+    # bootstrap (CPU platform), one worker per host.
+    from ray_tpu.train import (
+        JaxConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir, exist_ok=True)
+
+    def train_fn():
+        import jax
+
+        import ray_tpu
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        assert jax.process_count() == 2
+        nid = ray_tpu.get_runtime_context().node_id
+        with open(
+            os.path.join(marker_dir, f"rank{ctx.get_world_rank()}"), "w"
+        ) as f:
+            f.write(nid)
+        train.report({"ok": 1})
+
+    trainer = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 2}
+        ),
+        run_config=RunConfig(
+            name="cli_jax", storage_path=str(tmp_path / "results")
+        ),
+        jax_config=JaxConfig(distributed=True, platform="cpu"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    placed = {
+        open(os.path.join(marker_dir, f"rank{r}")).read() for r in range(2)
+    }
+    assert placed == ids  # one worker per host
