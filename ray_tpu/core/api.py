@@ -196,6 +196,27 @@ def init(
                     "set them on the node daemon (`raytpu start`) instead"
                 )
             gcs_addr = _parse_address(address)
+            # Remote workers dial THIS driver back (owner protocol), so the
+            # driver endpoint must not bind loopback when the cluster spans
+            # hosts: default the bind host to the interface that reaches
+            # the GCS (overridable via RAY_TPU_BIND_HOST).
+            if "RAY_TPU_BIND_HOST" not in os.environ and gcs_addr[0] not in (
+                "127.0.0.1",
+                "localhost",
+                "::1",
+            ):
+                import socket as _socket
+
+                probe_sock = _socket.socket(
+                    _socket.AF_INET, _socket.SOCK_DGRAM
+                )
+                try:
+                    probe_sock.connect((gcs_addr[0], gcs_addr[1]))
+                    os.environ["RAY_TPU_BIND_HOST"] = (
+                        probe_sock.getsockname()[0]
+                    )
+                finally:
+                    probe_sock.close()
             node_addr = _find_local_node(gcs_addr)
             runtime: Any = _AttachedRuntime(gcs_addr, node_addr)
         else:

@@ -32,9 +32,15 @@ class Config:
     object_store_bytes: int = 2 * 1024 * 1024 * 1024
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
-    # Worker pool
-    min_idle_workers: int = 0
+    # Worker pool (reference: worker_pool.h maximum_startup_concurrency +
+    # idle worker killing). max_worker_processes caps TASK workers per node
+    # (0 = auto: max(4, 2 * host cores)); actors bypass the cap (they hold
+    # workers for their lifetime). Idle workers above the min_idle_workers
+    # warm floor are reaped after idle_worker_ttl_s.
+    min_idle_workers: int = 1
     worker_start_timeout_s: float = 60.0
+    max_worker_processes: int = 0
+    idle_worker_ttl_s: float = 120.0
     # Scheduling
     lease_request_timeout_s: float = 60.0
     resource_report_interval_s: float = 0.2
@@ -55,6 +61,13 @@ class Config:
     @staticmethod
     def from_json(s: str) -> "Config":
         return Config(**json.loads(s))
+
+    def apply_json(self, s: str) -> None:
+        """Overwrite this config in place with the cluster-authoritative
+        values (the head's config, shipped via the GCS) — in place because
+        every module holds a reference to GLOBAL_CONFIG."""
+        for k, v in json.loads(s).items():
+            setattr(self, k, v)
 
 
 def load_config() -> Config:

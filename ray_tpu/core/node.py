@@ -59,6 +59,7 @@ class WorkerInfo:
     state: str = STARTING
     actor_ids: list = field(default_factory=list)
     ready: asyncio.Event = field(default_factory=asyncio.Event)
+    idle_since: float = 0.0  # monotonic time it last entered the idle pool
 
 
 @dataclass
@@ -105,6 +106,8 @@ class NodeManager:
         self.cluster_view: dict[str, NodeView] = {}
         self.view_meta: dict[str, dict] = {}
         self._pending_leases: list = []  # (req, future, deadline)
+        self._idle_waiters: list = []  # futures waiting for an idle worker
+        self._terminated_procs: list = []  # reaped, awaiting exit collection
         self._inflight_pulls: dict[str, asyncio.Future] = {}
         self._spread_rr = 0
         self._last_view_refresh = 0.0
@@ -129,6 +132,10 @@ class NodeManager:
                 self.gcs_addr, "gcs.get_session", {}, timeout=30
             )
             self.session_id = info["session_id"]
+            # The head's config is cluster-authoritative (config.py promises
+            # consistency): apply BEFORE creating the store, whose capacity
+            # is config-driven.
+            GLOBAL_CONFIG.apply_json(info["config"])
             self._make_store()
         reply = self.endpoint.call(
             self.gcs_addr,
@@ -239,6 +246,38 @@ class NodeManager:
             for wid, w in list(self.workers.items()):
                 if w.proc is not None and w.proc.poll() is not None:
                     await self._on_worker_death(wid, f"exit {w.proc.returncode}")
+            self._reap_idle_workers()
+            self._collect_terminated()
+
+    def _reap_idle_workers(self) -> None:
+        """Kill workers idle past their TTL, keeping a warm floor so the
+        next burst doesn't pay a cold start (reference: worker_pool
+        idle-worker killing)."""
+        ttl = GLOBAL_CONFIG.idle_worker_ttl_s
+        now = time.monotonic()
+        # Oldest-idle first; stop at the warm floor.
+        reapable = sorted(
+            (wid for wid in self.idle_workers),
+            key=lambda wid: self.workers[wid].idle_since,
+        )
+        for wid in reapable:
+            if len(self.idle_workers) <= GLOBAL_CONFIG.min_idle_workers:
+                return
+            w = self.workers[wid]
+            if now - w.idle_since < ttl:
+                return  # the rest are younger
+            self.idle_workers.remove(wid)
+            del self.workers[wid]
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.terminate()
+                # Collect the exit status later (no zombie accumulation in
+                # long-lived daemons); monitor loop polls this list.
+                self._terminated_procs.append(w.proc)
+
+    def _collect_terminated(self) -> None:
+        self._terminated_procs = [
+            p for p in self._terminated_procs if p.poll() is None
+        ]
 
     async def _on_worker_death(self, worker_id: str, reason: str):
         w = self.workers.pop(worker_id, None)
@@ -246,6 +285,12 @@ class NodeManager:
             return
         if worker_id in self.idle_workers:
             self.idle_workers.remove(worker_id)
+        # A death frees cap headroom: wake cap waiters so they re-check and
+        # spawn instead of sleeping out the full start timeout.
+        while self._idle_waiters:
+            fut = self._idle_waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)
         for lid, lease in list(self.leases.items()):
             if lease.worker_id == worker_id:
                 add(self.available, lease.resources)
@@ -274,6 +319,9 @@ class NodeManager:
         env = dict(os.environ)
         env.update(self.extra_env)
         env["RAY_TPU_WORKER_ID"] = worker_id
+        # Cluster-authoritative config (this node already synced with the
+        # head's) — workers must not fall back to their own env defaults.
+        env["RAY_TPU_INTERNAL_CONFIG"] = GLOBAL_CONFIG.to_json()
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -300,26 +348,75 @@ class NodeManager:
         self.workers[worker_id] = info
         return info
 
-    async def _get_idle_worker(self) -> WorkerInfo:
-        if self.idle_workers:
-            return self.workers[self.idle_workers.pop()]
-        # Reuse a starting-but-unclaimed worker if someone else spawned one
-        # that hasn't been grabbed; otherwise spawn.
-        info = self._spawn_worker()
-        try:
-            await asyncio.wait_for(
-                info.ready.wait(), GLOBAL_CONFIG.worker_start_timeout_s
-            )
-        except asyncio.TimeoutError:
-            if info.proc is not None:
-                info.proc.kill()
-            self.workers.pop(info.worker_id, None)
-            raise SchedulingError("worker failed to start in time")
-        # Registration put the new worker in the idle pool; we are claiming
-        # it, so take it back out (else the next lease steals it).
-        if info.worker_id in self.idle_workers:
-            self.idle_workers.remove(info.worker_id)
-        return info
+    def _worker_cap(self) -> int:
+        cap = GLOBAL_CONFIG.max_worker_processes
+        if cap <= 0:
+            cap = max(4, 2 * (os.cpu_count() or 1))
+        return cap
+
+    def _task_worker_count(self) -> int:
+        """Spawned processes currently serving (or about to serve) TASKS.
+        Actor workers left the pool for good and don't count against the
+        cap, nor do driver registrations (proc is None)."""
+        return sum(
+            1
+            for w in self.workers.values()
+            if w.proc is not None and w.state in (STARTING, IDLE, LEASED)
+        )
+
+    def _notify_idle(self) -> None:
+        while self._idle_waiters and self.idle_workers:
+            fut = self._idle_waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _get_idle_worker(self, for_actor: bool = False) -> WorkerInfo:
+        """Claim an idle worker, spawning one if the pool is below its cap.
+        At the cap, wait for a lease to return a worker instead — an
+        unbounded pool fork-bombs the host on task bursts, and extra
+        processes beyond ~2x cores only add GIL/context-switch overhead.
+        Actors bypass the cap: they keep their worker for life, so making
+        them wait for task workers to free would deadlock."""
+        deadline = (
+            asyncio.get_running_loop().time()
+            + GLOBAL_CONFIG.worker_start_timeout_s
+        )
+        while True:
+            if self.idle_workers:
+                return self.workers[self.idle_workers.pop()]
+            if for_actor or self._task_worker_count() < self._worker_cap():
+                info = self._spawn_worker()
+                try:
+                    await asyncio.wait_for(
+                        info.ready.wait(),
+                        GLOBAL_CONFIG.worker_start_timeout_s,
+                    )
+                except asyncio.TimeoutError:
+                    if info.proc is not None:
+                        info.proc.kill()
+                    self.workers.pop(info.worker_id, None)
+                    raise SchedulingError("worker failed to start in time")
+                # Registration put the new worker in the idle pool; we are
+                # claiming it, so take it back out (else the next lease
+                # steals it).
+                if info.worker_id in self.idle_workers:
+                    self.idle_workers.remove(info.worker_id)
+                return info
+            fut = asyncio.get_running_loop().create_future()
+            self._idle_waiters.append(fut)
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise SchedulingError(
+                    "no worker became available within the start timeout "
+                    f"(pool at cap {self._worker_cap()})"
+                )
+            try:
+                await asyncio.wait_for(fut, timeout=remaining)
+            except asyncio.TimeoutError:
+                raise SchedulingError(
+                    "no worker became available within the start timeout "
+                    f"(pool at cap {self._worker_cap()})"
+                )
 
     async def _h_register_worker(self, conn, p):
         info = self.workers.get(p["worker_id"])
@@ -332,7 +429,9 @@ class NodeManager:
             info.state = "driver"
         else:
             info.state = IDLE
+            info.idle_since = time.monotonic()
             self.idle_workers.append(info.worker_id)
+            self._notify_idle()
         info.ready.set()
         return {
             "node_id": self.node_id,
@@ -534,10 +633,10 @@ class NodeManager:
             return {"spill": tuple(self.cluster_view[choice].addr)}
         return None
 
-    async def _grant(self, req: SchedulingRequest):
+    async def _grant(self, req: SchedulingRequest, for_actor: bool = False):
         subtract(self.available, req.resources)
         try:
-            info = await self._get_idle_worker()
+            info = await self._get_idle_worker(for_actor=for_actor)
         except Exception:
             add(self.available, req.resources)
             raise
@@ -564,7 +663,9 @@ class NodeManager:
         info = self.workers.get(lease.worker_id)
         if info is not None and info.state == LEASED:
             info.state = IDLE
+            info.idle_since = time.monotonic()
             self.idle_workers.append(info.worker_id)
+            self._notify_idle()
         await self._drain_pending()
         return True
 
@@ -673,7 +774,7 @@ class NodeManager:
             raise SchedulingError(
                 f"node {self.node_id[:8]} cannot fit actor {req.resources}"
             )
-        grant = await self._grant(req)
+        grant = await self._grant(req, for_actor=True)
         info = self.workers[grant["worker_id"]]
         info.state = ACTOR
         info.actor_ids.append(record["actor_id"])

@@ -28,7 +28,11 @@ def main() -> None:
 
     import os
 
+    from ray_tpu.core.config import GLOBAL_CONFIG
     from ray_tpu.core.core_worker import CoreWorker
+
+    if os.environ.get("RAY_TPU_INTERNAL_CONFIG"):
+        GLOBAL_CONFIG.apply_json(os.environ["RAY_TPU_INTERNAL_CONFIG"])
 
     def parse(a: str) -> tuple:
         host, _, port = a.rpartition(":")

@@ -249,6 +249,37 @@ def test_runtime_context_and_nodes(rt):
     assert ray_tpu.cluster_resources()["CPU"] == 32.0
 
 
+def test_worker_pool_cap_reuses_instead_of_spawning(rt):
+    """A burst of zero-CPU tasks must not fork-bomb the node: at the pool
+    cap, leases wait for idle workers instead of spawning new processes."""
+    from ray_tpu.core import api
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    @ray_tpu.remote
+    def blip():
+        time.sleep(0.05)
+        return 1
+
+    # Prime one worker so the pool is non-empty, then freeze the cap at the
+    # current pool size: every further lease MUST reuse.
+    ray_tpu.get(blip.remote())
+    head = api._runtime.head
+    old_cap = GLOBAL_CONFIG.max_worker_processes
+    GLOBAL_CONFIG.max_worker_processes = head._task_worker_count()
+    procs_before = {
+        wid for wid, w in head.workers.items() if w.proc is not None
+    }
+    try:
+        refs = [blip.options(num_cpus=0).remote() for _ in range(20)]
+        assert ray_tpu.get(refs, timeout=60) == [1] * 20
+        procs_after = {
+            wid for wid, w in head.workers.items() if w.proc is not None
+        }
+        assert procs_after <= procs_before  # no new spawns (reaping allowed)
+    finally:
+        GLOBAL_CONFIG.max_worker_processes = old_cap
+
+
 def test_cancel_queued_task(rt):
     from ray_tpu.core.errors import TaskCancelledError
 

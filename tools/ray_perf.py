@@ -1,0 +1,162 @@
+"""Control-plane microbenchmarks (reference: python/ray/_private/ray_perf.py).
+
+Measures task/actor/object throughput of the ray_tpu runtime on one machine
+and prints one line per metric. Run:
+
+    python tools/ray_perf.py [--quick]
+
+Results are checked into PERF.md next to BASELINE.md's reference numbers.
+NOTE: the dev box has ONE physical core shared by driver + GCS + node +
+workers; the reference numbers were taken on an m5.16xlarge (64 vCPU) head,
+so absolute comparisons carry a large machine handicap.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name, fn, multiplier=1, warmup=1, min_s=2.0, max_iters=50):
+    for _ in range(warmup):
+        fn()
+    start = time.perf_counter()
+    iters = 0
+    while True:
+        fn()
+        iters += 1
+        elapsed = time.perf_counter() - start
+        if elapsed > min_s or iters >= max_iters:
+            break
+    rate = multiplier * iters / elapsed
+    print(f"{name}: {rate:,.1f} /s", flush=True)
+    return name, rate
+
+
+@ray_tpu.remote
+def tiny():
+    return b"ok"
+
+
+@ray_tpu.remote
+class Sink:
+    def ping(self):
+        return b"ok"
+
+    def with_arg(self, x):
+        return b"ok"
+
+    async def aping(self):
+        return b"ok"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    batch = 20 if args.quick else 100
+    min_s = 0.5 if args.quick else 2.0
+
+    ray_tpu.init(num_cpus=16)
+    results = {}
+
+    def record(name, fn, multiplier=1):
+        n, rate = timeit(name, fn, multiplier, min_s=min_s)
+        results[n] = rate
+
+    # -- objects -------------------------------------------------------------
+    small = b"x" * 1024
+
+    def put_small():
+        for _ in range(batch):
+            ray_tpu.put(small)
+
+    record("single_client_put_calls_1kb", put_small, batch)
+
+    ref_small = ray_tpu.put(small)
+
+    def get_small():
+        for _ in range(batch):
+            ray_tpu.get(ref_small)
+
+    record("single_client_get_calls_1kb", get_small, batch)
+
+    big = np.zeros(64 * 1024 * 1024, dtype=np.uint8)  # 64 MB through shm
+
+    def put_big():
+        ref = ray_tpu.put(big)
+        del ref
+
+    n, rate = timeit(
+        "single_client_put_gigabytes", put_big, 1, min_s=min_s, max_iters=20
+    )
+    results[n] = rate * big.nbytes / 1e9
+    print(f"  -> {results[n]:.2f} GB/s", flush=True)
+
+    # -- tasks ---------------------------------------------------------------
+    def tasks_sync():
+        for _ in range(batch):
+            ray_tpu.get(tiny.remote())
+
+    record("single_client_tasks_sync", tasks_sync, batch)
+
+    def tasks_async():
+        ray_tpu.get([tiny.remote() for _ in range(batch * 5)])
+
+    record("single_client_tasks_async", tasks_async, batch * 5)
+
+    # -- actors --------------------------------------------------------------
+    sink = Sink.remote()
+    ray_tpu.get(sink.ping.remote())
+
+    def actor_sync():
+        for _ in range(batch):
+            ray_tpu.get(sink.ping.remote())
+
+    record("1_1_actor_calls_sync", actor_sync, batch)
+
+    def actor_async():
+        ray_tpu.get([sink.ping.remote() for _ in range(batch * 5)])
+
+    record("1_1_actor_calls_async", actor_async, batch * 5)
+
+    def actor_with_arg():
+        ray_tpu.get([sink.with_arg.remote(small) for _ in range(batch * 2)])
+
+    record("1_1_actor_calls_with_arg_async", actor_with_arg, batch * 2)
+
+    asink = Sink.options(max_concurrency=8).remote()
+    ray_tpu.get(asink.aping.remote())
+
+    def async_actor_async():
+        ray_tpu.get([asink.aping.remote() for _ in range(batch * 5)])
+
+    record("1_1_async_actor_calls_async", async_actor_async, batch * 5)
+
+    # n:n — 4 actors, submissions interleaved from one driver (our driver is
+    # one process; the reference uses n driver processes).
+    sinks = [Sink.remote() for _ in range(4)]
+    ray_tpu.get([s.ping.remote() for s in sinks])
+
+    def n_n_async():
+        refs = []
+        for _ in range(batch * 2):
+            for s in sinks:
+                refs.append(s.ping.remote())
+        ray_tpu.get(refs)
+
+    record("n_n_actor_calls_async", n_n_async, batch * 2 * len(sinks))
+
+    print(json.dumps(results), flush=True)
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
