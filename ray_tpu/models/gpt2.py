@@ -43,6 +43,11 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16  # activation dtype
     param_dtype: Any = jnp.float32
     attn_impl: str = "auto"  # "auto" | "pallas" | "reference"
+    # Flash kernel block sizes. Bigger blocks amortize per-program switch
+    # cost (measured best at S=1024 on v5e: 512x512); clamped to S at
+    # dispatch.
+    attn_block_q: int = 512
+    attn_block_k: int = 512
     # Rematerialization policy for the per-layer scan:
     #   "full"  — recompute the whole block in backward (min memory, +FLOPs)
     #   "dots"  — save weight-matmul outputs, recompute attention/gelu/norms
@@ -53,6 +58,12 @@ class GPT2Config:
     #   "none"  — save everything XLA wants (max memory)
     # bools accepted for back-compat: True == "full", False == "none".
     remat: bool | str = "mlp"
+    # LM-head loss chunking: SEQUENCE positions per chunk for the
+    # logits/cross-entropy computation. The full [B, S, vocab] logits tensor
+    # (and its gradient) dominates HBM at train batch sizes — 3.3 GB each at
+    # B=32, S=1024 — so the loss scans over sequence chunks and
+    # REMATERIALIZES each chunk's logits in backward. 0 disables chunking.
+    loss_chunk: int = 128
 
     @property
     def head_dim(self) -> int:
@@ -158,7 +169,14 @@ def _attn_sublayer(x, p, cfg: GPT2Config):
     def heads(t):  # [B,S,D] -> [B,H,S,Dh]
         return t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
 
-    attn = causal_attention(heads(q), heads(k_), heads(v), impl=cfg.attn_impl)
+    attn = causal_attention(
+        heads(q),
+        heads(k_),
+        heads(v),
+        impl=cfg.attn_impl,
+        block_q=cfg.attn_block_q,
+        block_k=cfg.attn_block_k,
+    )
     attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
     return x + attn @ p["proj_w"].astype(cfg.dtype) + p["proj_b"].astype(cfg.dtype)
 
@@ -175,14 +193,19 @@ def _block(x, p, cfg: GPT2Config):
     return _mlp_sublayer(_attn_sublayer(x, p, cfg), p, cfg)
 
 
-def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
-    """tokens [B, S] int32 -> logits [B, S, vocab] (activation dtype)."""
+def hidden(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, S] int32 -> final-LN hidden states [B, S, d_model]."""
     B, S = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens]
     x = x + params["wpe"].astype(cfg.dtype)[:S][None]
 
     remat = {True: "full", False: "none"}.get(cfg.remat, cfg.remat)
-    if remat == "mlp" and not uses_flash_kernel(S, impl=cfg.attn_impl):
+    if remat == "mlp" and not uses_flash_kernel(
+        S,
+        impl=cfg.attn_impl,
+        block_q=cfg.attn_block_q,
+        block_k=cfg.attn_block_k,
+    ):
         # "mlp" exists to preserve the flash kernel's o/lse residuals. On the
         # jnp reference path there is no kernel, and leaving attention
         # un-checkpointed would stack O(L*B*H*S^2) softmax residuals.
@@ -215,10 +238,55 @@ def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
         return block_fn(x, layer_params), None
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
-    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-    # Tied embeddings: logits = x @ wte^T (vocab-parallel under tp rules).
-    logits = x @ params["wte"].astype(cfg.dtype).T
-    return logits
+    return _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+
+
+def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (activation dtype).
+    Tied embeddings: logits = x @ wte^T (vocab-parallel under tp rules)."""
+    x = hidden(params, tokens, cfg)
+    return x @ params["wte"].astype(cfg.dtype).T
+
+
+def _chunked_lm_loss(
+    x: jax.Array, wte: jax.Array, targets: jax.Array, chunk: int
+) -> jax.Array:
+    """Sum of next-token cross-entropies, scanning over SEQUENCE chunks.
+
+    Each chunk's logits ([B, chunk, vocab], f32-accumulated on the MXU) live
+    only inside the scan body and are rematerialized in backward
+    (jax.checkpoint), so nothing O(B*S*vocab) is ever resident in HBM — the
+    checkpointed scan trades one extra lm-head matmul per chunk for ~6.6 GB
+    of logits+grad at B=32. Chunking runs along S (not the flattened token
+    dim) so the dp/fsdp-sharded batch dim stays intact under SPMD.
+    Padded positions carry target -1 and contribute zero.
+    """
+    B, S, D = x.shape
+    n_chunks = -(-S // chunk)
+    pad = n_chunks * chunk - S
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)), constant_values=-1)
+    xs = x.reshape(B, n_chunks, chunk, D).transpose(1, 0, 2, 3)
+    ts = targets.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    def chunk_loss(total, xs_t):
+        x_c, t_c = xs_t  # [B, chunk, D], [B, chunk]
+        logits = jax.lax.dot_general(
+            x_c, wte, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B, chunk, vocab] f32
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, jnp.maximum(t_c, 0)[..., None], axis=-1
+        )[..., 0]
+        ce = jnp.where(t_c >= 0, lse - tgt, 0.0)
+        return total + jnp.sum(ce), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(chunk_loss), jnp.zeros((), jnp.float32), (xs, ts)
+    )
+    return total
 
 
 def loss_fn(
@@ -231,13 +299,23 @@ def loss_fn(
         inputs, targets = tokens, batch["targets"]
     else:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg).astype(jnp.float32)
-    # Cross-entropy as logsumexp - target_logit: both reduce over vocab, so
-    # XLA fuses the f32 upcast into the reductions and never materializes an
-    # f32 [B, S, vocab] log-prob tensor (log_softmax + take_along_axis would).
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    loss = jnp.mean(lse - tgt)
+    if cfg.loss_chunk and inputs.shape[1] > cfg.loss_chunk:
+        x = hidden(params, inputs, cfg)
+        total = _chunked_lm_loss(
+            x,
+            params["wte"].astype(cfg.dtype),
+            targets,
+            cfg.loss_chunk,
+        )
+        loss = total / targets.size
+    else:
+        logits = forward(params, inputs, cfg).astype(jnp.float32)
+        # Cross-entropy as logsumexp - target_logit: both reduce over
+        # vocab, so XLA fuses the f32 upcast into the reductions and never
+        # materializes an f32 [B, S, vocab] log-prob tensor.
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        loss = jnp.mean(lse - tgt)
     return loss, {"loss": loss, "tokens": jnp.array(targets.size, jnp.int32)}
 
 

@@ -149,46 +149,82 @@ def _flash_attention_fwd_impl(q, k, v, scale, block_q, block_k, interpret=False)
     )(q, k, v)
 
 
-def _flash_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, scale, block_q, block_k,
+def _flash_bwd_fused_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dq_ref, dq_acc,
+    *, scale, block_q, block_k, seq_len,
 ):
-    # Blocks: q/do/dq [1, 1, block_q, d]; k/v [1, 1, S, d];
-    # lse/delta [1, 1, block_q, 1]. lse is in base-2 units (see fwd kernel).
-    qi = pl.program_id(2)
-    qs = _scaled(q_ref, scale)
-    do = do_ref[0, 0]
-    lse = lse_ref[0, 0]  # [block_q, 1] f32, base-2
-    delta = delta_ref[0, 0]
-    d = qs.shape[-1]
+    """One-sweep backward: dk/dv for this k-block AND this k-block's
+    contribution to every dq row, accumulated in a VMEM scratch that
+    persists across the (sequential) k-block grid steps.
 
-    q_start = qi * block_q
-    n_interior = (q_start + 1) // block_k
-    n_total = (q_start + block_q + block_k - 1) // block_k
-    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    The two-kernel backward recomputes the score matrix twice (once per
+    reduction direction); the kernel is VPU-bound on exactly those
+    score/prob/ds passes, so folding dq into the dk/dv sweep nearly halves
+    backward time (measured ~2x fwd instead of ~3x on v5e).
+    """
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    d = k.shape[-1]
+    scale2 = scale * _LOG2E
 
-    def body(j, acc, masked):
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        s = _dot(qs, k_blk, trans_b=True)
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    k_start = kj * block_k
+    first_q_block = k_start // block_q
+    first_interior = (k_start + block_k - 1 + block_q - 1) // block_q
+    num_q_blocks = seq_len // block_q
+    col_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(i, carry, masked):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]  # [block_q, 1]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        qs = (q_blk.astype(jnp.float32) * scale2).astype(q_blk.dtype)
+        s = _dot(qs, k, trans_b=True)  # [block_q, block_k] f32, base-2
         if masked:
-            col_ids = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
+            row_ids = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
             )
             s = jnp.where(row_ids >= col_ids, s, _NEG_INF)
-        p = jnp.exp2(s - lse)  # true softmax probs; masked entries -> 0
-        dp = _dot(do, v_blk, trans_b=True)
+        p = jnp.exp2(s - lse)
+        pT = p.astype(do_blk.dtype)
+        dv_new = dv_acc + jax.lax.dot_general(
+            pT, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = _dot(do_blk, v, trans_b=True)
         ds = p * (dp - delta)
-        return acc + _dot(ds.astype(k_blk.dtype), k_blk)
+        ds_lp = ds.astype(q_blk.dtype)
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds_lp, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dq_acc[pl.ds(i * block_q, block_q), :] += _dot(ds_lp, k)
+        return dk_new, dv_new
 
-    acc = jnp.zeros((block_q, d), jnp.float32)
-    acc = jax.lax.fori_loop(
-        0, n_interior, functools.partial(body, masked=False), acc
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    carry = jax.lax.fori_loop(
+        first_q_block,
+        jnp.minimum(first_interior, num_q_blocks),
+        functools.partial(body, masked=True),
+        (zeros, zeros),
     )
-    acc = jax.lax.fori_loop(
-        n_interior, n_total, functools.partial(body, masked=True), acc
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        first_interior, num_q_blocks, functools.partial(body, masked=False), carry
     )
-    dq_ref[0, 0] = (acc * scale).astype(dq_ref.dtype)
+    dk_ref[0, 0] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
+
+    @pl.when(kj == n_k - 1)
+    def _flush():
+        dq_ref[0, 0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(
@@ -266,26 +302,19 @@ def _flash_attention_bwd_impl(
         g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B, H, S, 1]
 
-    qd_spec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0))
-    full_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0))
-    qrow_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0))
-    fullrow_spec = pl.BlockSpec((1, 1, S, 1), lambda b, h, i: (b, h, 0, 0))
+    full_spec = pl.BlockSpec((1, 1, S, D), lambda b, h, j: (b, h, 0, 0))
+    fullrow_spec = pl.BlockSpec((1, 1, S, 1), lambda b, h, j: (b, h, 0, 0))
     kd_spec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j: (b, h, j, 0))
 
-    dq = pl.pallas_call(
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError(
+            "flash attention backward needs pallas TPU support (pltpu) for "
+            "its VMEM scratch; use impl='reference' on this install"
+        )
+    scratch = [pltpu.VMEM((S, D), jnp.float32)]
+    dk, dv, dq = pl.pallas_call(
         functools.partial(
-            _flash_dq_kernel, scale=scale, block_q=block_q, block_k=block_k
-        ),
-        grid=(B, H, S // block_q),
-        in_specs=[qd_spec, full_spec, full_spec, qd_spec, qrow_spec, qrow_spec],
-        out_specs=qd_spec,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=interpret,
-    )(q, k, v, g, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _flash_dkv_kernel,
+            _flash_bwd_fused_kernel,
             scale=scale,
             block_q=block_q,
             block_k=block_k,
@@ -295,11 +324,13 @@ def _flash_attention_bwd_impl(
         in_specs=[
             full_spec, kd_spec, kd_spec, full_spec, fullrow_spec, fullrow_spec,
         ],
-        out_specs=[kd_spec, kd_spec],
+        out_specs=[kd_spec, kd_spec, full_spec],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
         ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v, g, lse, delta)
     return dq, dk, dv

@@ -31,6 +31,49 @@ def test_forward_shapes_and_finite():
     assert np.isfinite(np.asarray(logits, np.float32)).all()
 
 
+def test_chunked_loss_matches_unchunked():
+    """The sequence-chunked rematerializing LM-head loss must be numerically
+    equivalent (loss AND grads) to the monolithic-logits path, including the
+    S % chunk != 0 padding case."""
+    import dataclasses
+
+    # f32 activations so both paths are numerically identical up to
+    # reduction order (bf16 would add ~1e-2 noise from the different logits
+    # accumulation strategies).
+    cfg_full = dataclasses.replace(
+        _tiny_cfg(), loss_chunk=0, dtype=jnp.float32
+    )
+    cfg_chunk = dataclasses.replace(
+        _tiny_cfg(), loss_chunk=24, dtype=jnp.float32  # 31 % 24 != 0
+    )
+    params = gpt2.init_params(jax.random.key(0), cfg_full)
+    tokens = jax.random.randint(
+        jax.random.key(1), (2, 32), 0, cfg_full.vocab_size
+    )
+    batch = {"tokens": tokens}
+
+    (l_full, _), g_full = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, batch, cfg_full), has_aux=True
+    )(params)
+    (l_chunk, _), g_chunk = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, batch, cfg_chunk), has_aux=True
+    )(params)
+    np.testing.assert_allclose(
+        np.asarray(l_full), np.asarray(l_chunk), rtol=1e-5
+    )
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(g_full),
+        jax.tree_util.tree_leaves_with_path(g_chunk),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32),
+            np.asarray(b, np.float32),
+            rtol=1e-4,
+            atol=1e-6,
+            err_msg=str(path),
+        )
+
+
 def test_loss_decreases_single_device():
     cfg = _tiny_cfg()
     opt = optax.adam(1e-2)
