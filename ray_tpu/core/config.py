@@ -50,6 +50,9 @@ class Config:
     node_death_timeout_s: float = 10.0
     # Task defaults
     default_max_retries: int = 3
+    # Lineage reconstruction: resubmissions of a producing task whose output
+    # was lost (reference: task resubmit in task_manager.h:229)
+    max_lineage_attempts: int = 3
     # Actor defaults
     default_max_restarts: int = 0
     # RPC

@@ -66,6 +66,8 @@ class TaskSpec:
     policy: str = "hybrid"
     pg: tuple | None = None  # (pg_id, capture_child_tasks)
     cancelled: bool = False  # set by cancel(); suppresses push and retries
+    completed: bool = False  # finished at least once (spec kept for lineage)
+    lineage_attempts: int = 0  # reconstruction resubmissions so far
     # actor fields
     actor_id: str | None = None
     method: str | None = None
@@ -116,6 +118,8 @@ class CoreWorker:
         self._task_specs: dict[str, TaskSpec] = {}  # task_id -> spec (lineage)
         # owner side: task_id -> worker addr while a push RPC is in flight
         self._inflight_push: dict[str, tuple] = {}
+        # owner side: task_id -> future, in-flight lineage resubmissions
+        self._reconstructing: dict[str, asyncio.Future] = {}
         # executor side (all guarded by _cancel_lock):
         self._cancel_lock = threading.Lock()
         self._running_tasks: dict[str, int] = {}  # task_id -> thread ident
@@ -244,7 +248,14 @@ class CoreWorker:
             return
         if obj.local_refs <= 0 and obj.borrowers <= 0 and obj.state != PENDING:
             self.owner_store.delete(oid)
-            self._task_specs.pop(oid, None)
+            # Lineage GC: drop the producing spec once NONE of its return
+            # refs remain live (it can never be needed for reconstruction).
+            task_id = obj.producing_task
+            spec = self._task_specs.get(task_id) if task_id else None
+            if spec is not None and spec.completed and not any(
+                rid in self.owner_store.objects for rid in spec.return_ids
+            ):
+                self._task_specs.pop(task_id, None)
             for node_id in obj.locations:
                 addr = await self._node_addr_for(node_id)
                 if addr is not None:
@@ -270,23 +281,39 @@ class CoreWorker:
                     f"dropped before this fetch)"
                 )
             }
-        obj = await self.owner_store.wait_ready(oid, timeout)
-        if obj.state == FAILED:
-            return {"error": obj.error}
-        if obj.inline is not None:
-            return {"inline": obj.inline}
-        node_id = next(iter(obj.locations), None)
-        if node_id is None:
-            return {"error": ObjectLostError(f"object {oid} has no locations")}
-        info = await self._node_info_for(node_id) or {}
-        return {
-            "location": {
-                "node_id": node_id,
-                "addr": tuple(info["addr"]) if info.get("addr") else None,
-                "shm_root": info.get("shm_root"),
-                "size": obj.size,
+        exclude = set(p.get("exclude_nodes") or [])
+        reconstructed = False
+        while True:
+            obj = await self.owner_store.wait_ready(oid, timeout)
+            if obj.state == FAILED:
+                return {"error": obj.error}
+            if obj.inline is not None:
+                return {"inline": obj.inline}
+            # The borrower's excludes initially only FILTER our view (a
+            # failed pull may be transient). Once the filter exhausts every
+            # copy, the exclusion is corroborated: prune those locations
+            # for real and reconstruct. The filter is lifted afterwards —
+            # the rerun's copy is a fresh blob even if it landed on an
+            # excluded node.
+            avail = obj.locations if reconstructed else obj.locations - exclude
+            node_id = next(iter(avail), None)
+            if node_id is None:
+                obj.locations -= exclude
+                try:
+                    await self._reconstruct(oid)
+                    reconstructed = True
+                except Exception as e:  # noqa: BLE001
+                    return {"error": e}
+                continue
+            info = await self._node_info_for(node_id) or {}
+            return {
+                "location": {
+                    "node_id": node_id,
+                    "addr": tuple(info["addr"]) if info.get("addr") else None,
+                    "shm_root": info.get("shm_root"),
+                    "size": obj.size,
+                }
             }
-        }
 
     async def _h_owner_wait_ready(self, conn, p):
         if p["oid"] not in self.owner_store.objects:
@@ -387,37 +414,139 @@ class CoreWorker:
     ) -> bytes:
         oid = ref.hex()
         if self._is_owner(ref):
+            while True:
+                try:
+                    obj = await self.owner_store.wait_ready(oid, timeout)
+                except asyncio.TimeoutError:
+                    raise GetTimeoutError(
+                        f"object {oid[:12]} not ready in time"
+                    )
+                if obj.state == FAILED:
+                    raise obj.error
+                if obj.inline is not None:
+                    return obj.inline
+                node_id = next(iter(obj.locations), None)
+                if node_id is not None:
+                    try:
+                        return await self._fetch_from_location(
+                            oid,
+                            {
+                                "node_id": node_id,
+                                "size": obj.size,
+                                "addr": None,
+                                "shm_root": None,
+                            },
+                        )
+                    except (GetTimeoutError, TaskCancelledError):
+                        raise
+                    except Exception:
+                        # Copy unreachable (node died, blob gone). Drop the
+                        # location; try another copy or reconstruct.
+                        obj.locations.discard(node_id)
+                        continue
+                await self._reconstruct(oid)
+        # Borrower path: the owner resolves (and if needed reconstructs) the
+        # object; we retry with failed nodes excluded.
+        exclude: list = []
+        while True:
             try:
-                obj = await self.owner_store.wait_ready(oid, timeout)
-            except asyncio.TimeoutError:
-                raise GetTimeoutError(f"object {oid[:12]} not ready in time")
-            if obj.state == FAILED:
-                raise obj.error
-            if obj.inline is not None:
-                return obj.inline
-            return await self._fetch_from_location(
-                oid,
-                {
-                    "node_id": next(iter(obj.locations)),
-                    "size": obj.size,
-                    "addr": None,
-                    "shm_root": None,
-                },
+                reply = await self.endpoint.acall(
+                    ref.owner_addr,
+                    "owner.get_object",
+                    {"oid": oid, "timeout": timeout, "exclude_nodes": exclude},
+                )
+            except (ConnectionLost, ConnectionError, OSError):
+                # The owner process is gone; its objects die with it
+                # (reference: OwnerDiedError).
+                raise ObjectLostError(
+                    f"owner of object {oid[:12]} is unreachable (owner "
+                    f"process died?)"
+                )
+            if "error" in reply:
+                err = reply["error"]
+                raise err if isinstance(err, Exception) else ObjectLostError(
+                    str(err)
+                )
+            if "inline" in reply:
+                return reply["inline"]
+            loc = reply["location"]
+            try:
+                return await self._fetch_from_location(oid, loc)
+            except (GetTimeoutError, TaskCancelledError):
+                raise
+            except Exception:
+                if loc["node_id"] in exclude:
+                    raise
+                exclude.append(loc["node_id"])
+
+    async def _reconstruct(self, oid: str) -> None:
+        """Resubmit the producing task of a lost owned object (lineage
+        reconstruction; reference: object_recovery_manager.h:41,
+        task_manager.h:229 ResubmitTask). Concurrent losses of sibling
+        return values coalesce onto one resubmission."""
+        obj = self.owner_store.objects.get(oid)
+        task_id = obj.producing_task if obj else None
+        spec = self._task_specs.get(task_id) if task_id else None
+        if spec is None or spec.actor_id is not None:
+            raise ObjectLostError(
+                f"object {oid[:12]} was lost and has no lineage to "
+                f"reconstruct it"
             )
-        reply = await self.endpoint.acall(
-            ref.owner_addr, "owner.get_object", {"oid": oid, "timeout": timeout}
-        )
-        if "error" in reply:
-            err = reply["error"]
-            raise err if isinstance(err, Exception) else ObjectLostError(str(err))
-        if "inline" in reply:
-            return reply["inline"]
-        return await self._fetch_from_location(oid, reply["location"])
+        if spec.cancelled:
+            raise TaskCancelledError(f"task {spec.name} was cancelled")
+        fut = self._reconstructing.get(task_id)
+        if fut is not None:
+            await asyncio.shield(fut)
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._reconstructing[task_id] = fut
+        try:
+            if spec.lineage_attempts >= GLOBAL_CONFIG.max_lineage_attempts:
+                raise ObjectLostError(
+                    f"object {oid[:12]} lost; reconstruction gave up after "
+                    f"{spec.lineage_attempts} attempts"
+                )
+            spec.lineage_attempts += 1
+            spec.completed = False
+            for rid in spec.return_ids:
+                # Reset ONLY return values that are tracked and actually
+                # lost (READY with no remaining copy). Freed siblings must
+                # NOT be resurrected (nothing would ever release them), and
+                # siblings with healthy copies keep their entries — the
+                # rerun just adds a fresh location.
+                o = self.owner_store.objects.get(rid)
+                if o is None:
+                    continue
+                if o.state == READY and o.inline is None and not o.locations:
+                    o.state = PENDING
+                    o.error = None
+            await self._enqueue_task_respec(spec)
+            fut.set_result(None)
+        except BaseException as e:
+            fut.set_exception(e)
+            fut.exception()  # consumed; waiters that never arrive are fine
+            raise
+        finally:
+            del self._reconstructing[task_id]
+
+    async def _read_local_shm(self, oid: str) -> bytes:
+        try:
+            return bytes(self.shm_reader.get(oid))
+        except (FileNotFoundError, OSError):
+            # Not at the shm path — possibly spilled to disk by the node.
+            ok = await self.endpoint.acall(
+                self.node_addr, "node.restore_object", {"oid": oid}
+            )
+            if not ok:
+                raise ObjectLostError(
+                    f"object {oid[:12]} not in the local store"
+                )
+            return bytes(self.shm_reader.get(oid))
 
     async def _fetch_from_location(self, oid: str, loc: dict) -> bytes:
         node_id = loc["node_id"]
         if node_id == self.node_id:
-            return bytes(self.shm_reader.get(oid))
+            return await self._read_local_shm(oid)
         # Remote: ask our node to pull it over, then read locally.
         addr = loc.get("addr") or await self._node_addr_for(node_id)
         if addr is None:
@@ -427,7 +556,7 @@ class CoreWorker:
             "node.pull_object",
             {"oid": oid, "from_addr": tuple(addr), "size": loc["size"]},
         )
-        return bytes(self.shm_reader.get(oid))
+        return await self._read_local_shm(oid)
 
     def wait(
         self,
@@ -702,13 +831,33 @@ class CoreWorker:
         results = reply["results"]
         for oid, res in zip(spec.return_ids, results):
             kind = res[0]
+            if spec.lineage_attempts and oid not in self.owner_store.objects:
+                # A reconstruction rerun recomputed a sibling whose ref was
+                # already dropped: don't resurrect the owner entry, and free
+                # the orphan blob the rerun just sealed on its node.
+                if kind == "location":
+                    asyncio.ensure_future(self._free_remote_blob(res[1], oid))
+                continue
             if kind == "inline":
                 self.owner_store.put_inline(oid, res[1])
             elif kind == "location":
                 self.owner_store.put_location(oid, res[1], res[2])
             elif kind == "error":
                 self.owner_store.put_error(oid, res[1])
-        self._task_specs.pop(spec.task_id, None)
+        # Spec RETAINED while any return ref is live: it is the lineage used
+        # to reconstruct outputs whose only copy dies with a node
+        # (reference: task_manager.h:229 ResubmitTask; GC in _maybe_free).
+        spec.completed = True
+
+    async def _free_remote_blob(self, node_id: str, oid: str) -> None:
+        addr = await self._node_addr_for(node_id)
+        if addr is not None:
+            try:
+                await self.endpoint.anotify(
+                    addr, "node.free_object", {"oid": oid}
+                )
+            except Exception:
+                pass
 
     async def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
         for oid in spec.return_ids:

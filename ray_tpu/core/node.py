@@ -815,14 +815,31 @@ class NodeManager:
 
     # -- object plane --------------------------------------------------------
 
+    async def _store_call(self, fn, *args):
+        """Run a store operation in an executor thread: spill/restore may
+        copy multi-GB blobs between shm and disk, which must not stall the
+        event loop (heartbeats would miss and the node be declared dead).
+        The store is internally locked."""
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fn, *args
+        )
+
     async def _h_object_created(self, conn, p):
         """A local worker sealed an object file in our shm root."""
-        self.store.adopt(p["oid"], p["size"])
+        await self._store_call(self.store.adopt, p["oid"], p["size"])
         return True
 
     async def _h_free_object(self, conn, p):
         self.store.delete(p["oid"])
         return True
+
+    async def _h_restore_object(self, conn, p):
+        """A local worker's direct shm-path read missed — the blob was
+        spilled to disk. Restore it into shm so the worker can map it."""
+        if self.store.contains(p["oid"]):
+            await self._store_call(self.store.get, p["oid"])  # restores
+            return True
+        return False
 
     async def _h_fetch_object(self, conn, p):
         """Peer node requests a chunk of a sealed object."""
@@ -831,8 +848,10 @@ class NodeManager:
             # it before its object_created notification reached us.
             path = os.path.join(self.shm_root, p["oid"])
             if os.path.exists(path):
-                self.store.adopt(p["oid"], os.path.getsize(path))
-        view = self.store.get(p["oid"])
+                await self._store_call(
+                    self.store.adopt, p["oid"], os.path.getsize(path)
+                )
+        view = await self._store_call(self.store.get, p["oid"])
         off, ln = p["offset"], p["length"]
         return bytes(view[off : off + ln])
 
@@ -860,7 +879,7 @@ class NodeManager:
             del self._inflight_pulls[oid]
 
     async def _do_pull(self, oid: str, src_addr: tuple, size: int) -> dict:
-        buf = self.store.create(oid, size)
+        buf = await self._store_call(self.store.create, oid, size)
         try:
             chunk = GLOBAL_CONFIG.object_transfer_chunk_bytes
             off = 0

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import mmap
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
@@ -36,90 +37,185 @@ class ShmObjectStore:
     worker-side handles (`ShmReader`) just map.
     """
 
-    def __init__(self, root: str, capacity_bytes: int):
+    def __init__(
+        self, root: str, capacity_bytes: int, spill_root: str | None = None
+    ):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.capacity = capacity_bytes
-        self.used = 0
-        # object hex -> (size, sealed, last_access)
+        self.used = 0  # bytes resident in shm (spilled bytes excluded)
+        # Guards all metadata/file transitions: spill/restore copies run in
+        # executor threads (off the node's event loop) while the loop keeps
+        # serving RPCs.
+        self._lock = threading.RLock()
+        # object hex -> [size, sealed, last_access, location("shm"|"spill")]
         self.meta: dict[str, list] = {}
         self._maps: dict[str, tuple[mmap.mmap, memoryview]] = {}
+        # Spill tier: sealed blobs LRU-move to durable disk when shm is at
+        # capacity, and restore on access (reference:
+        # src/ray/raylet/local_object_manager.h:44 spill/restore).
+        self.spill_root = spill_root or os.path.join(
+            "/tmp", "raytpu_spill", *root.rstrip("/").split("/")[-2:]
+        )
 
     def _path(self, oid_hex: str) -> str:
         return os.path.join(self.root, oid_hex)
 
-    def create(self, oid_hex: str, size: int) -> memoryview:
-        if oid_hex in self.meta:
-            raise ValueError(f"object {oid_hex} already exists")
-        if self.used + size > self.capacity:
-            raise MemoryError(
-                f"object store over capacity: {self.used}+{size} > "
-                f"{self.capacity}"
+    def _spill_path(self, oid_hex: str) -> str:
+        return os.path.join(self.spill_root, oid_hex)
+
+    def _ensure_capacity(self, need: int) -> None:
+        """Spill LRU sealed shm blobs to disk until `need` more bytes fit."""
+        with self._lock:
+            if self.used + need <= self.capacity:
+                return
+            candidates = sorted(
+                (
+                    (entry[2], oid)
+                    for oid, entry in self.meta.items()
+                    if entry[1] and entry[3] == "shm"
+                ),
             )
-        path = self._path(oid_hex)
-        fd = os.open(path + ".tmp", os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
-        try:
-            os.ftruncate(fd, max(size, 1))
-            mm = mmap.mmap(fd, max(size, 1))
-        finally:
-            os.close(fd)
-        self.meta[oid_hex] = [size, False, time.monotonic()]
-        self.used += size
-        self._maps[oid_hex] = (mm, memoryview(mm)[:size])
-        return self._maps[oid_hex][1]
+            for _, oid in candidates:
+                if self.used + need <= self.capacity:
+                    return
+                self._spill(oid)
+            if self.used + need > self.capacity:
+                raise MemoryError(
+                    f"object store over capacity even after spilling: "
+                    f"{self.used}+{need} > {self.capacity}"
+                )
+
+    def _spill(self, oid_hex: str) -> None:
+        with self._lock:
+            import shutil
+
+            entry = self.meta[oid_hex]
+            pair = self._maps.pop(oid_hex, None)
+            if pair is not None:
+                mm, view = pair
+                view.release()
+                mm.close()
+            os.makedirs(self.spill_root, exist_ok=True)
+            # Copy+rename (shm and disk are different filesystems), then unlink.
+            tmp = self._spill_path(oid_hex) + ".tmp"
+            shutil.copyfile(self._path(oid_hex), tmp)
+            os.rename(tmp, self._spill_path(oid_hex))
+            os.unlink(self._path(oid_hex))
+            entry[3] = "spill"
+            self.used -= entry[0]
+
+    def _restore(self, oid_hex: str) -> None:
+        with self._lock:
+            import shutil
+
+            entry = self.meta[oid_hex]
+            self._ensure_capacity(entry[0])
+            tmp = self._path(oid_hex) + ".restore"
+            shutil.copyfile(self._spill_path(oid_hex), tmp)
+            os.rename(tmp, self._path(oid_hex))
+            os.unlink(self._spill_path(oid_hex))
+            entry[3] = "shm"
+            self.used += entry[0]
+
+    def create(self, oid_hex: str, size: int) -> memoryview:
+        with self._lock:
+            if oid_hex in self.meta:
+                raise ValueError(f"object {oid_hex} already exists")
+            self._ensure_capacity(size)
+            path = self._path(oid_hex)
+            fd = os.open(path + ".tmp", os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, max(size, 1))
+                mm = mmap.mmap(fd, max(size, 1))
+            finally:
+                os.close(fd)
+            self.meta[oid_hex] = [size, False, time.monotonic(), "shm"]
+            self.used += size
+            self._maps[oid_hex] = (mm, memoryview(mm)[:size])
+            return self._maps[oid_hex][1]
 
     def seal(self, oid_hex: str) -> None:
-        entry = self.meta[oid_hex]
-        mm, view = self._maps[oid_hex]
-        mm.flush()
-        os.rename(self._path(oid_hex) + ".tmp", self._path(oid_hex))
-        entry[1] = True
+        with self._lock:
+            entry = self.meta[oid_hex]
+            mm, view = self._maps[oid_hex]
+            mm.flush()
+            os.rename(self._path(oid_hex) + ".tmp", self._path(oid_hex))
+            entry[1] = True
 
     def adopt(self, oid_hex: str, size: int) -> None:
         """Account for a sealed object a local worker created directly in our
-        root (the worker wrote the file; we track capacity/eviction)."""
-        if oid_hex in self.meta:
-            return
-        self.meta[oid_hex] = [size, True, time.monotonic()]
-        self.used += size
+        root (the worker wrote the file; we track capacity/eviction). Adopt
+        can push `used` past capacity momentarily; spill-down restores the
+        invariant without touching the just-adopted blob (it is MRU)."""
+        with self._lock:
+            if oid_hex in self.meta:
+                return
+            self.meta[oid_hex] = [size, True, time.monotonic(), "shm"]
+            self.used += size
+            if self.used > self.capacity:
+                try:
+                    self._ensure_capacity(0)
+                except MemoryError:
+                    pass  # one oversized blob; nothing left to spill
 
     def contains(self, oid_hex: str) -> bool:
-        return oid_hex in self.meta and self.meta[oid_hex][1]
+        with self._lock:
+            return oid_hex in self.meta and self.meta[oid_hex][1]
+
+    def is_spilled(self, oid_hex: str) -> bool:
+        with self._lock:
+            return (
+                oid_hex in self.meta and self.meta[oid_hex][3] == "spill"
+            )
 
     def get(self, oid_hex: str) -> memoryview:
-        if not self.contains(oid_hex):
-            raise KeyError(oid_hex)
-        self.meta[oid_hex][2] = time.monotonic()
-        if oid_hex not in self._maps:
-            size = self.meta[oid_hex][0]
-            with open(self._path(oid_hex), "rb") as f:
-                mm = mmap.mmap(f.fileno(), max(size, 1), prot=mmap.PROT_READ)
-            self._maps[oid_hex] = (mm, memoryview(mm)[:size])
-        return self._maps[oid_hex][1]
+        with self._lock:
+            if not self.contains(oid_hex):
+                raise KeyError(oid_hex)
+            entry = self.meta[oid_hex]
+            entry[2] = time.monotonic()
+            if entry[3] == "spill":
+                self._restore(oid_hex)
+            if oid_hex not in self._maps:
+                size = entry[0]
+                with open(self._path(oid_hex), "rb") as f:
+                    mm = mmap.mmap(f.fileno(), max(size, 1), prot=mmap.PROT_READ)
+                self._maps[oid_hex] = (mm, memoryview(mm)[:size])
+            return self._maps[oid_hex][1]
 
     def delete(self, oid_hex: str) -> None:
-        entry = self.meta.pop(oid_hex, None)
-        if entry is None:
-            return
-        self.used -= entry[0]
-        pair = self._maps.pop(oid_hex, None)
-        if pair is not None:
-            mm, view = pair
-            view.release()
-            mm.close()
-        for suffix in ("", ".tmp"):
-            try:
-                os.unlink(self._path(oid_hex) + suffix)
-            except FileNotFoundError:
-                pass
+        with self._lock:
+            entry = self.meta.pop(oid_hex, None)
+            if entry is None:
+                return
+            if entry[3] == "shm":
+                self.used -= entry[0]
+            pair = self._maps.pop(oid_hex, None)
+            if pair is not None:
+                mm, view = pair
+                view.release()
+                mm.close()
+            for suffix in ("", ".tmp"):
+                try:
+                    os.unlink(self._path(oid_hex) + suffix)
+                except FileNotFoundError:
+                    pass
+            for suffix in ("", ".tmp"):
+                try:
+                    os.unlink(self._spill_path(oid_hex) + suffix)
+                except FileNotFoundError:
+                    pass
 
     def close(self) -> None:
-        for oid in list(self.meta):
-            self.delete(oid)
-        try:
-            os.rmdir(self.root)
-        except OSError:
-            pass
+        with self._lock:
+            for oid in list(self.meta):
+                self.delete(oid)
+            for d in (self.root, self.spill_root):
+                try:
+                    os.rmdir(d)
+                except OSError:
+                    pass
 
 
 class ShmWriter:

@@ -10,10 +10,10 @@ is part of the TPU-native compute tier that replaces those engines.
 Both directions are fused:
 
 - forward: online-softmax kernel that also writes the per-row logsumexp (LSE).
-- backward: two kernels that recompute block-local probabilities from the
-  saved LSE (p = exp(s - lse)) instead of re-running the softmax — one kernel
-  accumulates dq over k-blocks, the other accumulates dk/dv over q-blocks.
-  Nothing O(S^2) ever touches HBM.
+- backward: ONE fused kernel sweeping k-blocks that recomputes block-local
+  probabilities from the saved LSE (p = exp(s - lse)) instead of re-running
+  the softmax, producing dk/dv per block and accumulating dq in a VMEM
+  scratch. Nothing O(S^2) ever touches HBM.
 
 Matmuls run on the MXU in the input dtype (bf16 by design) with float32
 accumulation (preferred_element_type); softmax statistics stay float32.
@@ -225,69 +225,6 @@ def _flash_bwd_fused_kernel(
     @pl.when(kj == n_k - 1)
     def _flush():
         dq_ref[0, 0] = (dq_acc[...] * scale).astype(dq_ref.dtype)
-
-
-def _flash_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, scale, block_q, block_k, seq_len,
-):
-    # Blocks: k/v/dk/dv [1, 1, block_k, d]; q/do [1, 1, S, d];
-    # lse/delta [1, 1, S, 1] (base-2 lse).
-    kj = pl.program_id(2)
-    k = k_ref[0, 0]
-    v = v_ref[0, 0]
-    d = k.shape[-1]
-    scale2 = scale * _LOG2E
-
-    k_start = kj * block_k
-    # q-blocks strictly above the diagonal contribute nothing; blocks fully
-    # below it need no mask. Only the straddling band pays for masking.
-    first_q_block = k_start // block_q
-    first_interior = (k_start + block_k - 1 + block_q - 1) // block_q
-    num_q_blocks = seq_len // block_q
-    col_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-
-    def body(i, carry, masked):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        do_blk = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]  # [block_q, 1]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
-        qs = (q_blk.astype(jnp.float32) * scale2).astype(q_blk.dtype)
-        s = _dot(qs, k, trans_b=True)  # [block_q, block_k] f32, base-2
-        if masked:
-            row_ids = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            s = jnp.where(row_ids >= col_ids, s, _NEG_INF)
-        p = jnp.exp2(s - lse)
-        pT = p.astype(do_blk.dtype)
-        # Contract over the q dimension directly (dim 0 of both operands):
-        # the MXU handles this layout without an explicit transpose pass.
-        dv_new = dv_acc + jax.lax.dot_general(
-            pT, do_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = _dot(do_blk, v, trans_b=True)
-        ds = p * (dp - delta)
-        dk_new = dk_acc + jax.lax.dot_general(
-            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk_new, dv_new
-
-    zeros = jnp.zeros((block_k, d), jnp.float32)
-    carry = jax.lax.fori_loop(
-        first_q_block,
-        jnp.minimum(first_interior, num_q_blocks),
-        functools.partial(body, masked=True),
-        (zeros, zeros),
-    )
-    dk_acc, dv_acc = jax.lax.fori_loop(
-        first_interior, num_q_blocks, functools.partial(body, masked=False), carry
-    )
-    dk_ref[0, 0] = (dk_acc * scale).astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
 
 
 @functools.partial(

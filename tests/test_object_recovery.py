@@ -1,0 +1,115 @@
+"""Object recovery (lineage reconstruction) + spill-to-disk.
+
+Reference parity: src/ray/core_worker/object_recovery_manager.h:41 (lineage
+resubmit on lost copies), src/ray/raylet/local_object_manager.h:44
+(spill/restore). Chaos style mirrors the reference's ResourceKiller tests
+(python/ray/_private/test_utils.py:1412).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.errors import ObjectLostError
+
+
+@pytest.fixture()
+def fresh_cluster():
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_lineage_reconstruction_after_node_death(fresh_cluster):
+    """A large object whose ONLY copy dies with its node is transparently
+    reconstructed by resubmitting the producing task."""
+    runtime = fresh_cluster
+    node2 = runtime.add_node({"CPU": 2.0, "doomed": 1.0})
+    time.sleep(0.5)
+
+    @ray_tpu.remote(resources={"doomed": 1.0}, num_cpus=1)
+    def produce():
+        # Big enough to live in shm (not inline in the owner).
+        return np.full((1 << 20,), 7, np.uint8)
+
+    ref = produce.remote()
+    # Wait until the object exists (don't fetch: fetching would copy it to
+    # the head node and defeat the loss scenario).
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    node2.die_silently()
+    time.sleep(0.5)
+
+    # The only copy is gone; the resubmitted task has no feasible node for
+    # {"doomed": 1} until we add one — prove reconstruction re-runs rather
+    # than reading a stale copy by re-adding capacity.
+    runtime.add_node({"CPU": 2.0, "doomed": 1.0})
+    time.sleep(0.5)
+    out = ray_tpu.get(ref, timeout=120)
+    assert out.shape == (1 << 20,) and int(out[0]) == 7
+
+
+def test_lineage_reconstruction_from_borrower(fresh_cluster):
+    """A borrower (another task) triggers owner-side reconstruction when its
+    pull of the only copy fails."""
+    runtime = fresh_cluster
+    node2 = runtime.add_node({"CPU": 2.0, "doomed": 1.0})
+    time.sleep(0.5)
+
+    @ray_tpu.remote(resources={"doomed": 1.0}, num_cpus=1)
+    def produce():
+        return np.full((1 << 20,), 3, np.uint8)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(x):
+        return int(x[0]) + int(x[-1])
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    node2.die_silently()
+    time.sleep(0.5)
+    runtime.add_node({"CPU": 2.0, "doomed": 1.0})
+    time.sleep(0.5)
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == 6
+
+
+def test_put_object_lost_is_terminal(fresh_cluster):
+    """put() objects have no lineage: losing the only copy surfaces
+    ObjectLostError instead of hanging."""
+    runtime = fresh_cluster
+
+    # Put on a worker on a doomed node, return the ref to the driver.
+    node2 = runtime.add_node({"CPU": 2.0, "doomed": 1.0})
+    time.sleep(0.5)
+
+    @ray_tpu.remote(resources={"doomed": 1.0}, num_cpus=1)
+    def put_there():
+        return ray_tpu.put(np.zeros(1 << 20, np.uint8))
+
+    inner = ray_tpu.get(put_there.remote(), timeout=60)
+    node2.die_silently()
+    time.sleep(0.5)
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(inner, timeout=30)
+
+
+def test_spilling_keeps_puts_working(fresh_cluster):
+    """Filling the store past capacity spills LRU blobs to disk instead of
+    erroring, and spilled objects restore transparently on get()."""
+    from ray_tpu.core import api as core_api
+
+    runtime = fresh_cluster
+    store = runtime.head.store
+    old_cap = store.capacity
+    store.capacity = 10 << 20  # holds ~2 of the 4 MB blobs
+    try:
+        blobs = [np.full(4 << 20, i, np.uint8) for i in range(6)]
+        refs = [ray_tpu.put(b) for b in blobs]
+        # All 24 MB live logically in a 10 MB store: some spilled.
+        assert store.used <= store.capacity
+        assert any(store.is_spilled(r.hex()) for r in refs)
+        for b, r in zip(blobs, refs):
+            np.testing.assert_array_equal(ray_tpu.get(r, timeout=60), b)
+    finally:
+        store.capacity = old_cap
