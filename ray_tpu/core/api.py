@@ -8,6 +8,7 @@ TPU-native runtime in this package.
 
 from __future__ import annotations
 
+import asyncio
 import atexit
 import functools
 import os
@@ -519,6 +520,19 @@ def get(refs, timeout: float | None = None):
     return values[0] if single else values
 
 
+async def get_async(refs, timeout: float | None = None):
+    """Await object values from an async actor method (which runs on the
+    worker's endpoint loop, where the blocking get() would deadlock)."""
+    worker = _require_worker()
+    single = isinstance(refs, ObjectRef)
+    lst = [refs] if single else list(refs)
+    for r in lst:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get_async() expects ObjectRef(s), got {type(r)}")
+    values = await worker._get_async(lst, timeout)
+    return values[0] if single else values
+
+
 def put(value) -> ObjectRef:
     return _require_worker().put(value)
 
@@ -536,10 +550,17 @@ def wait(
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     worker = _require_worker()
-    worker.gcs.call(
-        "kill_actor",
-        {"actor_id": actor._actor_id, "allow_restart": not no_restart},
-    )
+    payload = {"actor_id": actor._actor_id, "allow_restart": not no_restart}
+    if worker.on_endpoint_loop():
+        # From an async actor method (endpoint loop): blocking would
+        # deadlock the loop; kill is fire-and-forget there.
+        from ray_tpu.core.core_worker import _logged
+
+        asyncio.ensure_future(
+            _logged(worker.gcs.acall("kill_actor", payload), "kill_actor")
+        )
+    else:
+        worker.gcs.call("kill_actor", payload)
 
 
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
@@ -557,6 +578,15 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
 def get_actor(name: str) -> ActorHandle:
     worker = _require_worker()
     info = worker.gcs.call("get_actor", {"name": name})
+    if info is None:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(info["actor_id"], "Actor")
+
+
+async def get_actor_async(name: str) -> ActorHandle:
+    """get_actor usable from async actor methods (endpoint loop)."""
+    worker = _require_worker()
+    info = await worker.gcs.acall("get_actor", {"name": name})
     if info is None:
         raise ValueError(f"no actor named {name!r}")
     return ActorHandle(info["actor_id"], "Actor")

@@ -654,8 +654,26 @@ class CoreWorker:
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, name)
             for oid in return_ids
         ]
-        self.endpoint.submit(self._enqueue_task(spec)).result(timeout=30)
+        self._run_on_loop(self._enqueue_task(spec))
         return refs
+
+    def on_endpoint_loop(self) -> bool:
+        """True when the caller is running ON this worker's endpoint loop
+        (async actor methods) — where any blocking wait would deadlock."""
+        try:
+            return asyncio.get_running_loop() is self.endpoint.loop
+        except RuntimeError:
+            return False
+
+    def _run_on_loop(self, coro) -> None:
+        """Run an enqueue coroutine on the endpoint loop. From the loop
+        itself (async actor methods submitting work), schedule it without
+        blocking; scheduling order is FIFO, so submission order (and thus
+        actor-task seq order) is preserved."""
+        if self.on_endpoint_loop():
+            asyncio.ensure_future(_logged(coro, "task enqueue"))
+        else:
+            self.endpoint.submit(coro).result(timeout=30)
 
     def _encode_arg(self, value: Any):
         if isinstance(value, ObjectRef):
@@ -848,6 +866,13 @@ class CoreWorker:
         # to reconstruct outputs whose only copy dies with a node
         # (reference: task_manager.h:229 ResubmitTask; GC in _maybe_free).
         spec.completed = True
+        # Fire-and-forget pattern: refs dropped while the task was PENDING
+        # couldn't free then — re-check now that results exist.
+        asyncio.ensure_future(self._free_completed_outputs(spec))
+
+    async def _free_completed_outputs(self, spec: TaskSpec) -> None:
+        for oid in spec.return_ids:
+            await self._maybe_free(oid)
 
     async def _free_remote_blob(self, node_id: str, oid: str) -> None:
         addr = await self._node_addr_for(node_id)
@@ -954,6 +979,19 @@ class CoreWorker:
             "class_name": getattr(cls, "__name__", "Actor"),
             "pg": pg,
         }
+        if self.on_endpoint_loop():
+            # Async actor method creating an actor: the actor id is chosen
+            # client-side, so registration can proceed without blocking the
+            # loop (the submitter retries name resolution until the GCS
+            # finishes scheduling it; a registration error is logged here
+            # and surfaces to callers as the actor never becoming alive).
+            asyncio.ensure_future(
+                _logged(
+                    self.gcs.acall("create_actor", {"spec": spec}),
+                    f"actor registration ({spec['class_name']})",
+                )
+            )
+            return {"actor_id": actor_id}
         info = self.gcs.call("create_actor", {"spec": spec}, timeout=120)
         return info
 
@@ -986,7 +1024,7 @@ class CoreWorker:
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, spec.name)
             for oid in return_ids
         ]
-        self.endpoint.submit(self._submit_actor_async(spec)).result(30)
+        self._run_on_loop(self._submit_actor_async(spec))
         return refs
 
     async def _submit_actor_async(self, spec: TaskSpec) -> None:
@@ -1130,13 +1168,28 @@ class CoreWorker:
                 self._cancelled_tasks.discard(task_id)
 
     async def _execute_actor_task(self, p) -> dict:
-        # Per-caller ordering: execute in sequence-number order.
+        # Per-caller ordering: calls START in sequence-number order (the
+        # reference guarantee). Once a call's args are resolved and the user
+        # method is about to run, the next call may proceed — that is what
+        # lets async actor methods interleave up to max_concurrency instead
+        # of serializing on completion.
         caller, seq = p["caller"], p["seq"]
         expected = self._actor_seq.get(caller, 0)
         if seq != expected:
             ev = asyncio.Event()
             self._actor_buffer[(caller, seq)] = ev
             await ev.wait()
+        advanced = False
+
+        def advance():
+            nonlocal advanced
+            if not advanced:
+                advanced = True
+                self._actor_seq[caller] = seq + 1
+                nxt = self._actor_buffer.pop((caller, seq + 1), None)
+                if nxt is not None:
+                    nxt.set()
+
         try:
             from ray_tpu.util.placement_group import _bind_ambient_pg
 
@@ -1159,9 +1212,11 @@ class CoreWorker:
 
             try:
                 if asyncio.iscoroutinefunction(method):
+                    advance()  # start-order satisfied; allow interleaving
                     with _bind_ambient_pg(pginfo):
                         result = await method(*args, **kwargs)
                 else:
+                    advance()  # executor thread serializes sync methods
                     result = await loop.run_in_executor(
                         self._executor, run_method
                     )
@@ -1171,10 +1226,7 @@ class CoreWorker:
             except Exception as e:  # noqa: BLE001
                 return {"results": self._error_results(p, e)}
         finally:
-            self._actor_seq[caller] = seq + 1
-            nxt = self._actor_buffer.pop((caller, seq + 1), None)
-            if nxt is not None:
-                nxt.set()
+            advance()
 
     async def _resolve_args(self, p) -> tuple[tuple, dict]:
         async def decode(item):
@@ -1413,10 +1465,20 @@ class _ActorSubmitter:
         """Find the actor's current address (waiting out restarts). On DEAD,
         fail everything. Returns True if the actor is reachable."""
         try:
-            info = await self.worker.gcs.acall(
-                "wait_actor_alive",
-                {"actor_id": self.actor_id, "timeout": 120.0},
-            )
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    info = await self.worker.gcs.acall(
+                        "wait_actor_alive",
+                        {"actor_id": self.actor_id, "timeout": 120.0},
+                    )
+                    break
+                except ValueError:
+                    # Creation was registered asynchronously (async-context
+                    # create_actor) and hasn't reached the GCS yet.
+                    if time.monotonic() > deadline:
+                        raise
+                    await asyncio.sleep(0.05)
         except Exception as e:
             err = e if isinstance(e, ActorDiedError) else ActorDiedError(
                 f"actor {self.actor_id[:8]}: {e}"
@@ -1430,6 +1492,17 @@ class _ActorSubmitter:
         self.incarnation += 1
         self.seq = 0
         return True
+
+
+async def _logged(coro, what: str):
+    """Await a fire-and-forget coroutine, logging instead of silently
+    dropping its failure."""
+    try:
+        return await coro
+    except Exception:  # noqa: BLE001
+        import logging
+
+        logging.getLogger("ray_tpu").exception("background %s failed", what)
 
 
 def _safe_exc(exc: Exception) -> Exception:

@@ -830,7 +830,9 @@ class NodeManager:
         return True
 
     async def _h_free_object(self, conn, p):
-        self.store.delete(p["oid"])
+        # Offloaded: delete blocks on the store lock, which a multi-GB
+        # spill copy may hold for seconds.
+        await self._store_call(self.store.delete, p["oid"])
         return True
 
     async def _h_restore_object(self, conn, p):
@@ -843,7 +845,7 @@ class NodeManager:
 
     async def _h_fetch_object(self, conn, p):
         """Peer node requests a chunk of a sealed object."""
-        if not self.store.contains(p["oid"]):
+        if not await self._store_call(self.store.contains, p["oid"]):
             # The sealed file is ground truth; a local worker may have sealed
             # it before its object_created notification reached us.
             path = os.path.join(self.shm_root, p["oid"])
@@ -851,16 +853,19 @@ class NodeManager:
                 await self._store_call(
                     self.store.adopt, p["oid"], os.path.getsize(path)
                 )
-        view = await self._store_call(self.store.get, p["oid"])
-        off, ln = p["offset"], p["length"]
-        return bytes(view[off : off + ln])
+        # read_range copies under the store lock — a concurrent spill can't
+        # invalidate the view mid-slice.
+        return await self._store_call(
+            self.store.read_range, p["oid"], p["offset"], p["length"]
+        )
 
     async def _h_pull_object(self, conn, p):
         """A local worker asks us to fetch an object from a remote node.
         Concurrent pulls of the same object coalesce onto one transfer."""
         oid = p["oid"]
-        if self.store.contains(oid):
-            return {"size": self.store.meta[oid][0]}
+        size = await self._store_call(self.store.size_of, oid)
+        if size is not None:
+            return {"size": size}
         inflight = self._inflight_pulls.get(oid)
         if inflight is not None:
             return await asyncio.shield(inflight)
@@ -893,9 +898,9 @@ class NodeManager:
                 buf[off : off + ln] = data
                 off += ln
         except Exception:
-            self.store.delete(oid)
+            await self._store_call(self.store.delete, oid)
             raise
-        self.store.seal(oid)
+        await self._store_call(self.store.seal, oid)
         return {"size": size}
 
     async def _h_get_info(self, conn, p):

@@ -184,6 +184,20 @@ class ShmObjectStore:
                 self._maps[oid_hex] = (mm, memoryview(mm)[:size])
             return self._maps[oid_hex][1]
 
+    def size_of(self, oid_hex: str) -> Optional[int]:
+        """Size of a sealed object, or None if absent."""
+        with self._lock:
+            if not self.contains(oid_hex):
+                return None
+            return self.meta[oid_hex][0]
+
+    def read_range(self, oid_hex: str, offset: int, length: int) -> bytes:
+        """Copy a byte range out UNDER the lock: the returned bytes stay
+        valid even if a concurrent spill releases the mmap right after."""
+        with self._lock:
+            view = self.get(oid_hex)
+            return bytes(view[offset : offset + length])
+
     def delete(self, oid_hex: str) -> None:
         with self._lock:
             entry = self.meta.pop(oid_hex, None)

@@ -1,0 +1,34 @@
+"""ray_tpu.serve — model serving tier.
+
+Reference parity: python/ray/serve (controller `_private/controller.py:106`,
+proxy `_private/proxy.py:710`, router `_private/router.py:473` with
+power-of-two-choices `request_router/pow_2_router.py:27`, replica
+`_private/replica.py:1139`). TPU-first differences: replicas pin TPU
+resources through the core resource model and run JAX callables; the data
+plane is the framework's own RPC fabric (no uvicorn/grpc dependency — the
+HTTP ingress is a stdlib asyncio server inside a proxy actor).
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "delete",
+    "deployment",
+    "get_handle",
+    "run",
+    "shutdown",
+    "status",
+]

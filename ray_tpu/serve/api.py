@@ -1,0 +1,151 @@
+"""serve public API: @deployment, run, shutdown, handles.
+
+Reference parity: python/ray/serve/api.py (serve.deployment :306, serve.run
+:686, serve.shutdown, get_deployment_handle).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.core import api as core_api
+from ray_tpu.core import serialization
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    name: str
+    num_replicas: int = 1
+    max_concurrent_queries: int = 8
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+    user_config: Any = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Application:
+    """A deployment bound to its init args (reference: serve 2.x
+    Deployment.bind output)."""
+
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, target: Callable, config: DeploymentConfig):
+        self._target = target
+        self._config = config
+
+    @property
+    def name(self) -> str:
+        return self._config.name
+
+    def options(self, **kw) -> "Deployment":
+        cfg = dataclasses.replace(self._config, **kw)
+        return Deployment(self._target, cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+
+def deployment(_target=None, **kw):
+    """@serve.deployment decorator (optionally with options)."""
+
+    def wrap(target):
+        cfg = DeploymentConfig(name=kw.pop("name", target.__name__), **kw)
+        return Deployment(target, cfg)
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        cls = ray_tpu.remote(ServeController)
+        return cls.options(
+            name=CONTROLLER_NAME, num_cpus=0, max_concurrency=64
+        ).remote()
+
+
+def run(
+    app: Application | Deployment,
+    *,
+    host: str = "127.0.0.1",
+    port: Optional[int] = None,
+    wait_timeout_s: float = 120.0,
+) -> DeploymentHandle:
+    """Deploy an application and return a handle. With ``port``, also
+    ensure an HTTP proxy serving /{deployment_name} on that port (0 picks a
+    free port — read it back via `proxy_port`)."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    dep = app.deployment
+    controller = _get_or_create_controller()
+    payload = cloudpickle.dumps(dep._target)
+    init_payload = serialization.dumps((app.args, app.kwargs))[0]
+    ray_tpu.get(
+        controller.deploy.remote(
+            dep.name, payload, init_payload, dep._config.to_dict()
+        ),
+        timeout=60,
+    )
+    ok = ray_tpu.get(
+        controller.wait_healthy.remote(dep.name, wait_timeout_s),
+        timeout=wait_timeout_s + 10,
+    )
+    if not ok:
+        raise RuntimeError(
+            f"deployment {dep.name!r} did not become healthy in "
+            f"{wait_timeout_s}s"
+        )
+    if port is not None:
+        bound = ray_tpu.get(
+            controller.ensure_proxy.remote(host, port), timeout=60
+        )
+        if port not in (0, bound):
+            raise RuntimeError(
+                f"proxy bound port {bound} != requested {port}"
+            )
+    return DeploymentHandle(dep.name)
+
+
+def proxy_port() -> int:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.ensure_proxy.remote("127.0.0.1", 0))
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> dict:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def delete(name: str) -> None:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return
+    try:
+        ray_tpu.get(controller.shutdown_serve.remote(), timeout=60)
+    finally:
+        ray_tpu.kill(controller)

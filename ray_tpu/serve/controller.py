@@ -1,0 +1,233 @@
+"""ServeController actor: deployment reconciler + routing-table authority.
+
+Reference parity: python/ray/serve/_private/controller.py:106 (control loop
+:482, deploy_application :919) and the DeploymentState reconcilers
+(_private/deployment_state.py), compressed into one actor: it owns the
+target state, converges actual replica actors toward it, health-checks
+them, and hands out versioned routing tables that routers poll.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import ray_tpu
+from ray_tpu.core import api as core_api
+
+CONTROLLER_NAME = "serve::controller"
+HEALTH_CHECK_PERIOD_S = 1.0
+
+
+class ServeController:
+    def __init__(self):
+        # name -> {"config": dict, "payload": bytes, "init": bytes,
+        #          "replicas": [ActorHandle], "version": int,
+        #          "next_replica_id": int}
+        self._deployments: dict[str, dict] = {}
+        self._version = 0
+        self._loop_running = False
+        self._proxy = None
+        self._proxy_port = None
+
+    # -- control plane API ----------------------------------------------------
+
+    async def deploy(
+        self, name: str, payload: bytes, init_payload: bytes, config: dict
+    ) -> bool:
+        self._ensure_control_loop()
+        dep = self._deployments.get(name)
+        if dep is None:
+            dep = self._deployments[name] = {
+                "replicas": [],
+                "next_replica_id": 0,
+            }
+        # A code/init/actor-options change rolls every replica (scaling
+        # num_replicas alone does not).
+        roll = (
+            dep.get("payload") != payload
+            or dep.get("init") != init_payload
+            or (dep.get("config") or {}).get("ray_actor_options")
+            != config.get("ray_actor_options")
+            or (dep.get("config") or {}).get("user_config")
+            != config.get("user_config")
+        )
+        dep["config"] = dict(config)
+        dep["payload"] = payload
+        dep["init"] = init_payload
+        if roll and dep["replicas"]:
+            for r in dep["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            dep["replicas"] = []
+        dep["version"] = self._bump()
+        await self._reconcile_one(name)
+        return True
+
+    async def delete_deployment(self, name: str) -> bool:
+        dep = self._deployments.pop(name, None)
+        if dep is None:
+            return False
+        self._bump()
+        for r in dep["replicas"]:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        return True
+
+    async def wait_healthy(self, name: str, timeout_s: float = 120.0) -> bool:
+        """Block until the deployment has its target number of live
+        replicas (used by serve.run)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            dep = self._deployments.get(name)
+            if dep is not None:
+                target = dep["config"].get("num_replicas", 1)
+                if len(dep["replicas"]) >= target:
+                    alive = await self._ping_all(dep["replicas"])
+                    if sum(alive) >= target:
+                        return True
+            await asyncio.sleep(0.1)
+        return False
+
+    async def get_routing(self, name: str, version: int = -1) -> dict:
+        """Routing table for one deployment. Routers pass their last seen
+        version; a matching version returns just {"version": v} (cheap
+        poll)."""
+        dep = self._deployments.get(name)
+        if dep is None:
+            return {"version": -1, "replicas": None, "missing": True}
+        if dep["version"] == version:
+            return {"version": version}
+        return {
+            "version": dep["version"],
+            "replicas": list(dep["replicas"]),
+            "max_concurrent": dep["config"].get("max_concurrent_queries", 8),
+        }
+
+    async def status(self) -> dict:
+        return {
+            name: {
+                "target_replicas": dep["config"].get("num_replicas", 1),
+                "live_replicas": len(dep["replicas"]),
+                "version": dep["version"],
+            }
+            for name, dep in self._deployments.items()
+        }
+
+    # -- reconciliation -------------------------------------------------------
+
+    def _ensure_control_loop(self) -> None:
+        """Start the reconcile loop as a background asyncio task on first
+        deploy. NOT a remote actor call: actor tasks from one caller are
+        ordered, so an infinite call would block every later call behind
+        it."""
+        if not self._loop_running:
+            self._loop_running = True
+            asyncio.ensure_future(self._control_loop())
+
+    async def _control_loop(self) -> None:
+        """Run forever: converge replicas toward target state and replace
+        dead ones."""
+        while True:
+            try:
+                for name in list(self._deployments):
+                    await self._reconcile_one(name)
+            except Exception:
+                pass
+            await asyncio.sleep(HEALTH_CHECK_PERIOD_S)
+
+    async def _ping_all(self, replicas: list) -> list:
+        refs = [r.ping.remote() for r in replicas]
+        out = []
+        for ref in refs:
+            try:
+                await core_api.get_async(ref, timeout=5.0)
+                out.append(True)
+            except Exception:
+                out.append(False)
+        return out
+
+    async def _reconcile_one(self, name: str) -> None:
+        dep = self._deployments.get(name)
+        if dep is None:
+            return
+        target = dep["config"].get("num_replicas", 1)
+        # Drop dead replicas from the table.
+        if dep["replicas"]:
+            alive = await self._ping_all(dep["replicas"])
+            live = [r for r, ok in zip(dep["replicas"], alive) if ok]
+            if len(live) != len(dep["replicas"]):
+                dep["replicas"] = live
+                dep["version"] = self._bump()
+        # Start missing replicas.
+        started = False
+        while len(dep["replicas"]) < target:
+            dep["replicas"].append(self._start_replica(name, dep))
+            dep["next_replica_id"] += 1
+            started = True
+        # Stop surplus replicas (scale down).
+        while len(dep["replicas"]) > target:
+            victim = dep["replicas"].pop()
+            started = True
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+        if started:
+            dep["version"] = self._bump()
+
+    def _start_replica(self, name: str, dep: dict):
+        from ray_tpu.serve.replica import ReplicaActor
+
+        cfg = dep["config"]
+        opts = dict(cfg.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 1)
+        opts["name"] = f"serve::{name}#{dep['next_replica_id']}"
+        opts["max_concurrency"] = cfg.get("max_concurrent_queries", 8) + 2
+        cls = ray_tpu.remote(ReplicaActor)
+        return cls.options(**opts).remote(
+            name, dep["payload"], dep["init"], cfg.get("user_config")
+        )
+
+    def _bump(self) -> int:
+        self._version += 1
+        return self._version
+
+    # -- ingress --------------------------------------------------------------
+
+    async def ensure_proxy(self, host: str, port: int) -> int:
+        """Start (or return) the HTTP proxy actor; returns the bound port.
+        Requesting a specific port while the proxy already listens on a
+        different one is an error (not a silent ignore)."""
+        if self._proxy is not None:
+            if port not in (0, self._proxy_port):
+                raise RuntimeError(
+                    f"serve proxy already listening on port "
+                    f"{self._proxy_port}; cannot rebind to {port}"
+                )
+            return self._proxy_port
+        from ray_tpu.serve.proxy import HTTPProxyActor
+
+        cls = ray_tpu.remote(HTTPProxyActor)
+        controller = await core_api.get_actor_async(CONTROLLER_NAME)
+        self._proxy = cls.options(
+            name="serve::proxy", num_cpus=0, max_concurrency=256
+        ).remote(controller)
+        ref = self._proxy.start.remote(host, port)
+        self._proxy_port = await core_api.get_async(ref, timeout=30)
+        return self._proxy_port
+
+    async def shutdown_serve(self) -> bool:
+        for name in list(self._deployments):
+            await self.delete_deployment(name)
+        if self._proxy is not None:
+            try:
+                ray_tpu.kill(self._proxy)
+            except Exception:
+                pass
+            self._proxy = None
+        return True

@@ -1,0 +1,48 @@
+"""DeploymentHandle — call a deployment from a driver or another replica.
+
+Reference parity: python/ray/serve/handle.py (DeploymentHandle /
+DeploymentResponse). Each handle owns a router; handles pickle by
+deployment name and rebind lazily in the destination process (that is how
+model composition passes handles between replicas).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+
+from ray_tpu.core import api as core_api
+from ray_tpu.serve.router import Router
+
+
+class DeploymentHandle:
+    def __init__(self, deployment: str, method: str = "__call__"):
+        self._deployment = deployment
+        self._method = method
+        self._router: Router | None = None
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._deployment, self._method))
+
+    async def _ensure_router(self) -> Router:
+        if self._router is None:
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+
+            controller = await core_api.get_actor_async(CONTROLLER_NAME)
+            self._router = Router(controller, self._deployment)
+        return self._router
+
+    def method(self, name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self._deployment, name)
+        h._router = self._router  # share routing state
+        return h
+
+    async def remote_async(self, *args, **kwargs):
+        """Await the result (for async contexts: replicas, proxies)."""
+        router = await self._ensure_router()
+        return await router.route(self._method, args, kwargs)
+
+    def remote(self, *args, **kwargs) -> concurrent.futures.Future:
+        """Route from a sync context (driver); returns a Future whose
+        .result() is the response value."""
+        worker = core_api._require_worker()
+        return worker.endpoint.submit(self.remote_async(*args, **kwargs))

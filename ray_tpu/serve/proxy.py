@@ -1,0 +1,129 @@
+"""HTTP ingress proxy actor.
+
+Reference parity: python/ray/serve/_private/proxy.py:710 (HTTPProxy), with a
+stdlib asyncio HTTP/1.1 server instead of uvicorn (zero extra dependencies;
+the proxy is an actor, so ingress scales by adding proxy actors per node).
+
+Routing: /{deployment}[/*] -> DeploymentHandle(deployment). The user callable
+receives one dict: {"method", "path", "query", "headers", "body"} where body
+is parsed JSON when the payload is JSON, else the raw string. The response
+value is JSON-encoded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from urllib.parse import parse_qs, urlparse
+
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class HTTPProxyActor:
+    def __init__(self, controller):
+        self._controller = controller
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._server = None
+        self._port = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(
+            self._serve_conn, host=host, port=port
+        )
+        self._port = self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def ping(self) -> bool:
+        return True
+
+    def _handle_for(self, deployment: str) -> DeploymentHandle:
+        h = self._handles.get(deployment)
+        if h is None:
+            h = self._handles[deployment] = DeploymentHandle(deployment)
+        return h
+
+    async def _serve_conn(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, target, _version = (
+                        line.decode("latin1").strip().split(" ", 2)
+                    )
+                except ValueError:
+                    await self._respond(writer, 400, {"error": "bad request"})
+                    return
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = h.decode("latin1").partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                body = b""
+                if "content-length" in headers:
+                    body = await reader.readexactly(
+                        int(headers["content-length"])
+                    )
+                status, payload = await self._route(
+                    method, target, headers, body
+                )
+                keep = headers.get("connection", "keep-alive") != "close"
+                await self._respond(writer, status, payload, keep)
+                if not keep:
+                    return
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(
+        self, method: str, target: str, headers: dict, body: bytes
+    ):
+        from ray_tpu.serve.router import DeploymentNotFoundError
+
+        url = urlparse(target)
+        parts = [p for p in url.path.split("/") if p]
+        if not parts:
+            return 404, {"error": "no deployment in path"}
+        deployment = parts[0]
+        try:
+            parsed = json.loads(body) if body else None
+        except ValueError:
+            parsed = body.decode("utf-8", "replace")
+        request = {
+            "method": method,
+            "path": "/" + "/".join(parts[1:]),
+            "query": {k: v[-1] for k, v in parse_qs(url.query).items()},
+            "headers": dict(headers),
+            "body": parsed,
+        }
+        try:
+            result = await self._handle_for(deployment).remote_async(request)
+            return 200, result
+        except DeploymentNotFoundError as e:
+            return 404, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 — user errors are 500s
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    async def _respond(self, writer, status: int, payload, keep=False):
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
+            status, "Internal Server Error"
+        )
+        try:
+            data = json.dumps(payload, default=str).encode()
+        except (TypeError, ValueError):
+            data = json.dumps({"result": str(payload)}).encode()
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            f"Connection: {'keep-alive' if keep else 'close'}\r\n"
+            f"\r\n".encode() + data
+        )
+        await writer.drain()

@@ -1,0 +1,70 @@
+"""Replica actor: wraps the user's deployment callable.
+
+Reference parity: python/ray/serve/_private/replica.py:1139 (UserCallableWrapper
++ queue-length reporting, minus ASGI). The callable may be a class (optionally
+with async methods) or a plain function; JAX inference callables pin TPU
+resources via the deployment's ray_actor_options.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+
+import cloudpickle
+
+from ray_tpu.core import serialization
+
+
+class ReplicaActor:
+    def __init__(
+        self,
+        deployment_name: str,
+        payload: bytes,
+        init_payload: bytes,
+        user_config,
+    ):
+        self._deployment = deployment_name
+        target = cloudpickle.loads(payload)
+        args, kwargs = serialization.loads(init_payload)[0]
+        if inspect.isclass(target):
+            self._callable = target(*args, **kwargs)
+        else:
+            if args or kwargs:
+                raise TypeError(
+                    "function deployments take no bind() arguments"
+                )
+            self._callable = target
+        if user_config is not None and hasattr(
+            self._callable, "reconfigure"
+        ):
+            self._callable.reconfigure(user_config)
+        self._inflight = 0
+
+    async def ping(self) -> bool:
+        return True
+
+    async def queue_len(self) -> int:
+        return self._inflight
+
+    async def handle(self, method: str, payload: bytes):
+        """Execute one request. Requests are (method, pickled (args, kwargs));
+        sync user code runs in the worker's executor thread so the replica
+        keeps answering pings while busy."""
+        args, kwargs = serialization.loads(payload)[0]
+        if method == "__call__" and inspect.isroutine(self._callable):
+            fn = self._callable  # function deployment
+        else:
+            # Bound method — also for instances' __call__, so coroutine
+            # detection sees the method, not the (non-coroutine) instance.
+            fn = getattr(self._callable, method)
+        self._inflight += 1
+        try:
+            if inspect.iscoroutinefunction(fn):
+                return await fn(*args, **kwargs)
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, lambda: fn(*args, **kwargs)
+            )
+        finally:
+            self._inflight -= 1

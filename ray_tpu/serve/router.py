@@ -1,0 +1,118 @@
+"""Power-of-two-choices request router.
+
+Reference parity: python/ray/serve/_private/router.py:473 +
+request_router/pow_2_router.py:27. Each router keeps a local in-flight
+estimate per replica, picks the less-loaded of two random candidates, and
+retries on dead replicas after refreshing the (versioned) routing table
+from the controller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from ray_tpu.core import api as core_api
+from ray_tpu.core import serialization
+from ray_tpu.core.errors import ActorDiedError, ActorUnavailableError
+
+
+class DeploymentNotFoundError(ValueError):
+    """No deployment with this name exists (routing table says missing)."""
+
+ROUTE_RETRIES = 8
+DEAD_MEMORY_S = 30.0
+
+
+class Router:
+    def __init__(self, controller, deployment: str):
+        self._controller = controller
+        self._deployment = deployment
+        self._replicas: list = []
+        self._version = -2  # never fetched
+        self._inflight: dict[str, int] = {}  # actor_id -> local estimate
+        # Replicas this router OBSERVED dying: filtered out of refreshed
+        # tables until the controller's reconciler has certainly purged
+        # them (the table it serves can be stale by one health-check
+        # period).
+        self._recently_dead: dict[str, float] = {}
+
+    async def _refresh(self, force: bool = False) -> None:
+        table = await core_api.get_async(
+            self._controller.get_routing.remote(
+                self._deployment, -1 if force else self._version
+            ),
+            timeout=30,
+        )
+        if table.get("missing"):
+            raise DeploymentNotFoundError(
+                f"no deployment named {self._deployment!r}"
+            )
+        if table.get("replicas") is not None:
+            import time
+
+            now = time.monotonic()
+            self._recently_dead = {
+                rid: t
+                for rid, t in self._recently_dead.items()
+                if now - t < DEAD_MEMORY_S
+            }
+            self._replicas = [
+                r
+                for r in table["replicas"]
+                if r._actor_id not in self._recently_dead
+            ]
+            self._version = table["version"]
+            self._inflight = {
+                r._actor_id: self._inflight.get(r._actor_id, 0)
+                for r in self._replicas
+            }
+
+    def _pick(self):
+        """Power of two choices on the local in-flight estimates."""
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        return (
+            a
+            if self._inflight.get(a._actor_id, 0)
+            <= self._inflight.get(b._actor_id, 0)
+            else b
+        )
+
+    async def route(self, method: str, args: tuple, kwargs: dict):
+        """Route one request; returns the result value."""
+        payload = serialization.dumps((args, kwargs))[0]
+        last_err: Exception | None = None
+        for attempt in range(ROUTE_RETRIES):
+            if self._version < -1 or not self._replicas:
+                await self._refresh(force=attempt > 0)
+                if not self._replicas:
+                    await asyncio.sleep(0.2)
+                    continue
+            replica = self._pick()
+            rid = replica._actor_id
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            try:
+                ref = replica.handle.remote(method, payload)
+                return await core_api.get_async(ref)
+            except (ActorDiedError, ActorUnavailableError) as e:
+                # Replica died mid-request: drop it locally, force-refresh
+                # membership, back off (the controller may still be
+                # replacing it), and retry on a healthy one.
+                import time
+
+                last_err = e
+                self._recently_dead[rid] = time.monotonic()
+                self._replicas = [
+                    r for r in self._replicas if r._actor_id != rid
+                ]
+                self._version = -2
+                await asyncio.sleep(min(0.1 * (attempt + 1), 1.0))
+            finally:
+                if rid in self._inflight:
+                    self._inflight[rid] -= 1
+        raise last_err or RuntimeError(
+            f"routing to {self._deployment!r} failed after "
+            f"{ROUTE_RETRIES} attempts"
+        )

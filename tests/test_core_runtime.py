@@ -362,7 +362,7 @@ def test_cancel_async_task(rt):
     time.sleep(1.0)
     ray_tpu.cancel(ref)
     with pytest.raises(TaskCancelledError):
-        ray_tpu.get(ref, timeout=15)
+        ray_tpu.get(ref, timeout=60)
 
 
 def test_cancel_actor_task_rejected(rt):
