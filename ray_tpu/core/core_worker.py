@@ -136,6 +136,7 @@ class CoreWorker:
         self._actor_ready = asyncio.Event()
         self._actor_init_error: Exception | None = None
         self._actor_lock: threading.Lock = threading.Lock()
+        self._actor_semaphore: asyncio.Semaphore | None = None
         self._actor_seq: dict[str, int] = {}  # caller -> next expected seq
         self._actor_buffer: dict[tuple, Any] = {}  # (caller, seq) -> pending
 
@@ -391,6 +392,12 @@ class CoreWorker:
             self.owner_store.put_location(oid, self.node_id, len(payload))
 
     def get(self, refs: list[ObjectRef], timeout: float | None = None):
+        if self.on_endpoint_loop():
+            raise RuntimeError(
+                "blocking get() called from an async actor method would "
+                "deadlock the event loop; use "
+                "`await ray_tpu.core.api.get_async(refs)` instead"
+            )
         fut = self.endpoint.submit(self._get_async(refs, timeout))
         try:
             return fut.result(
@@ -564,6 +571,12 @@ class CoreWorker:
         num_returns: int = 1,
         timeout: float | None = None,
     ):
+        if self.on_endpoint_loop():
+            raise RuntimeError(
+                "blocking wait() called from an async actor method would "
+                "deadlock the event loop; await the refs with get_async "
+                "or asyncio primitives instead"
+            )
         fut = self.endpoint.submit(self._wait_async(refs, num_returns, timeout))
         return fut.result()
 
@@ -868,7 +881,9 @@ class CoreWorker:
         spec.completed = True
         # Fire-and-forget pattern: refs dropped while the task was PENDING
         # couldn't free then — re-check now that results exist.
-        asyncio.ensure_future(self._free_completed_outputs(spec))
+        asyncio.ensure_future(
+            _logged(self._free_completed_outputs(spec), "output free")
+        )
 
     async def _free_completed_outputs(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids:
@@ -1055,6 +1070,10 @@ class CoreWorker:
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=max_conc, thread_name_prefix="actor-exec"
             )
+        # Async methods interleave after their ordered start — this is what
+        # actually caps them at max_concurrency (the executor above only
+        # bounds sync methods).
+        self._actor_semaphore = asyncio.Semaphore(max_conc)
         loop = asyncio.get_running_loop()
         self._actor_id = p["actor_id"]
         self._actor_pg = tuple(spec["pg"]) if spec.get("pg") else None
@@ -1213,8 +1232,9 @@ class CoreWorker:
             try:
                 if asyncio.iscoroutinefunction(method):
                     advance()  # start-order satisfied; allow interleaving
-                    with _bind_ambient_pg(pginfo):
-                        result = await method(*args, **kwargs)
+                    async with self._actor_semaphore:
+                        with _bind_ambient_pg(pginfo):
+                            result = await method(*args, **kwargs)
                 else:
                     advance()  # executor thread serializes sync methods
                     result = await loop.run_in_executor(

@@ -12,7 +12,6 @@ from typing import Any, Callable, Optional
 import cloudpickle
 
 import ray_tpu
-from ray_tpu.core import api as core_api
 from ray_tpu.core import serialization
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.handle import DeploymentHandle

@@ -29,6 +29,7 @@ class ServeController:
         self._loop_running = False
         self._proxy = None
         self._proxy_port = None
+        self._proxy_lock = asyncio.Lock()
 
     # -- control plane API ----------------------------------------------------
 
@@ -113,6 +114,7 @@ class ServeController:
             name: {
                 "target_replicas": dep["config"].get("num_replicas", 1),
                 "live_replicas": len(dep["replicas"]),
+                "replica_ids": [r._actor_id for r in dep["replicas"]],
                 "version": dep["version"],
             }
             for name, dep in self._deployments.items()
@@ -132,12 +134,15 @@ class ServeController:
     async def _control_loop(self) -> None:
         """Run forever: converge replicas toward target state and replace
         dead ones."""
+        import logging
+
+        log = logging.getLogger("ray_tpu.serve")
         while True:
             try:
                 for name in list(self._deployments):
                     await self._reconcile_one(name)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001
+                log.exception("serve controller reconcile failed")
             await asyncio.sleep(HEALTH_CHECK_PERIOD_S)
 
     async def _ping_all(self, replicas: list) -> list:
@@ -181,12 +186,19 @@ class ServeController:
             dep["version"] = self._bump()
 
     def _start_replica(self, name: str, dep: dict):
+        import uuid
+
         from ray_tpu.serve.replica import ReplicaActor
 
         cfg = dep["config"]
         opts = dict(cfg.get("ray_actor_options") or {})
         opts.setdefault("num_cpus", 1)
-        opts["name"] = f"serve::{name}#{dep['next_replica_id']}"
+        # uuid suffix: a delete + redeploy under the same name must never
+        # collide with a prior generation's replica name still pending its
+        # (async) kill in the GCS.
+        opts["name"] = (
+            f"serve::{name}#{dep['next_replica_id']}-{uuid.uuid4().hex[:6]}"
+        )
         opts["max_concurrency"] = cfg.get("max_concurrent_queries", 8) + 2
         cls = ray_tpu.remote(ReplicaActor)
         return cls.options(**opts).remote(
@@ -203,23 +215,25 @@ class ServeController:
         """Start (or return) the HTTP proxy actor; returns the bound port.
         Requesting a specific port while the proxy already listens on a
         different one is an error (not a silent ignore)."""
-        if self._proxy is not None:
-            if port not in (0, self._proxy_port):
-                raise RuntimeError(
-                    f"serve proxy already listening on port "
-                    f"{self._proxy_port}; cannot rebind to {port}"
-                )
-            return self._proxy_port
-        from ray_tpu.serve.proxy import HTTPProxyActor
+        async with self._proxy_lock:  # concurrent runs: one proxy, ever
+            if self._proxy is not None:
+                if port not in (0, self._proxy_port):
+                    raise RuntimeError(
+                        f"serve proxy already listening on port "
+                        f"{self._proxy_port}; cannot rebind to {port}"
+                    )
+                return self._proxy_port
+            from ray_tpu.serve.proxy import HTTPProxyActor
 
-        cls = ray_tpu.remote(HTTPProxyActor)
-        controller = await core_api.get_actor_async(CONTROLLER_NAME)
-        self._proxy = cls.options(
-            name="serve::proxy", num_cpus=0, max_concurrency=256
-        ).remote(controller)
-        ref = self._proxy.start.remote(host, port)
-        self._proxy_port = await core_api.get_async(ref, timeout=30)
-        return self._proxy_port
+            cls = ray_tpu.remote(HTTPProxyActor)
+            controller = await core_api.get_actor_async(CONTROLLER_NAME)
+            proxy = cls.options(
+                name="serve::proxy", num_cpus=0, max_concurrency=256
+            ).remote(controller)
+            ref = proxy.start.remote(host, port)
+            self._proxy_port = await core_api.get_async(ref, timeout=30)
+            self._proxy = proxy
+            return self._proxy_port
 
     async def shutdown_serve(self) -> bool:
         for name in list(self._deployments):

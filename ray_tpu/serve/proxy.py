@@ -70,7 +70,9 @@ class HTTPProxyActor:
                 status, payload = await self._route(
                     method, target, headers, body
                 )
-                keep = headers.get("connection", "keep-alive") != "close"
+                keep = (
+                    headers.get("connection", "keep-alive").lower() != "close"
+                )
                 await self._respond(writer, status, payload, keep)
                 if not keep:
                     return

@@ -1,0 +1,34 @@
+"""ray_tpu.tune — hyperparameter search tier.
+
+Reference parity: python/ray/tune (Tuner `tuner.py:43`, trial loop
+`execution/tune_controller.py:68`, search spaces `search/`, ASHA
+`schedulers/async_hyperband.py`, ResultGrid `result_grid.py`), compressed to
+the core surface: function trainables reporting intermediate metrics, grid +
+random search, FIFO/ASHA scheduling, bounded concurrency, ResultGrid.
+"""
+
+from ray_tpu.tune.result_grid import ResultGrid, TrialResult
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import TuneConfig, Tuner, report
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "ResultGrid",
+    "TrialResult",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "uniform",
+]

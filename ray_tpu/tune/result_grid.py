@@ -1,0 +1,77 @@
+"""ResultGrid — the outcome of a Tuner.fit() run.
+
+Reference parity: python/ray/tune/result_grid.py (get_best_result,
+per-trial Result with config/metrics/error).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: dict
+    metrics: Optional[dict] = None  # last reported
+    metrics_history: list = field(default_factory=list)
+    error: Optional[str] = None
+    status: str = "PENDING"  # TERMINATED | STOPPED | ERROR
+
+
+class ResultGrid:
+    def __init__(self, results: list[TrialResult], metric=None, mode=None):
+        self._results = list(results)
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i) -> TrialResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> list[TrialResult]:
+        return [r for r in self._results if r.error is not None]
+
+    def get_best_result(
+        self, metric: str | None = None, mode: str | None = None
+    ) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode or "min"
+        if metric is None:
+            raise ValueError("metric required (none set on TuneConfig)")
+        scored = [
+            r
+            for r in self._results
+            if r.metrics is not None and metric in r.metrics
+        ]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric]
+        )
+
+    def get_dataframe(self):
+        """Rows of config/* and final metrics (plain list of dicts; a
+        pandas DataFrame if pandas is importable)."""
+        rows = [
+            {
+                "trial_id": r.trial_id,
+                "status": r.status,
+                **{f"config/{k}": v for k, v in r.config.items()},
+                **(r.metrics or {}),
+            }
+            for r in self._results
+        ]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:  # pragma: no cover
+            return rows

@@ -1,0 +1,67 @@
+"""Trial schedulers: FIFO and ASHA early stopping.
+
+Reference parity: python/ray/tune/schedulers/async_hyperband.py
+(AsyncHyperBandScheduler/ASHA): rungs at grace_period * reduction_factor^k;
+at each rung a trial continues only if its metric is in the top
+1/reduction_factor of everything recorded at that rung.
+"""
+
+from __future__ import annotations
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "min",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        time_attr: str = "training_iteration",
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung milestone -> list of recorded metric values
+        rungs = []
+        t = grace_period
+        while t < max_t:
+            rungs.append(t)
+            t *= reduction_factor
+        self._rungs = {r: [] for r in rungs}
+        self._trial_rung: dict[str, int] = {}  # highest rung index reached
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP  # budget exhausted (counts as completion)
+        decision = CONTINUE
+        for i, milestone in enumerate(sorted(self._rungs)):
+            if t < milestone or self._trial_rung.get(trial_id, -1) >= i:
+                continue
+            self._trial_rung[trial_id] = i
+            recorded = self._rungs[milestone]
+            recorded.append(value)
+            if len(recorded) >= self.rf:
+                ordered = sorted(recorded, reverse=(self.mode == "max"))
+                cutoff = ordered[max(len(recorded) // self.rf - 1, 0)]
+                good = value >= cutoff if self.mode == "max" else value <= cutoff
+                if not good:
+                    decision = STOP
+        return decision

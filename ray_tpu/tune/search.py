@@ -1,0 +1,73 @@
+"""Search-space primitives + variant generation.
+
+Reference parity: python/ray/tune/search/sample.py (Categorical/Float/
+Integer/grid_search) and basic_variant.py (grid cross-product x
+num_samples).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class _Grid:
+    values: list
+
+
+@dataclass
+class _Sampler:
+    fn: Any  # rng -> value
+
+
+def grid_search(values) -> _Grid:
+    return _Grid(list(values))
+
+
+def choice(values) -> _Sampler:
+    vals = list(values)
+    return _Sampler(lambda rng: rng.choice(vals))
+
+
+def uniform(low: float, high: float) -> _Sampler:
+    return _Sampler(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> _Sampler:
+    import math
+
+    return _Sampler(
+        lambda rng: math.exp(rng.uniform(math.log(low), math.log(high)))
+    )
+
+
+def randint(low: int, high: int) -> _Sampler:
+    return _Sampler(lambda rng: rng.randrange(low, high))
+
+
+def generate_variants(
+    param_space: dict, num_samples: int = 1, seed: int | None = None
+) -> list[dict]:
+    """Cross-product of grid_search axes x num_samples draws of samplers
+    (reference: BasicVariantGenerator). Plain values pass through."""
+    rng = random.Random(seed)
+    grid_keys = [
+        k for k, v in param_space.items() if isinstance(v, _Grid)
+    ]
+    grid_values = [param_space[k].values for k in grid_keys]
+    variants = []
+    for combo in itertools.product(*grid_values) if grid_keys else [()]:
+        for _ in range(num_samples):
+            cfg = {}
+            for k, v in param_space.items():
+                if isinstance(v, _Grid):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, _Sampler):
+                    cfg[k] = v.fn(rng)
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

@@ -1,0 +1,183 @@
+"""Tuner — concurrent trial loop with scheduler-driven early stopping.
+
+Reference parity: python/ray/tune/tuner.py:43 (Tuner.fit :312) +
+execution/tune_controller.py:68, compressed: trials run as actors executing
+the user function in a worker thread; `tune.report(**metrics)` streams
+intermediate results to the driver loop, which feeds the scheduler and
+kills early-stopped trials.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.tune.result_grid import ResultGrid, TrialResult
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+
+_trial_ctx = threading.local()
+
+
+class StopTrial(Exception):
+    """Raised inside a trial's function when the scheduler stopped it."""
+
+
+def report(**metrics) -> None:
+    """Report intermediate metrics from inside a trainable. Adds
+    `training_iteration` (1-based count of reports) if absent."""
+    runner = getattr(_trial_ctx, "runner", None)
+    if runner is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    runner._record(metrics)
+
+
+class TrialRunner:
+    """Actor hosting one trial. The user fn runs in the worker's executor
+    thread; `drain` (async, on the loop) streams reports to the driver."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._reports: list[dict] = []
+        self._iteration = 0
+        self._stopped = False
+
+    def run(self, fn_payload: bytes, config: dict) -> str:
+        fn = cloudpickle.loads(fn_payload)
+        _trial_ctx.runner = self
+        try:
+            fn(config)
+            return "TERMINATED"
+        except StopTrial:
+            return "STOPPED"
+        finally:
+            _trial_ctx.runner = None
+
+    def _record(self, metrics: dict) -> None:
+        with self._lock:
+            if self._stopped:
+                raise StopTrial()
+            self._iteration += 1
+            rec = dict(metrics)
+            rec.setdefault("training_iteration", self._iteration)
+            self._reports.append(rec)
+
+    async def drain(self) -> list:
+        with self._lock:
+            out, self._reports = self._reports, []
+            return out
+
+    async def stop(self) -> bool:
+        """Cooperative early stop: the next report() raises StopTrial."""
+        with self._lock:
+            self._stopped = True
+        return True
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: Optional[int] = None
+    resources_per_trial: dict = dataclasses.field(
+        default_factory=lambda: {"CPU": 1.0}
+    )
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable[[dict], None],
+        *,
+        param_space: dict,
+        tune_config: Optional[TuneConfig] = None,
+    ):
+        self._trainable = trainable
+        self._param_space = dict(param_space)
+        self._cfg = tune_config or TuneConfig()
+
+    def fit(self, poll_interval_s: float = 0.1) -> ResultGrid:
+        cfg = self._cfg
+        scheduler = cfg.scheduler or FIFOScheduler()
+        payload = cloudpickle.dumps(self._trainable)
+        variants = generate_variants(
+            self._param_space, cfg.num_samples, cfg.seed
+        )
+        trials = [
+            TrialResult(trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:4]}",
+                        config=v)
+            for i, v in enumerate(variants)
+        ]
+        pending = list(trials)
+        running: dict[str, dict] = {}  # trial_id -> {actor, ref, trial}
+        done: list[TrialResult] = []
+
+        actor_cls = ray_tpu.remote(TrialRunner)
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent_trials:
+                trial = pending.pop(0)
+                actor = actor_cls.options(
+                    resources=dict(cfg.resources_per_trial),
+                    max_concurrency=4,
+                ).remote()
+                ref = actor.run.remote(payload, trial.config)
+                trial.status = "RUNNING"
+                running[trial.trial_id] = {
+                    "actor": actor, "ref": ref, "trial": trial,
+                }
+            # Drain reports, feed the scheduler.
+            for tid, entry in list(running.items()):
+                trial = entry["trial"]
+                try:
+                    reports = ray_tpu.get(
+                        entry["actor"].drain.remote(), timeout=30
+                    )
+                except Exception:
+                    reports = []
+                for rec in reports:
+                    trial.metrics_history.append(rec)
+                    trial.metrics = rec
+                    if scheduler.on_result(tid, rec) == STOP:
+                        # Cooperative stop; the run() call unwinds with
+                        # status STOPPED.
+                        entry["actor"].stop.remote()
+            # Reap finished trials.
+            finished, _ = ray_tpu.wait(
+                [e["ref"] for e in running.values()],
+                num_returns=len(running),
+                timeout=0,
+            )
+            finished_set = set(finished)
+            for tid, entry in list(running.items()):
+                if entry["ref"] not in finished_set:
+                    continue
+                trial = entry["trial"]
+                try:
+                    trial.status = ray_tpu.get(entry["ref"], timeout=10)
+                except Exception as e:  # noqa: BLE001
+                    trial.status = "ERROR"
+                    trial.error = str(e)
+                # Collect any reports that raced completion.
+                try:
+                    for rec in ray_tpu.get(
+                        entry["actor"].drain.remote(), timeout=10
+                    ):
+                        trial.metrics_history.append(rec)
+                        trial.metrics = rec
+                except Exception:
+                    pass
+                ray_tpu.kill(entry["actor"])
+                done.append(trial)
+                del running[tid]
+            if running or pending:
+                time.sleep(poll_interval_s)
+        return ResultGrid(done, metric=cfg.metric, mode=cfg.mode)

@@ -96,8 +96,8 @@ def test_replica_death_midtraffic_recovers(cluster):
     for i, t in enumerate(threads):
         t.start()
         if i == 30:  # mid-traffic: kill one replica
-            victim = ray_tpu.get_actor("serve::Sturdy#0")
-            ray_tpu.kill(victim)
+            rid = serve.status()["Sturdy"]["replica_ids"][0]
+            ray_tpu.kill(ray_tpu.ActorHandle(rid, "Replica"))
     for t in threads:
         t.join(timeout=180)
     assert not errors, errors[:3]
