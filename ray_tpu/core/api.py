@@ -460,7 +460,9 @@ class ActorClass:
             name=opts.get("name"),
             resources=resources,
             max_restarts=opts.get("max_restarts", 0),
-            max_concurrency=opts.get("max_concurrency", 1),
+            # 0 = auto: sync methods serialize; async methods cap at 1000
+            # (the reference's async-actor default).
+            max_concurrency=opts.get("max_concurrency", 0),
             label_selector=label_selector,
             soft_label_selector=soft_sel,
             policy=policy,

@@ -673,10 +673,7 @@ class CoreWorker:
     def on_endpoint_loop(self) -> bool:
         """True when the caller is running ON this worker's endpoint loop
         (async actor methods) — where any blocking wait would deadlock."""
-        try:
-            return asyncio.get_running_loop() is self.endpoint.loop
-        except RuntimeError:
-            return False
+        return self.endpoint.on_loop()
 
     def _run_on_loop(self, coro) -> None:
         """Run an enqueue coroutine on the endpoint loop. From the loop
@@ -973,7 +970,7 @@ class CoreWorker:
         name: str | None = None,
         resources: dict | None = None,
         max_restarts: int = 0,
-        max_concurrency: int = 1,
+        max_concurrency: int = 0,  # 0 = auto (sync serial, async 1000)
         label_selector: dict | None = None,
         soft_label_selector: dict | None = None,
         policy: str = "hybrid",
@@ -1065,7 +1062,11 @@ class CoreWorker:
         spec = p["spec"]
         cls = cloudpickle.loads(spec["class_payload"])
         (args, kwargs), _ = serialization.loads(spec["args_payload"])
-        max_conc = spec.get("max_concurrency", 1)
+        # max_concurrency 0 = "auto" (user never set it): sync methods stay
+        # serialized on one thread, async methods get the reference's
+        # async-actor default of 1000 — a cap of 1 would deadlock reentrant
+        # calls (A awaits B which calls back into A).
+        max_conc = spec.get("max_concurrency", 0)
         if max_conc > 1:
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=max_conc, thread_name_prefix="actor-exec"
@@ -1073,7 +1074,9 @@ class CoreWorker:
         # Async methods interleave after their ordered start — this is what
         # actually caps them at max_concurrency (the executor above only
         # bounds sync methods).
-        self._actor_semaphore = asyncio.Semaphore(max_conc)
+        self._actor_semaphore = asyncio.Semaphore(
+            max_conc if max_conc > 0 else 1000
+        )
         loop = asyncio.get_running_loop()
         self._actor_id = p["actor_id"]
         self._actor_pg = tuple(spec["pg"]) if spec.get("pg") else None

@@ -752,11 +752,7 @@ class GcsClient:
     # sync -------------------------------------------------------------------
 
     def call(self, method: str, payload: dict | None = None, timeout=60.0):
-        try:
-            on_loop = asyncio.get_running_loop() is self.endpoint.loop
-        except RuntimeError:
-            on_loop = False
-        if on_loop:
+        if self.endpoint.on_loop():
             raise RuntimeError(
                 f"blocking GCS call {method!r} from the endpoint loop "
                 f"(async actor method?) would deadlock; use acall()"

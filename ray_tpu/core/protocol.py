@@ -335,3 +335,11 @@ class Endpoint:
     def loop(self) -> asyncio.AbstractEventLoop:
         assert self._loop is not None
         return self._loop
+
+    def on_loop(self) -> bool:
+        """True when the caller runs ON this endpoint's event loop — where
+        any blocking wait on the loop would deadlock."""
+        try:
+            return asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            return False

@@ -64,6 +64,18 @@ class GPT2Config:
     # B=32, S=1024 — so the loss scans over sequence chunks and
     # REMATERIALIZES each chunk's logits in backward. 0 disables chunking.
     loss_chunk: int = 128
+    # Pipeline parallelism: number of microbatches for the GPipe schedule
+    # over the mesh's `pp` axis (0 = no pipelining). Takes effect when
+    # loss_fn/hidden receive a mesh whose pp axis is >1; the stacked layers
+    # dim is split into pp stages and activations rotate between stages
+    # via ppermute (SURVEY §2.4: the reference has NO native pp — this is
+    # the TPU-native differentiator).
+    pipeline_microbatches: int = 0
+    # Mixture-of-experts: replaces the dense MLP sublayer with a top-1
+    # switch layer of n_experts experts (0 = dense). Experts shard over the
+    # mesh's `ep` axis via the "experts" logical rule.
+    n_experts: int = 0
+    expert_capacity_factor: float = 1.5
 
     @property
     def head_dim(self) -> int:
@@ -95,6 +107,21 @@ class GPT2Config:
 def param_logical_specs(cfg: GPT2Config) -> Params:
     """Logical axis names per parameter (leaves are tuples of names)."""
     L = ("layers",)
+    if cfg.n_experts > 0:
+        ffn = {
+            "gate_w": L + ("embed", "norm"),  # tiny; replicate
+            "exp_w1": L + ("experts", "embed", "mlp"),
+            "exp_b1": L + ("experts", "mlp"),
+            "exp_w2": L + ("experts", "mlp", "embed"),
+            "exp_b2": L + ("norm",),
+        }
+    else:
+        ffn = {
+            "fc_w": L + ("embed", "mlp"),
+            "fc_b": L + ("mlp",),
+            "fc2_w": L + ("mlp", "embed"),
+            "fc2_b": L + ("norm",),
+        }
     return {
         "wte": ("vocab", "embed"),
         "wpe": ("seq_param", "embed"),
@@ -107,10 +134,7 @@ def param_logical_specs(cfg: GPT2Config) -> Params:
             "proj_b": L + ("norm",),
             "ln2_scale": L + ("norm",),
             "ln2_bias": L + ("norm",),
-            "fc_w": L + ("embed", "mlp"),
-            "fc_b": L + ("mlp",),
-            "fc2_w": L + ("mlp", "embed"),
-            "fc2_b": L + ("norm",),
+            **ffn,
         },
         "lnf_scale": ("norm",),
         "lnf_bias": ("norm",),
@@ -129,6 +153,22 @@ def init_params(key: jax.Array, cfg: GPT2Config) -> Params:
     def normal(key, shape, s):
         return (jax.random.normal(key, shape) * s).astype(pd)
 
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        ffn = {
+            "gate_w": normal(next(k), (L, D, E), std),
+            "exp_w1": normal(next(k), (L, E, D, F), std),
+            "exp_b1": jnp.zeros((L, E, F), pd),
+            "exp_w2": normal(next(k), (L, E, F, D), resid_std),
+            "exp_b2": jnp.zeros((L, D), pd),
+        }
+    else:
+        ffn = {
+            "fc_w": normal(next(k), (L, D, F), std),
+            "fc_b": jnp.zeros((L, F), pd),
+            "fc2_w": normal(next(k), (L, F, D), resid_std),
+            "fc2_b": jnp.zeros((L, D), pd),
+        }
     return {
         "wte": normal(next(k), (V, D), std),
         "wpe": normal(next(k), (S, D), std),
@@ -141,10 +181,7 @@ def init_params(key: jax.Array, cfg: GPT2Config) -> Params:
             "proj_b": jnp.zeros((L, D), pd),
             "ln2_scale": jnp.ones((L, D), pd),
             "ln2_bias": jnp.zeros((L, D), pd),
-            "fc_w": normal(next(k), (L, D, F), std),
-            "fc_b": jnp.zeros((L, F), pd),
-            "fc2_w": normal(next(k), (L, F, D), resid_std),
-            "fc2_b": jnp.zeros((L, D), pd),
+            **ffn,
         },
         "lnf_scale": jnp.ones((D,), pd),
         "lnf_bias": jnp.zeros((D,), pd),
@@ -188,18 +225,91 @@ def _mlp_sublayer(x, p, cfg: GPT2Config):
     return x + h @ p["fc2_w"].astype(cfg.dtype) + p["fc2_b"].astype(cfg.dtype)
 
 
+def _moe_sublayer(x, p, cfg: GPT2Config):
+    """Top-1 switch MoE (Fedus et al.) replacing the dense MLP: softmax
+    gate routes each token to one expert under a capacity limit; dropped
+    tokens pass through the residual unchanged. The expert dim of
+    exp_w1/exp_w2 carries the "experts" logical axis -> `ep` mesh axis, so
+    the dispatch/combine einsums compile to all-to-alls over ep.
+
+    Dense one-hot dispatch ([N, E, C] tensors) — simple and correct, sized
+    for the test/dryrun scale; a production MoE would sort-and-gather.
+    """
+    B, S, D = x.shape
+    E = cfg.n_experts
+    # XLA:CPU's AllReducePromotion pass crashes on the bf16 all-reduces the
+    # ep-sharded einsums (and their backward) produce; compute the expert
+    # path in f32 on CPU (virtual-mesh tests/dryrun). Real TPUs keep bf16.
+    cdt = jnp.float32 if jax.default_backend() == "cpu" else cfg.dtype
+    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    hf = h.reshape(B * S, D).astype(cdt)
+    N = B * S
+    cap = max(int(cfg.expert_capacity_factor * N / E), 1)
+
+    logits = (hf @ p["gate_w"].astype(cdt)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+    gate = jnp.max(probs, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [N, E]
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+    onehot = onehot * (pos < cap)  # over-capacity tokens dropped
+    dispatch = onehot[..., None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), cap, dtype=jnp.float32
+    )  # [N, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    xe = jnp.einsum("nd,nec->ecd", hf, dispatch.astype(cdt))
+    he = jnp.einsum("ecd,edf->ecf", xe, p["exp_w1"].astype(cdt))
+    he = jax.nn.gelu(
+        he + p["exp_b1"].astype(cdt)[:, None, :], approximate=True
+    )
+    ye = jnp.einsum("ecf,efd->ecd", he, p["exp_w2"].astype(cdt))
+    y = jnp.einsum("ecd,nec->nd", ye, combine.astype(cdt))
+    # Output bias only for tokens an expert actually served — dropped
+    # (over-capacity) tokens pass through the residual truly unchanged.
+    routed = jnp.sum(onehot, axis=-1, keepdims=True).astype(cdt)  # [N, 1]
+    y = y + p["exp_b2"].astype(cdt) * routed
+    return x + y.reshape(B, S, D).astype(x.dtype)
+
+
 def _block(x, p, cfg: GPT2Config):
     """One transformer block. x: [B, S, D]; p: single layer's params."""
-    return _mlp_sublayer(_attn_sublayer(x, p, cfg), p, cfg)
+    h = _attn_sublayer(x, p, cfg)
+    if cfg.n_experts > 0:
+        return _moe_sublayer(h, p, cfg)
+    return _mlp_sublayer(h, p, cfg)
 
 
-def hidden(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
-    """tokens [B, S] int32 -> final-LN hidden states [B, S, d_model]."""
+def hidden(
+    params: Params,
+    tokens: jax.Array,
+    cfg: GPT2Config,
+    mesh=None,
+) -> jax.Array:
+    """tokens [B, S] int32 -> final-LN hidden states [B, S, d_model].
+
+    With ``mesh`` whose `pp` axis is >1 and cfg.pipeline_microbatches > 0,
+    the stacked-layers scan runs as a GPipe pipeline over pp stages."""
     B, S = tokens.shape
+    pp_size = (
+        dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 1)
+        if mesh is not None
+        else 1
+    )
+    pipelined = pp_size > 1 and cfg.pipeline_microbatches > 0
+    if pipelined and jax.default_backend() == "cpu":
+        # XLA:CPU's AllReducePromotion crashes on the bf16 all-reduces the
+        # pipeline's backward emits; the virtual-mesh tests/dryrun run this
+        # section in f32. Real TPUs keep bf16.
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, dtype=jnp.float32)
     x = params["wte"].astype(cfg.dtype)[tokens]
     x = x + params["wpe"].astype(cfg.dtype)[:S][None]
 
     remat = {True: "full", False: "none"}.get(cfg.remat, cfg.remat)
+    if remat == "mlp" and cfg.n_experts > 0:
+        remat = "dots"  # the "mlp" policy checkpoints the DENSE sublayer
     if remat == "mlp" and not uses_flash_kernel(
         S,
         impl=cfg.attn_impl,
@@ -237,14 +347,109 @@ def hidden(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
     def scan_body(x, layer_params):
         return block_fn(x, layer_params), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    if pipelined:
+        x = _pipelined_blocks(
+            params["blocks"], x, block_fn, mesh,
+            n_micro=cfg.pipeline_microbatches,
+        )
+    else:
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
     return _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
 
 
-def forward(params: Params, tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+def _pipelined_blocks(blocks, x, block_fn, mesh, *, n_micro):
+    """GPipe over the mesh's `pp` axis: each stage holds L/pp stacked
+    layers; microbatches of activations rotate stage-to-stage via ppermute
+    inside a scan (scaling-book pipelining recipe — compiled collectives,
+    no per-hop host involvement). Differentiable: autodiff reverses the
+    schedule through scan+ppermute.
+
+    Only `pp` is manual inside the shard_map (`axis_names={"pp"}`); batch /
+    tensor / sequence axes stay under the compiler's automatic SPMD."""
+    from jax.sharding import PartitionSpec as P
+
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(
+            f"batch {B} not divisible by pipeline_microbatches {n_micro}"
+        )
+    n_layer = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    pp_stages = dict(zip(mesh.axis_names, mesh.devices.shape))["pp"]
+    if n_layer % pp_stages:
+        raise ValueError(
+            f"n_layer {n_layer} not divisible by the {pp_stages} pipeline "
+            f"stages (pp mesh axis)"
+        )
+
+    def stage(blocks_local, x_mb):
+        def body(h, layer_params):
+            return block_fn(h, layer_params), None
+
+        out, _ = jax.lax.scan(body, x_mb, blocks_local)
+        return out
+
+    pp = dict(zip(mesh.axis_names, mesh.devices.shape))["pp"]
+
+    orig_dtype = x.dtype
+    # f32 at the shard_map boundary ONLY on CPU: the replicated input's
+    # BACKWARD is a psum over pp, and a bf16 all-reduce trips XLA:CPU's
+    # AllReducePromotion pass (crash). TPUs keep the bf16 boundary — f32
+    # there would double collective traffic for nothing.
+    boundary_dtype = (
+        jnp.float32 if jax.default_backend() == "cpu" else orig_dtype
+    )
+
+    def pipelined(blocks_local, x_full_b):
+        x_full = x_full_b.astype(orig_dtype)
+        idx = jax.lax.axis_index("pp")
+        mb = B // n_micro
+        xs = x_full.reshape(n_micro, mb, *x_full.shape[1:])
+        n_steps = n_micro + pp - 1
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def step(carry, t):
+            recv, outs = carry
+            # Stage 0 feeds microbatch t (clamped; late steps are bubble).
+            feed = xs[jnp.minimum(t, n_micro - 1)]
+            inp = jnp.where(idx == 0, feed, recv)
+            out = stage(blocks_local, inp)
+            # The LAST stage completes microbatch t-(pp-1) at step t.
+            mo = jnp.clip(t - (pp - 1), 0, n_micro - 1)
+            take = jnp.logical_and(idx == pp - 1, t >= pp - 1)
+            outs = outs.at[mo].set(jnp.where(take, out, outs[mo]))
+            return (jax.lax.ppermute(out, "pp", perm), outs), None
+
+        # Carries become device-varying over pp after the first ppermute;
+        # mark the (replicated-zero) initial values accordingly.
+        init = jax.tree.map(
+            lambda z: jax.lax.pcast(z, ("pp",), to="varying"),
+            (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+        )
+        (_, outs), _ = jax.lax.scan(step, init, jnp.arange(n_steps))
+        # Valid only on the last stage; broadcast to every pp rank (the lm
+        # head and loss are replicated over pp).
+        outs = jax.lax.psum(
+            jnp.where(idx == pp - 1, outs, 0.0).astype(boundary_dtype),
+            "pp",
+        ).astype(x_full.dtype)
+        return outs.reshape(B, *x_full.shape[1:])
+
+    layer_specs = jax.tree.map(lambda _: P("pp"), blocks)
+    return jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=P(),
+        axis_names={"pp"},
+    )(blocks, x.astype(boundary_dtype))
+
+
+def forward(
+    params: Params, tokens: jax.Array, cfg: GPT2Config, mesh=None
+) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, vocab] (activation dtype).
     Tied embeddings: logits = x @ wte^T (vocab-parallel under tp rules)."""
-    x = hidden(params, tokens, cfg)
+    x = hidden(params, tokens, cfg, mesh=mesh)
     return x @ params["wte"].astype(cfg.dtype).T
 
 
@@ -290,7 +495,7 @@ def _chunked_lm_loss(
 
 
 def loss_fn(
-    params: Params, batch: dict, cfg: GPT2Config
+    params: Params, batch: dict, cfg: GPT2Config, mesh=None
 ) -> tuple[jax.Array, dict]:
     """Next-token cross-entropy. batch: {"tokens": [B, S+1] int32} or
     {"tokens": [B,S], "targets": [B,S]}."""
@@ -300,7 +505,7 @@ def loss_fn(
     else:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
     if cfg.loss_chunk and inputs.shape[1] > cfg.loss_chunk:
-        x = hidden(params, inputs, cfg)
+        x = hidden(params, inputs, cfg, mesh=mesh)
         total = _chunked_lm_loss(
             x,
             params["wte"].astype(cfg.dtype),
@@ -309,7 +514,7 @@ def loss_fn(
         )
         loss = total / targets.size
     else:
-        logits = forward(params, inputs, cfg).astype(jnp.float32)
+        logits = forward(params, inputs, cfg, mesh=mesh).astype(jnp.float32)
         # Cross-entropy as logsumexp - target_logit: both reduce over
         # vocab, so XLA fuses the f32 upcast into the reductions and never
         # materializes an f32 [B, S, vocab] log-prob tensor.

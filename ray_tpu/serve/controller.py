@@ -138,11 +138,14 @@ class ServeController:
 
         log = logging.getLogger("ray_tpu.serve")
         while True:
-            try:
-                for name in list(self._deployments):
+            for name in list(self._deployments):
+                try:
                     await self._reconcile_one(name)
-            except Exception:  # noqa: BLE001
-                log.exception("serve controller reconcile failed")
+                except Exception:  # noqa: BLE001 — per-deployment: one
+                    # broken deployment must not starve the others
+                    log.exception(
+                        "serve controller reconcile failed for %r", name
+                    )
             await asyncio.sleep(HEALTH_CHECK_PERIOD_S)
 
     async def _ping_all(self, replicas: list) -> list:

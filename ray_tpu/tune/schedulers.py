@@ -9,7 +9,8 @@ at each rung a trial continues only if its metric is in the top
 from __future__ import annotations
 
 CONTINUE = "CONTINUE"
-STOP = "STOP"
+STOP = "STOP"  # early-stopped: a loser at a rung
+COMPLETE = "COMPLETE"  # budget (max_t) reached: counts as full completion
 
 
 class FIFOScheduler:
@@ -50,7 +51,7 @@ class ASHAScheduler:
         if t is None or value is None:
             return CONTINUE
         if t >= self.max_t:
-            return STOP  # budget exhausted (counts as completion)
+            return COMPLETE  # budget exhausted — NOT an early stop
         decision = CONTINUE
         for i, milestone in enumerate(sorted(self._rungs)):
             if t < milestone or self._trial_rung.get(trial_id, -1) >= i:

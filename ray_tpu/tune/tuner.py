@@ -19,7 +19,7 @@ import cloudpickle
 
 import ray_tpu
 from ray_tpu.tune.result_grid import ResultGrid, TrialResult
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import COMPLETE, STOP, FIFOScheduler
 from ray_tpu.tune.search import generate_variants
 
 _trial_ctx = threading.local()
@@ -134,22 +134,29 @@ class Tuner:
                 running[trial.trial_id] = {
                     "actor": actor, "ref": ref, "trial": trial,
                 }
-            # Drain reports, feed the scheduler.
+            # Drain reports (all refs fired first — one slow actor must not
+            # head-of-line-block the others), then feed the scheduler.
+            drain_refs = {
+                tid: entry["actor"].drain.remote()
+                for tid, entry in running.items()
+            }
             for tid, entry in list(running.items()):
                 trial = entry["trial"]
                 try:
-                    reports = ray_tpu.get(
-                        entry["actor"].drain.remote(), timeout=30
-                    )
+                    reports = ray_tpu.get(drain_refs[tid], timeout=30)
                 except Exception:
                     reports = []
                 for rec in reports:
                     trial.metrics_history.append(rec)
                     trial.metrics = rec
-                    if scheduler.on_result(tid, rec) == STOP:
-                        # Cooperative stop; the run() call unwinds with
-                        # status STOPPED.
+                    decision = scheduler.on_result(tid, rec)
+                    if decision in (STOP, COMPLETE):
+                        # Cooperative stop; run() unwinds with STOPPED.
+                        # COMPLETE (max_t budget reached) is a full run,
+                        # not an early stop — relabel at reap time.
                         entry["actor"].stop.remote()
+                        if decision == COMPLETE:
+                            entry["complete"] = True
             # Reap finished trials.
             finished, _ = ray_tpu.wait(
                 [e["ref"] for e in running.values()],
@@ -163,6 +170,8 @@ class Tuner:
                 trial = entry["trial"]
                 try:
                     trial.status = ray_tpu.get(entry["ref"], timeout=10)
+                    if trial.status == "STOPPED" and entry.get("complete"):
+                        trial.status = "TERMINATED"
                 except Exception as e:  # noqa: BLE001
                     trial.status = "ERROR"
                     trial.error = str(e)

@@ -1,0 +1,162 @@
+"""Pipeline (pp) and expert (ep) parallelism on the virtual 8-device mesh.
+
+SURVEY §2.4: the reference has NO native pp/ep (it delegates to vLLM) — the
+TPU-native equivalents are a GPipe schedule via shard_map+ppermute over the
+`pp` mesh axis and a switch-MoE layer whose experts shard over `ep`.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.models import gpt2
+from ray_tpu.parallel import (
+    DEFAULT_RULES,
+    MeshSpec,
+    make_mesh,
+    shardings_from_logical,
+)
+from ray_tpu.train.spmd import make_train_state, make_train_step
+
+
+@pytest.fixture(scope="module")
+def devices8():
+    ds = jax.devices()
+    if len(ds) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return ds[:8]
+
+
+def _tiny(**kw):
+    cfg = gpt2.GPT2Config.tiny()
+    return dataclasses.replace(
+        cfg, dtype=jnp.float32, loss_chunk=0, **kw
+    )
+
+
+def test_pipeline_matches_plain_scan(devices8):
+    """pp=2 GPipe schedule == plain scan, bitwise-tolerant (f32)."""
+    cfg_plain = _tiny()
+    cfg_pp = _tiny(pipeline_microbatches=4)
+    params = gpt2.init_params(jax.random.key(0), cfg_plain)
+    tokens = jax.random.randint(
+        jax.random.key(1), (8, 32), 0, cfg_plain.vocab_size
+    )
+    batch = {"tokens": tokens}
+
+    (l_plain, _), g_plain = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, batch, cfg_plain), has_aux=True
+    )(params)
+
+    mesh = make_mesh(MeshSpec(pp=2, dp=2, tp=2), devices8)
+    shardings = shardings_from_logical(
+        gpt2.param_logical_specs(cfg_pp), DEFAULT_RULES, mesh
+    )
+    params_sharded = jax.device_put(params, shardings)
+
+    def pp_loss(p, b):
+        return gpt2.loss_fn(p, b, cfg_pp, mesh=mesh)
+
+    (l_pp, _), g_pp = jax.jit(
+        jax.value_and_grad(pp_loss, has_aux=True)
+    )(params_sharded, batch)
+
+    np.testing.assert_allclose(
+        np.asarray(l_plain), np.asarray(l_pp), rtol=1e-5
+    )
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(g_plain),
+        jax.tree_util.tree_leaves_with_path(g_pp),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a),
+            np.asarray(b),
+            rtol=1e-4,
+            atol=1e-6,
+            err_msg=str(path),
+        )
+
+
+def test_moe_forward_backward_and_ep_sharding(devices8):
+    """The switch-MoE model trains under ep=2 sharding, and the sharded
+    loss/grads match the unsharded single-device run."""
+    cfg = _tiny(n_experts=4)
+    params = gpt2.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.key(1), (4, 32), 0, cfg.vocab_size
+    )
+    batch = {"tokens": tokens}
+
+    (l_ref, _), g_ref = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, batch, cfg), has_aux=True
+    )(params)
+    assert np.isfinite(float(l_ref))
+
+    mesh = make_mesh(MeshSpec(ep=2, dp=2, tp=2), devices8[:8])
+    shardings = shardings_from_logical(
+        gpt2.param_logical_specs(cfg), DEFAULT_RULES, mesh
+    )
+    # Expert weights actually shard over ep.
+    assert shardings["blocks"]["exp_w1"].spec[1] == "ep"
+    params_sharded = jax.device_put(params, shardings)
+    (l_ep, _), g_ep = jax.jit(
+        jax.value_and_grad(
+            lambda p, b: gpt2.loss_fn(p, b, cfg), has_aux=True
+        )
+    )(params_sharded, batch)
+    np.testing.assert_allclose(
+        np.asarray(l_ref), np.asarray(l_ep), rtol=1e-5
+    )
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(g_ref),
+        jax.tree_util.tree_leaves_with_path(g_ep),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a),
+            np.asarray(b),
+            rtol=1e-4,
+            atol=1e-6,
+            err_msg=str(path),
+        )
+
+
+def test_moe_capacity_drops_tokens():
+    """Over-capacity tokens fall back to the residual path (output ==
+    input for dropped tokens' ffn contribution)."""
+    cfg = _tiny(n_experts=2, expert_capacity_factor=0.25)
+    params = gpt2.init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    loss, _ = gpt2.loss_fn(params, {"tokens": tokens}, cfg)
+    assert np.isfinite(float(loss))
+
+
+def test_pp_moe_full_train_step(devices8):
+    """One sharded train step with pp=2 AND ep=2 AND tp=2 on 8 devices:
+    the all-axes config compiles and produces a finite loss."""
+    cfg = _tiny(n_experts=2, pipeline_microbatches=2)
+    mesh = make_mesh(MeshSpec(pp=2, ep=2, tp=2), devices8)
+    shardings = shardings_from_logical(
+        gpt2.param_logical_specs(cfg), DEFAULT_RULES, mesh
+    )
+    opt = optax.adam(1e-3)
+    state = make_train_state(
+        lambda k: gpt2.init_params(k, cfg), opt, jax.random.key(0),
+        param_shardings=shardings,
+    )
+    step = make_train_step(
+        lambda p, b: gpt2.loss_fn(p, b, cfg, mesh=mesh),
+        opt,
+        mesh=mesh,
+        batch_spec=P(("dp", "fsdp")),
+        param_shardings=shardings,
+    )
+    tokens = jax.random.randint(
+        jax.random.key(1), (4, 32), 0, cfg.vocab_size
+    )
+    state, metrics = step(state, {"tokens": tokens})
+    assert np.isfinite(float(metrics["loss"]))
