@@ -73,9 +73,12 @@ class GPT2Config:
     pipeline_microbatches: int = 0
     # Mixture-of-experts: replaces the dense MLP sublayer with a top-1
     # switch layer of n_experts experts (0 = dense). Experts shard over the
-    # mesh's `ep` axis via the "experts" logical rule.
+    # mesh's `ep` axis via the "experts" logical rule. moe_aux_weight
+    # scales the Switch load-balancing loss (E * sum_e f_e * P_e) — without
+    # it top-1 routing collapses onto one expert.
     n_experts: int = 0
     expert_capacity_factor: float = 1.5
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -196,7 +199,7 @@ def _layer_norm(x, scale, bias, eps=1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _attn_sublayer(x, p, cfg: GPT2Config):
+def _attn_sublayer(x, p, cfg: GPT2Config, mesh=None):
     B, S, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
@@ -206,14 +209,23 @@ def _attn_sublayer(x, p, cfg: GPT2Config):
     def heads(t):  # [B,S,D] -> [B,H,S,Dh]
         return t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
 
-    attn = causal_attention(
-        heads(q),
-        heads(k_),
-        heads(v),
-        impl=cfg.attn_impl,
-        block_q=cfg.attn_block_q,
-        block_k=cfg.attn_block_k,
-    )
+    sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if sp_size > 1 and S % sp_size == 0:
+        # Sequence sharded over sp: ring attention keeps K/V distributed
+        # and rotates chunks over ICI instead of letting XLA re-gather the
+        # full sequence per chip (SURVEY §5.7 — must-build).
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        attn = ring_attention(heads(q), heads(k_), heads(v), mesh=mesh)
+    else:
+        attn = causal_attention(
+            heads(q),
+            heads(k_),
+            heads(v),
+            impl=cfg.attn_impl,
+            block_q=cfg.attn_block_q,
+            block_k=cfg.attn_block_k,
+        )
     attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
     return x + attn @ p["proj_w"].astype(cfg.dtype) + p["proj_b"].astype(cfg.dtype)
 
@@ -251,6 +263,12 @@ def _moe_sublayer(x, p, cfg: GPT2Config):
     gate = jnp.max(probs, axis=-1)
     expert = jnp.argmax(probs, axis=-1)
     onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [N, E]
+    # Switch load-balancing auxiliary loss: E * sum_e f_e * P_e, where f is
+    # the (pre-capacity) routed fraction and P the mean router probability.
+    # Minimized at uniform routing; without it top-1 collapses.
+    aux = E * jnp.sum(
+        jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0)
+    )
     pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
     onehot = onehot * (pos < cap)  # over-capacity tokens dropped
     dispatch = onehot[..., None] * jax.nn.one_hot(
@@ -269,15 +287,16 @@ def _moe_sublayer(x, p, cfg: GPT2Config):
     # (over-capacity) tokens pass through the residual truly unchanged.
     routed = jnp.sum(onehot, axis=-1, keepdims=True).astype(cdt)  # [N, 1]
     y = y + p["exp_b2"].astype(cdt) * routed
-    return x + y.reshape(B, S, D).astype(x.dtype)
+    return x + y.reshape(B, S, D).astype(x.dtype), aux
 
 
-def _block(x, p, cfg: GPT2Config):
-    """One transformer block. x: [B, S, D]; p: single layer's params."""
-    h = _attn_sublayer(x, p, cfg)
+def _block(x, p, cfg: GPT2Config, mesh=None):
+    """One transformer block -> (x, moe_aux). x: [B, S, D]; p: one layer's
+    params; moe_aux is 0 for dense layers."""
+    h = _attn_sublayer(x, p, cfg, mesh=mesh)
     if cfg.n_experts > 0:
         return _moe_sublayer(h, p, cfg)
-    return _mlp_sublayer(h, p, cfg)
+    return _mlp_sublayer(h, p, cfg), jnp.zeros((), jnp.float32)
 
 
 def hidden(
@@ -289,13 +308,12 @@ def hidden(
     """tokens [B, S] int32 -> final-LN hidden states [B, S, d_model].
 
     With ``mesh`` whose `pp` axis is >1 and cfg.pipeline_microbatches > 0,
-    the stacked-layers scan runs as a GPipe pipeline over pp stages."""
+    the stacked-layers scan runs as a GPipe pipeline over pp stages.
+    Returns (x, moe_aux): the summed Switch load-balancing loss (0 when
+    dense)."""
     B, S = tokens.shape
-    pp_size = (
-        dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 1)
-        if mesh is not None
-        else 1
-    )
+    pp_size = mesh.shape.get("pp", 1) if mesh is not None else 1
+    sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
     pipelined = pp_size > 1 and cfg.pipeline_microbatches > 0
     if pipelined and jax.default_backend() == "cpu":
         # XLA:CPU's AllReducePromotion crashes on the bf16 all-reduces the
@@ -310,22 +328,36 @@ def hidden(
     remat = {True: "full", False: "none"}.get(cfg.remat, cfg.remat)
     if remat == "mlp" and cfg.n_experts > 0:
         remat = "dots"  # the "mlp" policy checkpoints the DENSE sublayer
-    if remat == "mlp" and not uses_flash_kernel(
-        S,
-        impl=cfg.attn_impl,
-        block_q=cfg.attn_block_q,
-        block_k=cfg.attn_block_k,
+    uses_ring = (
+        not pipelined and sp_size > 1 and S % sp_size == 0
+    )  # must mirror _attn_sublayer's dispatch
+    if remat == "mlp" and (
+        uses_ring
+        or not uses_flash_kernel(
+            S,
+            impl=cfg.attn_impl,
+            block_q=cfg.attn_block_q,
+            block_k=cfg.attn_block_k,
+        )
     ):
-        # "mlp" exists to preserve the flash kernel's o/lse residuals. On the
-        # jnp reference path there is no kernel, and leaving attention
-        # un-checkpointed would stack O(L*B*H*S^2) softmax residuals.
+        # "mlp" exists to preserve the flash kernel's o/lse residuals. On
+        # the jnp reference path AND the ring path there is no custom_vjp
+        # kernel, and leaving attention un-checkpointed would stack
+        # O(L*B*H*S^2[/sp]) softmax residuals.
         remat = "dots"
+    # Ring attention (sp) nests a shard_map; inside the pp pipeline's
+    # shard_map that nesting is unsupported, so attention falls back to
+    # XLA's automatic resharding there.
+    attn_mesh = None if pipelined else mesh
     dots_policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     if remat == "full":
-        block_fn = jax.checkpoint(functools.partial(_block, cfg=cfg))
+        block_fn = jax.checkpoint(
+            functools.partial(_block, cfg=cfg, mesh=attn_mesh)
+        )
     elif remat == "dots":
         block_fn = jax.checkpoint(
-            functools.partial(_block, cfg=cfg), policy=dots_policy
+            functools.partial(_block, cfg=cfg, mesh=attn_mesh),
+            policy=dots_policy,
         )
     elif remat == "mlp":
         # Attention stays outside the checkpoint so the flash kernel's saved
@@ -337,24 +369,29 @@ def hidden(
         )
 
         def block_fn(x, layer_params):
-            return mlp_ckpt(_attn_sublayer(x, layer_params, cfg), layer_params)
+            out = mlp_ckpt(
+                _attn_sublayer(x, layer_params, cfg, mesh=attn_mesh),
+                layer_params,
+            )
+            return out, jnp.zeros((), jnp.float32)
 
     elif remat == "none":
-        block_fn = functools.partial(_block, cfg=cfg)
+        block_fn = functools.partial(_block, cfg=cfg, mesh=attn_mesh)
     else:
         raise ValueError(f"unknown remat policy {cfg.remat!r}")
 
     def scan_body(x, layer_params):
-        return block_fn(x, layer_params), None
+        return block_fn(x, layer_params)  # (carry, per-layer aux)
 
     if pipelined:
-        x = _pipelined_blocks(
+        x, aux = _pipelined_blocks(
             params["blocks"], x, block_fn, mesh,
             n_micro=cfg.pipeline_microbatches,
         )
     else:
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
-    return _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+        x, aux_layers = jax.lax.scan(scan_body, x, params["blocks"])
+        aux = jnp.sum(aux_layers)
+    return _layer_norm(x, params["lnf_scale"], params["lnf_bias"]), aux
 
 
 def _pipelined_blocks(blocks, x, block_fn, mesh, *, n_micro):
@@ -374,21 +411,17 @@ def _pipelined_blocks(blocks, x, block_fn, mesh, *, n_micro):
             f"batch {B} not divisible by pipeline_microbatches {n_micro}"
         )
     n_layer = jax.tree_util.tree_leaves(blocks)[0].shape[0]
-    pp_stages = dict(zip(mesh.axis_names, mesh.devices.shape))["pp"]
-    if n_layer % pp_stages:
+    if n_layer % mesh.shape["pp"]:
         raise ValueError(
-            f"n_layer {n_layer} not divisible by the {pp_stages} pipeline "
-            f"stages (pp mesh axis)"
+            f"n_layer {n_layer} not divisible by the {mesh.shape['pp']} "
+            f"pipeline stages (pp mesh axis)"
         )
 
     def stage(blocks_local, x_mb):
-        def body(h, layer_params):
-            return block_fn(h, layer_params), None
+        out, aux_layers = jax.lax.scan(block_fn, x_mb, blocks_local)
+        return out, jnp.sum(aux_layers)
 
-        out, _ = jax.lax.scan(body, x_mb, blocks_local)
-        return out
-
-    pp = dict(zip(mesh.axis_names, mesh.devices.shape))["pp"]
+    pp = mesh.shape["pp"]
 
     orig_dtype = x.dtype
     # f32 at the shard_map boundary ONLY on CPU: the replicated input's
@@ -408,38 +441,51 @@ def _pipelined_blocks(blocks, x, block_fn, mesh, *, n_micro):
         perm = [(i, (i + 1) % pp) for i in range(pp)]
 
         def step(carry, t):
-            recv, outs = carry
+            recv, outs, aux = carry
             # Stage 0 feeds microbatch t (clamped; late steps are bubble).
             feed = xs[jnp.minimum(t, n_micro - 1)]
             inp = jnp.where(idx == 0, feed, recv)
-            out = stage(blocks_local, inp)
+            out, aux_mb = stage(blocks_local, inp)
+            # Aux counts only GENUINE microbatch steps for this stage
+            # (stage s holds microbatch t-s at step t); bubble steps
+            # process clamped duplicates and must not contribute.
+            genuine = jnp.logical_and(t >= idx, t < idx + n_micro)
+            aux = aux + jnp.where(genuine, aux_mb, 0.0)
             # The LAST stage completes microbatch t-(pp-1) at step t.
             mo = jnp.clip(t - (pp - 1), 0, n_micro - 1)
             take = jnp.logical_and(idx == pp - 1, t >= pp - 1)
             outs = outs.at[mo].set(jnp.where(take, out, outs[mo]))
-            return (jax.lax.ppermute(out, "pp", perm), outs), None
+            return (jax.lax.ppermute(out, "pp", perm), outs, aux), None
 
         # Carries become device-varying over pp after the first ppermute;
         # mark the (replicated-zero) initial values accordingly.
         init = jax.tree.map(
             lambda z: jax.lax.pcast(z, ("pp",), to="varying"),
-            (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+            (
+                jnp.zeros_like(xs[0]),
+                jnp.zeros_like(xs),
+                jnp.zeros((), jnp.float32),
+            ),
         )
-        (_, outs), _ = jax.lax.scan(step, init, jnp.arange(n_steps))
+        (_, outs, aux), _ = jax.lax.scan(step, init, jnp.arange(n_steps))
         # Valid only on the last stage; broadcast to every pp rank (the lm
         # head and loss are replicated over pp).
         outs = jax.lax.psum(
             jnp.where(idx == pp - 1, outs, 0.0).astype(boundary_dtype),
             "pp",
         ).astype(x_full.dtype)
-        return outs.reshape(B, *x_full.shape[1:])
+        # Per-stage aux sums over this stage's layers; per-microbatch means
+        # average to the full-batch mean (equal microbatch sizes), so
+        # psum(stage sums)/n_micro == the unpipelined layer sum.
+        aux = jax.lax.psum(aux, "pp") / n_micro
+        return outs.reshape(B, *x_full.shape[1:]), aux
 
     layer_specs = jax.tree.map(lambda _: P("pp"), blocks)
     return jax.shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(layer_specs, P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={"pp"},
     )(blocks, x.astype(boundary_dtype))
 
@@ -449,7 +495,7 @@ def forward(
 ) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, vocab] (activation dtype).
     Tied embeddings: logits = x @ wte^T (vocab-parallel under tp rules)."""
-    x = hidden(params, tokens, cfg, mesh=mesh)
+    x, _aux = hidden(params, tokens, cfg, mesh=mesh)
     return x @ params["wte"].astype(cfg.dtype).T
 
 
@@ -504,24 +550,29 @@ def loss_fn(
         inputs, targets = tokens, batch["targets"]
     else:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    x, moe_aux = hidden(params, inputs, cfg, mesh=mesh)
     if cfg.loss_chunk and inputs.shape[1] > cfg.loss_chunk:
-        x = hidden(params, inputs, cfg, mesh=mesh)
         total = _chunked_lm_loss(
             x,
             params["wte"].astype(cfg.dtype),
             targets,
             cfg.loss_chunk,
         )
-        loss = total / targets.size
+        ce = total / targets.size
     else:
-        logits = forward(params, inputs, cfg, mesh=mesh).astype(jnp.float32)
+        logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
         # Cross-entropy as logsumexp - target_logit: both reduce over
         # vocab, so XLA fuses the f32 upcast into the reductions and never
         # materializes an f32 [B, S, vocab] log-prob tensor.
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-        loss = jnp.mean(lse - tgt)
-    return loss, {"loss": loss, "tokens": jnp.array(targets.size, jnp.int32)}
+        ce = jnp.mean(lse - tgt)
+    loss = ce
+    metrics = {"loss": ce, "tokens": jnp.array(targets.size, jnp.int32)}
+    if cfg.n_experts > 0:
+        loss = ce + cfg.moe_aux_weight * moe_aux
+        metrics["moe_aux"] = moe_aux
+    return loss, metrics
 
 
 def num_params(cfg: GPT2Config) -> int:

@@ -125,6 +125,29 @@ def test_moe_forward_backward_and_ep_sharding(devices8):
         )
 
 
+def test_moe_aux_loss_balances_router():
+    """The Switch aux loss appears in metrics and pushes gradient into the
+    gate for EVERY expert (not only the argmax one) — the anti-collapse
+    mechanism."""
+    cfg = _tiny(n_experts=4)
+    params = gpt2.init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    (loss, metrics), grads = jax.value_and_grad(
+        lambda p: gpt2.loss_fn(p, {"tokens": tokens}, cfg), has_aux=True
+    )(params)
+    assert "moe_aux" in metrics and np.isfinite(float(metrics["moe_aux"]))
+    # loss includes the weighted aux term
+    np.testing.assert_allclose(
+        float(loss),
+        float(metrics["loss"])
+        + cfg.moe_aux_weight * float(metrics["moe_aux"]),
+        rtol=1e-6,
+    )
+    g_gate = np.asarray(grads["blocks"]["gate_w"], np.float32)
+    # every expert column of the gate receives gradient somewhere
+    assert (np.abs(g_gate).sum(axis=(0, 1)) > 0).all()
+
+
 def test_moe_capacity_drops_tokens():
     """Over-capacity tokens fall back to the residual path (output ==
     input for dropped tokens' ffn contribution)."""
