@@ -22,6 +22,12 @@ from ray_tpu.train.context import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.sharded_checkpoint import (
+    load_sharded_state,
+    restore_sharded,
+    restore_template,
+    save_sharded,
+)
 from ray_tpu.train.spmd import (
     TrainState,
     make_train_state,
@@ -39,9 +45,13 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "load_sharded_state",
     "make_train_state",
     "make_train_step",
     "report",
+    "restore_sharded",
+    "restore_template",
+    "save_sharded",
     "state_shardings",
     # lazy (import the runtime stack only when asked for)
     "DataParallelTrainer",

@@ -60,14 +60,28 @@ class TrainContext:
         self,
         metrics: dict,
         checkpoint: Optional[Checkpoint] = None,
+        sharded_state: Any = None,
     ) -> None:
+        """Report metrics (all ranks, in lockstep) and optionally persist a
+        checkpoint. ``checkpoint`` copies a worker-local directory into the
+        run dir (per-rank files merge); ``sharded_state`` is the SPMD path:
+        a pytree of distributed jax arrays written IN PLACE into the run
+        dir with per-shard parallel IO (orbax) — every rank must pass its
+        (identical pytree-structure) state, and no bytes are staged or
+        copied. Restore with load_sharded_state(ctx.get_checkpoint())."""
+        if checkpoint is not None and sharded_state is not None:
+            raise ValueError(
+                "pass either checkpoint= or sharded_state=, not both"
+            )
         with self._lock:
             index = self._report_index
             self._report_index += 1
         # Persist OUTSIDE the lock: a multi-GB copytree must not block the
         # controller's status() polls (it would read as a dead worker).
         persisted = None
-        if checkpoint is not None and self.storage is not None:
+        if sharded_state is not None and self.storage is not None:
+            persisted = self._persist_sharded(sharded_state, index)
+        elif checkpoint is not None and self.storage is not None:
             persisted = self.storage.persist_checkpoint(
                 checkpoint,
                 index,
@@ -85,6 +99,27 @@ class TrainContext:
                     "world_rank": self.world_rank,
                 }
             )
+
+    def _persist_sharded(self, state: Any, index: int) -> Checkpoint:
+        """Collective sharded save straight into the run's checkpoint dir
+        (every rank writes only its own shards), then stamp this rank's
+        commit marker — the controller finalizes the round once every
+        rank's report arrived, exactly as for file checkpoints."""
+        import os
+
+        from ray_tpu.train.sharded_checkpoint import save_sharded
+        from ray_tpu.train.storage import SHARDED_SUBDIR, _marker_name
+
+        final = self.storage.checkpoint_dir(index)
+        save_sharded(state, os.path.join(final, SHARDED_SUBDIR))
+        with open(
+            os.path.join(
+                final, _marker_name(self.world_rank, self.world_size)
+            ),
+            "w",
+        ):
+            pass
+        return Checkpoint(final)
 
     def drain_reports(self) -> list:
         with self._lock:
@@ -105,10 +140,16 @@ def get_context() -> TrainContext:
     return ctx
 
 
-def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
+def report(
+    metrics: dict,
+    checkpoint: Optional[Checkpoint] = None,
+    sharded_state: Any = None,
+) -> None:
     """Report metrics (+ optional checkpoint) from the train loop
-    (reference: ray.train.report)."""
-    get_context().report(metrics, checkpoint)
+    (reference: ray.train.report). sharded_state= persists a pytree of
+    distributed jax arrays with per-shard parallel IO (see
+    TrainContext.report)."""
+    get_context().report(metrics, checkpoint, sharded_state=sharded_state)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

@@ -25,6 +25,9 @@ from ray_tpu.train.checkpoint import Checkpoint
 
 _MARKER_RE = re.compile(r"^\.committed_r(\d+)_of_(\d+)$")
 _CKPT_RE = re.compile(r"^checkpoint_\d{6}$")
+# Subdirectory of a checkpoint dir holding an orbax sharded-state tree
+# (written in place by TrainContext.report(sharded_state=...)).
+SHARDED_SUBDIR = "sharded_state"
 
 
 def _marker_name(world_rank: int, world_size: int) -> str:

@@ -1,0 +1,195 @@
+"""Sharded SPMD checkpointing: per-shard save/restore, mesh-reshape resume,
+and the Train-tier wiring (VERDICT r1 item 9)."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import gpt2
+from ray_tpu.parallel import (
+    DEFAULT_RULES,
+    MeshSpec,
+    make_mesh,
+    shardings_from_logical,
+)
+from ray_tpu.train.sharded_checkpoint import (
+    restore_sharded,
+    restore_template,
+    save_sharded,
+)
+from ray_tpu.train.spmd import make_train_state, state_shardings
+
+
+@pytest.fixture(scope="module")
+def devices8():
+    ds = jax.devices()
+    if len(ds) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return ds[:8]
+
+
+def test_bitwise_restore_across_mesh_reshape(devices8, tmp_path):
+    """Save a TrainState sharded on mesh A (fsdp=4, tp=2); restore onto
+    mesh B (fsdp=2, tp=4... different layout). Every leaf bitwise-equal."""
+    cfg = dataclasses.replace(gpt2.GPT2Config.tiny(), dtype=jnp.float32)
+    mesh_a = make_mesh(MeshSpec(fsdp=4, tp=2), devices8)
+    sh_a = shardings_from_logical(
+        gpt2.param_logical_specs(cfg), DEFAULT_RULES, mesh_a
+    )
+    opt = optax.adamw(1e-3)
+    state = make_train_state(
+        lambda k: gpt2.init_params(k, cfg), opt, jax.random.key(0),
+        param_shardings=sh_a,
+    )
+    path = str(tmp_path / "ck")
+    save_sharded(state, path)
+
+    mesh_b = make_mesh(MeshSpec(fsdp=2, tp=2, dp=2), devices8)
+    sh_params_b = shardings_from_logical(
+        gpt2.param_logical_specs(cfg), DEFAULT_RULES, mesh_b
+    )
+    # Target shardings: params per rules on mesh B; everything else
+    # replicated on mesh B.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl_b = NamedSharding(mesh_b, P())
+    target_sh = {
+        "params": sh_params_b,
+        "opt_state": jax.tree.map(lambda _: repl_b, state["opt_state"]),
+        "step": repl_b,
+    }
+    template = restore_template(state, target_sh)
+    restored = restore_sharded(path, template)
+
+    for (path_a, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(state),
+        jax.tree_util.tree_leaves_with_path(restored),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=str(path_a)
+        )
+    # And the restored params actually live on mesh B's shardings.
+    assert restored["params"]["wte"].sharding.mesh == mesh_b
+
+
+def test_report_sharded_state_e2e(tmp_path):
+    """Two real jax.distributed worker processes collectively persist a
+    cross-process sharded state via train.report(sharded_state=...); the
+    driver restores it from the finalized checkpoint — onto its OWN mesh."""
+    import ray_tpu
+    from ray_tpu.train import (
+        JaxConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    ray_tpu.init(num_cpus=8)
+    try:
+        storage = str(tmp_path / "results")
+
+        def train_fn():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            import ray_tpu.train as train
+
+            # All global devices (2 processes x their local cpu devices)
+            # form one dp mesh; w is genuinely cross-process sharded.
+            n = jax.device_count()
+            mesh = Mesh(np.array(jax.devices()).reshape(n), ("dp",))
+            w = jax.device_put(
+                jnp.arange(float(n * 8)).reshape(n, 8),
+                NamedSharding(mesh, P("dp", None)),
+            )
+            train.report(
+                {"n": n},
+                sharded_state={"w": w, "step": jnp.zeros((), jnp.int32)},
+            )
+
+        trainer = JaxTrainer(
+            train_fn,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(name="sharded", storage_path=storage),
+            jax_config=JaxConfig(distributed=True, platform="cpu"),
+        )
+        result = trainer.fit()
+        assert result.error is None
+        assert result.checkpoint is not None
+
+        # Driver-side restore (driver has its own jax runtime/mesh).
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.train.sharded_checkpoint import load_sharded_state
+
+        n = result.metrics["n"]
+        mesh = make_mesh(MeshSpec(dp=8), jax.devices()[:8])
+        repl = NamedSharding(mesh, P())
+        template = {
+            "w": jax.ShapeDtypeStruct(
+                (n, 8), jnp.float32,
+                sharding=NamedSharding(mesh, P("dp", None)),
+            ),
+            "step": jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
+        }
+        restored = load_sharded_state(result.checkpoint, template)
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]),
+            np.arange(float(n * 8)).reshape(n, 8),
+        )
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_train_step_resumes_identically(devices8, tmp_path):
+    """Checkpoint after step 1, keep training to step 3; restore at step 1
+    and retrain: step-3 states are identical (deterministic resume)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.train.spmd import make_train_step
+
+    cfg = dataclasses.replace(gpt2.GPT2Config.tiny(), dtype=jnp.float32)
+    mesh = make_mesh(MeshSpec(fsdp=4, tp=2), devices8)
+    sh = shardings_from_logical(
+        gpt2.param_logical_specs(cfg), DEFAULT_RULES, mesh
+    )
+    opt = optax.adamw(1e-3)
+    state = make_train_state(
+        lambda k: gpt2.init_params(k, cfg), opt, jax.random.key(0),
+        param_shardings=sh,
+    )
+    step = make_train_step(
+        lambda p, b: gpt2.loss_fn(p, b, cfg), opt, mesh=mesh,
+        batch_spec=P(("dp", "fsdp")), param_shardings=sh,
+    )
+    tokens = jax.random.randint(
+        jax.random.key(1), (8, cfg.max_seq), 0, cfg.vocab_size
+    )
+    batch = {"tokens": tokens}
+
+    state, _ = step(state, batch)
+    path = str(tmp_path / "step1")
+    save_sharded(state, path)
+    template = restore_template(state)
+    for _ in range(2):
+        state, _ = step(state, batch)
+
+    resumed = restore_sharded(path, template)
+    for _ in range(2):
+        resumed, _ = step(resumed, batch)
+
+    for (pth, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(state["params"]),
+        jax.tree_util.tree_leaves_with_path(resumed["params"]),
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=str(pth)
+        )
