@@ -1,0 +1,39 @@
+"""ray_tpu.rllib — TPU-native reinforcement learning tier.
+
+Capability parity target: the reference's RLlib (reference: rllib/algorithms/
+algorithm.py:212, rllib/core/learner/learner.py:112, rllib/env/
+single_agent_env_runner.py:67), redesigned TPU-first:
+
+- **EnvRunner** actors sample from gymnasium vector envs on CPU hosts and do
+  their own advantage postprocessing (GAE) so the learner sees ready
+  minibatches — the rollout plane never touches the accelerator.
+- **Learner** is one jitted SPMD update over a ``dp`` device mesh: the batch
+  is sharded over data-parallel devices and gradients are combined by XLA
+  collectives inside the compiled step (no DDP wrapper, no NCCL).
+- **LearnerGroup** scales to multiple learner processes with gradient
+  allreduce through :mod:`ray_tpu.util.collective` (XLA/ICI on TPU, CPU
+  coordinator backend in tests).
+- **Algorithm** drives the sample → learn → weight-sync loop and is
+  checkpointable (save/restore of module + optimizer state).
+"""
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
+from ray_tpu.rllib.rl_module import MLPModule, RLModule
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "EnvRunner",
+    "Learner",
+    "LearnerGroup",
+    "MLPModule",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "RLModule",
+    "SampleBatch",
+]

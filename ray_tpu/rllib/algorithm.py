@@ -1,0 +1,234 @@
+"""Algorithm: the sample -> learn -> sync driver loop.
+
+Reference parity: rllib/algorithms/algorithm.py:212 + AlgorithmConfig.
+Redesigned: an Algorithm is a plain driver-side object (not an actor) that
+owns EnvRunner actors and a LearnerGroup; one ``train()`` call is one
+iteration of the loop. Checkpointable via save/restore of the learner state
+(params + optimizer) and iteration counters.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+import pickle
+import time
+from typing import Callable
+
+import numpy as np
+
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import LearnerHyperparams
+from ray_tpu.rllib.rl_module import MLPModule, RLModule
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    """Builder-style config (reference: AlgorithmConfig fluent API)."""
+
+    env: str | Callable | None = None
+    num_env_runners: int = 2
+    num_envs_per_env_runner: int = 1
+    rollout_fragment_length: int = 256
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    lr: float = 3e-4
+    num_sgd_epochs: int = 4
+    minibatch_size: int = 128
+    grad_clip: float | None = 0.5
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    num_learners: int = 1
+    learner_resources: dict | None = None
+    env_runner_resources: dict | None = None
+    collective_backend: str = "cpu"
+
+    # -- fluent helpers -----------------------------------------------------
+    def environment(self, env) -> "AlgorithmConfig":
+        c = copy.copy(self)
+        c.env = env
+        return c
+
+    def env_runners(self, **kw) -> "AlgorithmConfig":
+        c = copy.copy(self)
+        for k, v in kw.items():
+            setattr(c, k if hasattr(c, k) else _miss(k), v)
+        return c
+
+    def training(self, **kw) -> "AlgorithmConfig":
+        return self.env_runners(**kw)
+
+    def learners(self, **kw) -> "AlgorithmConfig":
+        return self.env_runners(**kw)
+
+    def build(self) -> "Algorithm":
+        return self.algo_class(self)  # type: ignore[attr-defined]
+
+    def hyperparams(self) -> LearnerHyperparams:
+        return LearnerHyperparams(
+            lr=self.lr,
+            num_sgd_epochs=self.num_sgd_epochs,
+            minibatch_size=self.minibatch_size,
+            grad_clip=self.grad_clip,
+            seed=self.seed,
+        )
+
+
+def _miss(k: str):
+    raise AttributeError(f"unknown AlgorithmConfig field {k!r}")
+
+
+def _env_maker(env):
+    if callable(env):
+        return env
+
+    def make():
+        import gymnasium as gym
+
+        return gym.make(env)
+
+    return make
+
+
+class Algorithm:
+    """Base driver. Subclasses set ``learner_cls`` and may override
+    :meth:`default_module`."""
+
+    learner_cls: type = None  # type: ignore[assignment]
+
+    def __init__(self, config: AlgorithmConfig):
+        import ray_tpu
+        from ray_tpu.rllib.learner import LearnerGroup
+
+        if config.env is None:
+            raise ValueError("config.environment(...) is required")
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        maker = _env_maker(config.env)
+        self.module = self.default_module(maker, config)
+        self.learner_group = LearnerGroup(
+            self.learner_cls,
+            self.module,
+            config.hyperparams(),
+            num_learners=config.num_learners,
+            learner_resources=config.learner_resources,
+            backend=config.collective_backend,
+            loss_args=self.learner_loss_args(),
+        )
+        runner_opts = config.env_runner_resources or {"num_cpus": 1}
+        self.env_runners = [
+            ray_tpu.remote(EnvRunner)
+            .options(**runner_opts)
+            .remote(
+                maker,
+                self.module,
+                num_envs=config.num_envs_per_env_runner,
+                rollout_fragment_length=config.rollout_fragment_length,
+                gamma=config.gamma,
+                lambda_=config.lambda_,
+                seed=config.seed,
+                worker_index=i,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self._sync_weights()
+
+    # -- overridables -------------------------------------------------------
+    def default_module(self, maker, config: AlgorithmConfig) -> RLModule:
+        env = maker()
+        try:
+            obs_dim = int(np.prod(env.observation_space.shape))
+            space = env.action_space
+            discrete = hasattr(space, "n")
+            num_out = int(space.n) if discrete else int(np.prod(space.shape))
+        finally:
+            env.close()
+        return MLPModule(
+            obs_dim=obs_dim,
+            num_outputs=num_out,
+            hidden=tuple(config.hidden),
+            discrete=discrete,
+        )
+
+    def learner_loss_args(self) -> tuple:
+        return ()
+
+    # -- the loop -----------------------------------------------------------
+    def _sync_weights(self) -> None:
+        import ray_tpu
+
+        weights = self.learner_group.get_weights()
+        ray_tpu.get(
+            [r.set_weights.remote(weights) for r in self.env_runners]
+        )
+
+    def train(self) -> dict:
+        """One iteration: parallel sample -> learner update -> weight sync."""
+        import ray_tpu
+
+        t0 = time.perf_counter()
+        batches = ray_tpu.get(
+            [r.sample.remote() for r in self.env_runners]
+        )
+        batch = SampleBatch.concat(batches)
+        t_sample = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        learn_stats = self.learner_group.update(batch)
+        self._sync_weights()
+        t_learn = time.perf_counter() - t0
+        self._total_env_steps += len(batch)
+        self.iteration += 1
+        runner_metrics = ray_tpu.get(
+            [r.metrics.remote() for r in self.env_runners]
+        )
+        rets = [
+            m["episode_return_mean"]
+            for m in runner_metrics
+            if not np.isnan(m["episode_return_mean"])
+        ]
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "env_steps_this_iter": len(batch),
+            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
+            "learner": learn_stats,
+            "time_sample_s": round(t_sample, 3),
+            "time_learn_s": round(t_learn, 3),
+        }
+
+    # -- checkpointing (reference: rllib/utils/checkpoints.py Checkpointable)
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+            "config": dataclasses.asdict(
+                dataclasses.replace(self.config, env=None)
+            ),
+        }
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self._sync_weights()
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self.env_runners:
+            try:
+                r.stop.remote()
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.learner_group.shutdown()

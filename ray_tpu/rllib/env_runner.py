@@ -1,0 +1,211 @@
+"""EnvRunner: CPU rollout actor with on-runner GAE postprocessing.
+
+Reference parity: rllib/env/single_agent_env_runner.py:67 (gymnasium vector
+envs + connector pipelines). Redesigned: the runner owns the whole
+obs -> action -> advantage pipeline so the learner receives ready-to-train
+batches; inference runs as plain (non-jitted-on-accelerator) JAX on the CPU
+host, keeping TPU chips free for the Learner's SPMD step.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable
+
+import jax
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.rl_module import RLModule, to_numpy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def compute_gae(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    last_values: np.ndarray,
+    terminateds: np.ndarray,
+    truncateds: np.ndarray,
+    gamma: float,
+    lam: float,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Generalized advantage estimation over [T, N] fragments.
+
+    Episode boundaries: terminated -> bootstrap value 0; truncated (or
+    fragment end) -> bootstrap with the critic's value of the next obs.
+    Returns (advantages, value_targets), both [T, N].
+    """
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    next_adv = np.zeros((N,), np.float32)
+    next_values = last_values
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - terminateds[t]
+        # A truncated step still bootstraps from next_values, but the GAE
+        # recursion must not leak across the episode reset that follows.
+        carry = nonterminal * (1.0 - truncateds[t])
+        delta = rewards[t] + gamma * next_values * nonterminal - values[t]
+        adv[t] = delta + gamma * lam * carry * next_adv
+        next_adv = adv[t]
+        next_values = values[t]
+    return adv, adv + values
+
+
+class EnvRunner:
+    """Samples fixed-length fragments from a gymnasium vector env.
+
+    Run as a ray_tpu actor: ``remote(EnvRunner).options(...).remote(...)``.
+    """
+
+    def __init__(
+        self,
+        env_maker: Callable,
+        module: RLModule,
+        *,
+        num_envs: int = 1,
+        rollout_fragment_length: int = 200,
+        gamma: float = 0.99,
+        lambda_: float = 0.95,
+        seed: int = 0,
+        worker_index: int = 0,
+    ):
+        import gymnasium as gym
+
+        self.module = module
+        self.num_envs = num_envs
+        self.fragment_len = rollout_fragment_length
+        self.gamma = gamma
+        self.lam = lambda_
+        self._envs = gym.vector.SyncVectorEnv(
+            [env_maker for _ in range(num_envs)]
+        )
+        self._key = jax.random.key(seed * 100003 + worker_index)
+        self._params = None
+        self._obs, _ = self._envs.reset(seed=seed * 7919 + worker_index)
+        # Envs that finished on the previous step: gymnasium >=1.0 NEXT_STEP
+        # vector autoreset makes their next step a reset (action ignored,
+        # reward 0) — recorded but masked out of the loss.
+        self._autoreset = np.zeros(num_envs, bool)
+        # The whole rollout plane stays on the CPU backend even when the
+        # process can see a TPU: inference here must not contend with the
+        # Learner's chips.
+        try:
+            self._cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # pragma: no cover - no CPU backend
+            self._cpu = None
+
+        @jax.jit
+        def _policy_step(params, obs, key):
+            out = self.module.forward(params, obs)
+            actions = self.module.dist_sample(out, key)
+            logp = self.module.dist_logp(out, actions)
+            return actions, logp, out["vf"]
+
+        self._policy_step = _policy_step
+        self._vf = jax.jit(
+            lambda params, obs: self.module.forward(params, obs)["vf"]
+        )
+        # Per-env running episode accounting + a window of finished episodes.
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._episode_returns: collections.deque = collections.deque(
+            maxlen=100
+        )
+        self._episode_lengths: collections.deque = collections.deque(
+            maxlen=100
+        )
+        self._total_steps = 0
+
+    # -- weight sync --------------------------------------------------------
+    def set_weights(self, params) -> bool:
+        params = to_numpy(params)
+        if self._cpu is not None:
+            # Committing the params to the CPU device pins every jitted
+            # policy step to the CPU backend (inputs follow committed args).
+            params = jax.device_put(params, self._cpu)
+        self._params = params
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+    # -- sampling -----------------------------------------------------------
+    def sample(self) -> SampleBatch:
+        """One [T=fragment_len, N=num_envs] fragment, flattened to [T*N]
+        with GAE advantages/value targets attached."""
+        if self._params is None:
+            raise RuntimeError("set_weights() before sample()")
+        T, N = self.fragment_len, self.num_envs
+        obs_buf = np.empty((T, N) + self._obs.shape[1:], np.float32)
+        act_list, logp_buf = [], np.empty((T, N), np.float32)
+        vf_buf = np.empty((T, N), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        term_buf = np.empty((T, N), np.float32)
+        trunc_buf = np.empty((T, N), np.float32)
+        mask_buf = np.empty((T, N), np.float32)
+
+        for t in range(T):
+            self._key, k = jax.random.split(self._key)
+            actions, logp, vf = self._policy_step(self._params, self._obs, k)
+            actions_np = np.asarray(actions)
+            obs_buf[t] = self._obs
+            act_list.append(actions_np)
+            logp_buf[t] = np.asarray(logp)
+            vf_buf[t] = np.asarray(vf)
+            # Envs in autoreset perform their reset this step: the recorded
+            # transition is fabricated (action ignored, reward 0) and is
+            # masked out of the loss and the episode accounting.
+            live = ~self._autoreset
+            mask_buf[t] = live
+            next_obs, rew, term, trunc, _ = self._envs.step(actions_np)
+            rew_buf[t] = rew
+            term_buf[t] = term
+            trunc_buf[t] = trunc
+            self._ep_return += rew * live
+            self._ep_len += live
+            done = np.logical_or(term, trunc)
+            for i in np.flatnonzero(done):
+                self._episode_returns.append(self._ep_return[i])
+                self._episode_lengths.append(int(self._ep_len[i]))
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+            self._autoreset = done
+            self._obs = next_obs
+        self._total_steps += int(mask_buf.sum())
+
+        last_vf = np.asarray(self._vf(self._params, self._obs))
+        adv, targets = compute_gae(
+            rew_buf, vf_buf, last_vf, term_buf, trunc_buf, self.gamma, self.lam
+        )
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        return SampleBatch(
+            {
+                sb.OBS: flat(obs_buf),
+                sb.ACTIONS: flat(np.stack(act_list)),
+                sb.LOGP: flat(logp_buf),
+                sb.VF_PREDS: flat(vf_buf),
+                sb.REWARDS: flat(rew_buf),
+                sb.TERMINATEDS: flat(term_buf),
+                sb.TRUNCATEDS: flat(trunc_buf),
+                sb.ADVANTAGES: flat(adv),
+                sb.VALUE_TARGETS: flat(targets),
+                sb.LOSS_MASK: flat(mask_buf),
+            }
+        )
+
+    def metrics(self) -> dict:
+        rets = list(self._episode_returns)
+        return {
+            "num_env_steps_sampled": self._total_steps,
+            "num_episodes": len(rets),
+            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
+            "episode_return_max": float(np.max(rets)) if rets else np.nan,
+            "episode_len_mean": (
+                float(np.mean(self._episode_lengths))
+                if self._episode_lengths
+                else np.nan
+            ),
+        }
+
+    def stop(self) -> None:
+        self._envs.close()

@@ -1,0 +1,115 @@
+"""PPO: clipped-surrogate policy optimization (north-star config 4).
+
+Reference parity: rllib/algorithms/ppo/ (torch PPO over Learner/EnvRunner).
+The loss is a pure JAX function jitted once by the base Learner over its
+``dp`` mesh; advantages arrive precomputed (GAE on the EnvRunners) and are
+re-standardized per minibatch, matching the reference's
+``standardize_fields=["advantages"]`` default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner, LearnerHyperparams
+from ray_tpu.rllib.rl_module import RLModule
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOParams:
+    clip_param: float = 0.2
+    vf_clip_param: float = 10.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.0
+    kl_target: float | None = None  # None: no adaptive-KL term (clip only)
+
+
+class PPOLearner(Learner):
+    def __init__(
+        self,
+        module: RLModule,
+        hps: LearnerHyperparams,
+        ppo: PPOParams = PPOParams(),
+        *,
+        group_name: str | None = None,
+        world_size: int = 1,
+    ):
+        super().__init__(
+            module, hps, group_name=group_name, world_size=world_size
+        )
+        self.ppo = ppo
+
+    def loss(self, params, mb):
+        p = self.ppo
+        # Mask out gymnasium autoreset dummy transitions (LOSS_MASK == 0).
+        mask = mb.get(sb.LOSS_MASK)
+        if mask is None:
+            mask = jnp.ones_like(mb[sb.LOGP])
+        denom = jnp.sum(mask) + 1e-8
+
+        def mmean(x):
+            return jnp.sum(x * mask) / denom
+
+        out = self.module.forward(params, mb[sb.OBS])
+        logp = self.module.dist_logp(out, mb[sb.ACTIONS])
+        ratio = jnp.exp(logp - mb[sb.LOGP])
+        adv = mb[sb.ADVANTAGES]
+        adv_mean = mmean(adv)
+        adv_std = jnp.sqrt(mmean(jnp.square(adv - adv_mean)))
+        adv = (adv - adv_mean) / (adv_std + 1e-8)
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - p.clip_param, 1 + p.clip_param) * adv,
+        )
+        pi_loss = -mmean(surr)
+
+        vf = out["vf"]
+        vf_err = jnp.square(vf - mb[sb.VALUE_TARGETS])
+        vf_loss = mmean(jnp.minimum(vf_err, p.vf_clip_param**2))
+
+        entropy = mmean(self.module.dist_entropy(out))
+        total = (
+            pi_loss + p.vf_loss_coeff * vf_loss - p.entropy_coeff * entropy
+        )
+        approx_kl = mmean(mb[sb.LOGP] - logp)
+        stats = {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "approx_kl": approx_kl,
+            "clip_frac": mmean(
+                (jnp.abs(ratio - 1.0) > p.clip_param).astype(jnp.float32)
+            ),
+        }
+        return total, stats
+
+
+@dataclasses.dataclass
+class PPOConfig(AlgorithmConfig):
+    clip_param: float = 0.2
+    vf_clip_param: float = 10.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.0
+
+    @property
+    def algo_class(self) -> type:
+        return PPO
+
+    def ppo_params(self) -> PPOParams:
+        return PPOParams(
+            clip_param=self.clip_param,
+            vf_clip_param=self.vf_clip_param,
+            vf_loss_coeff=self.vf_loss_coeff,
+            entropy_coeff=self.entropy_coeff,
+        )
+
+
+class PPO(Algorithm):
+    learner_cls = PPOLearner
+
+    def learner_loss_args(self) -> tuple:
+        return (self.config.ppo_params(),)  # type: ignore[attr-defined]

@@ -1,0 +1,129 @@
+"""RLModule: the neural-net policy/value container, pure JAX.
+
+Reference parity: rllib/core/rl_module/rl_module.py (torch modules behind a
+framework-agnostic ABC). Redesigned TPU-first: a module is a pytree of
+parameters plus pure functions — ``forward(params, obs)`` — so the same
+module runs jitted on a device mesh in the Learner and as plain numpy-ish
+JAX-on-CPU inside EnvRunner actors, with weights moving as numpy pytrees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any  # pytree of jnp arrays
+
+
+class RLModule:
+    """ABC. Subclasses are stateless: parameters are passed explicitly."""
+
+    def init(self, key: jax.Array) -> Params:
+        raise NotImplementedError
+
+    def forward(self, params: Params, obs: jax.Array) -> dict:
+        """obs [B, ...] -> {"logits" or ("mean","log_std"), "vf"}."""
+        raise NotImplementedError
+
+    # -- action distribution over the forward output ------------------------
+    def dist_sample(self, out: dict, key: jax.Array):
+        raise NotImplementedError
+
+    def dist_logp(self, out: dict, actions: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def dist_entropy(self, out: dict) -> jax.Array:
+        raise NotImplementedError
+
+
+def _mlp_init(key, sizes, scale_last=0.01):
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        s = scale_last if i == len(sizes) - 2 else float(np.sqrt(2.0 / din))
+        w = jax.random.normal(keys[i], (din, dout), jnp.float32) * s
+        params.append({"w": w, "b": jnp.zeros((dout,), jnp.float32)})
+    return params
+
+
+def _mlp_apply(layers, x):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPModule(RLModule):
+    """Separate actor/critic MLP torsos with tanh activations.
+
+    discrete: categorical head of ``num_outputs`` logits;
+    continuous: diag-gaussian with state-independent log_std.
+    """
+
+    obs_dim: int
+    num_outputs: int
+    hidden: Sequence[int] = (64, 64)
+    discrete: bool = True
+
+    def init(self, key: jax.Array) -> Params:
+        k_pi, k_vf, k_std = jax.random.split(key, 3)
+        sizes_pi = [self.obs_dim, *self.hidden, self.num_outputs]
+        sizes_vf = [self.obs_dim, *self.hidden, 1]
+        params = {
+            "pi": _mlp_init(k_pi, sizes_pi),
+            "vf": _mlp_init(k_vf, sizes_vf, scale_last=1.0),
+        }
+        if not self.discrete:
+            params["log_std"] = jnp.zeros((self.num_outputs,), jnp.float32)
+        return params
+
+    def forward(self, params: Params, obs: jax.Array) -> dict:
+        obs = obs.astype(jnp.float32)
+        if obs.ndim > 2:  # flatten non-1D observation spaces to obs_dim
+            obs = obs.reshape(obs.shape[0], -1)
+        out = {
+            "logits": _mlp_apply(params["pi"], obs),
+            "vf": _mlp_apply(params["vf"], obs)[..., 0],
+        }
+        if not self.discrete:
+            out["log_std"] = params["log_std"]
+        return out
+
+    # -- distributions ------------------------------------------------------
+    def dist_sample(self, out: dict, key: jax.Array):
+        if self.discrete:
+            return jax.random.categorical(key, out["logits"], axis=-1)
+        std = jnp.exp(out["log_std"])
+        eps = jax.random.normal(key, out["logits"].shape)
+        return out["logits"] + std * eps
+
+    def dist_logp(self, out: dict, actions: jax.Array) -> jax.Array:
+        if self.discrete:
+            logp = jax.nn.log_softmax(out["logits"], axis=-1)
+            return jnp.take_along_axis(
+                logp, actions[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+        log_std = out["log_std"]
+        z = (actions - out["logits"]) / jnp.exp(log_std)
+        return jnp.sum(
+            -0.5 * z**2 - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1
+        )
+
+    def dist_entropy(self, out: dict) -> jax.Array:
+        if self.discrete:
+            logp = jax.nn.log_softmax(out["logits"], axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return jnp.sum(
+            out["log_std"] + 0.5 * jnp.log(2 * jnp.pi * jnp.e)
+        ) * jnp.ones(out["logits"].shape[:-1])
+
+
+def to_numpy(params: Params) -> Params:
+    """Device pytree -> host numpy pytree (for shipping to EnvRunners)."""
+    return jax.tree.map(lambda x: np.asarray(x), params)

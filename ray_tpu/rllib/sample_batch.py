@@ -1,0 +1,77 @@
+"""SampleBatch: the unit of experience moving EnvRunner -> Learner.
+
+A thin dict-of-numpy-arrays with concat/shuffle/minibatch helpers
+(reference: rllib/policy/sample_batch.py, redesigned: no lazy views or
+compression — batches here are small host-side numpy that feed a jitted
+SPMD update, so simplicity wins).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping
+
+import numpy as np
+
+# Canonical column names (reference: rllib/policy/sample_batch.py columns).
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+TERMINATEDS = "terminateds"
+TRUNCATEDS = "truncateds"
+LOGP = "action_logp"
+VF_PREDS = "vf_preds"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+# 0.0 marks transitions that must not contribute to the loss (the dummy
+# step gymnasium >=1.0 NEXT_STEP vector autoreset inserts after each done).
+LOSS_MASK = "loss_mask"
+
+
+class SampleBatch(dict, Mapping[str, np.ndarray]):
+    """Dict of equally-sized leading-dim numpy arrays."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        sizes = {k: len(v) for k, v in self.items()}
+        if sizes and len(set(sizes.values())) > 1:
+            raise ValueError(f"ragged SampleBatch columns: {sizes}")
+
+    def __len__(self) -> int:  # number of timesteps, not number of keys
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    @staticmethod
+    def concat(batches: list["SampleBatch"]) -> "SampleBatch":
+        if not batches:
+            return SampleBatch()
+        keys = batches[0].keys()
+        return SampleBatch(
+            {k: np.concatenate([b[k] for b in batches], axis=0) for k in keys}
+        )
+
+    def shuffled(self, rng: np.random.Generator) -> "SampleBatch":
+        perm = rng.permutation(len(self))
+        return SampleBatch({k: v[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        """Equal-size minibatches; a ragged tail is dropped so every jitted
+        update sees one static shape (one XLA compile for the whole run)."""
+        n = (len(self) // size) * size
+        for start in range(0, n, size):
+            yield SampleBatch(
+                {k: v[start : start + size] for k, v in self.items()}
+            )
+
+    def pad_to_multiple(self, m: int) -> "SampleBatch":
+        """Repeat-pad rows so len % m == 0 (for sharding over a dp axis)."""
+        n = len(self)
+        if n == 0 or n % m == 0:
+            return self
+        pad = m - n % m
+        idx = np.concatenate([np.arange(n), np.arange(pad) % n])
+        return SampleBatch({k: v[idx] for k, v in self.items()})

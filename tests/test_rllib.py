@@ -1,0 +1,192 @@
+"""RLlib tier: EnvRunner sampling, GAE, PPO learner, Algorithm loop.
+
+Reference parity: rllib/algorithms/ppo/tests/test_ppo.py + env runner tests
+(compressed: mechanics + a short CartPole learning run).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    MLPModule,
+    PPOConfig,
+    SampleBatch,
+)
+from ray_tpu.rllib.env_runner import EnvRunner, compute_gae
+from ray_tpu.rllib.learner import LearnerHyperparams
+from ray_tpu.rllib.ppo import PPOLearner, PPOParams
+from ray_tpu.rllib import sample_batch as sb
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=16)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_compute_gae_matches_manual():
+    # T=3, N=1, no termination: classic recursive check.
+    r = np.array([[1.0], [1.0], [1.0]], np.float32)
+    v = np.array([[0.5], [0.5], [0.5]], np.float32)
+    last_v = np.array([0.5], np.float32)
+    zeros = np.zeros((3, 1), np.float32)
+    gamma, lam = 0.9, 0.8
+    adv, tgt = compute_gae(r, v, last_v, zeros, zeros, gamma, lam)
+    # manual backward recursion
+    expect = np.zeros(3)
+    next_adv, next_v = 0.0, 0.5
+    for t in (2, 1, 0):
+        delta = 1.0 + gamma * next_v - 0.5
+        expect[t] = delta + gamma * lam * next_adv
+        next_adv, next_v = expect[t], 0.5
+    np.testing.assert_allclose(adv[:, 0], expect, rtol=1e-5)
+    np.testing.assert_allclose(tgt, adv + v, rtol=1e-6)
+
+
+def test_compute_gae_termination_blocks_bootstrap():
+    r = np.array([[0.0], [10.0]], np.float32)
+    v = np.array([[1.0], [1.0]], np.float32)
+    term = np.array([[0.0], [1.0]], np.float32)
+    zeros = np.zeros((2, 1), np.float32)
+    # terminal step: delta = r - v (no bootstrap from huge last value)
+    adv, _ = compute_gae(
+        r, v, np.array([100.0], np.float32), term, zeros, 1.0, 1.0
+    )
+    assert adv[1, 0] == pytest.approx(9.0)
+
+
+def test_env_runner_sample_shapes_local():
+    mod = MLPModule(obs_dim=4, num_outputs=2)
+    runner = EnvRunner(
+        lambda: __import__("gymnasium").make("CartPole-v1"),
+        mod,
+        num_envs=2,
+        rollout_fragment_length=16,
+        seed=3,
+    )
+    import jax
+
+    runner.set_weights(mod.init(jax.random.key(0)))
+    batch = runner.sample()
+    assert len(batch) == 32
+    assert batch[sb.OBS].shape == (32, 4)
+    assert batch[sb.ADVANTAGES].shape == (32,)
+    assert np.isfinite(batch[sb.ADVANTAGES]).all()
+    # Autoreset dummy steps are recorded (static shapes) but masked.
+    assert batch[sb.LOSS_MASK].shape == (32,)
+    n_genuine = int(batch[sb.LOSS_MASK].sum())
+    m = runner.metrics()
+    assert m["num_env_steps_sampled"] == n_genuine <= 32
+    runner.stop()
+
+
+def test_ppo_learner_update_improves_loss_direction():
+    mod = MLPModule(obs_dim=4, num_outputs=2)
+    learner = PPOLearner(
+        mod,
+        LearnerHyperparams(lr=1e-2, num_sgd_epochs=2, minibatch_size=32),
+        PPOParams(),
+    )
+    learner.build()
+    rng = np.random.default_rng(0)
+    n = 64
+    batch = SampleBatch(
+        {
+            sb.OBS: rng.normal(size=(n, 4)).astype(np.float32),
+            sb.ACTIONS: rng.integers(0, 2, size=(n,)),
+            sb.LOGP: np.full((n,), -0.693, np.float32),
+            sb.ADVANTAGES: rng.normal(size=(n,)).astype(np.float32),
+            sb.VALUE_TARGETS: rng.normal(size=(n,)).astype(np.float32),
+        }
+    )
+    w0 = learner.get_weights()
+    stats = learner.update(batch)
+    w1 = learner.get_weights()
+    assert stats["num_grad_steps"] == 4  # 2 epochs x 2 minibatches
+    assert np.isfinite(stats["total_loss"])
+    # weights actually moved
+    moved = any(
+        not np.allclose(a["w"], b["w"])
+        for a, b in zip(w0["pi"], w1["pi"])
+    )
+    assert moved
+
+
+def test_ppo_cartpole_learns(cluster):
+    """Short CartPole run: mean return must clearly beat the random policy
+    (~20) within a few iterations. Deterministic seed keeps this stable."""
+    config = (
+        PPOConfig(
+            num_env_runners=2,
+            num_envs_per_env_runner=4,
+            rollout_fragment_length=128,
+            minibatch_size=256,
+            num_sgd_epochs=6,
+            lr=3e-4,
+            entropy_coeff=0.01,
+            seed=0,
+        )
+        .environment("CartPole-v1")
+    )
+    algo = config.build()
+    first = algo.train()
+    result = first
+    for _ in range(11):
+        result = algo.train()
+    assert result["training_iteration"] == 12
+    assert result["num_env_steps_sampled_lifetime"] == 12 * 2 * 4 * 128
+    # Random policy scores ~20 on CartPole; require a clear improvement
+    # over both that and the first iteration's trailing mean.
+    assert result["episode_return_mean"] > 45, result
+    assert result["episode_return_mean"] > first["episode_return_mean"], (
+        first,
+        result,
+    )
+    algo.stop()
+
+
+def test_ppo_save_restore_roundtrip(cluster, tmp_path):
+    config = PPOConfig(
+        num_env_runners=1,
+        num_envs_per_env_runner=1,
+        rollout_fragment_length=32,
+        minibatch_size=32,
+        num_sgd_epochs=1,
+        seed=1,
+    ).environment("CartPole-v1")
+    algo = config.build()
+    algo.train()
+    path = algo.save(str(tmp_path / "ckpt"))
+    w_saved = algo.learner_group.get_weights()
+    algo.train()  # mutate further
+    algo.restore(path)
+    w_restored = algo.learner_group.get_weights()
+    for a, b in zip(w_saved["pi"], w_restored["pi"]):
+        np.testing.assert_array_equal(a["w"], b["w"])
+    assert algo.iteration == 1
+    algo.stop()
+
+
+def test_ppo_multi_learner_group(cluster):
+    """2 learner actors with flat-gradient allreduce produce identical
+    replicas after an update."""
+    config = PPOConfig(
+        num_env_runners=1,
+        num_envs_per_env_runner=2,
+        rollout_fragment_length=64,
+        minibatch_size=32,
+        num_sgd_epochs=1,
+        num_learners=2,
+        seed=2,
+    ).environment("CartPole-v1")
+    algo = config.build()
+    algo.train()
+    ws = [
+        ray_tpu.get(a.get_weights.remote())
+        for a in algo.learner_group._actors
+    ]
+    for a, b in zip(ws[0]["pi"], ws[1]["pi"]):
+        np.testing.assert_allclose(a["w"], b["w"], atol=1e-6)
+    algo.stop()
