@@ -18,6 +18,7 @@ from typing import Any, Optional, Sequence
 
 import cloudpickle
 
+from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.core_worker import CoreWorker
 from ray_tpu.core.errors import RayTpuError
 from ray_tpu.core.gcs import GcsServer
@@ -185,6 +186,10 @@ def init(
             if ignore_reinit_error:
                 return _runtime
             raise RayTpuError("ray_tpu already initialized")
+        if address is None:
+            # Submitted jobs' drivers join the submitting cluster
+            # (reference: RAY_ADDRESS env honored by ray.init).
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
         if address is not None:
             if (
                 num_cpus is not None
@@ -230,6 +235,11 @@ def init(
             runtime.gcs_addr, runtime.head_addr, kind="driver"
         )
         worker.start()
+        if GLOBAL_CONFIG.log_to_driver:
+            try:
+                worker.enable_log_subscription()
+            except Exception:
+                pass
         _runtime = runtime
         _worker = worker
         atexit.register(shutdown)
@@ -376,6 +386,14 @@ def _scheduling_from_opts(
 
 
 class ActorMethod:
+    def bind(self, *args, **kwargs):
+        """Add this method call to a static DAG (reference:
+        python/ray/dag — actor.method.bind); compile with
+        .experimental_compile()."""
+        from ray_tpu.dag.nodes import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def __init__(self, handle: "ActorHandle", name: str):
         self._handle = handle
         self._name = name

@@ -57,6 +57,16 @@ class Config:
     default_max_restarts: int = 0
     # RPC
     rpc_connect_timeout_s: float = 30.0
+    # GCS fault tolerance: non-empty -> sqlite-backed durable GCS tables at
+    # this path (reference: RAY_external_storage_namespace + redis FT).
+    gcs_storage_path: str = ""
+    # Observability (reference: task_event_buffer.h flush loop +
+    # gcs_task_manager.h bounded store; log_monitor.py tail interval)
+    task_event_flush_interval_s: float = 1.0
+    task_events_max: int = 10000
+    metrics_report_interval_s: float = 2.0
+    log_monitor_interval_s: float = 0.3
+    log_to_driver: bool = True
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

@@ -142,10 +142,18 @@ class CoreWorker:
 
         # actor-client side: per-actor ordered submitters
         self._actor_submitters: dict[str, _ActorSubmitter] = {}
+        # compiled-graph loops running in this actor process (dag_id -> loop)
+        self._dag_loops: dict[str, Any] = {}
 
         self._stopped = False
         self._view_cache: dict | None = None
         self._view_time = 0.0
+
+        # Observability: buffered task lifecycle events, flushed to the GCS
+        # on an interval (reference: task_event_buffer.h -> GcsTaskManager).
+        self._task_events_buf: list[dict] = []
+        self._task_flush_task = None
+        self._metrics_push_task = None
 
         for n in [n for n in dir(self) if n.startswith("_h_")]:
             topic, _, meth = n[3:].partition("_")
@@ -173,11 +181,21 @@ class CoreWorker:
         object_ref_mod.install_hooks(
             self._on_ref_deserialized, self._on_ref_deleted
         )
+        self._task_flush_task = self.endpoint.submit(
+            self._task_event_flush_loop()
+        )
+        self._metrics_push_task = self.endpoint.submit(
+            self._metrics_push_loop()
+        )
         return addr
 
     def stop(self) -> None:
         self._stopped = True
         object_ref_mod.clear_hooks()
+        if self._task_flush_task is not None:
+            self._task_flush_task.cancel()
+        if self._metrics_push_task is not None:
+            self._metrics_push_task.cancel()
         if self.kind == "driver":
             # Leave the node's registry (long-lived `raytpu start` daemons
             # would otherwise keep one dead driver entry per session).
@@ -193,6 +211,83 @@ class CoreWorker:
         if self._executor is not None:
             self._executor.shutdown(wait=False, cancel_futures=True)
         self.endpoint.stop()
+
+    # -- task events ---------------------------------------------------------
+
+    def _task_event(self, task_id: str, state: str, **fields) -> None:
+        """Record one lifecycle transition; flushed to the GCS in batches."""
+        ev = {
+            "task_id": task_id,
+            "state": state,
+            "states": {state: time.time()},
+            **fields,
+        }
+        buf = self._task_events_buf
+        buf.append(ev)
+        cap = 4 * GLOBAL_CONFIG.task_events_max
+        if len(buf) > cap:  # GCS unreachable for a long time: shed oldest
+            del buf[: cap // 2]
+
+    async def _task_event_flush_loop(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(GLOBAL_CONFIG.task_event_flush_interval_s)
+            if not self._task_events_buf:
+                continue
+            batch, self._task_events_buf = self._task_events_buf, []
+            try:
+                await self.gcs.acall(
+                    "report_task_events", {"events": batch}
+                )
+            except Exception:
+                self._task_events_buf = batch + self._task_events_buf
+
+    async def _metrics_push_loop(self) -> None:
+        """Ship this process's user-metric registry to the node manager,
+        which aggregates per node and reports to the GCS (reference:
+        metrics_agent.py OpenCensusProxyCollector)."""
+        from ray_tpu.util.metrics import registry
+
+        while not self._stopped:
+            await asyncio.sleep(GLOBAL_CONFIG.metrics_report_interval_s)
+            snap = registry().snapshot()
+            if not snap["points"]:
+                continue
+            try:
+                await self.endpoint.anotify(
+                    self.node_addr,
+                    "node.report_metrics",
+                    {"worker_id": self.worker_id, "snapshot": snap},
+                )
+            except Exception:
+                pass
+
+    def enable_log_subscription(self) -> None:
+        """Driver-side: stream worker stdout/stderr lines from every node
+        to this process's stderr (reference: log_monitor.py -> driver
+        printing with the (pid=..., ip=...) prefix)."""
+        import sys as _sys
+
+        async def on_pub(conn, p):
+            if p.get("channel") != "logs":
+                return None
+            data = p.get("data") or {}
+            node = str(data.get("node_id", ""))[:8]
+            for batch in data.get("batches", []):
+                src = batch.get("source", "?")
+                for line in batch.get("lines", []):
+                    print(
+                        f"({src}, node={node}) {line}",
+                        file=_sys.stderr,
+                        flush=True,
+                    )
+            return None
+
+        self.endpoint.register("pub", on_pub)
+
+        async def subscribe():
+            await self.gcs.acall("subscribe", {"channels": ["logs"]})
+
+        self.endpoint.submit(subscribe()).result(timeout=10)
 
     # -- ref hooks -----------------------------------------------------------
 
@@ -667,6 +762,7 @@ class CoreWorker:
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, name)
             for oid in return_ids
         ]
+        self._task_event(task_id, "PENDING_SCHEDULING", name=name, kind="task")
         self._run_on_loop(self._enqueue_task(spec))
         return refs
 
@@ -810,6 +906,12 @@ class CoreWorker:
             "pg": spec.pg,
         }
         self._inflight_push[spec.task_id] = tuple(grant["worker_addr"])
+        self._task_event(
+            spec.task_id,
+            "RUNNING",
+            node_id=grant.get("node_id"),
+            worker_id=grant.get("worker_id"),
+        )
         try:
             reply = await self.endpoint.acall(
                 tuple(grant["worker_addr"]), "worker.push_task", payload
@@ -876,6 +978,13 @@ class CoreWorker:
         # to reconstruct outputs whose only copy dies with a node
         # (reference: task_manager.h:229 ResubmitTask; GC in _maybe_free).
         spec.completed = True
+        failed = any(r[0] == "error" for r in results)
+        self._task_event(
+            spec.task_id,
+            "FAILED" if failed else "FINISHED",
+            name=spec.name,
+            **(reply.get("exec") or {}),
+        )
         # Fire-and-forget pattern: refs dropped while the task was PENDING
         # couldn't free then — re-check now that results exist.
         asyncio.ensure_future(
@@ -900,6 +1009,9 @@ class CoreWorker:
         for oid in spec.return_ids:
             self.owner_store.put_error(oid, error)
         self._task_specs.pop(spec.task_id, None)
+        self._task_event(
+            spec.task_id, "FAILED", name=spec.name, error=str(error)[:500]
+        )
 
     # -- cancellation --------------------------------------------------------
 
@@ -1036,6 +1148,13 @@ class CoreWorker:
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, spec.name)
             for oid in return_ids
         ]
+        self._task_event(
+            task_id,
+            "SUBMITTED_TO_ACTOR",
+            name=spec.name,
+            kind="actor_task",
+            actor_id=actor_id,
+        )
         self._run_on_loop(self._submit_actor_async(spec))
         return refs
 
@@ -1122,9 +1241,40 @@ class CoreWorker:
             return await self._execute_actor_task(p)
         return await self._execute_task(p)
 
+    # -- compiled graphs (reference: compiled_dag_node.py ExecutableTask) ----
+
+    async def _h_worker_start_dag_loop(self, conn, p) -> bool:
+        from ray_tpu.dag.executor import DagLoop
+
+        await self._actor_ready.wait()
+        if self._actor_init_error is not None:
+            raise self._actor_init_error
+        loop = DagLoop(self._actor_instance, p["tasks"])
+        self._dag_loops[p["dag_id"]] = loop
+        loop.start()
+        return True
+
+    async def _h_worker_stop_dag_loop(self, conn, p) -> bool:
+        loop = self._dag_loops.pop(p["dag_id"], None)
+        if loop is not None:
+            await asyncio.get_running_loop().run_in_executor(None, loop.stop)
+        return True
+
+    def _exec_span(self, t0: float) -> dict:
+        """Executor-side timing attached to task replies; the owner merges
+        it into the task event (timeline 'execution' span)."""
+        return {
+            "exec_start_ts": t0,
+            "exec_end_ts": time.time(),
+            "exec_pid": os.getpid(),
+            "exec_worker_id": self.worker_id,
+            "exec_node_id": self.node_id,
+        }
+
     async def _execute_task(self, p) -> dict:
         from ray_tpu.util.placement_group import _bind_ambient_pg
 
+        t_exec0 = time.time()
         func = cloudpickle.loads(p["func"])
         args, kwargs = await self._resolve_args(p)
         loop = asyncio.get_running_loop()
@@ -1182,9 +1332,12 @@ class CoreWorker:
                 result = await loop.run_in_executor(self._executor, run)
             results = self._encode_results(p, result)
             await self._flush_created(results)
-            return {"results": results}
+            return {"results": results, "exec": self._exec_span(t_exec0)}
         except Exception as e:  # noqa: BLE001
-            return {"results": self._error_results(p, e)}
+            return {
+                "results": self._error_results(p, e),
+                "exec": self._exec_span(t_exec0),
+            }
         finally:
             with self._cancel_lock:
                 self._cancelled_tasks.discard(task_id)
@@ -1227,6 +1380,7 @@ class CoreWorker:
             args, kwargs = await self._resolve_args(p)
             loop = asyncio.get_running_loop()
             pginfo = self._actor_pg
+            t_exec0 = time.time()
 
             def run_method():
                 with _bind_ambient_pg(pginfo):
@@ -1245,9 +1399,12 @@ class CoreWorker:
                     )
                 results = self._encode_results(p, result)
                 await self._flush_created(results)
-                return {"results": results}
+                return {"results": results, "exec": self._exec_span(t_exec0)}
             except Exception as e:  # noqa: BLE001
-                return {"results": self._error_results(p, e)}
+                return {
+                    "results": self._error_results(p, e),
+                    "exec": self._exec_span(t_exec0),
+                }
         finally:
             advance()
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -69,7 +70,23 @@ class ActorRecord:
 
 
 class GcsServer:
-    def __init__(self, session_id: str):
+    def __init__(self, session_id: str, storage_path: str | None = None):
+        from ray_tpu.core.gcs_store import make_store
+
+        # Durable metadata storage (reference: gcs_table_storage.h over
+        # store_client/; RedisStoreClient:126 is the FT path). With a
+        # storage path, a restarted GCS reloads every table and nodes
+        # re-register on their next heartbeat.
+        self.store = make_store(
+            storage_path
+            if storage_path is not None
+            else (GLOBAL_CONFIG.gcs_storage_path or None)
+        )
+        stored_session = self.store.get("meta", "session_id")
+        if stored_session is not None:
+            session_id = stored_session.decode()
+        else:
+            self.store.put("meta", "session_id", session_id.encode())
         self.session_id = session_id
         self.endpoint = Endpoint("gcs")
         self.kv: dict[str, dict[str, bytes]] = {}
@@ -84,24 +101,106 @@ class GcsServer:
         self.pending_pgs: list[str] = []
         self.pg_release_retries: list[tuple] = []  # (node_id, pg_id)
         self.subs: dict[str, list[Connection]] = {}
+        # Observability: bounded task-event store (reference:
+        # GcsTaskManager, gcs_task_manager.h) keyed by task_id — each
+        # report merges state timestamps into one record; per-node metric
+        # snapshots arrive with heartbeats.
+        self.task_events: "OrderedDict[str, dict]" = OrderedDict()
+        self.node_metrics: dict[str, list] = {}
         self.internal_config: str = GLOBAL_CONFIG.to_json()
         self._health_task = None
+        self._restored_live: list[str] = []
+        self._load_from_store()
         for name in [n for n in dir(self) if n.startswith("_h_")]:
             self.endpoint.register("gcs." + name[3:], getattr(self, name))
+
+    # -- durability ----------------------------------------------------------
+
+    def _load_from_store(self) -> None:
+        import pickle
+
+        for key, value in self.store.scan("kv"):
+            ns, _, k = key.partition("\x00")
+            self.kv.setdefault(ns, {})[k] = value
+        for _, value in self.store.scan("actors"):
+            rec: ActorRecord = pickle.loads(value)
+            rec.waiters = []
+            self.actors[rec.actor_id] = rec
+            if rec.name and rec.state != DEAD:
+                self.named_actors[rec.name] = rec.actor_id
+            if rec.state in (PENDING, RESTARTING):
+                self.pending_actors.append(rec.actor_id)
+            elif rec.state == ALIVE:
+                # Verified after restart: if the hosting node never
+                # re-registers, the actor is failed over (or declared
+                # dead) instead of staying ALIVE-but-unreachable forever.
+                self._restored_live.append(rec.actor_id)
+        for _, value in self.store.scan("pgs"):
+            pg: PgRecord = pickle.loads(value)
+            pg.waiters = []
+            pg.scheduling = False
+            self.pgs[pg.pg_id] = pg
+            if pg.name and pg.state != PG_REMOVED:
+                self.named_pgs[pg.name] = pg.pg_id
+            if pg.state in (PG_PENDING, PG_RESCHEDULING):
+                self.pending_pgs.append(pg.pg_id)
+
+    def _save_actor(self, rec: ActorRecord) -> None:
+        import dataclasses as _dc
+        import pickle
+
+        clean = _dc.replace(rec, waiters=[])
+        self.store.put("actors", rec.actor_id, pickle.dumps(clean))
+
+    def _save_pg(self, rec: PgRecord) -> None:
+        import dataclasses as _dc
+        import pickle
+
+        clean = _dc.replace(rec, waiters=[], scheduling=False)
+        self.store.put("pgs", rec.pg_id, pickle.dumps(clean))
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> tuple:
         addr = self.endpoint.start(host=host, port=port)
         self._health_task = self.endpoint.submit(self._health_loop())
+        if self._restored_live:
+            self.endpoint.submit(self._reconcile_restored_actors())
         return addr
+
+    async def _reconcile_restored_actors(self) -> None:
+        """Post-restart sweep: ALIVE actors restored from storage whose
+        node did not re-register within the grace window are failed over
+        (reference: GCS FT replays node state via NotifyGCSRestart; here
+        nodes re-register on their next heartbeat)."""
+        await asyncio.sleep(5 * GLOBAL_CONFIG.node_heartbeat_interval_s)
+        actor_ids, self._restored_live = self._restored_live, []
+        for actor_id in actor_ids:
+            rec = self.actors.get(actor_id)
+            if rec is None or rec.state != ALIVE:
+                continue
+            if rec.node_id not in self.nodes:
+                await self._on_actor_failure(
+                    rec, "hosting node lost across GCS restart"
+                )
 
     def stop(self) -> None:
         if self._health_task is not None:
             self._health_task.cancel()
         self.endpoint.stop()
+        self.store.close()
 
     # -- pubsub --------------------------------------------------------------
 
     async def _publish(self, channel: str, data: Any) -> None:
+        # Every actor/PG state transition publishes — one persistence hook
+        # covers the whole lifecycle.
+        if channel == "actors":
+            rec = self.actors.get(data.get("actor_id"))
+            if rec is not None:
+                self._save_actor(rec)
+        elif channel == "placement_groups":
+            pg = self.pgs.get(data.get("pg_id"))
+            if pg is not None:
+                self._save_pg(pg)
         for conn in list(self.subs.get(channel, [])):
             if conn.closed:
                 self.subs[channel].remove(conn)
@@ -125,12 +224,16 @@ class GcsServer:
         if not p.get("overwrite", True) and p["key"] in ns:
             return False
         ns[p["key"]] = p["value"]
+        self.store.put(
+            "kv", f"{p.get('ns', '')}\x00{p['key']}", p["value"]
+        )
         return True
 
     async def _h_kv_get(self, conn, p):
         return self.kv.get(p.get("ns", ""), {}).get(p["key"])
 
     async def _h_kv_del(self, conn, p):
+        self.store.delete("kv", f"{p.get('ns', '')}\x00{p['key']}")
         return self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
 
     async def _h_kv_keys(self, conn, p):
@@ -237,6 +340,7 @@ class GcsServer:
             return
         view.alive = False
         view.available = {}
+        self.node_metrics.pop(node_id, None)
         await self._publish(
             "nodes", {"node_id": node_id, "state": DEAD, "reason": reason}
         )
@@ -267,6 +371,7 @@ class GcsServer:
                 raise ValueError(f"actor name {rec.name!r} already taken")
             self.named_actors[rec.name] = rec.actor_id
         self.actors[rec.actor_id] = rec
+        self._save_actor(rec)
         await self._schedule_actor(rec)
         return self._actor_info(rec)
 
@@ -403,6 +508,59 @@ class GcsServer:
 
     async def _h_list_actors(self, conn, p):
         return [self._actor_info(r) for r in self.actors.values()]
+
+    # -- observability -------------------------------------------------------
+
+    async def _h_report_task_events(self, conn, p):
+        """Merge a batch of owner/executor task events into the bounded
+        store (reference: TaskInfoGcsService.AddTaskEventData,
+        gcs_service.proto:881)."""
+        cap = GLOBAL_CONFIG.task_events_max
+        for ev in p["events"]:
+            tid = ev["task_id"]
+            rec = self.task_events.get(tid)
+            if rec is None:
+                rec = {"task_id": tid}
+                self.task_events[tid] = rec
+                while len(self.task_events) > cap:
+                    self.task_events.popitem(last=False)
+            states = rec.setdefault("states", {})
+            states.update(ev.get("states", {}))
+            for k, v in ev.items():
+                if k not in ("task_id", "states"):
+                    rec[k] = v
+        return True
+
+    async def _h_list_task_events(self, conn, p):
+        limit = p.get("limit", 1000)
+        filt_state = p.get("state")
+        filt_name = p.get("name")
+        out = []
+        for rec in reversed(self.task_events.values()):
+            if filt_name and rec.get("name") != filt_name:
+                continue
+            if filt_state and rec.get("state") != filt_state:
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
+
+    async def _h_publish_logs(self, conn, p):
+        await self._publish("logs", p)
+        return True
+
+    async def _h_report_metrics(self, conn, p):
+        # Ignore reports from nodes already declared dead (stale series
+        # would otherwise be re-merged into every scrape forever).
+        view = self.nodes.get(p["node_id"])
+        if view is not None and view.alive:
+            self.node_metrics[p["node_id"]] = p["snapshots"]
+        return True
+
+    async def _h_dump_metrics(self, conn, p):
+        snaps = [s for lst in self.node_metrics.values() for s in lst]
+        return snaps
 
     def _resolve_actor(self, p) -> Optional[ActorRecord]:
         if p.get("actor_id"):

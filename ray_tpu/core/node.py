@@ -16,6 +16,7 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -114,6 +115,11 @@ class NodeManager:
         self._tasks: list = []
         self._stopping = False
         self._resources_freed = False
+        # Observability: worker-pushed metric snapshots + worker log tails
+        # (reference: metrics_agent.py per-node aggregation; log_monitor.py)
+        self._worker_metric_snaps: dict[str, dict] = {}
+        self._log_offsets: dict[str, int] = {}
+        self.log_dir: str | None = None
         for n in [n for n in dir(self) if n.startswith("_h_")]:
             self.endpoint.register("node." + n[3:], getattr(self, n))
 
@@ -157,8 +163,17 @@ class NodeManager:
                 f"address reused after a head restart? Restart this node "
                 f"without an explicit session."
             )
+        # NB: not named "ray_tpu" — a directory with the package's name
+        # under /tmp becomes an importable namespace package that shadows
+        # the real one for any script executed from /tmp.
+        self.log_dir = os.path.join(
+            tempfile.gettempdir(), "raytpu-sessions", self.session_id, "logs"
+        )
+        os.makedirs(self.log_dir, exist_ok=True)
         self._tasks.append(self.endpoint.submit(self._heartbeat_loop()))
         self._tasks.append(self.endpoint.submit(self._worker_monitor_loop()))
+        self._tasks.append(self.endpoint.submit(self._metrics_report_loop()))
+        self._tasks.append(self.endpoint.submit(self._log_monitor_loop()))
         return addr
 
     def stop(self, kill_workers: bool = True) -> None:
@@ -196,7 +211,7 @@ class NodeManager:
         while not self._stopping:
             try:
                 freed, self._resources_freed = self._resources_freed, False
-                await self.endpoint.acall(
+                ok = await self.endpoint.acall(
                     self.gcs_addr,
                     "gcs.node_heartbeat",
                     {
@@ -206,6 +221,22 @@ class NodeManager:
                         "resources_freed": freed,
                     },
                 )
+                if ok is False:
+                    # The GCS does not know us: it restarted from durable
+                    # storage (reference: NotifyGCSRestart,
+                    # node_manager.proto:454) — re-register and resume.
+                    await self.endpoint.acall(
+                        self.gcs_addr,
+                        "gcs.register_node",
+                        {
+                            "node_id": self.node_id,
+                            "addr": self.endpoint.address,
+                            "resources": self.total,
+                            "labels": self.labels,
+                            "shm_root": self.shm_root,
+                            "hostname": socket.gethostname(),
+                        },
+                    )
             except Exception:
                 pass
             await self._refresh_cluster_view(force=True)
@@ -283,6 +314,7 @@ class NodeManager:
         w = self.workers.pop(worker_id, None)
         if w is None:
             return
+        self._worker_metric_snaps.pop(worker_id, None)
         if worker_id in self.idle_workers:
             self.idle_workers.remove(worker_id)
         # A death frees cap headroom: wake cap waiters so they re-check and
@@ -339,14 +371,31 @@ class NodeManager:
                 self.session_id,
             ],
             env=env,
-            stdout=subprocess.DEVNULL if os.environ.get(
-                "RAY_TPU_SILENCE_WORKERS"
-            ) else None,
-            stderr=None,
+            stdout=(out_f := self._worker_log_file(worker_id, "out")),
+            stderr=(err_f := self._worker_log_file(worker_id, "err")),
         )
+        # Popen dup'd the fds into the child; drop the parent's copies.
+        for f in (out_f, err_f):
+            if hasattr(f, "close"):
+                f.close()
         info = WorkerInfo(worker_id=worker_id, proc=proc)
         self.workers[worker_id] = info
         return info
+
+    def _worker_log_file(self, worker_id: str, stream: str):
+        """Per-worker log files tailed by the log monitor and published to
+        the driver (reference: worker log redirection + log_monitor.py).
+        Set RAY_TPU_WORKER_LOG_INHERIT=1 to keep logs on the node's tty."""
+        if os.environ.get("RAY_TPU_WORKER_LOG_INHERIT"):
+            return subprocess.DEVNULL if stream == "out" and os.environ.get(
+                "RAY_TPU_SILENCE_WORKERS"
+            ) else None
+        if self.log_dir is None:
+            return None
+        path = os.path.join(
+            self.log_dir, f"worker-{worker_id[:12]}.{stream}"
+        )
+        return open(path, "ab", buffering=0)
 
     def _worker_cap(self) -> int:
         cap = GLOBAL_CONFIG.max_worker_processes
@@ -902,6 +951,131 @@ class NodeManager:
             raise
         await self._store_call(self.store.seal, oid)
         return {"size": size}
+
+    # -- observability -------------------------------------------------------
+
+    def _own_metric_snapshot(self) -> dict:
+        """Node-level gauges, merged with user metrics at the GCS."""
+        tags = {"node_id": self.node_id[:12]}
+        meta = {
+            "raytpu_node_workers": {
+                "kind": "gauge",
+                "description": "worker processes on this node",
+                "boundaries": [],
+            },
+            "raytpu_node_object_store_bytes": {
+                "kind": "gauge",
+                "description": "bytes resident in the shm object store",
+                "boundaries": [],
+            },
+            "raytpu_node_cpu_available": {
+                "kind": "gauge",
+                "description": "unleased CPU resource",
+                "boundaries": [],
+            },
+        }
+        points = [
+            ["raytpu_node_workers", tags, float(len(self.workers))],
+            [
+                "raytpu_node_object_store_bytes",
+                tags,
+                float(self.store.used if self.store else 0),
+            ],
+            [
+                "raytpu_node_cpu_available",
+                tags,
+                float(self.available.get("CPU", 0.0)),
+            ],
+        ]
+        return {"meta": meta, "points": points}
+
+    async def _metrics_report_loop(self):
+        from ray_tpu.util.metrics import registry
+
+        while not self._stopping:
+            await asyncio.sleep(GLOBAL_CONFIG.metrics_report_interval_s)
+            snaps = [self._own_metric_snapshot(), registry().snapshot()]
+            snaps.extend(self._worker_metric_snaps.values())
+            try:
+                await self.endpoint.acall(
+                    self.gcs_addr,
+                    "gcs.report_metrics",
+                    {"node_id": self.node_id, "snapshots": snaps},
+                )
+            except Exception:
+                pass
+
+    async def _h_report_metrics(self, conn, p):
+        self._worker_metric_snaps[p["worker_id"]] = p["snapshot"]
+        return True
+
+    async def _log_monitor_loop(self):
+        """Tail worker log files; publish new lines to the GCS "logs"
+        channel (reference: python/ray/_private/log_monitor.py)."""
+        while not self._stopping:
+            await asyncio.sleep(GLOBAL_CONFIG.log_monitor_interval_s)
+            if self.log_dir is None:
+                continue
+            batches = []
+            try:
+                names = os.listdir(self.log_dir)
+            except OSError:
+                continue
+            for fname in names:
+                path = os.path.join(self.log_dir, fname)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                off = self._log_offsets.get(fname, 0)
+                if size <= off:
+                    continue
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        chunk = f.read(min(size - off, 1 << 20))
+                except OSError:
+                    continue
+                # Only ship complete lines; carry the tail to the next poll.
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    continue
+                self._log_offsets[fname] = off + cut + 1
+                lines = chunk[: cut].decode("utf-8", "replace").splitlines()
+                worker, _, stream = fname.rpartition(".")
+                batches.append(
+                    {"source": worker, "stream": stream, "lines": lines}
+                )
+            if not batches:
+                continue
+            try:
+                await self.endpoint.acall(
+                    self.gcs_addr,
+                    "gcs.publish_logs",
+                    {"node_id": self.node_id, "batches": batches},
+                )
+            except Exception:
+                pass
+
+    async def _h_list_objects(self, conn, p):
+        """Objects resident in this node's store (reference: list_objects
+        asks owners; here the shm store is node-scoped and authoritative
+        for sealed blobs)."""
+        if self.store is None:
+            return []
+        out = []
+        with self.store._lock:
+            for oid, (size, sealed, last, loc) in self.store.meta.items():
+                out.append(
+                    {
+                        "object_id": oid,
+                        "size": size,
+                        "sealed": bool(sealed),
+                        "location": loc,
+                        "node_id": self.node_id,
+                    }
+                )
+        return out
 
     async def _h_get_info(self, conn, p):
         return {

@@ -28,6 +28,22 @@ def main() -> None:
 
     import os
 
+    # Honor JAX_PLATFORMS in worker processes. TPU plugins (axon) override
+    # the env var at import time, so setting it is not enough — the config
+    # must be forced after import, BEFORE any user code initializes a
+    # backend. Without this, every worker on a test box grabs the one real
+    # tunneled chip and each eager op pays a network round-trip (observed:
+    # CPU-envs RL sampling 20x slower, serve replicas hanging). Guarded so
+    # production workers (no JAX_PLATFORMS) never pay the jax import.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
     from ray_tpu.core.config import GLOBAL_CONFIG
     from ray_tpu.core.core_worker import CoreWorker
 

@@ -175,16 +175,58 @@ class TrainController:
             except Exception as e:  # noqa: BLE001
                 return "failed", f"lost contact with workers: {e!r}"
             live = [i for i in range(len(group)) if not done[i]]
+            failure: Optional[str] = None
             for i, st in zip(live, statuses):
                 for rep in st["reports"]:
                     self._record_report(rep, len(group))
                 if st["state"] == "failed":
-                    return "failed", st["error"]
+                    failure = st["error"]
                 if st["state"] == "finished":
                     done[i] = True
+            if failure is not None:
+                # Drain the surviving ranks' buffered reports before the
+                # teardown: a checkpoint round only finalizes once EVERY
+                # rank's report arrived, and under load a surviving rank
+                # may not have reported the round rank 0 just persisted —
+                # without the drain, restore would fall back a full
+                # generation (or to scratch) and burn max_failures.
+                self._drain_reports(group, done)
+                return "failed", failure
             if all(done):
                 return "finished", None
             time.sleep(POLL_INTERVAL_S)
+
+    def _drain_reports(
+        self, group: WorkerGroup, done: list, timeout_s: float = 3.0
+    ) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            pending = [
+                i
+                for i, d in enumerate(done)
+                if not d and i < len(group)
+            ]
+            if not pending:
+                return
+            try:
+                statuses = ray_tpu.get(
+                    [group.workers[i].actor.status.remote() for i in pending],
+                    timeout=timeout_s,
+                )
+            except Exception:
+                return
+            progressed = False
+            for i, st in zip(pending, statuses):
+                for rep in st["reports"]:
+                    self._record_report(rep, len(group))
+                    progressed = True
+                if st["state"] in ("finished", "failed"):
+                    done[i] = True
+            if not progressed and all(
+                st["state"] != "running" for st in statuses
+            ):
+                return
+            time.sleep(0.1)
 
     def _record_report(self, rep: dict, world_size: int) -> None:
         if rep["world_rank"] == 0:

@@ -1,0 +1,235 @@
+"""User-facing metrics: Counter / Gauge / Histogram + process registry.
+
+Reference parity: python/ray/util/metrics.py (user API) and the C++ metric
+registry (src/ray/stats/metric.h:25) + per-node metrics agent
+(python/ray/_private/metrics_agent.py:628). Redesigned: one process-local
+``MetricsRegistry``; worker registries are pushed to their node manager over
+the existing RPC fabric, node managers attach the merged snapshot to their
+GCS heartbeat, and the GCS renders the cluster-wide scrape as Prometheus
+text (``ray_tpu.util.state.cluster_metrics_text``) — no sidecar agent
+process, no OpenCensus dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+_DEFAULT_HIST_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+]
+
+
+class MetricsRegistry:
+    """Thread-safe store of metric points for one process.
+
+    Keys: (name, frozenset(tag items)). Values per kind:
+      counter -> float (monotonic sum)
+      gauge   -> float (last value)
+      histogram -> {"count": n, "sum": s, "buckets": [c_le_b0, ...]}
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._meta: Dict[str, dict] = {}  # name -> {kind, description, bounds}
+        self._points: Dict[Tuple[str, frozenset], object] = {}
+
+    def describe(
+        self,
+        name: str,
+        kind: str,
+        description: str = "",
+        boundaries: Optional[list] = None,
+    ) -> None:
+        with self._lock:
+            meta = self._meta.get(name)
+            if meta is not None and meta["kind"] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {meta['kind']}"
+                )
+            self._meta[name] = {
+                "kind": kind,
+                "description": description,
+                "boundaries": list(boundaries or _DEFAULT_HIST_BOUNDARIES),
+            }
+
+    def record(self, name: str, value: float, tags: dict | None = None) -> None:
+        tags = tags or {}
+        key = (name, frozenset(tags.items()))
+        with self._lock:
+            meta = self._meta.get(name)
+            if meta is None:
+                raise ValueError(f"metric {name!r} not registered")
+            kind = meta["kind"]
+            if kind == "counter":
+                self._points[key] = float(self._points.get(key, 0.0)) + value
+            elif kind == "gauge":
+                self._points[key] = float(value)
+            else:  # histogram
+                pt = self._points.get(key)
+                if pt is None:
+                    pt = {
+                        "count": 0,
+                        "sum": 0.0,
+                        "buckets": [0] * len(meta["boundaries"]),
+                    }
+                    self._points[key] = pt
+                pt["count"] += 1
+                pt["sum"] += value
+                for i, b in enumerate(meta["boundaries"]):
+                    if value <= b:
+                        pt["buckets"][i] += 1
+
+    def snapshot(self) -> dict:
+        """Wire format: {"meta": {...}, "points": [[name, tags, value]]}."""
+        with self._lock:
+            return {
+                "meta": dict(self._meta),
+                "points": [
+                    [name, dict(tags), value]
+                    for (name, tags), value in self._points.items()
+                ],
+            }
+
+
+def merge_snapshots(snaps: list) -> dict:
+    """Merge per-process snapshots (sum counters/histograms, last gauge)."""
+    meta: dict = {}
+    points: dict = {}
+    for snap in snaps:
+        meta.update(snap.get("meta", {}))
+        for name, tags, value in snap.get("points", []):
+            key = (name, frozenset(tags.items()))
+            kind = meta.get(name, {}).get("kind", "gauge")
+            cur = points.get(key)
+            if cur is None:
+                points[key] = (
+                    dict(value) if isinstance(value, dict) else value
+                )
+            elif kind == "counter":
+                points[key] = cur + value
+            elif kind == "gauge":
+                points[key] = value
+            else:
+                cur["count"] += value["count"]
+                cur["sum"] += value["sum"]
+                cur["buckets"] = [
+                    a + b for a, b in zip(cur["buckets"], value["buckets"])
+                ]
+    return {
+        "meta": meta,
+        "points": [
+            [name, dict(tags), value]
+            for (name, tags), value in points.items()
+        ],
+    }
+
+
+def to_prometheus(snapshot: dict) -> str:
+    """Render a (merged) snapshot as Prometheus exposition text."""
+
+    def fmt_tags(tags: dict) -> str:
+        if not tags:
+            return ""
+        inner = ",".join(
+            f'{k}="{str(v).replace(chr(34), "")}"'
+            for k, v in sorted(tags.items())
+        )
+        return "{" + inner + "}"
+
+    meta = snapshot.get("meta", {})
+    lines = []
+    by_name: dict = {}
+    for name, tags, value in snapshot.get("points", []):
+        by_name.setdefault(name, []).append((tags, value))
+    for name in sorted(by_name):
+        m = meta.get(name, {"kind": "gauge", "description": ""})
+        kind = m["kind"]
+        prom_type = {"counter": "counter", "gauge": "gauge"}.get(
+            kind, "histogram"
+        )
+        if m.get("description"):
+            lines.append(f"# HELP {name} {m['description']}")
+        lines.append(f"# TYPE {name} {prom_type}")
+        for tags, value in by_name[name]:
+            if kind in ("counter", "gauge"):
+                lines.append(f"{name}{fmt_tags(tags)} {value}")
+            else:
+                # record() stores buckets cumulatively already (every
+                # boundary >= value is incremented) — emit as-is.
+                for b, c in zip(m["boundaries"], value["buckets"]):
+                    lines.append(
+                        f"{name}_bucket{fmt_tags({**tags, 'le': b})} {c}"
+                    )
+                lines.append(
+                    f"{name}_bucket{fmt_tags({**tags, 'le': '+Inf'})} "
+                    f"{value['count']}"
+                )
+                lines.append(f"{name}_sum{fmt_tags(tags)} {value['sum']}")
+                lines.append(f"{name}_count{fmt_tags(tags)} {value['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        tag_keys: tuple = (),
+        **kw,
+    ):
+        self._name = name
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+        _registry.describe(name, self.kind, description, **kw)
+
+    def set_default_tags(self, tags: dict) -> "_Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: dict | None) -> dict:
+        out = dict(self._default_tags)
+        if tags:
+            out.update(tags)
+        return out
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None) -> None:
+        _registry.record(self._name, value, self._tags(tags))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: dict | None = None) -> None:
+        _registry.record(self._name, value, self._tags(tags))
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Optional[list] = None,
+        tag_keys: tuple = (),
+    ):
+        super().__init__(
+            name, description, tag_keys, boundaries=boundaries
+        )
+
+    def observe(self, value: float, tags: dict | None = None) -> None:
+        _registry.record(self._name, value, self._tags(tags))

@@ -1,0 +1,23 @@
+from ray_tpu.util.state.api import (
+    cluster_metrics_text,
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_tasks,
+    timeline,
+)
+
+__all__ = [
+    "cluster_metrics_text",
+    "list_actors",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "list_workers",
+    "summarize_tasks",
+    "timeline",
+]

@@ -1,0 +1,165 @@
+"""State API: live cluster introspection + task timeline.
+
+Reference parity: python/ray/util/state/api.py (list_actors :793,
+list_tasks :1020, list_objects, list_nodes) and the `ray timeline`
+Chrome-trace dump (python/ray/_private/state.py:441). Redesigned: all
+queries are direct GCS/node RPCs over the existing fabric — no dashboard
+head process in the path.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+
+def _worker():
+    from ray_tpu.core import api as core_api
+
+    return core_api._require_worker()
+
+
+def list_nodes() -> list[dict]:
+    import ray_tpu
+
+    return ray_tpu.nodes()
+
+
+def list_actors(
+    *, state: Optional[str] = None, limit: int = 1000
+) -> list[dict]:
+    w = _worker()
+    out = w.gcs.call("list_actors", {})
+    if state:
+        out = [a for a in out if a.get("state") == state]
+    return out[:limit]
+
+
+def list_placement_groups(limit: int = 1000) -> list[dict]:
+    w = _worker()
+    return w.gcs.call("list_placement_groups", {})[:limit]
+
+
+def list_tasks(
+    *,
+    state: Optional[str] = None,
+    name: Optional[str] = None,
+    limit: int = 1000,
+) -> list[dict]:
+    w = _worker()
+    return w.gcs.call(
+        "list_task_events",
+        {"state": state, "name": name, "limit": limit},
+    )
+
+
+def summarize_tasks() -> dict:
+    """Counts by terminal/live state (reference: `ray summary tasks`)."""
+    counts: dict = {}
+    for rec in list_tasks(limit=100000):
+        counts[rec.get("state", "?")] = counts.get(rec.get("state", "?"), 0) + 1
+    return counts
+
+
+def list_workers(limit: int = 1000) -> list[dict]:
+    w = _worker()
+    out = []
+    for node in list_nodes():
+        if not node.get("Alive", True):
+            continue
+        try:
+            info = w.endpoint.call(
+                tuple(node["Address"]), "node.get_info", {}, timeout=10
+            )
+        except Exception:
+            continue
+        out.append(
+            {
+                "node_id": node["NodeID"],
+                "num_workers": info.get("num_workers"),
+                "addr": node.get("Address"),
+            }
+        )
+    return out[:limit]
+
+
+def list_objects(limit: int = 10000) -> list[dict]:
+    """Sealed shm objects cluster-wide (one RPC per node) plus this
+    process's owned in-memory objects."""
+    w = _worker()
+    out = []
+    for node in list_nodes():
+        if not node.get("Alive", True):
+            continue
+        try:
+            out.extend(
+                w.endpoint.call(
+                    tuple(node["Address"]), "node.list_objects", {}, timeout=10
+                )
+            )
+        except Exception:
+            continue
+        if len(out) >= limit:
+            break
+    return out[:limit]
+
+
+def cluster_metrics_text() -> str:
+    """Cluster-wide metrics in Prometheus exposition format (the scrape
+    the reference serves from per-node metrics agents). All registries —
+    including this driver's — arrive via the worker->node->GCS push path;
+    appending the local registry here would double-count it."""
+    from ray_tpu.util.metrics import merge_snapshots, to_prometheus
+
+    w = _worker()
+    snaps = list(w.gcs.call("dump_metrics", {}))
+    return to_prometheus(merge_snapshots(snaps))
+
+
+def timeline(filename: Optional[str] = None) -> "str | list":
+    """Chrome-trace (about:tracing / perfetto) dump of task events
+    (reference: `ray timeline`, state.py:441). Returns the filename, or
+    the event list when filename is None."""
+    events = []
+    for rec in list_tasks(limit=100000):
+        states = rec.get("states", {})
+        exec_start = rec.get("exec_start_ts")
+        exec_end = rec.get("exec_end_ts")
+        row_pid = rec.get("exec_node_id", rec.get("node_id", "owner"))
+        row_tid = rec.get("exec_worker_id", rec.get("worker_id", "?"))
+        if exec_start and exec_end:
+            events.append(
+                {
+                    "name": rec.get("name", rec["task_id"][:8]),
+                    "cat": rec.get("kind", "task"),
+                    "ph": "X",
+                    "ts": exec_start * 1e6,
+                    "dur": (exec_end - exec_start) * 1e6,
+                    "pid": str(row_pid)[:12],
+                    "tid": str(row_tid)[:12],
+                    "args": {"task_id": rec["task_id"], "state": rec.get("state")},
+                }
+            )
+        sub = states.get("PENDING_SCHEDULING") or states.get(
+            "SUBMITTED_TO_ACTOR"
+        )
+        run = states.get("RUNNING")
+        if sub and run and run > sub:
+            events.append(
+                {
+                    "name": f"sched:{rec.get('name', '')}",
+                    "cat": "scheduling",
+                    "ph": "X",
+                    "ts": sub * 1e6,
+                    "dur": (run - sub) * 1e6,
+                    "pid": "scheduling",
+                    "tid": str(rec.get("worker_id", "?"))[:12],
+                    "args": {"task_id": rec["task_id"]},
+                }
+            )
+    if filename is None:
+        return events
+    with open(filename, "w") as f:
+        json.dump(events, f)
+    return filename

@@ -1,0 +1,118 @@
+"""GCS fault tolerance: durable tables + restart + node re-registration.
+
+Reference parity: GCS FT via RedisStoreClient (redis_store_client.h:126) and
+raylet reconnect (NotifyGCSRestart, node_manager.proto:454), redesigned over
+an sqlite-WAL store (no external redis daemon).
+"""
+
+import pickle
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.gcs import GcsServer
+from ray_tpu.core.gcs_store import InMemoryStoreClient, SqliteStoreClient
+
+
+def test_sqlite_store_roundtrip(tmp_path):
+    s = SqliteStoreClient(str(tmp_path / "gcs.db"))
+    s.put("t", "a", b"1")
+    s.put("t", "b", b"2")
+    s.put("t", "a", b"3")  # overwrite
+    assert s.get("t", "a") == b"3"
+    assert dict(s.scan("t")) == {"a": b"3", "b": b"2"}
+    s.delete("t", "a")
+    assert s.get("t", "a") is None
+    s.close()
+    # durable across re-open
+    s2 = SqliteStoreClient(str(tmp_path / "gcs.db"))
+    assert s2.get("t", "b") == b"2"
+    s2.close()
+
+
+def test_in_memory_store_is_default():
+    g = GcsServer("sess-mem")
+    assert isinstance(g.store, InMemoryStoreClient)
+    g.store.close()
+
+
+def _wait(pred, timeout=20.0, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError("condition not met")
+
+
+def test_gcs_restart_preserves_state_and_cluster_recovers(tmp_path):
+    GLOBAL_CONFIG.gcs_storage_path = str(tmp_path / "gcs.db")
+    try:
+        runtime = ray_tpu.init(num_cpus=8)
+        worker = ray_tpu.get_runtime_context()  # ensure connected
+        assert worker is not None
+
+        from ray_tpu.core import api as core_api
+
+        w = core_api._require_worker()
+        w.gcs.kv_put("durable_key", b"durable_value", ns="test")
+
+        @ray_tpu.remote
+        class Keeper:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        keeper = Keeper.options(name="keeper", num_cpus=0).remote()
+        assert ray_tpu.get(keeper.bump.remote()) == 1
+
+        # -- kill the GCS, restart it from the same storage on the same port
+        old_addr = runtime.gcs_addr
+        session = runtime.session_id
+        runtime.gcs.stop()
+        time.sleep(0.5)
+        new_gcs = GcsServer(session)
+        # Adopted the persisted session id from storage.
+        assert new_gcs.session_id == session
+        addr = new_gcs.start(host=old_addr[0], port=old_addr[1])
+        assert addr == old_addr
+        runtime.gcs = new_gcs
+
+        # KV survived the restart.
+        assert _wait(
+            lambda: w.gcs.kv_get("durable_key", ns="test") == b"durable_value"
+        )
+        # Actor table survived: the name resolves and the handle reaches the
+        # SAME instance (state n==1 proves the worker was never restarted).
+        h = ray_tpu.get_actor("keeper")
+        assert ray_tpu.get(h.bump.remote()) == 2
+
+        # The node re-registered on its next heartbeat: new work schedules.
+        _wait(lambda: len(new_gcs.nodes) >= 1)
+
+        @ray_tpu.remote
+        def after_restart(x):
+            return x + 1
+
+        assert ray_tpu.get(after_restart.remote(41)) == 42
+    finally:
+        GLOBAL_CONFIG.gcs_storage_path = ""
+        ray_tpu.shutdown()
+
+
+def test_actor_record_pickles_without_waiters(tmp_path):
+    g = GcsServer("sess-p", storage_path=str(tmp_path / "g.db"))
+    from ray_tpu.core.gcs import ActorRecord
+
+    rec = ActorRecord(actor_id="a1", name="x", spec={"resources": {}})
+    rec.waiters.append(object())  # unpicklable live waiter
+    g._save_actor(rec)
+    stored = pickle.loads(g.store.get("actors", "a1"))
+    assert stored.waiters == [] and stored.name == "x"
+    g.store.close()

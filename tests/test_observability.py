@@ -1,0 +1,228 @@
+"""Observability tier: metrics, task events, state API, timeline, logs.
+
+Reference parity: python/ray/tests/test_metrics_agent.py,
+test_state_api.py, test_task_events.py patterns (compressed).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as m
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_metrics_registry_counter_gauge_histogram():
+    reg = m.MetricsRegistry()
+    reg.describe("c", "counter", "a counter")
+    reg.describe("g", "gauge")
+    reg.describe("h", "histogram", boundaries=[1.0, 10.0])
+    reg.record("c", 1.0, {"k": "v"})
+    reg.record("c", 2.0, {"k": "v"})
+    reg.record("g", 5.0)
+    reg.record("g", 7.0)
+    reg.record("h", 0.5)
+    reg.record("h", 100.0)
+    snap = reg.snapshot()
+    points = {(n, frozenset(t.items())): v for n, t, v in snap["points"]}
+    assert points[("c", frozenset({("k", "v")}))] == 3.0
+    assert points[("g", frozenset())] == 7.0
+    hist = points[("h", frozenset())]
+    assert hist["count"] == 2 and hist["buckets"] == [1, 1]
+
+
+def test_metrics_merge_and_prometheus():
+    r1, r2 = m.MetricsRegistry(), m.MetricsRegistry()
+    for r in (r1, r2):
+        r.describe("reqs", "counter", "requests")
+        r.record("reqs", 2.0, {"app": "x"})
+    merged = m.merge_snapshots([r1.snapshot(), r2.snapshot()])
+    text = m.to_prometheus(merged)
+    assert "# TYPE reqs counter" in text
+    assert 'reqs{app="x"} 4.0' in text
+
+
+def test_user_metrics_api():
+    c = m.Counter("test_api_counter", "d", tag_keys=("t",))
+    c.inc(3.0, {"t": "a"})
+    g = m.Gauge("test_api_gauge")
+    g.set(1.5)
+    h = m.Histogram("test_api_hist", boundaries=[1, 2])
+    h.observe(1.5)
+    snap = m.registry().snapshot()
+    names = {p[0] for p in snap["points"]}
+    assert {"test_api_counter", "test_api_gauge", "test_api_hist"} <= names
+
+
+def _wait_for(pred, timeout=15.0, interval=0.2):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError("condition not met")
+
+
+def test_task_events_and_state_api(cluster):
+    @ray_tpu.remote
+    def grind(x):
+        return x * 2
+
+    refs = [grind.remote(i) for i in range(4)]
+    assert ray_tpu.get(refs) == [0, 2, 4, 6]
+
+    def finished():
+        recs = state.list_tasks(name="grind")
+        done = [r for r in recs if r.get("state") == "FINISHED"]
+        return done if len(done) >= 4 else None
+
+    done = _wait_for(finished)
+    rec = done[0]
+    assert rec["states"].get("PENDING_SCHEDULING")
+    assert rec["states"].get("RUNNING")
+    assert rec["states"].get("FINISHED")
+    assert rec.get("exec_end_ts") >= rec.get("exec_start_ts")
+    assert rec.get("exec_pid")
+
+
+def test_task_events_record_failure(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("nope")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+
+    def failed():
+        recs = state.list_tasks(name="boom")
+        return [r for r in recs if r.get("state") == "FAILED"] or None
+
+    assert _wait_for(failed)
+
+
+def test_actor_task_events(cluster):
+    @ray_tpu.remote
+    class Worker:
+        def work(self):
+            return 42
+
+    a = Worker.remote()
+    assert ray_tpu.get(a.work.remote()) == 42
+
+    def seen():
+        recs = state.list_tasks(name="Worker.work")
+        return [
+            r
+            for r in recs
+            if r.get("kind") == "actor_task" and r.get("state") == "FINISHED"
+        ] or None
+
+    assert _wait_for(seen)
+    ray_tpu.kill(a)
+
+
+def test_timeline_chrome_trace(cluster, tmp_path):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(2)])
+    _wait_for(
+        lambda: [
+            r
+            for r in state.list_tasks(name="traced")
+            if r.get("state") == "FINISHED" and r.get("exec_start_ts")
+        ]
+        or None
+    )
+    path = str(tmp_path / "trace.json")
+    out = state.timeline(path)
+    assert out == path
+    import json
+
+    events = json.load(open(path))
+    spans = [e for e in events if e["name"] == "traced"]
+    assert spans and all(e["ph"] == "X" and e["dur"] > 0 for e in spans)
+
+
+def test_cluster_metrics_roundtrip(cluster):
+    c = m.Counter("test_cluster_counter", "cluster-wide")
+    c.inc(5.0)
+    # Driver-side registry merges in directly; node gauges arrive via
+    # heartbeat within metrics_report_interval_s.
+    text = _wait_for(
+        lambda: (
+            t := state.cluster_metrics_text()
+        )
+        and "test_cluster_counter" in t
+        and "raytpu_node_workers" in t
+        and t
+        or None,
+        timeout=20,
+    )
+    assert "raytpu_node_object_store_bytes" in text
+
+
+def test_worker_metrics_flow_to_cluster(cluster):
+    @ray_tpu.remote
+    def emit():
+        from ray_tpu.util import metrics as wm
+
+        wm.Counter("test_worker_counter", "from a worker").inc(7.0)
+        return True
+
+    assert ray_tpu.get(emit.remote())
+    text = _wait_for(
+        lambda: (
+            t := state.cluster_metrics_text()
+        )
+        and "test_worker_counter" in t
+        and t
+        or None,
+        timeout=25,
+    )
+    assert "test_worker_counter 7.0" in text
+
+
+def test_list_objects_sees_shm_blobs(cluster):
+    big = b"x" * (2 * 1024 * 1024)  # above inline threshold -> shm
+    ref = ray_tpu.put(big)
+    objs = _wait_for(
+        lambda: [o for o in state.list_objects() if o["size"] >= len(big)]
+        or None
+    )
+    assert all(o["sealed"] for o in objs)
+    del ref
+
+
+def test_worker_logs_reach_driver(cluster, capfd):
+    @ray_tpu.remote
+    def chatty():
+        print("hello-from-worker-stdout", flush=True)
+        return True
+
+    assert ray_tpu.get(chatty.remote())
+
+    def got():
+        err = capfd.readouterr().err
+        return "hello-from-worker-stdout" in err or None
+
+    # Lines flow worker file -> node tail -> GCS pubsub -> driver stderr.
+    deadline = time.time() + 15
+    seen = False
+    acc = ""
+    while time.time() < deadline and not seen:
+        time.sleep(0.3)
+        acc += capfd.readouterr().err
+        seen = "hello-from-worker-stdout" in acc
+    assert seen, f"worker log line never reached driver; got: {acc[-500:]}"
