@@ -1,0 +1,28 @@
+"""ray_tpu.dag — compiled graphs (static DAGs of actor method calls).
+
+Reference parity: python/ray/dag + python/ray/experimental/channel
+(SURVEY §2.4 compiled graphs / aDAG). Build with
+``actor.method.bind(...)`` + ``InputNode`` / ``MultiOutputNode``, run
+interpreted with ``.execute(x)``, or compile with
+``.experimental_compile()`` for the channel-based data path.
+"""
+
+from ray_tpu.dag.channel import ChannelTimeout, ShmChannel
+from ray_tpu.dag.compiled import CompiledDAG, DAGRef
+from ray_tpu.dag.nodes import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "ChannelTimeout",
+    "ClassMethodNode",
+    "CompiledDAG",
+    "DAGNode",
+    "DAGRef",
+    "InputNode",
+    "MultiOutputNode",
+    "ShmChannel",
+]

@@ -1,0 +1,136 @@
+"""Channels: fixed buffers that move values between compiled-DAG tasks
+without the task-submission path.
+
+Reference parity: python/ray/experimental/channel/shared_memory_channel.py
+(mutable plasma objects + experimental_mutable_object_manager in the core
+worker). Redesigned: an SPSC ring of one slot in a plain mmap file —
+seq/ack counters make writer backpressure and reader blocking a pair of
+spin-waits, no IPC at all on the data path. Cross-process visibility comes
+from /dev/shm; cross-node pairs use an RPC channel over the same endpoint
+fabric instead (the reference's NCCL channel role falls to XLA collectives
+inside SPMD programs, SURVEY §2.4 — host-side DAGs only move small control
+values between hosts).
+
+Layout: [seq u64 | ack u64 | len u64 | payload...]. Writer: wait ack==seq,
+write payload+len, seq+=1. Reader: wait seq>ack, read, ack=seq.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import time
+import uuid
+
+_HDR = struct.Struct("<QQQ")  # seq, ack, len
+_U64 = struct.Struct("<Q")
+_OFF_SEQ, _OFF_ACK, _OFF_LEN = 0, 8, 16
+_SPIN_S = 0.0002
+
+
+class ChannelTimeout(Exception):
+    pass
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+def _chan_root() -> str:
+    root = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+    path = os.path.join(root, "raytpu_chans")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+class ShmChannel:
+    """Single-producer single-consumer mutable shm buffer."""
+
+    def __init__(self, path: str, capacity: int, create: bool):
+        self.path = path
+        self.capacity = capacity
+        total = _HDR.size + capacity
+        if create:
+            with open(path, "wb") as f:
+                f.truncate(total)
+        self._f = open(path, "r+b")
+        self._mm = mmap.mmap(self._f.fileno(), total)
+        self._closed = False
+
+    @classmethod
+    def create(cls, capacity: int = 1 << 20) -> "ShmChannel":
+        path = os.path.join(_chan_root(), f"chan-{uuid.uuid4().hex[:16]}")
+        return cls(path, capacity, create=True)
+
+    @classmethod
+    def open(cls, spec: dict) -> "ShmChannel":
+        return cls(spec["path"], spec["capacity"], create=False)
+
+    def spec(self) -> dict:
+        return {"kind": "shm", "path": self.path, "capacity": self.capacity}
+
+    # -- protocol ------------------------------------------------------------
+    def _hdr(self) -> tuple:
+        return _HDR.unpack_from(self._mm, 0)
+
+    def write(self, value, timeout: float | None = None) -> None:
+        payload = pickle.dumps(value, protocol=5)
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"value of {len(payload)}B exceeds channel capacity "
+                f"{self.capacity}B — raise buffer_size at compile time"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._closed:
+                raise ChannelClosed(self.path)
+            seq, ack, _ = self._hdr()
+            if ack == seq:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeout(f"write {self.path}")
+            time.sleep(_SPIN_S)
+        # Field ownership: the writer touches ONLY seq/len, the reader ONLY
+        # ack — concurrent whole-header writes would race. Order matters:
+        # payload, then len, then seq (the reader's ready signal).
+        self._mm[_HDR.size : _HDR.size + len(payload)] = payload
+        _U64.pack_into(self._mm, _OFF_LEN, len(payload))
+        _U64.pack_into(self._mm, _OFF_SEQ, seq + 1)
+
+    def read(self, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._closed:
+                raise ChannelClosed(self.path)
+            seq, ack, ln = self._hdr()
+            if seq > ack:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeout(f"read {self.path}")
+            time.sleep(_SPIN_S)
+        value = pickle.loads(self._mm[_HDR.size : _HDR.size + ln])
+        _U64.pack_into(self._mm, _OFF_ACK, seq)  # reader owns ack only
+        return value
+
+    def close(self, unlink: bool = False) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._mm.close()
+            self._f.close()
+        except Exception:
+            pass
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def open_channel(spec: dict):
+    if spec["kind"] == "shm":
+        return ShmChannel.open(spec)
+    raise ValueError(f"unknown channel kind {spec['kind']!r}")

@@ -1,0 +1,113 @@
+"""Actor-side compiled-DAG executor loop.
+
+Reference parity: the ExecutableTask loop compiled_dag_node.py schedules
+onto each actor. One daemon thread per (actor, DAG): read operand channels
+(in task order), invoke the bound methods on the actor instance, write
+result channels. Errors travel the channels as ``_DagTaskError`` markers so
+the driver re-raises and downstream nodes skip execution for that index
+instead of deadlocking.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.dag.channel import ChannelTimeout, open_channel
+
+_POLL_S = 0.2
+
+
+class _DagTaskError:
+    """Marker shipped through channels when a node raises."""
+
+    def __init__(self, exc: Exception):
+        self.exc = exc
+
+
+class DagLoop:
+    def __init__(self, instance, tasks: list[dict]):
+        self.instance = instance
+        self.tasks = []
+        for t in tasks:
+            self.tasks.append(
+                {
+                    "method": t["method"],
+                    "args": [
+                        (k, open_channel(v) if k == "chan" else v)
+                        for k, v in t["args"]
+                    ],
+                    "kwargs": {
+                        name: (k, open_channel(v) if k == "chan" else v)
+                        for name, (k, v) in t["kwargs"].items()
+                    },
+                    "outputs": [open_channel(s) for s in t["outputs"]],
+                }
+            )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dag-loop"
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        for t in self.tasks:
+            for k, v in t["args"]:
+                if k == "chan":
+                    v.close()
+            for k, v in t["kwargs"].values():
+                if k == "chan":
+                    v.close()
+            for ch in t["outputs"]:
+                ch.close()
+
+    def _read(self, ch):
+        while not self._stop.is_set():
+            try:
+                return ch.read(timeout=_POLL_S)
+            except ChannelTimeout:
+                continue
+        raise _StopLoop
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                for t in self.tasks:
+                    operands = []
+                    err = None
+                    for k, v in t["args"]:
+                        val = self._read(v) if k == "chan" else v
+                        if isinstance(val, _DagTaskError):
+                            err = val
+                        operands.append(val)
+                    kw = {}
+                    for name, (k, v) in t["kwargs"].items():
+                        val = self._read(v) if k == "chan" else v
+                        if isinstance(val, _DagTaskError):
+                            err = val
+                        kw[name] = val
+                    if err is None:
+                        try:
+                            result = getattr(self.instance, t["method"])(
+                                *operands, **kw
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            result = _DagTaskError(e)
+                    else:
+                        result = err  # propagate upstream failure
+                    for ch in t["outputs"]:
+                        while not self._stop.is_set():
+                            try:
+                                ch.write(result, timeout=_POLL_S)
+                                break
+                            except ChannelTimeout:
+                                continue
+        except _StopLoop:
+            pass
+
+
+class _StopLoop(Exception):
+    pass

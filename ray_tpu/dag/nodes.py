@@ -1,0 +1,70 @@
+"""DAG node types: build a static graph of actor method calls.
+
+Reference parity: python/ray/dag/ (InputNode, ClassMethodNode,
+MultiOutputNode; `actor.method.bind(...)`). The graph is data only — no
+execution logic lives here; compiled.py turns it into channel-connected
+loops.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any
+
+_ids = itertools.count()
+
+
+class DAGNode:
+    def __init__(self, args: tuple = (), kwargs: dict | None = None):
+        self.node_id = next(_ids)
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+
+    def upstream(self) -> list["DAGNode"]:
+        ups = [a for a in self.args if isinstance(a, DAGNode)]
+        ups += [v for v in self.kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    def experimental_compile(self, **kw):
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, **kw)
+
+    def execute(self, *args, **kwargs):
+        """Uncompiled execution: plain actor calls, topological order
+        (reference: dag.execute without compile)."""
+        from ray_tpu.dag.compiled import interpret
+
+        return interpret(self, args, kwargs)
+
+
+class InputNode(DAGNode):
+    """The DAG's single input placeholder (context-manager optional)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor method call."""
+
+    def __init__(self, actor, method_name: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self.actor = actor
+        self.method_name = method_name
+
+    def __repr__(self):
+        return f"ClassMethodNode({self.method_name}, id={self.node_id})"
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle N leaf nodes into one output tuple."""
+
+    def __init__(self, outputs: list):
+        super().__init__(args=tuple(outputs))
