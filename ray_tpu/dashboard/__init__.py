@@ -1,0 +1,11 @@
+"""ray_tpu.dashboard — REST head for cluster introspection + job API.
+
+Reference parity: python/ray/dashboard/ (aiohttp head + module REST APIs;
+the React frontend is out of scope — every endpoint returns JSON, and
+/metrics returns the Prometheus scrape). Runs inside any process connected
+to the cluster (the `raytpu start --head` daemon starts one by default).
+"""
+
+from ray_tpu.dashboard.head import DashboardHead
+
+__all__ = ["DashboardHead"]

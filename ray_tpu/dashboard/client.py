@@ -1,0 +1,58 @@
+"""HTTP client for the dashboard job API (reference: JobSubmissionClient
+sdk.py:36 REST mode)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+
+class HttpJobClient:
+    def __init__(self, address: str):
+        self._base = address.rstrip("/")
+
+    def _req(self, method: str, path: str, body: dict | None = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self._base}{path}",
+            data=data,
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: str | None = None,
+        runtime_env: dict | None = None,
+        metadata: dict | None = None,
+    ) -> str:
+        out = self._req(
+            "POST",
+            "/api/jobs",
+            {
+                "entrypoint": entrypoint,
+                "submission_id": submission_id,
+                "runtime_env": runtime_env,
+                "metadata": metadata,
+            },
+        )
+        return out["job_id"]
+
+    def get_job_info(self, job_id: str) -> dict:
+        return self._req("GET", f"/api/jobs/{job_id}")
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id)["status"]
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._req("GET", f"/api/jobs/{job_id}/logs")["logs"]
+
+    def list_jobs(self) -> list[dict]:
+        return self._req("GET", "/api/jobs")
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._req("POST", f"/api/jobs/{job_id}/stop")["stopped"]

@@ -1,0 +1,197 @@
+"""Dashboard head: stdlib asyncio HTTP/1.1 JSON API.
+
+Endpoints (reference: dashboard modules state/job/metrics):
+  GET  /api/version
+  GET  /api/nodes | /api/actors | /api/tasks | /api/objects
+  GET  /api/placement_groups | /api/workers | /api/task_summary
+  GET  /api/cluster_resources | /api/available_resources
+  GET  /metrics                      (Prometheus text)
+  GET  /api/jobs                     POST /api/jobs {entrypoint, ...}
+  GET  /api/jobs/{id}  /api/jobs/{id}/logs   POST /api/jobs/{id}/stop
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import threading
+from urllib.parse import urlparse
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server = None
+        self._loop = None
+        self._thread = None
+        self._started = threading.Event()
+        self._job_manager = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dashboard"
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=15):
+            raise RuntimeError("dashboard failed to start")
+        return self._port
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._serve_conn, host=self._host, port=self._port
+            )
+            self._port = self._server.sockets[0].getsockname()[1]
+            self._started.set()
+
+        self._loop.run_until_complete(boot())
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    def stop(self) -> None:
+        if self._loop is None:
+            return
+
+        def _stop():
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        self._loop.call_soon_threadsafe(_stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    # -- HTTP plumbing -------------------------------------------------------
+    async def _serve_conn(self, reader, writer):
+        try:
+            while True:
+                req_line = await reader.readline()
+                if not req_line:
+                    break
+                method, target, _ = req_line.decode().split(" ", 2)
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0))
+                if n:
+                    body = await reader.readexactly(n)
+                status, ctype, payload = await self._route(
+                    method, target, body
+                )
+                writer.write(
+                    f"HTTP/1.1 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    f"Connection: keep-alive\r\n\r\n".encode() + payload
+                )
+                await writer.drain()
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            ValueError,
+        ):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, target: str, body: bytes):
+        path = urlparse(target).path.rstrip("/")
+        try:
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, self._handle, method, path, body
+            )
+        except KeyError as e:
+            return "404 Not Found", "application/json", json.dumps(
+                {"error": str(e)}
+            ).encode()
+        except Exception as e:  # noqa: BLE001
+            return "500 Internal Server Error", "application/json", (
+                json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
+            )
+        if data is None:
+            return "404 Not Found", "application/json", b'{"error": "no route"}'
+        if isinstance(data, str):
+            return "200 OK", "text/plain; version=0.0.4", data.encode()
+        return "200 OK", "application/json", json.dumps(data).encode()
+
+    # -- routes (executed off the HTTP loop: they make blocking RPCs) --------
+    def _jobs(self):
+        if self._job_manager is None:
+            from ray_tpu.job import JobManager
+
+            self._job_manager = JobManager()
+        return self._job_manager
+
+    def _handle(self, method: str, path: str, body: bytes):
+        import ray_tpu
+        from ray_tpu.util import state
+
+        if path == "/api/version":
+            from ray_tpu._version import __version__
+
+            return {"version": __version__}
+        if path == "/metrics":
+            return state.cluster_metrics_text()
+        if method == "GET":
+            simple = {
+                "/api/nodes": state.list_nodes,
+                "/api/actors": state.list_actors,
+                "/api/tasks": state.list_tasks,
+                "/api/objects": state.list_objects,
+                "/api/placement_groups": state.list_placement_groups,
+                "/api/workers": state.list_workers,
+                "/api/task_summary": state.summarize_tasks,
+                "/api/cluster_resources": ray_tpu.cluster_resources,
+                "/api/available_resources": ray_tpu.available_resources,
+            }
+            if path in simple:
+                return _jsonable(simple[path]())
+        if path == "/api/jobs":
+            if method == "POST":
+                req = json.loads(body or b"{}")
+                job_id = self._jobs().submit_job(
+                    entrypoint=req["entrypoint"],
+                    submission_id=req.get("submission_id"),
+                    runtime_env=req.get("runtime_env"),
+                    metadata=req.get("metadata"),
+                )
+                return {"job_id": job_id, "submission_id": job_id}
+            return [_jsonable(j) for j in self._jobs().list_jobs()]
+        if path.startswith("/api/jobs/"):
+            rest = path[len("/api/jobs/") :]
+            if rest.endswith("/logs"):
+                return {"logs": self._jobs().get_job_logs(rest[: -len("/logs")])}
+            if rest.endswith("/stop") and method == "POST":
+                return {"stopped": self._jobs().stop_job(rest[: -len("/stop")])}
+            return _jsonable(self._jobs().get_job_info(rest))
+        return None
+
+
+def _jsonable(obj):
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(o) for o in obj]
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    return obj

@@ -1,0 +1,18 @@
+"""ray_tpu.job — job submission: run driver scripts ON the cluster.
+
+Reference parity: python/ray/dashboard/modules/job/ (JobManager
+job_manager.py:62, per-job JobSupervisor actor job_supervisor.py:57, REST +
+JobSubmissionClient sdk.py:36). Redesigned: the supervisor actor spawns the
+entrypoint as a subprocess wired to the cluster address, streams its output
+into the GCS KV, and drives the PENDING→RUNNING→SUCCEEDED/FAILED/STOPPED
+state machine; the REST surface lives on the dashboard head.
+"""
+
+from ray_tpu.job.manager import (
+    JobInfo,
+    JobManager,
+    JobStatus,
+    JobSubmissionClient,
+)
+
+__all__ = ["JobInfo", "JobManager", "JobStatus", "JobSubmissionClient"]

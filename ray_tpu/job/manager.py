@@ -1,0 +1,298 @@
+"""Job manager + supervisor actor + submission client.
+
+Reference call stack being mirrored (SURVEY §2.3 job submission):
+JobSubmissionClient.submit_job -> REST -> JobManager.submit_job -> spawn
+JobSupervisor actor -> subprocess entrypoint -> status/logs polled back.
+Here the client talks straight to the GCS KV + supervisor actors over the
+RPC fabric; the dashboard adds the HTTP façade on top.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import time
+import uuid
+from typing import Optional
+
+_KV_NS = "jobs"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclasses.dataclass
+class JobInfo:
+    job_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    metadata: dict = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @staticmethod
+    def from_json(data: bytes) -> "JobInfo":
+        return JobInfo(**json.loads(data))
+
+
+class JobSupervisor:
+    """Actor owning one job's entrypoint subprocess (reference:
+    job_supervisor.py:57). Runs with num_cpus=0 so jobs never compete with
+    their own workload for scheduling resources."""
+
+    def __init__(self, job_id: str, entrypoint: str, env: dict, metadata: dict):
+        self.job_id = job_id
+        self.entrypoint = entrypoint
+        self.env = env
+        self.metadata = metadata
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_chunks: list[str] = []
+        self._status = JobStatus.PENDING
+        self._message = ""
+        self._start = 0.0
+        self._end = 0.0
+
+    def start(self, gcs_addr: tuple, node_addr: tuple) -> bool:
+        env = dict(os.environ)
+        env.update(self.env)
+        # The job's driver joins THIS cluster (reference: RAY_ADDRESS
+        # injection into the job's environment).
+        env["RAY_TPU_ADDRESS"] = f"{gcs_addr[0]}:{gcs_addr[1]}"
+        # Make the framework importable from entrypoints run anywhere
+        # (`python script.py` puts the script's dir, not our checkout, on
+        # sys.path; the reference relies on site-packages installation).
+        import ray_tpu as _pkg
+
+        pkg_parent = os.path.dirname(os.path.dirname(_pkg.__file__))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_parent not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_parent + (os.pathsep + existing if existing else "")
+            )
+        self._start = time.time()
+        try:
+            self.proc = subprocess.Popen(
+                self.entrypoint,
+                shell=True,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        except OSError as e:
+            self._status = JobStatus.FAILED
+            self._message = f"failed to spawn entrypoint: {e}"
+            self._end = time.time()
+            return False
+        self._status = JobStatus.RUNNING
+        import threading
+
+        threading.Thread(target=self._reap, daemon=True).start()
+        return True
+
+    def _reap(self) -> None:
+        assert self.proc is not None
+        for line in self.proc.stdout:  # type: ignore[union-attr]
+            self._log_chunks.append(line)
+            if len(self._log_chunks) > 10000:
+                del self._log_chunks[:5000]
+        rc = self.proc.wait()
+        self._end = time.time()
+        if self._status == JobStatus.STOPPED:
+            return
+        self._status = JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+        self._message = f"exit code {rc}"
+
+    def status(self) -> dict:
+        return {
+            "status": self._status,
+            "message": self._message,
+            "start_time": self._start,
+            "end_time": self._end,
+        }
+
+    def logs(self) -> str:
+        return "".join(self._log_chunks)
+
+    def stop(self) -> bool:
+        if self.proc is not None and self.proc.poll() is None:
+            self._status = JobStatus.STOPPED
+            self._message = "stopped by user"
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+            self._end = time.time()
+            return True
+        return False
+
+    def ping(self) -> bool:
+        return True
+
+
+def _supervisor_name(job_id: str) -> str:
+    return f"_job_supervisor_{job_id}"
+
+
+class JobManager:
+    """Driver/dashboard-side job orchestration (reference:
+    job_manager.py:62)."""
+
+    def __init__(self):
+        import ray_tpu
+        from ray_tpu.core import api as core_api
+
+        self._ray = ray_tpu
+        self._worker = core_api._require_worker()
+
+    # -- submission ----------------------------------------------------------
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: str | None = None,
+        runtime_env: dict | None = None,
+        metadata: dict | None = None,
+    ) -> str:
+        job_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        if self._worker.gcs.kv_get(job_id, ns=_KV_NS) is not None:
+            raise ValueError(f"job {job_id!r} already exists")
+        env = dict((runtime_env or {}).get("env_vars", {}))
+        info = JobInfo(
+            job_id=job_id,
+            entrypoint=entrypoint,
+            metadata=dict(metadata or {}),
+            start_time=time.time(),
+        )
+        self._worker.gcs.kv_put(job_id, info.to_json(), ns=_KV_NS)
+        sup = (
+            self._ray.remote(JobSupervisor)
+            .options(name=_supervisor_name(job_id), num_cpus=0)
+            .remote(job_id, entrypoint, env, info.metadata)
+        )
+        ok = self._ray.get(
+            sup.start.remote(
+                self._worker.gcs_addr, self._worker.node_addr
+            )
+        )
+        info.status = JobStatus.RUNNING if ok else JobStatus.FAILED
+        self._worker.gcs.kv_put(job_id, info.to_json(), ns=_KV_NS)
+        return job_id
+
+    # -- queries -------------------------------------------------------------
+    def _refresh(self, info: JobInfo) -> JobInfo:
+        if info.status in JobStatus.TERMINAL:
+            return info
+        try:
+            sup = self._ray.get_actor(_supervisor_name(info.job_id))
+            st = self._ray.get(sup.status.remote())
+        except Exception:
+            info.status = JobStatus.FAILED
+            info.message = "supervisor actor died"
+            self._worker.gcs.kv_put(info.job_id, info.to_json(), ns=_KV_NS)
+            return info
+        info.status = st["status"]
+        info.message = st["message"]
+        info.end_time = st["end_time"]
+        self._worker.gcs.kv_put(info.job_id, info.to_json(), ns=_KV_NS)
+        return info
+
+    def get_job_info(self, job_id: str) -> JobInfo:
+        raw = self._worker.gcs.kv_get(job_id, ns=_KV_NS)
+        if raw is None:
+            raise KeyError(f"no such job {job_id!r}")
+        return self._refresh(JobInfo.from_json(raw))
+
+    def get_job_status(self, job_id: str) -> str:
+        return self.get_job_info(job_id).status
+
+    def get_job_logs(self, job_id: str) -> str:
+        self.get_job_info(job_id)  # existence check
+        try:
+            sup = self._ray.get_actor(_supervisor_name(job_id))
+            return self._ray.get(sup.logs.remote())
+        except Exception:
+            return ""
+
+    def list_jobs(self) -> list[JobInfo]:
+        keys = self._worker.gcs.kv_keys(ns=_KV_NS)
+        out = []
+        for k in keys:
+            try:
+                out.append(self.get_job_info(k))
+            except KeyError:
+                continue
+        return out
+
+    def stop_job(self, job_id: str) -> bool:
+        info = self.get_job_info(job_id)
+        if info.status in JobStatus.TERMINAL:
+            return False
+        sup = self._ray.get_actor(_supervisor_name(job_id))
+        ok = self._ray.get(sup.stop.remote())
+        self._refresh(info)
+        return ok
+
+    def wait(
+        self, job_id: str, timeout: float = 300.0, interval: float = 0.5
+    ) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            status = self.get_job_status(job_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(interval)
+        raise TimeoutError(f"job {job_id} still {status} after {timeout}s")
+
+
+class JobSubmissionClient:
+    """SDK entrypoint (reference: sdk.py:36). ``address`` may be an
+    http://host:port dashboard URL or a host:port GCS address; with no
+    address, uses the already-initialized local cluster."""
+
+    def __init__(self, address: str | None = None):
+        if address and address.startswith("http"):
+            from ray_tpu.dashboard.client import HttpJobClient
+
+            self._impl = HttpJobClient(address)
+        else:
+            import ray_tpu
+
+            if address:
+                ray_tpu.init(address=address)
+            self._impl = JobManager()
+
+    def submit_job(self, **kw) -> str:
+        return self._impl.submit_job(**kw)
+
+    def get_job_status(self, job_id: str) -> str:
+        return self._impl.get_job_status(job_id)
+
+    def get_job_info(self, job_id: str):
+        return self._impl.get_job_info(job_id)
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._impl.get_job_logs(job_id)
+
+    def list_jobs(self):
+        return self._impl.list_jobs()
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._impl.stop_job(job_id)
+
+    def tail_job_logs(self, job_id: str) -> str:
+        return self._impl.get_job_logs(job_id)

@@ -1,0 +1,26 @@
+"""ray_tpu.llm — LLM serving and batch inference tier.
+
+Reference parity: python/ray/llm/ (serve.llm + data.llm facades over vLLM,
+_internal/serve/engines/vllm/). Redesigned TPU-native: the engine is
+framework-owned JAX (KV-cache prefill/decode with slot-based continuous
+batching, compiled twice, sharded over a tp mesh axis by the standard rule
+table) instead of an external inference engine; serving rides the Serve
+tier's controller/router/proxy; batch inference plugs into Data's
+map_batches.
+"""
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import LLMEngine
+from ray_tpu.llm.tokenizer import ByteTokenizer
+from ray_tpu.llm.serve_llm import LLMServer, build_openai_app
+from ray_tpu.llm.batch import build_llm_processor
+
+__all__ = [
+    "ByteTokenizer",
+    "LLMConfig",
+    "LLMEngine",
+    "LLMServer",
+    "SamplingParams",
+    "build_llm_processor",
+    "build_openai_app",
+]

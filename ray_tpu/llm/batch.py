@@ -1,0 +1,55 @@
+"""data.llm: batch inference as a Data map stage.
+
+Reference parity: python/ray/llm/_internal/batch/processor/ (vLLM engine
+processor for ray.data). Redesigned: ``build_llm_processor`` returns a
+callable for ``Dataset.map_batches`` whose per-task engine is built once per
+worker process and cached (the reference uses actor pools; here worker
+reuse across leases gives the same amortization).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+
+_ENGINE_CACHE: dict = {}
+
+
+def _engine_for(config: LLMConfig):
+    key = (
+        config.model_id,
+        config.max_slots,
+        config.max_seq,
+        config.seed,
+        config.weights_path,
+        config.tensor_parallelism,
+        repr(config.model_config),  # frozen dataclass -> stable repr
+    )
+    eng = _ENGINE_CACHE.get(key)
+    if eng is None:
+        from ray_tpu.llm.engine import LLMEngine
+
+        eng = LLMEngine(config)
+        _ENGINE_CACHE[key] = eng
+    return eng
+
+
+def build_llm_processor(
+    config: LLMConfig,
+    *,
+    input_column: str = "prompt",
+    output_column: str = "generated_text",
+    sampling: Optional[SamplingParams] = None,
+):
+    """Returns fn(batch: dict) -> dict for Dataset.map_batches."""
+
+    def process(batch: dict) -> dict:
+        prompts = [str(p) for p in batch[input_column]]
+        if not prompts:
+            return {**batch, output_column: []}
+        engine = _engine_for(config)
+        results = engine.generate(prompts, sampling)
+        return {**batch, output_column: [r["text"] for r in results]}
+
+    return process

@@ -1,0 +1,49 @@
+"""LLM tier configuration.
+
+Reference parity: LLMConfig with TP/placement-group config
+(python/ray/llm/_internal/serve/engines/vllm/vllm_models.py:89), minus the
+vLLM passthrough fields — parallelism here is a mesh axis, not an engine
+flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 32
+    temperature: float = 0.0  # 0 -> greedy
+    stop_token: Optional[int] = None
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    model_id: str = "gpt2-125m"
+    # None -> GPT2Config.gpt2_125m(); tests pass a tiny config.
+    model_config: Any = None
+    # Serving shape
+    max_slots: int = 8  # concurrent sequences (continuous-batching slots)
+    max_seq: int = 256  # cache length (prompt + generation)
+    prefill_buckets: tuple = (32, 64, 128, 256)  # prompt pad buckets
+    # Parallelism: tensor-parallel degree (mesh `tp` axis over local devices)
+    tensor_parallelism: int = 1
+    # Placement: resources each replica actor demands
+    placement: dict = dataclasses.field(
+        default_factory=lambda: {"num_cpus": 1}
+    )
+    # Initial weights: a path to a pickled params pytree, or None for
+    # random init (tests; real deployments restore a checkpoint).
+    weights_path: Optional[str] = None
+    seed: int = 0
+
+    def build_model_config(self):
+        from ray_tpu.models.gpt2 import GPT2Config
+
+        if self.model_config is not None:
+            return self.model_config
+        cfg = GPT2Config.gpt2_125m()
+        return dataclasses.replace(cfg, max_seq=max(cfg.max_seq, self.max_seq))

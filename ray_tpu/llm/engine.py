@@ -1,0 +1,281 @@
+"""LLMEngine: slot-based continuous batching over the JAX decode path.
+
+Reference parity: the role vLLM's engine plays under ray.llm
+(python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py). Redesigned:
+
+- **Two compiled programs total.** ``prefill`` (one per prompt-length
+  bucket) and ``decode_step`` (one). Static shapes everywhere: the decode
+  batch is always [max_slots] — idle slots decode garbage that is never
+  read. On TPU this trades a few wasted FLOPs for zero recompiles, the
+  profitable side of that trade at every batch size.
+- **Continuous batching**: a request occupies a cache slot from admission
+  until EOS/max_tokens; new requests prefill into freed slots between
+  decode steps, so long generations never block short ones behind a
+  static batch barrier.
+- **Tensor parallelism** = the standard rule table over a ``tp`` mesh axis;
+  XLA shards the einsums and inserts ICI collectives — no per-layer manual
+  split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import pickle
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.tokenizer import ByteTokenizer
+from ray_tpu.models import gpt2
+from ray_tpu.models.gpt2_decode import decode_step, init_kv_cache, prefill
+
+
+@dataclasses.dataclass
+class _Request:
+    request_id: str
+    prompt: list
+    max_tokens: int
+    temperature: float
+    stop_token: Optional[int]
+    generated: list = dataclasses.field(default_factory=list)
+    slot: int = -1
+    finished: bool = False
+
+
+class LLMEngine:
+    def __init__(self, config: LLMConfig, tokenizer=None):
+        # Honor JAX_PLATFORMS even where a TPU plugin overrides it at import
+        # (the axon plugin does): replica actors spawned with
+        # JAX_PLATFORMS=cpu must NOT contend for the chip the test/driver
+        # owns. No-op once the backend is already initialized.
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception:
+                pass
+        self.config = config
+        self.tokenizer = tokenizer or ByteTokenizer()
+        cfg = config.build_model_config()
+        if cfg.vocab_size < self.tokenizer.vocab_size:
+            raise ValueError("model vocab smaller than tokenizer vocab")
+        self.model_config = cfg
+        devices = jax.devices()
+        tp = config.tensor_parallelism
+        if tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.parallel import (
+                DEFAULT_RULES,
+                MeshSpec,
+                make_mesh,
+                shardings_from_logical,
+            )
+
+            self.mesh = make_mesh(MeshSpec(tp=tp), devices[:tp])
+            shardings = shardings_from_logical(
+                gpt2.param_logical_specs(cfg), DEFAULT_RULES, self.mesh
+            )
+            self._replicated = NamedSharding(self.mesh, P())
+        else:
+            self.mesh = None
+            shardings = None
+
+        if config.weights_path:
+            with open(config.weights_path, "rb") as f:
+                params = jax.tree.map(jnp.asarray, pickle.load(f))
+        else:
+            params = gpt2.init_params(jax.random.key(config.seed), cfg)
+        if shardings is not None:
+            params = jax.device_put(params, shardings)
+        self.params = params
+
+        B, S = config.max_slots, config.max_seq
+        self.cache = init_kv_cache(cfg, B, S)
+        # cfg binds as a jit-static closure constant; one compile per
+        # prefill bucket + one for decode.
+        self._prefill = jax.jit(functools.partial(self._prefill_impl, cfg=cfg))
+        self._decode = jax.jit(functools.partial(self._decode_impl, cfg=cfg))
+        # Host-side slot state (numpy: mutated per step)
+        self.positions = np.zeros(B, np.int32)  # next write position
+        self.last_tokens = np.zeros(B, np.int32)
+        self.slot_free = [True] * B
+        self.requests: dict[str, _Request] = {}
+        self._slot_req: list = [None] * B
+        self._rng = np.random.default_rng(config.seed)
+        self._steps = 0
+
+    # -- jitted bodies (slot-batched cache update) ---------------------------
+    @staticmethod
+    def _prefill_impl(params, tokens, length, cache, slot, cfg):
+        """Prefill ONE slot: tokens [1, T]; merge that slot's cache rows."""
+        sub = {
+            "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
+            "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
+        }
+        sub, logits = prefill(params, tokens, length[None], sub, cfg)
+        cache = {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], sub["k"], slot, axis=1
+            ),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], sub["v"], slot, axis=1
+            ),
+        }
+        return cache, logits[0]
+
+    @staticmethod
+    def _decode_impl(params, last_tokens, positions, cache, cfg):
+        return decode_step(params, last_tokens, positions, cache, cfg)
+
+    # -- admission -----------------------------------------------------------
+    def add_request(
+        self,
+        request_id: str,
+        prompt: "str | list",
+        sampling: SamplingParams | None = None,
+    ) -> None:
+        sampling = sampling or SamplingParams()
+        ids = (
+            self.tokenizer.encode(prompt)
+            if isinstance(prompt, str)
+            else list(prompt)
+        )
+        max_prompt = max(self.config.prefill_buckets)
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]
+        stop = (
+            sampling.stop_token
+            if sampling.stop_token is not None
+            else self.tokenizer.eos_id
+        )
+        self.requests[request_id] = _Request(
+            request_id=request_id,
+            prompt=ids,
+            max_tokens=sampling.max_tokens,
+            temperature=sampling.temperature,
+            stop_token=stop,
+        )
+
+    def _admit_waiting(self) -> None:
+        waiting = [
+            r for r in self.requests.values() if r.slot < 0 and not r.finished
+        ]
+        for req in waiting:
+            try:
+                slot = self.slot_free.index(True)
+            except ValueError:
+                return
+            T = len(req.prompt)
+            bucket = next(
+                (b for b in self.config.prefill_buckets if b >= T),
+                self.config.prefill_buckets[-1],
+            )
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :T] = req.prompt
+            self.cache, logits = self._prefill(
+                self.params,
+                jnp.asarray(toks),
+                jnp.asarray(T, jnp.int32),
+                self.cache,
+                slot,
+            )
+            tok = self._sample(np.asarray(logits), req)
+            req.slot = slot
+            req.generated.append(tok)
+            self.slot_free[slot] = False
+            self._slot_req[slot] = req
+            self.positions[slot] = T
+            self.last_tokens[slot] = tok
+            self._maybe_finish(req)
+
+    def _sample(self, logits: np.ndarray, req: _Request) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = logits / req.temperature
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _maybe_finish(self, req: _Request) -> None:
+        done = (
+            len(req.generated) >= req.max_tokens
+            or req.generated[-1] == req.stop_token
+            or (req.slot >= 0 and self.positions[req.slot] + 1 >= self.config.max_seq)
+        )
+        if done:
+            req.finished = True
+            if req.slot >= 0:
+                self.slot_free[req.slot] = True
+                self._slot_req[req.slot] = None
+                req.slot = -1
+
+    # -- the engine loop ------------------------------------------------------
+    def step(self) -> list:
+        """Admit + one decode step for all active slots. Returns the
+        requests that finished this step."""
+        self._admit_waiting()
+        active = [r for r in self._slot_req if r is not None]
+        finished = []
+        if active:
+            self.cache, logits = self._decode(
+                self.params,
+                jnp.asarray(self.last_tokens),
+                jnp.asarray(self.positions),
+                self.cache,
+            )
+            logits_np = np.asarray(logits)
+            for req in active:
+                slot = req.slot
+                self.positions[slot] += 1
+                tok = self._sample(logits_np[slot], req)
+                req.generated.append(tok)
+                self.last_tokens[slot] = tok
+                self._maybe_finish(req)
+                if req.finished:
+                    finished.append(req)
+        self._steps += 1
+        return finished
+
+    def has_unfinished(self) -> bool:
+        return any(not r.finished for r in self.requests.values())
+
+    def pop_finished(self) -> list:
+        done = [r for r in self.requests.values() if r.finished]
+        for r in done:
+            del self.requests[r.request_id]
+        return done
+
+    # -- convenience -----------------------------------------------------------
+    def generate(
+        self, prompts: list, sampling: SamplingParams | None = None
+    ) -> list[dict]:
+        """Blocking batch generation; returns [{text, token_ids}] in order."""
+        base = self._steps
+        ids = [f"gen-{base}-{i}" for i in range(len(prompts))]
+        for rid, p in zip(ids, prompts):
+            self.add_request(rid, p, sampling)
+        while self.has_unfinished():
+            self.step()
+        done = {r.request_id: r for r in self.pop_finished()}
+        out = []
+        for rid in ids:
+            req = done[rid]
+            toks = [
+                t for t in req.generated if t != req.stop_token
+            ]
+            out.append(
+                {
+                    "request_id": rid,
+                    "token_ids": list(req.generated),
+                    "text": self.tokenizer.decode(toks),
+                    "num_generated": len(req.generated),
+                }
+            )
+        return out

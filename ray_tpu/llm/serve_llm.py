@@ -1,0 +1,154 @@
+"""serve.llm: OpenAI-compatible serving on the Serve tier.
+
+Reference parity: python/ray/llm/_internal/serve/ (LLMServer deployment +
+OpenAI-compatible router). The replica owns one LLMEngine pinned to its
+actor's devices; an asyncio pump loop runs the engine's continuous-batching
+steps while requests await their finish events, so concurrent HTTP requests
+batch onto the same decode step.
+
+Endpoints (via the Serve HTTP proxy, path-routed to this deployment):
+  POST /{name}/v1/completions       {"prompt": ..., "max_tokens": ...}
+  POST /{name}/v1/chat/completions  {"messages": [{role, content}...]}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+import time
+
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.llm.engine import LLMEngine
+from ray_tpu.serve import api as serve_api
+
+
+class LLMServer:
+    """The deployment callable (one engine per replica)."""
+
+    def __init__(self, config: LLMConfig):
+        self.config = config
+        self.engine = LLMEngine(config)
+        self._counter = itertools.count()
+        self._finished: dict[str, object] = {}  # request_id -> _Request
+        self._events: dict[str, asyncio.Event] = {}
+        # Thread-safety: the engine is touched ONLY by the pump's executor
+        # thread. The event loop enqueues admissions here; the pump drains
+        # them into the engine at step boundaries (a direct add_request from
+        # the loop would mutate engine.requests while step() iterates it).
+        self._pending: list[tuple] = []
+        self._pending_lock = threading.Lock()
+        self._pump_task = None
+
+    def _ensure_pump(self) -> None:
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.ensure_future(self._pump())
+
+    def _step_with_admissions(self) -> list:
+        with self._pending_lock:
+            batch, self._pending = self._pending, []
+        for rid, prompt, sampling in batch:
+            self.engine.add_request(rid, prompt, sampling)
+        finished = self.engine.step()
+        for req in finished:
+            self.engine.requests.pop(req.request_id, None)
+        more = self.engine.has_unfinished()
+        return finished, more
+
+    async def _pump(self) -> None:
+        """Engine loop: steps while work exists, yields to the event loop
+        between steps so new requests can join the batch."""
+        loop = asyncio.get_running_loop()
+        while True:
+            finished, more = await loop.run_in_executor(
+                None, self._step_with_admissions
+            )
+            for req in finished:
+                self._finished[req.request_id] = req
+                ev = self._events.pop(req.request_id, None)
+                if ev is not None:
+                    ev.set()
+            with self._pending_lock:
+                if not more and not self._pending:
+                    return
+
+    async def _generate(self, prompt, sampling: SamplingParams) -> dict:
+        rid = f"req-{next(self._counter)}"
+        ev = asyncio.Event()
+        self._events[rid] = ev
+        with self._pending_lock:
+            self._pending.append((rid, prompt, sampling))
+        self._ensure_pump()
+        await ev.wait()
+        req = self._finished.pop(rid)
+        toks = [t for t in req.generated if t != req.stop_token]
+        return {
+            "text": self.engine.tokenizer.decode(toks),
+            "token_ids": list(req.generated),
+            "num_generated": len(req.generated),
+        }
+
+    @staticmethod
+    def _sampling(body: dict) -> SamplingParams:
+        return SamplingParams(
+            max_tokens=int(body.get("max_tokens", 16)),
+            temperature=float(body.get("temperature", 0.0)),
+        )
+
+    async def __call__(self, request: dict) -> dict:
+        path = request.get("path", "")
+        body = request.get("body") or {}
+        if not isinstance(body, dict):
+            return {"error": "JSON body required"}
+        created = int(time.time())
+        if path.endswith("/v1/chat/completions"):
+            msgs = body.get("messages", [])
+            prompt = "\n".join(
+                f"{m.get('role', 'user')}: {m.get('content', '')}"
+                for m in msgs
+            )
+            out = await self._generate(prompt, self._sampling(body))
+            return {
+                "id": "chatcmpl-raytpu",
+                "object": "chat.completion",
+                "created": created,
+                "model": self.config.model_id,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {
+                            "role": "assistant",
+                            "content": out["text"],
+                        },
+                        "finish_reason": "stop",
+                    }
+                ],
+                "usage": {"completion_tokens": out["num_generated"]},
+            }
+        # default: completions
+        prompt = body.get("prompt", "")
+        out = await self._generate(prompt, self._sampling(body))
+        return {
+            "id": "cmpl-raytpu",
+            "object": "text_completion",
+            "created": created,
+            "model": self.config.model_id,
+            "choices": [
+                {"index": 0, "text": out["text"], "finish_reason": "stop"}
+            ],
+            "usage": {"completion_tokens": out["num_generated"]},
+        }
+
+
+def build_openai_app(
+    config: LLMConfig, *, name: str = "llm", num_replicas: int = 1
+):
+    """An Application serving OpenAI-style routes under /{name}/v1/...
+    (reference: ray.serve.llm build_openai_app)."""
+    dep = serve_api.deployment(
+        LLMServer,
+        name=name,
+        num_replicas=num_replicas,
+        ray_actor_options=dict(config.placement),
+    )
+    return dep.bind(config)

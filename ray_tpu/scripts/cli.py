@@ -50,7 +50,7 @@ def cmd_start(args) -> int:
     gcs = None
     if args.head:
         session = uuid.uuid4().hex[:12]
-        gcs = GcsServer(session)
+        gcs = GcsServer(session, storage_path=args.gcs_storage)
         gcs_addr = gcs.start(host=args.host, port=args.port)
         node = NodeManager(
             gcs_addr,
@@ -72,22 +72,30 @@ def cmd_start(args) -> int:
             name=args.node_name or f"node-{uuid.uuid4().hex[:6]}",
         )
     node_addr = node.start()
-    print(
-        json.dumps(
-            {
-                "gcs_address": f"{gcs_addr[0]}:{gcs_addr[1]}",
-                "node_id": node.node_id,
-                "node_address": f"{node_addr[0]}:{node_addr[1]}",
-            }
-        ),
-        flush=True,
-    )
+    info = {
+        "gcs_address": f"{gcs_addr[0]}:{gcs_addr[1]}",
+        "node_id": node.node_id,
+        "node_address": f"{node_addr[0]}:{node_addr[1]}",
+    }
+    dashboard = None
+    if args.head and args.dashboard_port is not None:
+        # The dashboard queries through a driver connection to this cluster.
+        import ray_tpu
+        from ray_tpu.dashboard import DashboardHead
+
+        ray_tpu.init(address=info["gcs_address"])
+        dashboard = DashboardHead(host=args.host, port=args.dashboard_port)
+        dport = dashboard.start()
+        info["dashboard_url"] = f"http://{args.host}:{dport}"
+    print(json.dumps(info), flush=True)
 
     stop_ev = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop_ev.set())
     stop_ev.wait()
     try:
+        if dashboard is not None:
+            dashboard.stop()
         node.stop()
     finally:
         if gcs is not None:
@@ -125,6 +133,17 @@ def main(argv: list[str] | None = None) -> int:
     p_start.add_argument("--resources", help="JSON dict of extra resources")
     p_start.add_argument("--labels", help="JSON dict of node labels")
     p_start.add_argument("--node-name", default=None)
+    p_start.add_argument(
+        "--dashboard-port",
+        type=int,
+        default=None,
+        help="start the REST dashboard on this port (head only; 0=ephemeral)",
+    )
+    p_start.add_argument(
+        "--gcs-storage",
+        default=None,
+        help="sqlite path for durable GCS tables (head only; enables GCS FT)",
+    )
     p_start.set_defaults(fn=cmd_start)
 
     p_status = sub.add_parser("status", help="print the cluster view")

@@ -1,0 +1,160 @@
+"""Job submission + dashboard REST API.
+
+Reference parity: python/ray/dashboard/modules/job/tests + dashboard API
+tests (compressed).
+"""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import DashboardHead
+from ray_tpu.job import JobManager, JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def dashboard(cluster):
+    head = DashboardHead()
+    head.start()
+    yield head
+    head.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=30
+    ) as r:
+        body = r.read()
+        if r.headers.get_content_type() == "application/json":
+            return json.loads(body)
+        return body.decode()
+
+
+def _post(port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_job_lifecycle_success(cluster):
+    jm = JobManager()
+    job_id = jm.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job-ran-ok')\""
+    )
+    status = jm.wait(job_id, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "job-ran-ok" in jm.get_job_logs(job_id)
+    infos = {j.job_id for j in jm.list_jobs()}
+    assert job_id in infos
+
+
+def test_job_failure_reports_exit_code(cluster):
+    jm = JobManager()
+    job_id = jm.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+    assert jm.wait(job_id, timeout=60) == JobStatus.FAILED
+    assert "exit code 3" in jm.get_job_info(job_id).message
+
+
+def test_job_stop(cluster):
+    jm = JobManager()
+    job_id = jm.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(300)'"
+    )
+    time.sleep(1)
+    assert jm.stop_job(job_id)
+    assert jm.wait(job_id, timeout=30) == JobStatus.STOPPED
+
+
+def test_job_env_vars_runtime_env(cluster):
+    jm = JobManager()
+    job_id = jm.submit_job(
+        entrypoint=(
+            f"{sys.executable} -c \"import os; print('V=' + os.environ['MY_VAR'])\""
+        ),
+        runtime_env={"env_vars": {"MY_VAR": "hello42"}},
+    )
+    assert jm.wait(job_id, timeout=60) == JobStatus.SUCCEEDED
+    assert "V=hello42" in jm.get_job_logs(job_id)
+
+
+def test_job_driver_joins_cluster(cluster, tmp_path):
+    """The submitted entrypoint is a DRIVER: it ray_tpu.init()s into the
+    submitting cluster via the injected address and runs a task."""
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # picks up RAY_TPU_ADDRESS
+        "@ray_tpu.remote\n"
+        "def f(): return 'from-cluster-task'\n"
+        "print(ray_tpu.get(f.remote()))\n"
+    )
+    jm = JobManager()
+    job_id = jm.submit_job(entrypoint=f"{sys.executable} {script}")
+    assert jm.wait(job_id, timeout=120) == JobStatus.SUCCEEDED
+    assert "from-cluster-task" in jm.get_job_logs(job_id)
+
+
+def test_dashboard_state_endpoints(cluster, dashboard):
+    port = dashboard.port
+    assert "version" in _get(port, "/api/version")
+    nodes = _get(port, "/api/nodes")
+    assert len(nodes) == 1 and nodes[0]["Alive"]
+    assert isinstance(_get(port, "/api/actors"), list)
+    assert isinstance(_get(port, "/api/tasks"), list)
+    assert "CPU" in _get(port, "/api/cluster_resources")
+    metrics = _get(port, "/metrics")
+    assert isinstance(metrics, str)
+
+
+def test_dashboard_job_api_and_http_client(cluster, dashboard):
+    port = dashboard.port
+    out = _post(
+        port,
+        "/api/jobs",
+        {"entrypoint": f"{sys.executable} -c \"print('via-http')\""},
+    )
+    job_id = out["job_id"]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        info = _get(port, f"/api/jobs/{job_id}")
+        if info["status"] in JobStatus.TERMINAL:
+            break
+        time.sleep(0.5)
+    assert info["status"] == JobStatus.SUCCEEDED
+    assert "via-http" in _get(port, f"/api/jobs/{job_id}/logs")["logs"]
+
+    # SDK in HTTP mode against the same dashboard
+    client = JobSubmissionClient(f"http://127.0.0.1:{port}")
+    jid2 = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('via-sdk')\""
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.get_job_status(jid2) in JobStatus.TERMINAL:
+            break
+        time.sleep(0.5)
+    assert client.get_job_status(jid2) == JobStatus.SUCCEEDED
+    jobs = client.list_jobs()
+    assert {j["job_id"] for j in jobs} >= {job_id, jid2}
+
+
+def test_dashboard_404(cluster, dashboard):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(dashboard.port, "/api/nope")
+    assert e.value.code == 404

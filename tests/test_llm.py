@@ -1,0 +1,178 @@
+"""LLM tier: KV-cache decode parity, continuous batching, OpenAI serving.
+
+Reference parity: python/ray/llm tests (engine + serve integration),
+compressed; the decode-vs-forward parity test is the correctness anchor the
+reference outsources to vLLM's own suite.
+"""
+
+import dataclasses
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.llm import (
+    ByteTokenizer,
+    LLMConfig,
+    LLMEngine,
+    SamplingParams,
+    build_llm_processor,
+    build_openai_app,
+)
+from ray_tpu.models import gpt2
+from ray_tpu.models.gpt2_decode import decode_step, init_kv_cache, prefill
+
+
+def tiny_cfg(**kw):
+    cfg = gpt2.GPT2Config.tiny(vocab_size=512, max_seq=128)
+    return dataclasses.replace(
+        cfg, dtype=jnp.float32, attn_impl="reference", **kw
+    )
+
+
+def test_decode_matches_full_forward():
+    """Teacher-forced decode through the KV cache must reproduce the
+    training path's logits position by position."""
+    cfg = tiny_cfg()
+    params = gpt2.init_params(jax.random.key(0), cfg)
+    toks = np.asarray(
+        jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab_size)
+    )
+    full = np.asarray(gpt2.forward(params, jnp.asarray(toks), cfg))
+
+    T0 = 5  # prompt length; rest decoded token-by-token
+    cache = init_kv_cache(cfg, n_slots=2, max_seq=32)
+    cache, logits = prefill(
+        params,
+        jnp.asarray(toks[:, :T0]),
+        jnp.full((2,), T0, jnp.int32),
+        cache,
+        cfg,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), full[:, T0 - 1], rtol=1e-4, atol=1e-4
+    )
+    positions = np.full((2,), T0, np.int32)
+    for t in range(T0, toks.shape[1]):
+        cache, logits = decode_step(
+            params,
+            jnp.asarray(toks[:, t]),
+            jnp.asarray(positions),
+            cache,
+            cfg,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), full[:, t], rtol=1e-4, atol=1e-4
+        )
+        positions += 1
+
+
+def test_engine_greedy_deterministic():
+    config = LLMConfig(
+        model_config=tiny_cfg(), max_slots=2, max_seq=64,
+        prefill_buckets=(16, 32), seed=3,
+    )
+    outs1 = LLMEngine(config).generate(
+        ["hello", "world"], SamplingParams(max_tokens=8)
+    )
+    outs2 = LLMEngine(config).generate(
+        ["hello", "world"], SamplingParams(max_tokens=8)
+    )
+    assert [o["token_ids"] for o in outs1] == [o["token_ids"] for o in outs2]
+    assert all(1 <= o["num_generated"] <= 8 for o in outs1)
+
+
+def test_engine_continuous_batching_more_requests_than_slots():
+    config = LLMConfig(
+        model_config=tiny_cfg(), max_slots=2, max_seq=64,
+        prefill_buckets=(16,), seed=0,
+    )
+    engine = LLMEngine(config)
+    prompts = [f"req {i}" for i in range(5)]
+    outs = engine.generate(prompts, SamplingParams(max_tokens=6))
+    assert len(outs) == 5
+    assert all(o["num_generated"] >= 1 for o in outs)
+    # all slots recycled
+    assert all(engine.slot_free)
+
+
+def test_engine_slot_isolation():
+    """A long and a short request sharing the engine must produce exactly
+    what they produce when run alone (slots don't leak KV)."""
+    config = LLMConfig(
+        model_config=tiny_cfg(), max_slots=2, max_seq=64,
+        prefill_buckets=(16,), seed=0,
+    )
+    alone = LLMEngine(config).generate(["abc"], SamplingParams(max_tokens=5))
+    together = LLMEngine(config).generate(
+        ["abc", "a much longer prompt xyz"], SamplingParams(max_tokens=5)
+    )
+    assert alone[0]["token_ids"] == together[0]["token_ids"]
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    ids = tok.encode("héllo")
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "héllo"
+
+
+def test_batch_processor():
+    config = LLMConfig(
+        model_config=tiny_cfg(), max_slots=2, max_seq=64,
+        prefill_buckets=(16,), seed=1,
+    )
+    proc = build_llm_processor(config, sampling=SamplingParams(max_tokens=4))
+    out = proc({"prompt": ["one", "two", "three"]})
+    assert len(out["generated_text"]) == 3
+    assert out["prompt"][0] == "one"
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_openai_serving_e2e(cluster):
+    from ray_tpu.serve import api as serve
+
+    config = LLMConfig(
+        model_config=tiny_cfg(), max_slots=4, max_seq=64,
+        prefill_buckets=(32,), seed=2,
+    )
+    serve.run(build_openai_app(config, name="llm"))
+    try:
+        port = serve.proxy_port()
+
+        def post(path, body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.loads(r.read())
+
+        out = post(
+            "/llm/v1/completions", {"prompt": "hi", "max_tokens": 4}
+        )
+        assert out["object"] == "text_completion"
+        assert out["usage"]["completion_tokens"] >= 1
+
+        chat = post(
+            "/llm/v1/chat/completions",
+            {
+                "messages": [{"role": "user", "content": "hey"}],
+                "max_tokens": 4,
+            },
+        )
+        assert chat["choices"][0]["message"]["role"] == "assistant"
+    finally:
+        serve.shutdown()
