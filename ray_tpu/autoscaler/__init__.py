@@ -1,0 +1,29 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference parity: python/ray/autoscaler/v2/ (Autoscaler autoscaler.py:50,
+ResourceDemandScheduler scheduler.py:695, InstanceManager
+instance_manager.py:29, monitor.py daemon loop). Redesigned: demand flows
+through the GCS (per-node pending lease queues + pending actors/PGs) as
+one RPC; the scheduler bin-packs demand onto declared node types; the
+instance manager reconciles through a NodeProvider ABC — the in-process
+fake provider (reference: fake_multi_node) boots real NodeManagers so
+autoscaled capacity genuinely joins the cluster in tests.
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalingConfig, NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import (
+    FakeMultiNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.scheduler import ResourceDemandScheduler
+from ray_tpu.autoscaler.sdk import request_resources
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalingConfig",
+    "FakeMultiNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "ResourceDemandScheduler",
+    "request_resources",
+]

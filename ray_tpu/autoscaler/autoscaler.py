@@ -1,0 +1,169 @@
+"""Autoscaler: the reconcile loop gluing GCS demand to a NodeProvider.
+
+Reference parity: python/ray/autoscaler/v2/autoscaler.py:50 +
+instance_manager.py:29 + monitor.py:184, folded into one object: each
+tick reads autoscaler state from the GCS, bin-packs unmet demand, launches
+through the provider, and terminates instances idle past the timeout
+(draining them via the GCS first so the scheduler stops placing there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.scheduler import ResourceDemandScheduler
+
+_REQUEST_KV_NS = "autoscaler"
+_REQUEST_KEY = "resource_requests"
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    resources: dict
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    node_types: dict  # name -> NodeTypeConfig
+    idle_timeout_s: float = 60.0
+    interval_s: float = 1.0
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        config: AutoscalingConfig,
+        provider: NodeProvider,
+        gcs_addr: tuple,
+        endpoint=None,
+    ):
+        from ray_tpu.core.protocol import Endpoint
+
+        self.config = config
+        self.provider = provider
+        self.gcs_addr = tuple(gcs_addr)
+        self._own_endpoint = endpoint is None
+        self.endpoint = endpoint or Endpoint("autoscaler")
+        if self._own_endpoint:
+            self.endpoint.start()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.scheduler = ResourceDemandScheduler(config.node_types)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="autoscaler"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.provider.shutdown()
+        if self._own_endpoint:
+            self.endpoint.stop()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:
+                pass
+            self._stop.wait(self.config.interval_s)
+
+    # -- one reconcile tick ---------------------------------------------------
+    def reconcile_once(self) -> dict:
+        state = self.endpoint.call(
+            self.gcs_addr, "gcs.get_autoscaler_state", {}, timeout=30
+        )
+        # explicit requests (sdk.request_resources) ride the GCS KV
+        explicit = self._explicit_requests()
+        demands = list(explicit)
+        for n in state["nodes"]:
+            if n["alive"]:
+                demands.extend(n["pending_demand"])
+        demands.extend(state["pending"])
+
+        instances = self.provider.non_terminated_nodes()
+        counts: dict = {}
+        for info in instances.values():
+            counts[info["node_type"]] = counts.get(info["node_type"], 0) + 1
+        alive_avail = [
+            n["available"] for n in state["nodes"] if n["alive"]
+        ]
+        # Instances created but not yet registered count as full capacity
+        # (prevents relaunching for the same demand every tick).
+        known_ids = {n["node_id"] for n in state["nodes"]}
+        for info in instances.values():
+            if info["cluster_node_id"] not in known_ids:
+                cfg = self.config.node_types.get(info["node_type"])
+                if cfg is not None:
+                    alive_avail.append(dict(cfg.resources))
+
+        to_launch = self.scheduler.schedule(demands, alive_avail, counts)
+        launched = []
+        for name in to_launch:
+            cfg = self.config.node_types[name]
+            pid = self.provider.create_node(name, cfg.resources, cfg.labels)
+            launched.append(pid)
+
+        # Scale-down: provider instances idle past the timeout, above their
+        # type's min floor. Autoscaler-owned nodes only — the head and
+        # user-started nodes are never terminated.
+        terminated = []
+        idle_by_id = {
+            n["node_id"]: n["idle_s"] for n in state["nodes"] if n["alive"]
+        }
+        for pid, info in list(instances.items()):
+            cfg = self.config.node_types.get(info["node_type"])
+            if cfg is None:
+                continue
+            if counts.get(info["node_type"], 0) <= cfg.min_workers:
+                continue
+            idle_s = idle_by_id.get(info["cluster_node_id"], 0.0)
+            if idle_s >= self.config.idle_timeout_s:
+                try:
+                    self.endpoint.call(
+                        self.gcs_addr,
+                        "gcs.drain_node",
+                        {"node_id": info["cluster_node_id"]},
+                        timeout=10,
+                    )
+                except Exception:
+                    pass
+                self.provider.terminate_node(pid)
+                counts[info["node_type"]] -= 1
+                terminated.append(pid)
+        return {
+            "demands": len(demands),
+            "launched": launched,
+            "terminated": terminated,
+        }
+
+    def _explicit_requests(self) -> list[dict]:
+        import json
+
+        try:
+            raw = self.endpoint.call(
+                self.gcs_addr,
+                "gcs.kv_get",
+                {"ns": _REQUEST_KV_NS, "key": _REQUEST_KEY},
+                timeout=10,
+            )
+        except Exception:
+            return []
+        if not raw:
+            return []
+        try:
+            return json.loads(raw)
+        except Exception:
+            return []

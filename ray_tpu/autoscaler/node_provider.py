@@ -1,0 +1,90 @@
+"""NodeProvider: the cloud abstraction the instance manager drives.
+
+Reference parity: python/ray/autoscaler/node_provider.py ABC + the fake
+multi-node provider (autoscaler/_private/fake_multi_node/node_provider.py).
+The fake here boots REAL NodeManager instances against the cluster's GCS,
+so scaled-up capacity actually schedules work.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+
+class NodeProvider:
+    """ABC. Nodes are provider-scoped ids tagged with their node type."""
+
+    def create_node(self, node_type: str, resources: dict, labels: dict) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> dict:
+        """provider_id -> {"node_type": ..., "cluster_node_id": ... | None}"""
+        raise NotImplementedError
+
+    def cluster_node_id(self, provider_id: str) -> Optional[str]:
+        """The runtime node id once the instance joined, else None."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches in-process NodeManagers joined to ``gcs_addr``."""
+
+    def __init__(self, gcs_addr: tuple):
+        self._gcs_addr = tuple(gcs_addr)
+        self._lock = threading.Lock()
+        self._counter = itertools.count()
+        self._nodes: dict[str, dict] = {}
+
+    def create_node(self, node_type: str, resources: dict, labels: dict) -> str:
+        from ray_tpu.core.node import NodeManager
+
+        pid = f"fake-{next(self._counter)}"
+        node = NodeManager(
+            self._gcs_addr,
+            dict(resources),
+            labels=dict(labels),
+            session_id=None,  # fetched from the GCS (join path)
+            name=f"auto-{node_type}-{pid}",
+        )
+        node.start()
+        with self._lock:
+            self._nodes[pid] = {"node_type": node_type, "node": node}
+        return pid
+
+    def terminate_node(self, provider_id: str) -> None:
+        with self._lock:
+            info = self._nodes.pop(provider_id, None)
+        if info is not None:
+            info["node"].stop()
+
+    def non_terminated_nodes(self) -> dict:
+        with self._lock:
+            return {
+                pid: {
+                    "node_type": info["node_type"],
+                    "cluster_node_id": info["node"].node_id,
+                }
+                for pid, info in self._nodes.items()
+            }
+
+    def cluster_node_id(self, provider_id: str) -> Optional[str]:
+        with self._lock:
+            info = self._nodes.get(provider_id)
+        return None if info is None else info["node"].node_id
+
+    def shutdown(self) -> None:
+        with self._lock:
+            nodes, self._nodes = list(self._nodes.values()), {}
+        for info in nodes:
+            try:
+                info["node"].stop()
+            except Exception:
+                pass

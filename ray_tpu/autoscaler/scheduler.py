@@ -1,0 +1,72 @@
+"""ResourceDemandScheduler: bin-pack unmet demand onto node types.
+
+Reference parity: python/ray/autoscaler/v2/scheduler.py:695 (demand
+bin-packing over declared node types with min/max counts). First-fit
+decreasing over the declared node-type order; returns launch decisions,
+never termination (idle policy lives in the Autoscaler loop).
+"""
+
+from __future__ import annotations
+
+
+def _fits(avail: dict, demand: dict) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _subtract(avail: dict, demand: dict) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class ResourceDemandScheduler:
+    def __init__(self, node_types: dict):
+        # node_types: name -> NodeTypeConfig (resources, min/max workers)
+        self.node_types = node_types
+
+    def schedule(
+        self,
+        demands: list[dict],
+        existing_available: list[dict],
+        counts_by_type: dict,
+    ) -> list[str]:
+        """Returns node-type names to launch (one entry per node).
+
+        demands: unmet resource requests; existing_available: available
+        resources per live node (virtual copies — demand already running is
+        excluded); counts_by_type: current instances per node type.
+        """
+        avails = [dict(a) for a in existing_available]
+        to_launch: list[str] = []
+        launched_counts = dict(counts_by_type)
+        # Feasibility-ordered: big demands first so they don't strand small
+        # nodes (first-fit decreasing).
+        for demand in sorted(
+            demands, key=lambda d: -sum(v for v in d.values())
+        ):
+            placed = False
+            for a in avails:
+                if _fits(a, demand):
+                    _subtract(a, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for name, cfg in self.node_types.items():
+                if launched_counts.get(name, 0) >= cfg.max_workers:
+                    continue
+                if _fits(dict(cfg.resources), demand):
+                    fresh = dict(cfg.resources)
+                    _subtract(fresh, demand)
+                    avails.append(fresh)
+                    to_launch.append(name)
+                    launched_counts[name] = launched_counts.get(name, 0) + 1
+                    placed = True
+                    break
+            # unplaceable on every type -> leave for the user to notice via
+            # pending state (reference: infeasible demand warning)
+        # min_workers floor
+        for name, cfg in self.node_types.items():
+            while launched_counts.get(name, 0) < cfg.min_workers:
+                to_launch.append(name)
+                launched_counts[name] = launched_counts.get(name, 0) + 1
+        return to_launch

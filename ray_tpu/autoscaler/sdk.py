@@ -1,0 +1,19 @@
+"""Autoscaler SDK (reference: ray.autoscaler.sdk.request_resources):
+pin a minimum resource demand independent of queued work."""
+
+from __future__ import annotations
+
+import json
+
+from ray_tpu.autoscaler.autoscaler import _REQUEST_KEY, _REQUEST_KV_NS
+
+
+def request_resources(bundles: list[dict]) -> None:
+    """Ask the autoscaler to provision capacity for ``bundles`` (a list of
+    resource dicts). Overwrites the previous request; [] clears it."""
+    from ray_tpu.core import api as core_api
+
+    worker = core_api._require_worker()
+    worker.gcs.kv_put(
+        _REQUEST_KEY, json.dumps(list(bundles)).encode(), ns=_REQUEST_KV_NS
+    )

@@ -57,6 +57,12 @@ class Config:
     default_max_restarts: int = 0
     # RPC
     rpc_connect_timeout_s: float = 30.0
+    # Memory monitor (reference: memory_monitor.h:52 +
+    # worker_killing_policy.h:33): when the node's memory usage fraction
+    # exceeds the threshold, the newest leased task worker is killed (its
+    # task retries elsewhere). <= 0 disables.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
     # GCS fault tolerance: non-empty -> sqlite-backed durable GCS tables at
     # this path (reference: RAY_external_storage_namespace + redis FT).
     gcs_storage_path: str = ""

@@ -107,6 +107,10 @@ class GcsServer:
         # snapshots arrive with heartbeats.
         self.task_events: "OrderedDict[str, dict]" = OrderedDict()
         self.node_metrics: dict[str, list] = {}
+        # Versioned view sync: bumped only on REAL state changes so idle
+        # clusters gossip ~nothing (reference: delta-streaming RaySyncer).
+        self.view_version = 0
+        self.node_versions: dict[str, int] = {}
         self.internal_config: str = GLOBAL_CONFIG.to_json()
         self._health_task = None
         self._restored_live: list[str] = []
@@ -268,6 +272,7 @@ class GcsServer:
             "hostname": p.get("hostname", "localhost"),
         }
         self.node_last_seen[p["node_id"]] = time.monotonic()
+        self._bump_node_version(p["node_id"])
         await self._publish("nodes", {"node_id": p["node_id"], "state": ALIVE})
         await self._retry_pending_actors()
         await self._retry_pending_pgs()
@@ -277,27 +282,64 @@ class GcsServer:
         view = self.nodes.get(p["node_id"])
         if view is None:
             return False
-        view.available = dict(p["available"])
-        if "total" in p:
-            view.total = dict(p["total"])
+        new_avail = dict(p["available"])
+        new_total = dict(p.get("total", view.total))
+        if new_avail != view.available or new_total != view.total:
+            self._bump_node_version(p["node_id"])
+        view.available = new_avail
+        view.total = new_total
+        meta = self.node_meta.setdefault(p["node_id"], {})
+        meta["pending_demand"] = p.get("pending_demand", [])
+        if p.get("idle"):
+            meta.setdefault("idle_since", time.monotonic())
+        else:
+            meta.pop("idle_since", None)
         self.node_last_seen[p["node_id"]] = time.monotonic()
         if p.get("resources_freed"):
             await self._retry_pending_actors()
             await self._retry_pending_pgs()
         return True
 
-    async def _h_get_cluster_view(self, conn, p):
+    def _node_entry(self, nid) -> dict:
+        v = self.nodes[nid]
+        meta = self.node_meta.get(nid, {})
         return {
-            nid: {
-                "addr": v.addr,
-                "total": v.total,
-                "available": v.available,
-                "labels": v.labels,
-                "alive": v.alive,
-                **self.node_meta.get(nid, {}),
-            }
-            for nid, v in self.nodes.items()
+            "addr": v.addr,
+            "total": v.total,
+            "available": v.available,
+            "labels": v.labels,
+            "alive": v.alive,
+            "shm_root": meta.get("shm_root"),
+            "hostname": meta.get("hostname", "localhost"),
         }
+
+    def _bump_node_version(self, nid: str) -> None:
+        self.view_version += 1
+        self.node_versions[nid] = self.view_version
+
+    async def _h_get_cluster_view(self, conn, p):
+        """Full view (no ``since``) or versioned delta (``since``: the
+        caller's last seen version). Delta replies carry only nodes whose
+        state changed — the reference's RaySyncer gossip role
+        (ray_syncer.h:90) without per-heartbeat O(nodes) payloads."""
+        since = p.get("since")
+        if since is None:
+            return {nid: self._node_entry(nid) for nid in self.nodes}
+        if since < 0 or since > self.view_version:
+            # Fresh cursor, or one predating a GCS restart: full resync.
+            # full=True tells the caller to REPLACE its view — merging
+            # would retain nodes that vanished with the old GCS.
+            return {
+                "version": self.view_version,
+                "changed": {nid: self._node_entry(nid) for nid in self.nodes},
+                "full": True,
+            }
+        changed = {
+            nid: self._node_entry(nid)
+            for nid, ver in self.node_versions.items()
+            if ver > since and nid in self.nodes
+        }
+        return {"version": self.view_version, "changed": changed}
 
     async def _h_drain_node(self, conn, p):
         await self._mark_node_dead(p["node_id"], "drained")
@@ -341,6 +383,7 @@ class GcsServer:
         view.alive = False
         view.available = {}
         self.node_metrics.pop(node_id, None)
+        self._bump_node_version(node_id)
         await self._publish(
             "nodes", {"node_id": node_id, "state": DEAD, "reason": reason}
         )
@@ -545,6 +588,38 @@ class GcsServer:
             if len(out) >= limit:
                 break
         return out
+
+    async def _h_get_autoscaler_state(self, conn, p):
+        """Cluster load + membership for the autoscaler (reference:
+        GcsAutoscalerStateManager feeding autoscaler v2)."""
+        now = time.monotonic()
+        nodes = []
+        for nid, v in self.nodes.items():
+            meta = self.node_meta.get(nid, {})
+            idle_since = meta.get("idle_since")
+            nodes.append(
+                {
+                    "node_id": nid,
+                    "alive": v.alive,
+                    "total": v.total,
+                    "available": v.available,
+                    "labels": v.labels,
+                    "pending_demand": meta.get("pending_demand", []),
+                    "idle_s": (now - idle_since) if idle_since else 0.0,
+                }
+            )
+        pending = []
+        for actor_id in self.pending_actors:
+            rec = self.actors.get(actor_id)
+            if rec is not None:
+                pending.append(rec.spec.get("resources", {}))
+        for pg_id in self.pending_pgs:
+            rec = self.pgs.get(pg_id)
+            if rec is not None:
+                for i, b in enumerate(rec.bundles):
+                    if i >= len(rec.bundle_nodes) or rec.bundle_nodes[i] is None:
+                        pending.append(dict(b))
+        return {"nodes": nodes, "pending": pending}
 
     async def _h_publish_logs(self, conn, p):
         await self._publish("logs", p)

@@ -42,17 +42,22 @@ class HttpJobClient:
         )
         return out["job_id"]
 
-    def get_job_info(self, job_id: str) -> dict:
-        return self._req("GET", f"/api/jobs/{job_id}")
+    def get_job_info(self, job_id: str):
+        from ray_tpu.job.manager import JobInfo
+
+        return JobInfo(**self._req("GET", f"/api/jobs/{job_id}"))
 
     def get_job_status(self, job_id: str) -> str:
-        return self.get_job_info(job_id)["status"]
+        return self.get_job_info(job_id).status
 
     def get_job_logs(self, job_id: str) -> str:
         return self._req("GET", f"/api/jobs/{job_id}/logs")["logs"]
 
-    def list_jobs(self) -> list[dict]:
-        return self._req("GET", "/api/jobs")
+    def list_jobs(self) -> list:
+        from ray_tpu.job.manager import JobInfo
+
+        # Same contract as the direct JobManager: JobInfo dataclasses.
+        return [JobInfo(**j) for j in self._req("GET", "/api/jobs")]
 
     def stop_job(self, job_id: str) -> bool:
         return self._req("POST", f"/api/jobs/{job_id}/stop")["stopped"]

@@ -124,6 +124,10 @@ class DashboardHead:
             return "404 Not Found", "application/json", json.dumps(
                 {"error": str(e)}
             ).encode()
+        except ValueError as e:
+            return "400 Bad Request", "application/json", json.dumps(
+                {"error": str(e)}
+            ).encode()
         except Exception as e:  # noqa: BLE001
             return "500 Internal Server Error", "application/json", (
                 json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
@@ -169,6 +173,8 @@ class DashboardHead:
         if path == "/api/jobs":
             if method == "POST":
                 req = json.loads(body or b"{}")
+                if not req.get("entrypoint"):
+                    raise ValueError("'entrypoint' is required")
                 job_id = self._jobs().submit_job(
                     entrypoint=req["entrypoint"],
                     submission_id=req.get("submission_id"),

@@ -20,11 +20,26 @@ from ray_tpu.data.block import Block, BlockAccessor, concat_blocks, rows_to_bloc
 
 
 @dataclass
+class ActorPoolStrategy:
+    """compute= strategy for map stages (reference:
+    ray.data.ActorPoolStrategy): run the stage's fused chain inside a pool
+    of long-lived actors so per-block setup (model load, jit compile)
+    amortizes across blocks."""
+
+    def __init__(self, size: int = 2, max_tasks_in_flight_per_actor: int = 2):
+        if size < 1:
+            raise ValueError("actor pool size must be >= 1")
+        self.size = size
+        self.max_tasks_in_flight_per_actor = max_tasks_in_flight_per_actor
+
+
+@dataclass
 class MapBatchesOp:
     fn: Callable
     batch_size: Optional[int] = None  # None = whole block
     batch_format: str = "numpy"
     fn_kwargs: dict = field(default_factory=dict)
+    compute: Optional[ActorPoolStrategy] = None
 
 
 @dataclass

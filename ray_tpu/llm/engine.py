@@ -162,7 +162,11 @@ class LLMEngine:
             stop_token=stop,
         )
 
-    def _admit_waiting(self) -> None:
+    def _admit_waiting(self) -> list:
+        """Admit waiting requests into free slots; returns requests that
+        finished DURING admission (max_tokens=1 / stop token at prefill) —
+        step() must surface these too, or their callers never learn."""
+        admit_finished: list = []
         waiting = [
             r for r in self.requests.values() if r.slot < 0 and not r.finished
         ]
@@ -170,7 +174,7 @@ class LLMEngine:
             try:
                 slot = self.slot_free.index(True)
             except ValueError:
-                return
+                return admit_finished
             T = len(req.prompt)
             bucket = next(
                 (b for b in self.config.prefill_buckets if b >= T),
@@ -193,6 +197,9 @@ class LLMEngine:
             self.positions[slot] = T
             self.last_tokens[slot] = tok
             self._maybe_finish(req)
+            if req.finished:
+                admit_finished.append(req)
+        return admit_finished
 
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
         if req.temperature <= 0.0:
@@ -220,9 +227,8 @@ class LLMEngine:
     def step(self) -> list:
         """Admit + one decode step for all active slots. Returns the
         requests that finished this step."""
-        self._admit_waiting()
+        finished = self._admit_waiting()
         active = [r for r in self._slot_req if r is not None]
-        finished = []
         if active:
             self.cache, logits = self._decode(
                 self.params,

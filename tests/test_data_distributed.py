@@ -1,0 +1,93 @@
+"""Data-tier hardening: distributed sample-sort + actor-pool compute.
+
+Reference parity: ray.data sort_benchmark / actor-pool map tests
+(compressed). VERDICT weak #9 acceptance: sort no longer funnels every
+block into one task.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data.plan import ActorPoolStrategy
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=16)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_distributed_sort_global_order(cluster):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(4000)
+    ds = rd.from_items([{"x": int(v)} for v in vals]).repartition(8)
+    out = ds.sort("x").take_all()
+    assert [r["x"] for r in out] == sorted(vals.tolist())
+
+
+def test_distributed_sort_descending(cluster):
+    rng = np.random.default_rng(1)
+    vals = rng.integers(0, 1000, size=997)  # dupes + odd size
+    ds = rd.from_items([{"x": int(v)} for v in vals]).repartition(5)
+    out = ds.sort("x", descending=True).take_all()
+    assert [r["x"] for r in out] == sorted(vals.tolist(), reverse=True)
+
+
+def test_distributed_sort_skewed_keys(cluster):
+    # Heavy skew: most keys identical — boundaries collapse; partitions
+    # must still cover everything exactly once.
+    vals = [5] * 900 + list(range(100))
+    ds = rd.from_items([{"x": v} for v in vals]).repartition(6)
+    out = ds.sort("x").take_all()
+    assert [r["x"] for r in out] == sorted(vals)
+
+
+def test_actor_pool_map_batches_bounded_processes(cluster):
+    ds = rd.range(400).repartition(8)
+
+    def tag_pid(batch):
+        batch["pid"] = np.full(len(batch["id"]), os.getpid())
+        return batch
+
+    out = ds.map_batches(
+        tag_pid, compute=ActorPoolStrategy(size=2)
+    ).take_all()
+    assert len(out) == 400
+    assert {r["id"] for r in out} == set(range(400))
+    # all 8 blocks were served by the pool's 2 processes
+    assert len({r["pid"] for r in out}) <= 2
+
+
+def test_actor_pool_amortizes_state(cluster):
+    """Expensive setup in the fn closure happens once per pool actor, not
+    once per block (the point of actor compute)."""
+    ds = rd.range(200).repartition(8)
+
+    class Counter:
+        def __init__(self):
+            self.inits = 0
+            self.ready = False
+
+        def __call__(self, batch):
+            if not self.ready:  # simulated model load
+                self.inits += 1
+                self.ready = True
+            batch["inits"] = np.full(len(batch["id"]), self.inits)
+            return batch
+
+    out = ds.map_batches(Counter(), compute="actors").take_all()
+    assert len(out) == 200
+    # every block saw inits == 1: state persisted across blocks
+    assert {r["inits"] for r in out} == {1}
+
+
+def test_compute_argument_forms(cluster):
+    ds = rd.range(20)
+    assert len(ds.map_batches(lambda b: b, compute=1).take_all()) == 20
+    with pytest.raises(TypeError):
+        ds.map_batches(lambda b: b, compute=3.5)

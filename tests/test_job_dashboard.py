@@ -151,7 +151,14 @@ def test_dashboard_job_api_and_http_client(cluster, dashboard):
         time.sleep(0.5)
     assert client.get_job_status(jid2) == JobStatus.SUCCEEDED
     jobs = client.list_jobs()
-    assert {j["job_id"] for j in jobs} >= {job_id, jid2}
+    # Same JobInfo contract as the direct JobManager path.
+    assert {j.job_id for j in jobs} >= {job_id, jid2}
+
+
+def test_dashboard_post_without_entrypoint_is_400(cluster, dashboard):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(dashboard.port, "/api/jobs", {})
+    assert e.value.code == 400
 
 
 def test_dashboard_404(cluster, dashboard):

@@ -174,5 +174,11 @@ def test_openai_serving_e2e(cluster):
             },
         )
         assert chat["choices"][0]["message"]["role"] == "assistant"
+
+        # Regression: a request that finishes AT admission (max_tokens=1)
+        # must still resolve — finished-during-prefill requests used to be
+        # dropped from step()'s return and hang the HTTP caller.
+        one = post("/llm/v1/completions", {"prompt": "x", "max_tokens": 1})
+        assert one["usage"]["completion_tokens"] == 1
     finally:
         serve.shutdown()
