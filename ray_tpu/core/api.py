@@ -292,6 +292,13 @@ _was_initialized = False
 def _require_worker(auto_init: bool = True) -> CoreWorker:
     global _was_initialized
     if _worker is None:
+        if os.environ.get("RAY_TPU_WORKER_ID"):
+            # Managed worker process: auto-init would silently nest a whole
+            # private cluster inside this worker. The attach must win.
+            raise RayTpuError(
+                "no attached CoreWorker in this managed worker process "
+                "(task ran before worker bootstrap completed?)"
+            )
         if not auto_init or _was_initialized:
             # After an explicit shutdown, refs/handles from the old cluster
             # are dead — auto-reinit would dangle them on a fresh cluster.

@@ -32,6 +32,9 @@ class Config:
     object_store_bytes: int = 2 * 1024 * 1024 * 1024
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Verify node-to-node transfers with a native FNV-1a fingerprint
+    # (opt-in: trades ~1 GB/s of hashing per side for corruption detection).
+    verify_transfers: bool = False
     # Worker pool (reference: worker_pool.h maximum_startup_concurrency +
     # idle worker killing). max_worker_processes caps TASK workers per node
     # (0 = auto: max(4, 2 * host cores)); actors bypass the cap (they hold

@@ -259,6 +259,16 @@ class GcsServer:
     # -- nodes ---------------------------------------------------------------
 
     async def _h_register_node(self, conn, p):
+        # A node daemon from a DIFFERENT session may dial this address
+        # after a port reuse (its old GCS died; we bound the same port).
+        # Accepting it would splice a foreign cluster's capacity into this
+        # one — tasks would run on nodes the driver never created.
+        peer_session = p.get("session_id")
+        if peer_session is not None and peer_session != self.session_id:
+            raise RuntimeError(
+                f"session mismatch: node {p['node_id'][:8]} belongs to "
+                f"session {peer_session}, this GCS serves {self.session_id}"
+            )
         view = NodeView(
             node_id=p["node_id"],
             addr=tuple(p["addr"]),
@@ -445,6 +455,14 @@ class GcsServer:
             reply = await self.endpoint.acall(
                 view.addr, "node.start_actor", {"record": self._start_spec(rec)}
             )
+        except SchedulingError:
+            # The node's ACTUAL availability lagged our gossiped view (e.g.
+            # task leases still returning): a capacity rejection is not an
+            # actor failure — requeue and retry on the next resource event
+            # (reference: GcsActorScheduler reschedules rejected leases).
+            if rec.actor_id not in self.pending_actors:
+                self.pending_actors.append(rec.actor_id)
+            return
         except Exception as e:
             await self._on_actor_failure(rec, f"start_actor failed: {e!r}")
             return

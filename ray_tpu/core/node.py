@@ -157,6 +157,7 @@ class NodeManager:
                 "labels": self.labels,
                 "shm_root": self.shm_root,
                 "hostname": socket.gethostname(),
+                "session_id": self.session_id,
             },
             timeout=30,
         )
@@ -240,19 +241,28 @@ class NodeManager:
                     # The GCS does not know us: it restarted from durable
                     # storage (reference: NotifyGCSRestart,
                     # node_manager.proto:454) — re-register and resume.
+                    # session_id travels so a DIFFERENT cluster that reused
+                    # the address rejects us (we then stop heartbeating:
+                    # this node is an orphan of a dead session).
                     self._view_since = -1  # new version epoch: full resync
-                    await self.endpoint.acall(
-                        self.gcs_addr,
-                        "gcs.register_node",
-                        {
-                            "node_id": self.node_id,
-                            "addr": self.endpoint.address,
-                            "resources": self.total,
-                            "labels": self.labels,
-                            "shm_root": self.shm_root,
-                            "hostname": socket.gethostname(),
-                        },
-                    )
+                    try:
+                        await self.endpoint.acall(
+                            self.gcs_addr,
+                            "gcs.register_node",
+                            {
+                                "node_id": self.node_id,
+                                "addr": self.endpoint.address,
+                                "resources": self.total,
+                                "labels": self.labels,
+                                "shm_root": self.shm_root,
+                                "hostname": socket.gethostname(),
+                                "session_id": self.session_id,
+                            },
+                        )
+                    except Exception as e:
+                        if "session mismatch" in str(e):
+                            return  # orphaned: stop heartbeating for good
+                        raise
             except Exception:
                 pass
             await self._refresh_cluster_view(force=True)
@@ -290,6 +300,11 @@ class NodeManager:
                     alive=v["alive"],
                 )
                 self.view_meta[nid] = {"shm_root": v.get("shm_root")}
+            if reply["changed"] and self._pending_leases:
+                # A changed cluster (e.g. a NEW node) can unblock queued
+                # requests that were infeasible everywhere — re-evaluate
+                # now instead of letting them sit out their deadline.
+                await self._drain_pending()
         except Exception:
             pass
 
@@ -647,9 +662,11 @@ class NodeManager:
         if any_feasible(req, self.cluster_view):
             return {"retry_after": 0.2}
         # The gossiped view may be stale (e.g. a placement-group bundle was
-        # committed on a peer since our last heartbeat) — refresh once from
-        # the GCS before declaring the request infeasible.
-        await self._refresh_cluster_view()
+        # committed on a peer, or a brand-new node registered, since our
+        # last heartbeat) — force one refresh from the GCS before declaring
+        # the request infeasible. This is the last chance before a hard
+        # error, so the throttle must not apply.
+        await self._refresh_cluster_view(force=True)
         spill = self._try_spill(req)
         if spill is not None:
             return spill
@@ -741,22 +758,32 @@ class NodeManager:
         return True
 
     async def _drain_pending(self):
+        # Snapshot-and-clear FIRST: drains can run concurrently (lease
+        # returns, worker deaths, view changes), and two drains holding the
+        # same entry would double-grant it across the _grant await (leaking
+        # a LEASED worker + its resources). Each entry belongs to exactly
+        # one drain; requests that stay unserved are appended back, which
+        # preserves entries queued meanwhile.
+        todo, self._pending_leases = self._pending_leases, []
         still = []
-        for req, fut, deadline in self._pending_leases:
+        for req, fut, deadline in todo:
             if fut.done():
                 continue
             if time.monotonic() > deadline:
                 fut.set_exception(
                     SchedulingError(f"lease timed out for {req.resources}")
                 )
-            elif fits(self.available, req.resources):
+            elif labels_match(self.labels, req.label_selector) and fits(
+                self.available, req.resources
+            ):
                 try:
                     fut.set_result(await self._grant(req))
                 except Exception as e:
-                    fut.set_exception(e)
+                    if not fut.done():
+                        fut.set_exception(e)
             else:
                 still.append((req, fut, deadline))
-        self._pending_leases = still
+        self._pending_leases.extend(still)
 
     # -- placement-group bundles ---------------------------------------------
     # Node side of the GCS 2PC (reference:
@@ -968,11 +995,41 @@ class NodeManager:
                 )
                 buf[off : off + ln] = data
                 off += ln
+            if GLOBAL_CONFIG.verify_transfers:
+                # End-to-end integrity: compare the assembled bytes' native
+                # FNV-1a against the source's (opt-in: costs ~1 GB/s of
+                # fingerprinting on each side).
+                from ray_tpu import _native
+
+                expect = await self.endpoint.acall(
+                    src_addr, "node.object_fingerprint", {"oid": oid}
+                )
+                got = await self._store_call(_native.fingerprint, buf)
+                if (
+                    expect is not None
+                    and got is not None
+                    and expect != got
+                ):
+                    raise IOError(
+                        f"transfer of {oid[:12]} corrupted: fingerprint "
+                        f"{got:#x} != source {expect:#x}"
+                    )
         except Exception:
             await self._store_call(self.store.delete, oid)
             raise
         await self._store_call(self.store.seal, oid)
         return {"size": size}
+
+    async def _h_object_fingerprint(self, conn, p):
+        """Native FNV-1a of a sealed blob (transfer verification)."""
+        from ray_tpu import _native
+
+        def compute():
+            with self.store._lock:
+                mv = self.store.get(p["oid"])
+                return _native.fingerprint(mv)
+
+        return await self._store_call(compute)
 
     # -- memory monitor ------------------------------------------------------
 

@@ -70,7 +70,8 @@ def dumps(value: Any) -> tuple[bytes, list[ObjectRef]]:
 
 
 def loads(data: bytes | memoryview) -> tuple[Any, list[ObjectRef]]:
-    """Deserialize; returns (value, contained_refs).
+    """Deserialize; returns (value, contained_refs). Transparently handles
+    both plain pickle payloads and framed out-of-band payloads.
 
     Ref collection happens via the ObjectRef deserialization hook, so nested
     refs anywhere in the value are found.
@@ -87,7 +88,155 @@ def loads(data: bytes | memoryview) -> tuple[Any, list[ObjectRef]]:
 
     _or._on_ref_deserialized = hook
     try:
-        value = pickle.loads(data)
+        mv = memoryview(data)
+        if len(mv) >= 4 and bytes(mv[:4]) == _MAGIC:
+            value = _loads_framed(mv)
+        else:
+            value = pickle.loads(data)
     finally:
         _or._on_ref_deserialized = prev_hook
     return value, collected
+
+
+# ---------------------------------------------------------------------------
+# Framed out-of-band payloads (pickle protocol-5 buffers)
+#
+# The hot path for array-bearing values: the pickle header carries only the
+# object structure; each large buffer (numpy data) is copied ONCE, by the
+# native multi-threaded memcpy, directly into the destination (shm mmap).
+# Plain dumps() pays pickle's internal copy AND the write copy.
+#
+# Layout (little-endian):
+#   "RTB1" | u32 nbuf | u64 header_len | u64 buf_len * nbuf
+#   | header | pad-to-64 | buf0 | pad-to-64 | buf1 | ...
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"RTB1"
+_ALIGN = 64
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class FramedPayload:
+    """A serialized value as (header, out-of-band buffers) plus the exact
+    framed size — so writers can allocate once and copy once."""
+
+    __slots__ = ("header", "buffers", "nbytes")
+
+    def __init__(self, header: bytes, buffers: list):
+        self.header = header
+        self.buffers = buffers
+        off = 4 + 4 + 8 + 8 * len(buffers)
+        off += _pad(len(header))
+        for b in buffers:
+            off += _pad(b.nbytes)
+        self.nbytes = off
+
+    def write_into(self, dst: memoryview) -> None:
+        from ray_tpu import _native
+
+        import struct
+
+        nbuf = len(self.buffers)
+        struct.pack_into(
+            f"<4sIQ{nbuf}Q",
+            dst,
+            0,
+            _MAGIC,
+            nbuf,
+            len(self.header),
+            *[b.nbytes for b in self.buffers],
+        )
+        off = 4 + 4 + 8 + 8 * nbuf
+        dst[off : off + len(self.header)] = self.header
+        off += _pad(len(self.header))
+        for b in self.buffers:
+            flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+            _native.copy_into(dst[off : off + b.nbytes], flat)
+            off += _pad(b.nbytes)
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.nbytes)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+    def write_stream(self, f) -> None:
+        """Sequential single-copy write of the framed layout to a file."""
+        import struct
+
+        nbuf = len(self.buffers)
+        f.write(
+            struct.pack(
+                f"<4sIQ{nbuf}Q",
+                _MAGIC,
+                nbuf,
+                len(self.header),
+                *[b.nbytes for b in self.buffers],
+            )
+        )
+        f.write(self.header)
+        pad = _pad(len(self.header)) - len(self.header)
+        if pad:
+            f.write(b"\x00" * pad)
+        for b in self.buffers:
+            flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+            f.write(flat)
+            pad = _pad(b.nbytes) - b.nbytes
+            if pad:
+                f.write(b"\x00" * pad)
+
+
+def _loads_framed(mv: memoryview):
+    import struct
+
+    nbuf, header_len = struct.unpack_from("<IQ", mv, 4)
+    lens = struct.unpack_from(f"<{nbuf}Q", mv, 16)
+    off = 4 + 4 + 8 + 8 * nbuf
+    header = mv[off : off + header_len]
+    off += _pad(header_len)
+    from ray_tpu import _native
+
+    buffers = []
+    for ln in lens:
+        # Copy out of the (possibly shm-backed) source: zero-copy views
+        # would dangle if the blob is spilled or freed while the value
+        # lives on. One memcpy — the same cost plain pickle.loads pays,
+        # but multi-threaded on multicore hosts.
+        out = bytearray(ln)
+        _native.copy_into(memoryview(out), mv[off : off + ln])
+        buffers.append(out)
+        off += _pad(ln)
+    return pickle.loads(header, buffers=buffers)
+
+
+def dumps_oob(value: Any) -> tuple["FramedPayload | bytes", list[ObjectRef]]:
+    """Like dumps(), but large contiguous buffers stay out-of-band.
+    Returns plain bytes when the value carries no out-of-band buffers."""
+    buffers: list = []
+
+    def cb(pb: pickle.PickleBuffer) -> bool:
+        # pickle semantics: a TRUTHY return keeps the buffer IN-band; a
+        # falsy return takes it out-of-band (the inverse reads naturally
+        # but is wrong).
+        try:
+            raw = pb.raw()
+        except BufferError:
+            return True  # non-contiguous: keep in-band
+        if raw.nbytes < 4096:
+            return True  # tiny: framing overhead beats the copy win
+        buffers.append(raw)
+        return False
+
+    buf = io.BytesIO()
+    prev = _ctx.collecting
+    _ctx.collecting = refs = []
+    try:
+        _Pickler(buf, protocol=5, buffer_callback=cb).dump(value)
+    finally:
+        _ctx.collecting = prev
+    header = buf.getvalue()
+    if not buffers:
+        return header, refs
+    return FramedPayload(header, buffers), refs

@@ -60,11 +60,18 @@ def main() -> None:
         kind="worker",
         worker_id=os.environ.get("RAY_TPU_WORKER_ID"),
     )
-    worker.start()
 
     import ray_tpu.core.api as api
 
+    # Attach BEFORE start(): registration makes this worker leasable, and a
+    # task can arrive (on the endpoint thread) before the main thread runs
+    # the next statement. User code calling get_runtime_context()/remote()
+    # in that window would find no attached worker and AUTO-INIT a nested
+    # in-process cluster — tasks then report node ids of a cluster that
+    # exists only inside one worker process (observed as "ran on a node
+    # that is not in the cluster" flakes).
     api._attach_existing_worker(worker)
+    worker.start()
 
     stop = []
 

@@ -71,13 +71,35 @@ def test_cli_cluster_forms_and_runs_tasks(two_host_cluster, tmp_path):
     assert ids == {head_info["node_id"], worker_info["node_id"]}
     assert ray_tpu.cluster_resources()["CPU"] == 6.0
 
-    # Tasks land on both hosts (2 CPUs each forces one per node).
+    # Tasks land on both hosts: 2 CPUs each AND long enough to overlap —
+    # otherwise the submitter's lease reuse may legally run both
+    # sequentially on one node.
     @ray_tpu.remote
     def where():
+        import time as _t
+
+        _t.sleep(2.0)
         return ray_tpu.get_runtime_context().node_id
 
     refs = [where.options(num_cpus=2).remote() for _ in range(2)]
-    assert set(ray_tpu.get(refs, timeout=60)) == ids
+    got = set(ray_tpu.get(refs, timeout=60))
+    if got != ids:  # diagnostic: which PROCESS executed the strays?
+        import time as _t
+
+        _t.sleep(2)
+        from ray_tpu.util import state
+
+        detail = []
+        for t in state.list_tasks(name="where"):
+            pid = t.get("exec_pid")
+            cmdline = ""
+            try:
+                with open(f"/proc/{pid}/cmdline") as f:
+                    cmdline = f.read().replace("\x00", " ")
+            except OSError:
+                cmdline = "(gone)"
+            detail.append((t.get("exec_node_id"), pid, cmdline[:160]))
+        raise AssertionError(f"got={got} ids={ids} detail={detail}")
 
     # A 2-worker JaxTrainer spans the two daemons: real jax.distributed
     # bootstrap (CPU platform), one worker per host.

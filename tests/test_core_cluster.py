@@ -38,7 +38,11 @@ def test_custom_resource_routes_to_node(cluster):
 
         return rr.get_runtime_context().node_id
 
-    assert ray_tpu.get(where.remote()) == node2.node_id
+    nid = ray_tpu.get(where.remote())
+    assert nid == node2.node_id, (
+        f"ran on {nid}, cluster="
+        f"{[(n['NodeID'][:8], n['Resources'], n['Alive']) for n in ray_tpu.nodes()]}"
+    )
 
 
 def test_label_selector_scheduling(cluster):
@@ -53,7 +57,9 @@ def test_label_selector_scheduling(cluster):
     nid = ray_tpu.get(
         where.options(label_selector={"zone": "c"}).remote()
     )
-    assert nid == node3.node_id
+    assert nid == node3.node_id, (
+        f"ran on {nid}, cluster={[(n['NodeID'][:8], n['Labels'], n['Alive']) for n in ray_tpu.nodes()]}"
+    )
 
 
 def test_infeasible_errors(cluster):
