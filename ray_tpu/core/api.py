@@ -352,6 +352,7 @@ class RemoteFunction:
             policy=policy,
             func_payload=self._payload,
             pg=pg,
+            runtime_env=_runtime_env_from_opts(opts, worker),
         )
         return refs[0] if opts.get("num_returns", 1) == 1 else refs
 
@@ -371,6 +372,33 @@ def _resources_from_opts(opts: dict) -> dict:
     if resources.get("CPU") == 0:
         del resources["CPU"]
     return resources
+
+
+_renv_cache: dict = {}
+
+
+def _runtime_env_from_opts(opts: dict, worker: CoreWorker) -> dict:
+    """Normalize + upload a runtime_env once per driver process
+    (content-addressed packages dedupe in the GCS KV anyway)."""
+    renv = opts.get("runtime_env")
+    if not renv:
+        return {}
+    import json as _json
+
+    from ray_tpu import runtime_env as _re
+
+    # Keyed by session too: packages upload to ONE cluster's KV — a cache
+    # hit across shutdown()/init() would hand the new cluster a pkg: URI
+    # that exists only in the old one.
+    cache_key = (
+        worker.session_id,
+        _json.dumps(renv, sort_keys=True, default=str),
+    )
+    norm = _renv_cache.get(cache_key)
+    if norm is None:
+        norm = _re.prepare(renv, worker.gcs)
+        _renv_cache[cache_key] = norm
+    return norm
 
 
 def _scheduling_from_opts(
@@ -492,6 +520,7 @@ class ActorClass:
             soft_label_selector=soft_sel,
             policy=policy,
             pg=pg,
+            runtime_env=_runtime_env_from_opts(opts, worker),
         )
         return ActorHandle(
             info["actor_id"],

@@ -65,6 +65,7 @@ class TaskSpec:
     soft_label_selector: dict = field(default_factory=dict)
     policy: str = "hybrid"
     pg: tuple | None = None  # (pg_id, capture_child_tasks)
+    runtime_env: dict = field(default_factory=dict)  # normalized (prepare())
     cancelled: bool = False  # set by cancel(); suppresses push and retries
     completed: bool = False  # finished at least once (spec kept for lineage)
     lineage_attempts: int = 0  # reconstruction resubmissions so far
@@ -754,6 +755,7 @@ class CoreWorker:
         policy: str = "hybrid",
         func_payload: bytes | None = None,
         pg: tuple | None = None,
+        runtime_env: dict | None = None,
     ) -> list[ObjectRef]:
         # NB: an explicitly empty dict means "no resource demand" (e.g.
         # num_cpus=0 probes) — only None gets the 1-CPU default.
@@ -777,6 +779,7 @@ class CoreWorker:
             soft_label_selector=dict(soft_label_selector or {}),
             policy=policy,
             pg=pg,
+            runtime_env=dict(runtime_env or {}),
         )
         refs = [
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, name)
@@ -812,7 +815,10 @@ class CoreWorker:
         return _SchedKey(
             tuple(sorted(spec.resources.items())),
             tuple(sorted(map(str, spec.label_selector.items())))
-            + tuple(sorted(map(str, spec.soft_label_selector.items()))),
+            + tuple(sorted(map(str, spec.soft_label_selector.items())))
+            # runtime-env identity: leases bind workers to one env, so
+            # different envs must never share a scheduling class.
+            + (spec.runtime_env.get("hash", ""),),
             spec.policy,
         )
 
@@ -881,6 +887,7 @@ class CoreWorker:
             "label_selector": spec.label_selector,
             "soft_label_selector": spec.soft_label_selector,
             "policy": spec.policy,
+            "runtime_env": spec.runtime_env,
         }
         node_addr = self.node_addr
         deadline = time.monotonic() + GLOBAL_CONFIG.lease_request_timeout_s
@@ -1120,9 +1127,11 @@ class CoreWorker:
         soft_label_selector: dict | None = None,
         policy: str = "hybrid",
         pg: tuple | None = None,
+        runtime_env: dict | None = None,
     ) -> dict:
         actor_id = ActorID.random().hex()
         spec = {
+            "runtime_env": dict(runtime_env or {}),
             "actor_id": actor_id,
             "name": name,
             "class_payload": cloudpickle.dumps(cls),
@@ -1273,6 +1282,22 @@ class CoreWorker:
         if p.get("actor_id") is not None:
             return await self._execute_actor_task(p)
         return await self._execute_task(p)
+
+    # -- device objects (reference: gpu_object_manager __ray_send__) ---------
+
+    async def _h_worker_rdt_fetch(self, conn, p):
+        """Serve a device object as host numpy (device->host happens in the
+        executor thread: jax transfers must not block the endpoint loop)."""
+        from ray_tpu.experimental.device_objects import store
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, store().fetch_host, p["oid"]
+        )
+
+    async def _h_worker_rdt_free(self, conn, p):
+        from ray_tpu.experimental.device_objects import store
+
+        return store().free(p["oid"])
 
     # -- compiled graphs (reference: compiled_dag_node.py ExecutableTask) ----
 
@@ -1452,15 +1477,24 @@ class CoreWorker:
             advance()
 
     async def _resolve_args(self, p) -> tuple[tuple, dict]:
+        # Deserialization runs OFF the endpoint loop: reconstructors may
+        # block (DeviceRef fetches issue their own RPCs through this very
+        # loop), and big unpickles would stall every RPC this process
+        # serves either way.
+        loop = asyncio.get_running_loop()
+
+        def loads_off_loop(data):
+            return serialization.loads(data)[0]
+
         async def decode(item):
             kind, payload = item[0], item[1]
             if kind == "v":
-                value, _ = serialization.loads(payload)
-                return value
+                return await loop.run_in_executor(
+                    None, loads_off_loop, payload
+                )
             ref: ObjectRef = payload
             data = await self._fetch_payload(ref, None)
-            value, _ = serialization.loads(data)
-            return value
+            return await loop.run_in_executor(None, loads_off_loop, data)
 
         args = await asyncio.gather(*(decode(a) for a in p["args"]))
         kw_items = list(p["kwargs"].items())

@@ -12,6 +12,7 @@ through the GCS instead of a dedicated syncer stream.
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import socket
 import subprocess
@@ -61,6 +62,7 @@ class WorkerInfo:
     actor_ids: list = field(default_factory=list)
     ready: asyncio.Event = field(default_factory=asyncio.Event)
     idle_since: float = 0.0  # monotonic time it last entered the idle pool
+    env_hash: str = ""  # runtime-env identity; pool reuse must match
 
 
 @dataclass
@@ -383,10 +385,15 @@ class NodeManager:
 
     # -- worker pool ---------------------------------------------------------
 
-    def _spawn_worker(self) -> WorkerInfo:
+    def _spawn_worker(self, runtime_env: dict | None = None) -> WorkerInfo:
         worker_id = WorkerID.random().hex()
         env = dict(os.environ)
         env.update(self.extra_env)
+        if runtime_env:
+            # env_vars applied at spawn; working_dir/py_modules are set up
+            # by the worker itself before it registers (runtime_env.py).
+            env.update(runtime_env.get("env_vars", {}))
+            env["RAY_TPU_RUNTIME_ENV"] = json.dumps(runtime_env)
         env["RAY_TPU_WORKER_ID"] = worker_id
         # Cluster-authoritative config (this node already synced with the
         # head's) — workers must not fall back to their own env defaults.
@@ -415,7 +422,11 @@ class NodeManager:
         for f in (out_f, err_f):
             if hasattr(f, "close"):
                 f.close()
-        info = WorkerInfo(worker_id=worker_id, proc=proc)
+        info = WorkerInfo(
+            worker_id=worker_id,
+            proc=proc,
+            env_hash=(runtime_env or {}).get("hash", ""),
+        )
         self.workers[worker_id] = info
         return info
 
@@ -456,7 +467,22 @@ class NodeManager:
             if not fut.done():
                 fut.set_result(None)
 
-    async def _get_idle_worker(self, for_actor: bool = False) -> WorkerInfo:
+    def _pop_idle_matching(self, env_hash: str) -> Optional[WorkerInfo]:
+        """Claim an idle worker whose runtime-env identity matches."""
+        for i in range(len(self.idle_workers) - 1, -1, -1):
+            wid = self.idle_workers[i]
+            info = self.workers.get(wid)
+            if info is None:
+                self.idle_workers.pop(i)
+                continue
+            if info.env_hash == env_hash:
+                self.idle_workers.pop(i)
+                return info
+        return None
+
+    async def _get_idle_worker(
+        self, for_actor: bool = False, runtime_env: dict | None = None
+    ) -> WorkerInfo:
         """Claim an idle worker, spawning one if the pool is below its cap.
         At the cap, wait for a lease to return a worker instead — an
         unbounded pool fork-bombs the host on task bursts, and extra
@@ -467,11 +493,27 @@ class NodeManager:
             asyncio.get_running_loop().time()
             + GLOBAL_CONFIG.worker_start_timeout_s
         )
+        env_hash = (runtime_env or {}).get("hash", "")
         while True:
-            if self.idle_workers:
-                return self.workers[self.idle_workers.pop()]
-            if for_actor or self._task_worker_count() < self._worker_cap():
-                info = self._spawn_worker()
+            match = self._pop_idle_matching(env_hash)
+            if match is not None:
+                return match
+            at_cap = self._task_worker_count() >= self._worker_cap()
+            if at_cap and self.idle_workers and not for_actor:
+                # (actors bypass the cap entirely — evicting a warm task
+                # worker for them would be pure waste)
+                # Pool full of OTHER-env idle workers: evict one to make
+                # room (reference: idle workers with mismatched runtime
+                # envs are killed rather than starving the new env).
+                victim = self.workers.get(self.idle_workers.pop(0))
+                if victim is not None:
+                    self.workers.pop(victim.worker_id, None)
+                    if victim.proc is not None and victim.proc.poll() is None:
+                        victim.proc.kill()
+                        self._terminated_procs.append(victim.proc)
+                at_cap = False
+            if for_actor or not at_cap:
+                info = self._spawn_worker(runtime_env)
                 try:
                     await asyncio.wait_for(
                         info.ready.wait(),
@@ -566,6 +608,7 @@ class NodeManager:
             label_selector=p.get("label_selector", {}),
             soft_label_selector=p.get("soft_label_selector", {}),
             policy=p.get("policy", "hybrid"),
+            runtime_env=p.get("runtime_env") or {},
         )
         deadline = time.monotonic() + GLOBAL_CONFIG.lease_request_timeout_s
         return await self._lease_or_spill(req, deadline)
@@ -724,7 +767,9 @@ class NodeManager:
     async def _grant(self, req: SchedulingRequest, for_actor: bool = False):
         subtract(self.available, req.resources)
         try:
-            info = await self._get_idle_worker(for_actor=for_actor)
+            info = await self._get_idle_worker(
+                for_actor=for_actor, runtime_env=req.runtime_env
+            )
         except Exception:
             add(self.available, req.resources)
             raise
@@ -867,7 +912,10 @@ class NodeManager:
     async def _h_start_actor(self, conn, p):
         record = p["record"]
         spec = record["spec"]
-        req = SchedulingRequest(resources=spec.get("resources", {}))
+        req = SchedulingRequest(
+            resources=spec.get("resources", {}),
+            runtime_env=spec.get("runtime_env") or {},
+        )
         if not fits(self.available, req.resources):
             raise SchedulingError(
                 f"node {self.node_id[:8]} cannot fit actor {req.resources}"

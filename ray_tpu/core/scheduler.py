@@ -87,6 +87,9 @@ class SchedulingRequest:
     # "hybrid" (default: prefer local then best remote), "spread",
     # "node_affinity:<node_id>", "strict_node_affinity:<node_id>"
     policy: str = "hybrid"
+    # Normalized runtime environment (ray_tpu.runtime_env.prepare output).
+    # Does not affect node choice — it selects/spawns the WORKER.
+    runtime_env: dict = field(default_factory=dict)
 
 
 def pick_node(

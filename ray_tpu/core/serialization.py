@@ -51,7 +51,14 @@ class _Pickler(cloudpickle.Pickler):
         if mod.partition(".")[0] in ("jaxlib", "jax") and hasattr(
             obj, "__array__"
         ):
-            # Device array -> host numpy. Weakly-typed scalars survive fine.
+            # Opt-in RDT (reference: tensor_transport): the array stays on
+            # THIS process's device; a fetch-on-load marker travels instead.
+            from ray_tpu.experimental import device_objects as _dev
+
+            if _dev.intercept_active():
+                return _dev.intercept_reduce(obj)
+            # Default: device array -> host numpy. Weakly-typed scalars
+            # survive fine.
             return (_identity, (np.asarray(obj),))
         # cloudpickle's own reducer_override handles functions/classes.
         return super().reducer_override(obj)

@@ -61,6 +61,19 @@ def main() -> None:
         worker_id=os.environ.get("RAY_TPU_WORKER_ID"),
     )
 
+    # Runtime env: working_dir / py_modules must be live BEFORE the worker
+    # registers (registration makes it leasable).
+    if os.environ.get("RAY_TPU_RUNTIME_ENV"):
+        import json as _json
+
+        from ray_tpu import runtime_env as _re
+
+        _re.setup_in_worker(
+            _json.loads(os.environ["RAY_TPU_RUNTIME_ENV"]),
+            parse(args.gcs_addr),
+            args.session_id,
+        )
+
     import ray_tpu.core.api as api
 
     # Attach BEFORE start(): registration makes this worker leasable, and a

@@ -1,0 +1,24 @@
+"""ray_tpu.experimental — device-resident objects (RDT-equivalent).
+
+Reference parity: python/ray/experimental/gpu_object_manager/ (Ray Direct
+Transport: GPU objects stay on-device, moved by NCCL/NIXL). TPU-native
+redesign in :mod:`ray_tpu.experimental.device_objects`.
+"""
+
+from ray_tpu.experimental.device_objects import (
+    DeviceRef,
+    device_free,
+    device_get,
+    device_put,
+    device_store_stats,
+    enable_device_objects,
+)
+
+__all__ = [
+    "DeviceRef",
+    "device_free",
+    "device_get",
+    "device_put",
+    "device_store_stats",
+    "enable_device_objects",
+]

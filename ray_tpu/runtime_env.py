@@ -1,0 +1,164 @@
+"""Runtime environments: per-task/actor worker environment setup.
+
+Reference parity: python/ray/_private/runtime_env/ (plugins + per-node
+RuntimeEnvAgent, runtime_env_agent.py:165, URI caching, zip packaging to
+the GCS KV). Redesigned without the agent daemon: the driver packages and
+uploads once (content-addressed in the GCS KV); the node injects env vars
+at worker spawn and tags the worker with the env hash so the pool never
+hands an env-A worker to env-B work; the worker extracts/caches packages
+itself before registering (so it only becomes leasable once ready).
+
+Supported plugins (reference: pip/uv/conda/py_modules/working_dir/...):
+- ``env_vars``:   {name: value} injected into the worker process.
+- ``working_dir``: local dir, zipped + uploaded; workers chdir into it and
+  put it on sys.path.
+- ``py_modules``: list of local dirs, uploaded; sys.path only.
+- ``pip`` / ``conda``: rejected with a clear error — this environment has
+  no package index egress; bake dependencies into the image instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+
+_PKG_NS = "runtime_env_packages"
+_MAX_PKG_BYTES = 200 * 1024 * 1024
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules"}
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for fname in files:
+                full = os.path.join(root, fname)
+                zf.write(full, os.path.relpath(full, path))
+    data = buf.getvalue()
+    if len(data) > _MAX_PKG_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(cap {_MAX_PKG_BYTES}); ship big data via the object store"
+        )
+    return data
+
+
+def _upload_dir(path: str, gcs) -> str:
+    """Zip + content-address + upload once. Returns 'pkg:<sha16>'."""
+    data = _zip_dir(path)
+    digest = hashlib.sha256(data).hexdigest()[:16]
+    uri = f"pkg:{digest}"
+    gcs.kv_put(uri, data, ns=_PKG_NS, overwrite=False)
+    return uri
+
+
+def prepare(runtime_env: dict, gcs) -> dict:
+    """Driver-side normalization: upload dirs, validate, hash.
+
+    Returns {"env_vars", "working_dir_uri", "py_module_uris", "hash"} —
+    the wire form nodes and workers consume.
+    """
+    if not runtime_env:
+        return {}
+    unknown = set(runtime_env) - {
+        "env_vars", "working_dir", "py_modules", "pip", "conda",
+    }
+    if unknown:
+        raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
+    if "pip" in runtime_env or "conda" in runtime_env:
+        raise ValueError(
+            "runtime_env pip/conda plugins need package-index egress, "
+            "which this deployment does not have — bake dependencies into "
+            "the worker image (reference parity: pip plugin exists there; "
+            "here it is an explicit unsupported-capability error)"
+        )
+    env_vars = dict(runtime_env.get("env_vars", {}))
+    for k, v in env_vars.items():
+        if not isinstance(k, str) or not isinstance(v, str):
+            raise TypeError("env_vars must be str->str")
+    norm: dict = {"env_vars": env_vars}
+    wd = runtime_env.get("working_dir")
+    if wd:
+        norm["working_dir_uri"] = (
+            wd if wd.startswith("pkg:") else _upload_dir(wd, gcs)
+        )
+    mods = []
+    for m in runtime_env.get("py_modules", []):
+        mods.append(m if m.startswith("pkg:") else _upload_dir(m, gcs))
+    if mods:
+        norm["py_module_uris"] = mods
+    canonical = json.dumps(norm, sort_keys=True)
+    norm["hash"] = hashlib.sha256(canonical.encode()).hexdigest()[:16]
+    return norm
+
+
+def env_hash(norm: dict | None) -> str:
+    return (norm or {}).get("hash", "")
+
+
+# -- worker side -------------------------------------------------------------
+
+
+def _extract_cache_dir(session_id: str) -> str:
+    import tempfile
+
+    return os.path.join(
+        tempfile.gettempdir(), "raytpu-sessions", session_id, "runtime_envs"
+    )
+
+
+def _fetch_and_extract(uri: str, gcs_addr: tuple, session_id: str) -> str:
+    """Download a package into the node-local cache (idempotent)."""
+    target = os.path.join(_extract_cache_dir(session_id), uri.replace(":", "-"))
+    marker = target + ".ready"
+    if os.path.exists(marker):
+        return target
+    from ray_tpu.core.gcs import GcsClient
+    from ray_tpu.core.protocol import Endpoint
+
+    ep = Endpoint("renv-fetch")
+    ep.start()
+    try:
+        data = GcsClient(ep, gcs_addr).kv_get(uri, ns=_PKG_NS)
+    finally:
+        ep.stop()
+    if data is None:
+        raise FileNotFoundError(f"runtime_env package {uri} not in GCS KV")
+    tmp = target + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)  # empty packages must still yield a dir
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        if not os.path.isdir(target):
+            # the rename did NOT lose to a concurrent extractor — the
+            # cache is genuinely broken; do not poison it with a marker
+            raise
+    with open(marker, "w") as f:
+        f.write(uri)
+    return target
+
+
+def setup_in_worker(norm: dict, gcs_addr: tuple, session_id: str) -> None:
+    """Apply working_dir/py_modules inside a freshly spawned worker, BEFORE
+    it registers (env_vars were already injected by the node at spawn)."""
+    import sys
+
+    for uri in norm.get("py_module_uris", []):
+        path = _fetch_and_extract(uri, gcs_addr, session_id)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    wd_uri = norm.get("working_dir_uri")
+    if wd_uri:
+        path = _fetch_and_extract(wd_uri, gcs_addr, session_id)
+        os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)

@@ -1,0 +1,109 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Reference parity: python/ray/util/actor_pool.py (same API: submit /
+get_next / get_next_unordered / map / map_unordered / has_next /
+has_free). Results complete out of order internally and are buffered;
+get_next serves them in submission order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self._idle = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        self._future_to_meta: dict = {}  # ref -> (index, actor)
+        self._done: dict = {}  # index -> value
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list = []
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queued when every actor is busy."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_meta[ref] = (self._next_task_index, actor)
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(
+            self._done or self._future_to_meta or self._pending_submits
+        )
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    # -- internals -----------------------------------------------------------
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    def _absorb_one(self, timeout: float | None) -> None:
+        """Wait for ANY in-flight result; buffer it and recycle its actor.
+        The actor returns to the pool BEFORE the value is fetched, so a
+        raising task never leaks its actor (reference semantics); the
+        exception is buffered and re-raised at ITS index's retrieval."""
+        refs = list(self._future_to_meta)
+        ready, _ = self._ray.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no actor-pool result ready in time")
+        ref = ready[0]
+        idx, actor = self._future_to_meta.pop(ref)
+        self._return_actor(actor)
+        try:
+            self._done[idx] = ("ok", self._ray.get(ref))
+        except Exception as e:  # noqa: BLE001 — rethrown at retrieval
+            self._done[idx] = ("err", e)
+
+    # -- retrieval -----------------------------------------------------------
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        idx = self._next_return_index
+        while idx not in self._done:
+            self._absorb_one(timeout)
+        self._next_return_index += 1
+        state, value = self._done.pop(idx)
+        if state == "err":
+            raise value
+        return value
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Next COMPLETED result, any order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        if not self._done:
+            self._absorb_one(timeout)
+        idx = next(iter(self._done))
+        self._next_return_index = max(self._next_return_index, idx + 1)
+        state, value = self._done.pop(idx)
+        if state == "err":
+            raise value
+        return value
+
+    # -- bulk ----------------------------------------------------------------
+    def map(self, fn: Callable, values: Iterable) -> Iterable:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable) -> Iterable:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

@@ -1,0 +1,111 @@
+"""Distributed FIFO queue backed by a named actor.
+
+Reference parity: ray.util.queue.Queue (actor-backed queue with
+put/get/qsize/empty/full, blocking semantics via async actor methods).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize)
+
+    async def put(self, item, timeout: Optional[float]) -> bool:
+        try:
+            if timeout is None:
+                await self._q.put(item)
+            else:
+                await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def put_nowait(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get(self, timeout: Optional[float]):
+        try:
+            if timeout is None:
+                return True, await self._q.get()
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
+
+    async def empty(self) -> bool:
+        return self._q.empty()
+
+    async def full(self) -> bool:
+        return self._q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: dict | None = None):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        opts = dict(actor_options or {"num_cpus": 0})
+        opts.setdefault("name", f"_queue_{uuid.uuid4().hex[:10]}")
+        self._actor = (
+            ray_tpu.remote(_QueueActor).options(**opts).remote(maxsize)
+        )
+
+    def put(self, item: Any, timeout: Optional[float] = None) -> None:
+        if not self._ray.get(self._actor.put.remote(item, timeout)):
+            raise Full("queue put timed out")
+
+    def put_nowait(self, item: Any) -> None:
+        if not self._ray.get(self._actor.put_nowait.remote(item)):
+            raise Full("queue is full")
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        ok, item = self._ray.get(self._actor.get.remote(timeout))
+        if not ok:
+            raise Empty("queue get timed out")
+        return item
+
+    def get_nowait(self) -> Any:
+        ok, item = self._ray.get(self._actor.get_nowait.remote())
+        if not ok:
+            raise Empty("queue is empty")
+        return item
+
+    def qsize(self) -> int:
+        return self._ray.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self._ray.get(self._actor.empty.remote())
+
+    def full(self) -> bool:
+        return self._ray.get(self._actor.full.remote())
+
+    def shutdown(self) -> None:
+        try:
+            self._ray.kill(self._actor)
+        except Exception:
+            pass

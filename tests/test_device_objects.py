@@ -1,0 +1,114 @@
+"""Device-resident objects (RDT-equivalent): store, refs, interception.
+
+Reference parity: python/ray/tests/test_gpu_objects* (compressed, CPU
+virtual devices stand in for TPU chips).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import (
+    device_get,
+    device_put,
+    device_free,
+    device_store_stats,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Producer:
+    def __init__(self):
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+
+    def make_ref(self, n):
+        # device_put keeps the array in THIS actor process
+        return device_put(self._jnp.arange(n) * 2)
+
+    def make_budgeted_ref(self, n):
+        return device_put(self._jnp.ones(n), fetches_before_free=1)
+
+    def stats(self):
+        return device_store_stats()
+
+    def intercepted_return(self, n):
+        from ray_tpu.experimental import enable_device_objects
+
+        enable_device_objects(fetches_before_free=1)
+        return {"w": self._jnp.full((n,), 3.0), "tag": "ok"}
+
+
+@ray_tpu.remote
+class Consumer:
+    def consume(self, ref):
+        arr = device_get(ref)
+        return float(arr.sum())
+
+    def consume_value(self, value):
+        # value arrived via interception: arrays already reassembled
+        return float(value["w"].sum()), value["tag"]
+
+
+def test_device_ref_roundtrip(cluster):
+    p = Producer.options(num_cpus=0).remote()
+    c = Consumer.options(num_cpus=0).remote()
+    ref = ray_tpu.get(p.make_ref.remote(10))
+    assert ref.shape == (10,)
+    # owner still holds it on device
+    assert ray_tpu.get(p.stats.remote())["num_objects"] == 1
+    total = ray_tpu.get(c.consume.remote(ref))
+    assert total == float(sum(range(10)) * 2)
+    # unlimited fetches: still resident; explicit free drops it
+    assert ray_tpu.get(p.stats.remote())["num_objects"] == 1
+    assert device_free(ref)
+    assert ray_tpu.get(p.stats.remote())["num_objects"] == 0
+    for h in (p, c):
+        ray_tpu.kill(h)
+
+
+def test_fetch_budget_frees_after_handoff(cluster):
+    p = Producer.options(num_cpus=0).remote()
+    c = Consumer.options(num_cpus=0).remote()
+    ref = ray_tpu.get(p.make_budgeted_ref.remote(5))
+    assert ray_tpu.get(c.consume.remote(ref)) == 5.0
+    assert ray_tpu.get(p.stats.remote())["num_objects"] == 0
+    with pytest.raises(Exception, match="gone"):
+        ray_tpu.get(c.consume.remote(ref))
+    for h in (p, c):
+        ray_tpu.kill(h)
+
+
+def test_transparent_interception(cluster):
+    """enable_device_objects: returned arrays never transit the object
+    store; the consumer fetches from the producer on deserialize."""
+    p = Producer.options(num_cpus=0).remote()
+    c = Consumer.options(num_cpus=0).remote()
+    value_ref = p.intercepted_return.remote(7)
+    ray_tpu.wait([value_ref])
+    # PROOF of interception: the array is parked in the producer's device
+    # store (a host-converted fallback would leave the store empty and the
+    # numbers below would still pass).
+    assert ray_tpu.get(p.stats.remote())["num_objects"] == 1
+    total, tag = ray_tpu.get(c.consume_value.remote(value_ref))
+    assert (total, tag) == (21.0, "ok")
+    # fetch budget 1: consumed exactly once, then freed at the owner
+    assert ray_tpu.get(p.stats.remote())["num_objects"] == 0
+    for h in (p, c):
+        ray_tpu.kill(h)
+
+
+def test_driver_side_fetch(cluster):
+    p = Producer.options(num_cpus=0).remote()
+    ref = ray_tpu.get(p.make_ref.remote(4))
+    arr = device_get(ref)
+    assert list(np.asarray(arr)) == [0, 2, 4, 6]
+    ray_tpu.kill(p)

@@ -1,0 +1,80 @@
+"""ActorPool + distributed Queue (reference: ray.util tests, compressed)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map_ordered(cluster):
+    actors = [Doubler.options(num_cpus=0).remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_unordered_and_backlog(cluster):
+    actors = [Doubler.options(num_cpus=0).remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    # more submissions than actors: backlog queues then drains
+    for i in range(6):
+        pool.submit(lambda a, v: a.double.remote(v), i)
+    assert not pool.has_free()
+    got = sorted(
+        pool.get_next_unordered(timeout=30) for _ in range(6)
+    )
+    assert got == [0, 2, 4, 6, 8, 10]
+    assert not pool.has_next() and pool.has_free()
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_queue_fifo_and_nowait(cluster):
+    q = Queue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    with pytest.raises(Full):
+        q.put_nowait("c")
+    assert q.qsize() == 2 and q.full()
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_between_actors(cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    pref = producer.remote(q, 5)
+    cref = consumer.remote(q, 5)
+    assert ray_tpu.get(pref) is True
+    assert ray_tpu.get(cref) == list(range(5))
+    q.shutdown()
