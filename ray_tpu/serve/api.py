@@ -24,6 +24,10 @@ class DeploymentConfig:
     max_concurrent_queries: int = 8
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
     user_config: Any = None
+    # {"min_replicas", "max_replicas", "target_ongoing_requests",
+    #  "downscale_delay_s"} — demand-driven replica count (reference:
+    # serve autoscaling_config). None = fixed num_replicas.
+    autoscaling_config: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -17,12 +17,14 @@ from ray_tpu.core import api as core_api
 
 CONTROLLER_NAME = "serve::controller"
 HEALTH_CHECK_PERIOD_S = 1.0
+REGISTRATION_GRACE_S = 30.0
 
 
 class ServeController:
     def __init__(self):
         # name -> {"config": dict, "payload": bytes, "init": bytes,
-        #          "replicas": [ActorHandle], "version": int,
+        #          "replicas": [(ActorHandle, started_at_monotonic)],
+        #          "version": int,
         #          "next_replica_id": int}
         self._deployments: dict[str, dict] = {}
         self._version = 0
@@ -57,7 +59,7 @@ class ServeController:
         dep["payload"] = payload
         dep["init"] = init_payload
         if roll and dep["replicas"]:
-            for r in dep["replicas"]:
+            for r, _ in dep["replicas"]:
                 try:
                     ray_tpu.kill(r)
                 except Exception:
@@ -72,27 +74,51 @@ class ServeController:
         if dep is None:
             return False
         self._bump()
-        for r in dep["replicas"]:
+        for r, _ in dep["replicas"]:
             try:
                 ray_tpu.kill(r)
             except Exception:
                 pass
         return True
 
+    @staticmethod
+    def _base_target(dep: dict) -> int:
+        """Configured floor: min_replicas when autoscaled, else
+        num_replicas. Readiness and status report against this."""
+        auto = dep["config"].get("autoscaling_config")
+        if auto:
+            return max(1, int(auto.get("min_replicas", 1)))
+        return dep["config"].get("num_replicas", 1)
+
     async def wait_healthy(self, name: str, timeout_s: float = 120.0) -> bool:
-        """Block until the deployment has its target number of live
-        replicas (used by serve.run)."""
+        """Block until the deployment has its target number of READY
+        replicas (used by serve.run). Readiness means the replica ANSWERS
+        a ping — i.e. its __init__ finished — which is a stricter predicate
+        than the GCS-state liveness the reconciler prunes by: a replica
+        mid-model-load is alive but not yet servable, and one whose
+        __init__ raises must never count as healthy."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             dep = self._deployments.get(name)
             if dep is not None:
-                target = dep["config"].get("num_replicas", 1)
-                if len(dep["replicas"]) >= target:
-                    alive = await self._ping_all(dep["replicas"])
-                    if sum(alive) >= target:
+                target = self._base_target(dep)
+                replicas = [r for r, _ in dep["replicas"]]
+                if len(replicas) >= target:
+                    ready = await asyncio.gather(
+                        *(self._ready(r) for r in replicas)
+                    )
+                    if sum(ready) >= target:
                         return True
             await asyncio.sleep(0.1)
         return False
+
+    @staticmethod
+    async def _ready(replica) -> bool:
+        try:
+            await core_api.get_async(replica.ping.remote(), timeout=5.0)
+            return True
+        except Exception:
+            return False
 
     async def get_routing(self, name: str, version: int = -1) -> dict:
         """Routing table for one deployment. Routers pass their last seen
@@ -105,16 +131,16 @@ class ServeController:
             return {"version": version}
         return {
             "version": dep["version"],
-            "replicas": list(dep["replicas"]),
+            "replicas": [r for r, _ in dep["replicas"]],
             "max_concurrent": dep["config"].get("max_concurrent_queries", 8),
         }
 
     async def status(self) -> dict:
         return {
             name: {
-                "target_replicas": dep["config"].get("num_replicas", 1),
+                "target_replicas": self._base_target(dep),
                 "live_replicas": len(dep["replicas"]),
-                "replica_ids": [r._actor_id for r in dep["replicas"]],
+                "replica_ids": [r._actor_id for r, _ in dep["replicas"]],
                 "version": dep["version"],
             }
             for name, dep in self._deployments.items()
@@ -148,38 +174,108 @@ class ServeController:
                     )
             await asyncio.sleep(HEALTH_CHECK_PERIOD_S)
 
-    async def _ping_all(self, replicas: list) -> list:
-        refs = [r.ping.remote() for r in replicas]
+    async def _ping_all(self, entries: list) -> list:
+        """Liveness by GCS actor STATE, not by ping latency: a replica
+        whose heavy __init__ (model load, jit compile) outlasts a ping
+        timeout is STARTING, not dead — treating it as dead used to drop
+        it from the table without killing it, leaking its CPU and spiraling
+        into replace-churn until the cluster was out of resources.
+
+        A replica the GCS does not know yet gets a registration grace:
+        the controller is an async actor, so create_actor registration is
+        fire-and-forget and may land after the first reconcile tick."""
+        worker = core_api._require_worker(auto_init=False)
         out = []
-        for ref in refs:
+        now = time.monotonic()
+        for r, started_at in entries:
             try:
-                await core_api.get_async(ref, timeout=5.0)
-                out.append(True)
+                info = await worker.gcs.acall(
+                    "get_actor", {"actor_id": r._actor_id}
+                )
             except Exception:
-                out.append(False)
+                out.append(True)  # GCS hiccup: keep, re-check next tick
+                continue
+            if info is None:
+                out.append(now - started_at < REGISTRATION_GRACE_S)
+            else:
+                out.append(info.get("state") != "DEAD")
         return out
+
+    async def _autoscale_target(self, dep: dict) -> int:
+        """Demand-driven replica target (reference:
+        serve/autoscaling_policy.py + _private/autoscaling_state.py):
+        desired = ceil(total ongoing requests / target_ongoing_requests),
+        clamped to [min, max]; upscale applies immediately, downscale only
+        after demand stays low for downscale_delay_s. min_replicas is
+        floored at 1 (scale-from-zero needs router-side demand metrics
+        this design does not collect)."""
+        import math
+
+        auto = dep["config"]["autoscaling_config"]
+        target_ongoing = max(float(auto.get("target_ongoing_requests", 2)), 0.1)
+        lo = max(1, int(auto.get("min_replicas", 1)))
+        hi = int(auto.get("max_replicas", max(lo, 1)))
+        delay_s = float(auto.get("downscale_delay_s", 30.0))
+        current = max(len(dep["replicas"]), 1)
+
+        async def one_len(r):
+            try:
+                return await core_api.get_async(
+                    r.queue_len.remote(), timeout=2.0
+                )
+            except Exception:
+                return 0  # starting/dead: contributes no demand
+
+        lens = await asyncio.gather(
+            *(one_len(r) for r, _ in dep["replicas"])
+        )
+        total = float(sum(lens))
+        desired = max(lo, min(hi, math.ceil(total / target_ongoing)))
+        if desired >= current:
+            dep.pop("_low_since", None)
+            return desired
+        # downscale: require sustained low demand
+        now = time.monotonic()
+        low_since = dep.setdefault("_low_since", now)
+        if now - low_since >= delay_s:
+            dep.pop("_low_since", None)
+            return desired
+        return current
 
     async def _reconcile_one(self, name: str) -> None:
         dep = self._deployments.get(name)
         if dep is None:
             return
-        target = dep["config"].get("num_replicas", 1)
-        # Drop dead replicas from the table.
+        # Prune dead replicas FIRST: a stale entry would both inflate the
+        # autoscaler's "current" and absorb a start slot.
         if dep["replicas"]:
             alive = await self._ping_all(dep["replicas"])
-            live = [r for r, ok in zip(dep["replicas"], alive) if ok]
-            if len(live) != len(dep["replicas"]):
-                dep["replicas"] = live
+            if not all(alive):
+                for (r, _), ok in zip(dep["replicas"], alive):
+                    if not ok:
+                        try:  # release its worker even if half-alive
+                            ray_tpu.kill(r)
+                        except Exception:
+                            pass
+                dep["replicas"] = [
+                    entry for entry, ok in zip(dep["replicas"], alive) if ok
+                ]
                 dep["version"] = self._bump()
+        if dep["config"].get("autoscaling_config"):
+            target = await self._autoscale_target(dep)
+        else:
+            target = dep["config"].get("num_replicas", 1)
         # Start missing replicas.
         started = False
         while len(dep["replicas"]) < target:
-            dep["replicas"].append(self._start_replica(name, dep))
+            dep["replicas"].append(
+                (self._start_replica(name, dep), time.monotonic())
+            )
             dep["next_replica_id"] += 1
             started = True
         # Stop surplus replicas (scale down).
         while len(dep["replicas"]) > target:
-            victim = dep["replicas"].pop()
+            victim, _ = dep["replicas"].pop()
             started = True
             try:
                 ray_tpu.kill(victim)

@@ -153,3 +153,59 @@ def test_scale_down_and_delete(cluster):
     assert handle.remote({"body": {"x": 1}}).result(timeout=60) == {"y": 2}
     serve.delete("Shrink")
     assert "Shrink" not in serve.status()
+
+
+def test_autoscaling_up_and_down(cluster):
+    """Demand-driven replicas (reference: serve autoscaling_policy):
+    concurrent slow requests scale the deployment up; sustained idleness
+    scales it back to min after the downscale delay."""
+    import concurrent.futures
+    import time as _t
+
+    class Slow:
+        async def __call__(self, request):
+            import asyncio as _a
+
+            await _a.sleep(4.0)
+            return {"ok": True}
+
+    app = serve.deployment(
+        Slow,
+        name="autoscaled",
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 1,
+            "downscale_delay_s": 3.0,
+        },
+    ).bind()
+    serve.run(app)
+    try:
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+
+        def replica_count():
+            st = ray_tpu.get(controller.status.remote())
+            return st["autoscaled"]["live_replicas"]
+
+        handle = serve.get_handle("autoscaled")
+        futs = [handle.remote({}) for _ in range(12)]
+        deadline = _t.time() + 45
+        peak = 1
+        while _t.time() < deadline:
+            peak = max(peak, replica_count())
+            if peak >= 2:
+                break
+            _t.sleep(0.3)
+        for f in futs:
+            assert f.result(timeout=60)["ok"]
+        assert peak >= 2, f"never scaled up (peak={peak})"
+        # idle -> back down to min after the delay
+        deadline = _t.time() + 60
+        while _t.time() < deadline:
+            if replica_count() == 1:
+                break
+            _t.sleep(0.5)
+        assert replica_count() == 1
+    finally:
+        serve.delete("autoscaled")
