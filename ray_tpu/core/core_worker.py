@@ -66,6 +66,7 @@ class TaskSpec:
     policy: str = "hybrid"
     pg: tuple | None = None  # (pg_id, capture_child_tasks)
     runtime_env: dict = field(default_factory=dict)  # normalized (prepare())
+    trace_ctx: tuple | None = None  # (trace_id, span_id) when tracing
     cancelled: bool = False  # set by cancel(); suppresses push and retries
     completed: bool = False  # finished at least once (spec kept for lineage)
     lineage_attempts: int = 0  # reconstruction resubmissions so far
@@ -785,7 +786,14 @@ class CoreWorker:
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, name)
             for oid in return_ids
         ]
-        self._task_event(task_id, "PENDING_SCHEDULING", name=name, kind="task")
+        from ray_tpu.util import tracing
+
+        tfields = tracing.submission_fields()
+        if tfields:
+            spec.trace_ctx = (tfields["trace_id"], tfields["span_id"])
+        self._task_event(
+            task_id, "PENDING_SCHEDULING", name=name, kind="task", **tfields
+        )
         self._run_on_loop(self._enqueue_task(spec))
         return refs
 
@@ -931,6 +939,7 @@ class CoreWorker:
             "return_ids": spec.return_ids,
             "owner_addr": tuple(self.endpoint.address),
             "pg": spec.pg,
+            "trace_ctx": spec.trace_ctx,
         }
         self._inflight_push[spec.task_id] = tuple(grant["worker_addr"])
         self._task_event(
@@ -1190,12 +1199,18 @@ class CoreWorker:
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, spec.name)
             for oid in return_ids
         ]
+        from ray_tpu.util import tracing
+
+        tfields = tracing.submission_fields()
+        if tfields:
+            spec.trace_ctx = (tfields["trace_id"], tfields["span_id"])
         self._task_event(
             task_id,
             "SUBMITTED_TO_ACTOR",
             name=spec.name,
             kind="actor_task",
             actor_id=actor_id,
+            **tfields,
         )
         self._run_on_loop(self._submit_actor_async(spec))
         return refs
@@ -1357,8 +1372,11 @@ class CoreWorker:
                     raise TaskCancelledError(f"task {p['name']} cancelled")
                 self._running_tasks[task_id] = threading.get_ident()
             try:
-                with _bind_ambient_pg(pginfo):
-                    return func(*args, **kwargs)
+                from ray_tpu.util import tracing
+
+                with tracing.execution_scope(p.get("trace_ctx")):
+                    with _bind_ambient_pg(pginfo):
+                        return func(*args, **kwargs)
             finally:
                 with self._cancel_lock:
                     self._running_tasks.pop(task_id, None)
@@ -1451,8 +1469,11 @@ class CoreWorker:
             t_exec0 = time.time()
 
             def run_method():
-                with _bind_ambient_pg(pginfo):
-                    return method(*args, **kwargs)
+                from ray_tpu.util import tracing
+
+                with tracing.execution_scope(p.get("trace_ctx")):
+                    with _bind_ambient_pg(pginfo):
+                        return method(*args, **kwargs)
 
             try:
                 if asyncio.iscoroutinefunction(method):
@@ -1675,6 +1696,7 @@ class _ActorSubmitter:
             "kwargs": spec.kwargs,
             "return_ids": spec.return_ids,
             "owner_addr": tuple(self.worker.endpoint.address),
+            "trace_ctx": spec.trace_ctx,
         }
 
     async def _on_reply(self, spec: TaskSpec, fut: asyncio.Future) -> None:

@@ -1,0 +1,182 @@
+"""Distributed tracing: span context propagated through task submission.
+
+Reference parity: python/ray/util/tracing/tracing_helper.py (OpenTelemetry
+spans around remote calls, context piggybacked on TaskOptions, opt-in via
+RAY_TRACING_ENABLED). Redesigned without an OTel dependency: spans ride
+the EXISTING task-event pipeline (core worker buffer -> GCS store), so one
+storage/one query path serves the timeline, the state API, and trace
+trees.
+
+Usage::
+
+    from ray_tpu.util import tracing
+    tracing.enable()                 # or RAY_TPU_TRACING_ENABLED=1
+
+    with tracing.span("ingest"):
+        refs = [f.remote(x) for x in data]   # child tasks inherit the trace
+        ray_tpu.get(refs)
+
+    tree = tracing.trace_tree()      # forest of {name, children, ...}
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+import uuid
+from typing import Any, Optional
+
+_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace", default=None
+)  # (trace_id, span_id) | None
+
+_enabled_override: Optional[bool] = None
+
+
+def enable() -> None:
+    global _enabled_override
+    _enabled_override = True
+
+
+def disable() -> None:
+    global _enabled_override
+    _enabled_override = False
+
+
+def enabled() -> bool:
+    # An inherited span context means the trace is live HERE regardless of
+    # local flags — worker processes learn about tracing purely from the
+    # contexts tasks carry in (no cluster-wide flag distribution needed).
+    if _ctx.get() is not None:
+        return True
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("RAY_TPU_TRACING_ENABLED", "").lower() in (
+        "1", "true", "yes",
+    )
+
+
+def current_context() -> Optional[tuple]:
+    """(trace_id, span_id) of the active span, or None."""
+    return _ctx.get()
+
+
+def new_span_ids(parent: Optional[tuple]) -> tuple:
+    """(trace_id, span_id, parent_span_id) for a fresh span."""
+    span_id = uuid.uuid4().hex[:16]
+    if parent is None:
+        return uuid.uuid4().hex[:16], span_id, None
+    return parent[0], span_id, parent[1]
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """User span: records start/end into the task-event pipeline; nested
+    remote calls inside the block inherit the trace context."""
+    if not enabled():
+        yield None
+        return
+    trace_id, span_id, parent_id = new_span_ids(_ctx.get())
+    token = _ctx.set((trace_id, span_id))
+    start = time.time()
+    try:
+        yield (trace_id, span_id)
+    finally:
+        _ctx.reset(token)
+        _record_span_event(
+            {
+                "task_id": f"span-{span_id}",
+                "state": "FINISHED",
+                "states": {"RUNNING": start, "FINISHED": time.time()},
+                "kind": "user_span",
+                "name": name,
+                "trace_id": trace_id,
+                "span_id": span_id,
+                "parent_span_id": parent_id,
+                "exec_start_ts": start,
+                "exec_end_ts": time.time(),
+                **({"attrs": attrs} if attrs else {}),
+            }
+        )
+
+
+def _record_span_event(ev: dict) -> None:
+    try:
+        from ray_tpu.core import api as core_api
+
+        worker = core_api._require_worker(auto_init=False)
+        worker._task_events_buf.append(ev)
+    except Exception:
+        pass
+
+
+# -- submission/execution hooks (called by the core worker) ------------------
+
+
+def submission_fields() -> dict:
+    """Trace fields for a task being submitted NOW (ties the task's event
+    record into the active trace; the task itself becomes a span)."""
+    if not enabled():
+        return {}
+    trace_id, span_id, parent_id = new_span_ids(_ctx.get())
+    out = {"trace_id": trace_id, "span_id": span_id}
+    if parent_id is not None:
+        out["parent_span_id"] = parent_id
+    return out
+
+
+@contextlib.contextmanager
+def execution_scope(trace_ctx: Optional[tuple]):
+    """Bind the submitter's trace context around task execution so spans
+    and nested remote calls inside the user function join the trace."""
+    if trace_ctx is None:
+        yield
+        return
+    token = _ctx.set(tuple(trace_ctx))
+    try:
+        yield
+    finally:
+        _ctx.reset(token)
+
+
+# -- querying ----------------------------------------------------------------
+
+
+def trace_tree(trace_id: Optional[str] = None) -> list:
+    """Reconstruct span forests from the task-event store.
+
+    Returns a list of root spans {name, kind, span_id, duration_s,
+    children: [...]}, for one trace or all of them.
+    """
+    from ray_tpu.util import state
+
+    spans: dict[str, dict] = {}
+    for rec in state.list_tasks(limit=100000):
+        sid = rec.get("span_id")
+        if sid is None:
+            continue
+        if trace_id is not None and rec.get("trace_id") != trace_id:
+            continue
+        start = rec.get("exec_start_ts")
+        end = rec.get("exec_end_ts")
+        spans[sid] = {
+            "span_id": sid,
+            "trace_id": rec.get("trace_id"),
+            "name": rec.get("name", rec.get("task_id", "?")),
+            "kind": rec.get("kind", "task"),
+            "parent_span_id": rec.get("parent_span_id"),
+            "duration_s": (
+                round(end - start, 6) if start and end else None
+            ),
+            "children": [],
+        }
+    roots = []
+    for sp in spans.values():
+        parent = spans.get(sp["parent_span_id"])
+        if parent is not None:
+            parent["children"].append(sp)
+        else:
+            roots.append(sp)
+    return roots

@@ -1,0 +1,114 @@
+"""Distributed tracing: span propagation through tasks and actors.
+
+Reference parity: python/ray/tests/test_tracing.py (OTel spans around
+remote calls), compressed onto the task-event pipeline.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    tracing.enable()
+    yield runtime
+    tracing.disable()
+    ray_tpu.shutdown()
+
+
+def _wait_tree(pred, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        roots = tracing.trace_tree()
+        v = pred(roots)
+        if v:
+            return v
+        time.sleep(0.3)
+    raise TimeoutError(f"trace condition not met; last roots={roots}")
+
+
+def test_span_ids_and_nesting_rules():
+    t1 = tracing.new_span_ids(None)
+    assert t1[2] is None and t1[0] != t1[1]
+    t2 = tracing.new_span_ids((t1[0], t1[1]))
+    assert t2[0] == t1[0] and t2[2] == t1[1]
+
+
+def test_task_joins_user_span(cluster):
+    @ray_tpu.remote
+    def traced_child(x):
+        return x + 1
+
+    with tracing.span("parent-op") as (trace_id, span_id):
+        assert ray_tpu.get(traced_child.remote(1)) == 2
+
+    def find(roots):
+        for r in roots:
+            if r["name"] == "parent-op" and r["trace_id"] == trace_id:
+                kids = [c["name"] for c in r["children"]]
+                if "traced_child" in kids:
+                    return r
+        return None
+
+    root = _wait_tree(find)
+    assert root["duration_s"] is not None
+
+
+def test_trace_propagates_through_nested_tasks(cluster):
+    @ray_tpu.remote
+    def leaf():
+        return "leaf"
+
+    @ray_tpu.remote
+    def mid():
+        return ray_tpu.get(leaf.remote())
+
+    with tracing.span("root-op") as (trace_id, _):
+        assert ray_tpu.get(mid.remote()) == "leaf"
+
+    def find(roots):
+        for r in roots:
+            if r["name"] == "root-op" and r["trace_id"] == trace_id:
+                for c in r["children"]:
+                    if c["name"] == "mid":
+                        if any(g["name"] == "leaf" for g in c["children"]):
+                            return r
+        return None
+
+    _wait_tree(find)
+
+
+def test_actor_calls_traced(cluster):
+    @ray_tpu.remote
+    class Svc:
+        def handle(self):
+            return "ok"
+
+    a = Svc.options(num_cpus=0).remote()
+    with tracing.span("svc-call") as (trace_id, _):
+        assert ray_tpu.get(a.handle.remote()) == "ok"
+
+    def find(roots):
+        for r in roots:
+            if r["name"] == "svc-call" and r["trace_id"] == trace_id:
+                if any(c["name"] == "Svc.handle" for c in r["children"]):
+                    return r
+        return None
+
+    _wait_tree(find)
+    ray_tpu.kill(a)
+
+
+def test_disabled_tracing_adds_nothing(cluster):
+    tracing.disable()
+    try:
+        assert tracing.submission_fields() == {}
+        with tracing.span("ignored") as s:
+            assert s is None
+    finally:
+        tracing.enable()
