@@ -10,6 +10,14 @@ tokens/sec/chip for GPT-2-125M. No measured reference number is checked in
 A100 on GPT-2-125M bf16 at ~25-30% MFU (312 TFLOPs peak, ~6·N FLOPs/token).
 vs_baseline > 1.0 means beating that estimate per chip.
 
+Wedge-proofing contract (round 3): the parent process NEVER imports jax.  It
+first probes backend liveness in a killable subprocess with a hard timeout
+(the axon TPU tunnel can wedge such that jax.devices() hangs forever), then
+runs the measurement itself in a second subprocess under a generous timeout.
+On a wedged/unavailable backend it prints a machine-readable skip marker
+  {"metric": ..., "value": 0, "vs_baseline": 0, "skipped": "tpu-unavailable"}
+and exits 0 instead of hanging or dying in a traceback.
+
 Runs on however many chips are visible (the driver gives one); uses a dp mesh
 over all local devices and reports per-chip throughput.
 """
@@ -18,17 +26,33 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 100_000.0
+METRIC = "gpt2_125m_train_tokens_per_sec_per_chip"
+PROBE_TIMEOUT_S = 75
+BENCH_TIMEOUT_S = 1500
 
 
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _repin_platform_from_env() -> None:
+    """Honor an explicit JAX_PLATFORMS override (e.g. CPU smoke runs): the
+    axon TPU plugin stomps the env var at jax import time, so it must be
+    re-pinned via jax.config after import.  No-op when the env var is unset
+    (the real driver bench path — must see the real TPU)."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def run_bench() -> dict:
+    _repin_platform_from_env()
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -114,7 +138,7 @@ def run_bench() -> dict:
                 f"{per_chip:,.0f} tok/s/chip ({dt / iters * 1e3:.1f} ms/step)"
             )
             return {
-                "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+                "metric": METRIC,
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(
@@ -136,6 +160,85 @@ def run_bench() -> dict:
     raise RuntimeError(f"all batch sizes failed; last error: {last_err}")
 
 
+def _probe_backend_alive() -> bool:
+    """Check jax can enumerate devices, in a killable subprocess with a hard
+    timeout (a wedged axon tunnel makes jax.devices() hang forever, with no
+    error).  Retries once: the first touch after an idle period sometimes
+    times out while the tunnel re-establishes."""
+    code = (
+        "import os, jax\n"
+        "if os.environ.get('JAX_PLATFORMS'):\n"
+        "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
+        "print(len(jax.devices()), jax.default_backend())"
+    )
+    for attempt in (1, 2):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=PROBE_TIMEOUT_S,
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode == 0:
+                _log(f"backend probe ok: {r.stdout.strip()}")
+                return True
+            tail = "\n".join(r.stderr.strip().splitlines()[-3:])
+            _log(f"backend probe attempt {attempt} rc={r.returncode}: {tail}")
+        except subprocess.TimeoutExpired:
+            _log(
+                f"backend probe attempt {attempt} timed out after "
+                f"{PROBE_TIMEOUT_S}s (tunnel wedged?)"
+            )
+    return False
+
+
+def _skip(reason: str) -> dict:
+    return {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "skipped": reason,
+    }
+
+
+def main() -> None:
+    if "--run" in sys.argv:
+        # Measurement subprocess: this is the only process that imports jax.
+        print(json.dumps(run_bench()), flush=True)
+        return
+
+    if not _probe_backend_alive():
+        print(json.dumps(_skip("tpu-unavailable")), flush=True)
+        return
+
+    try:
+        # stdout captured for the one-JSON-line contract; stderr inherited so
+        # progress logs stream live and survive a timeout kill.
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run"],
+            timeout=BENCH_TIMEOUT_S,
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        _log(f"bench subprocess exceeded {BENCH_TIMEOUT_S}s; tunnel wedge?")
+        print(json.dumps(_skip("tpu-unavailable")), flush=True)
+        return
+    if r.returncode != 0:
+        # The backend was alive (probe passed), so a failing measurement is a
+        # real bug: emit the marker for machine readability but FAIL the gate.
+        _log(f"bench subprocess failed rc={r.returncode}")
+        print(json.dumps(_skip(f"bench-failed-rc{r.returncode}")), flush=True)
+        sys.exit(1)
+    # Forward the subprocess's final JSON line as our one-line contract.
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            print(line, flush=True)
+            return
+    print(json.dumps(_skip("no-output")), flush=True)
+
+
 if __name__ == "__main__":
-    result = run_bench()
-    print(json.dumps(result), flush=True)
+    main()
