@@ -160,17 +160,22 @@ def run_bench() -> dict:
     raise RuntimeError(f"all batch sizes failed; last error: {last_err}")
 
 
-def _probe_backend_alive() -> bool:
+def _probe_backend() -> str:
     """Check jax can enumerate devices, in a killable subprocess with a hard
     timeout (a wedged axon tunnel makes jax.devices() hang forever, with no
     error).  Retries once: the first touch after an idle period sometimes
-    times out while the tunnel re-establishes."""
+    times out while the tunnel re-establishes.
+
+    Returns "ok", "wedged" (any attempt hung — environmental, skip cleanly)
+    or "broken" (fast nonzero exits — a jax/plugin/install regression that
+    must fail the gate, not silently skip)."""
     code = (
         "import os, jax\n"
         "if os.environ.get('JAX_PLATFORMS'):\n"
         "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
         "print(len(jax.devices()), jax.default_backend())"
     )
+    saw_timeout = False
     for attempt in (1, 2):
         try:
             r = subprocess.run(
@@ -181,15 +186,16 @@ def _probe_backend_alive() -> bool:
             )
             if r.returncode == 0:
                 _log(f"backend probe ok: {r.stdout.strip()}")
-                return True
+                return "ok"
             tail = "\n".join(r.stderr.strip().splitlines()[-3:])
             _log(f"backend probe attempt {attempt} rc={r.returncode}: {tail}")
         except subprocess.TimeoutExpired:
+            saw_timeout = True
             _log(
                 f"backend probe attempt {attempt} timed out after "
                 f"{PROBE_TIMEOUT_S}s (tunnel wedged?)"
             )
-    return False
+    return "wedged" if saw_timeout else "broken"
 
 
 def _skip(reason: str) -> dict:
@@ -208,9 +214,15 @@ def main() -> None:
         print(json.dumps(run_bench()), flush=True)
         return
 
-    if not _probe_backend_alive():
+    probe = _probe_backend()
+    if probe == "wedged":
         print(json.dumps(_skip("tpu-unavailable")), flush=True)
         return
+    if probe == "broken":
+        # Fast nonzero exits mean jax/the plugin is broken, not that the
+        # tunnel is down — a real regression must go red, not skip.
+        print(json.dumps(_skip("backend-probe-failed")), flush=True)
+        sys.exit(1)
 
     try:
         # stdout captured for the one-JSON-line contract; stderr inherited so

@@ -250,14 +250,22 @@ class Endpoint:
                 )
             except asyncio.TimeoutError:
                 pass
-            self._loop.stop()
 
+        # NB: the loop must be stopped from OUTSIDE the coroutine. Calling
+        # loop.stop() as the coroutine's last statement kills the loop before
+        # run_coroutine_threadsafe's done-callback delivers the result, so
+        # .result() always burned its full timeout (3 endpoints x 5 s = the
+        # deterministic 15 s teardown every test module used to pay).
         try:
             asyncio.run_coroutine_threadsafe(shutdown(), self._loop).result(
                 timeout=5
             )
         except Exception:
             pass
+        try:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        except RuntimeError:
+            pass  # loop already closed
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout=5)
 

@@ -71,17 +71,31 @@ def test_cli_cluster_forms_and_runs_tasks(two_host_cluster, tmp_path):
     assert ids == {head_info["node_id"], worker_info["node_id"]}
     assert ray_tpu.cluster_resources()["CPU"] == 6.0
 
-    # Tasks land on both hosts: 2 CPUs each AND long enough to overlap —
-    # otherwise the submitter's lease reuse may legally run both
-    # sequentially on one node.
+    # Tasks land on both hosts: at 2 CPUs each they can't fit one 3-CPU
+    # node concurrently, and the rendezvous forces them to RUN concurrently
+    # (a fixed sleep raced lease reuse under full-suite load: the first task
+    # could finish before the second was pushed, legally landing both on one
+    # node).
+    rendezvous = str(tmp_path / "rendezvous")
+    os.makedirs(rendezvous, exist_ok=True)
+
     @ray_tpu.remote
-    def where():
+    def where(rank: int, peer: int, rv_dir: str):
         import time as _t
 
-        _t.sleep(2.0)
+        with open(os.path.join(rv_dir, str(rank)), "w") as f:
+            f.write("here")
+        deadline = _t.monotonic() + 30
+        while not os.path.exists(os.path.join(rv_dir, str(peer))):
+            if _t.monotonic() > deadline:
+                raise TimeoutError(f"peer {peer} never arrived")
+            _t.sleep(0.05)
         return ray_tpu.get_runtime_context().node_id
 
-    refs = [where.options(num_cpus=2).remote() for _ in range(2)]
+    refs = [
+        where.options(num_cpus=2).remote(r, 1 - r, rendezvous)
+        for r in range(2)
+    ]
     got = set(ray_tpu.get(refs, timeout=60))
     if got != ids:  # diagnostic: which PROCESS executed the strays?
         import time as _t
