@@ -85,6 +85,11 @@ class Autoscaler:
         state = self.endpoint.call(
             self.gcs_addr, "gcs.get_autoscaler_state", {}, timeout=30
         )
+        # Cloud providers join instances to runtime nodes via node labels
+        # (gce.py registers a provider-id label through its startup script).
+        observe = getattr(self.provider, "observe_cluster_nodes", None)
+        if observe is not None:
+            observe(state["nodes"])
         # explicit requests (sdk.request_resources) ride the GCS KV
         explicit = self._explicit_requests()
         demands = list(explicit)

@@ -1,0 +1,286 @@
+"""GCE TPU node provider: launch/list/terminate against a recording fake
+transport, plus the full autoscaler reconcile loop driving mocked GCE calls
+end-to-end (reference behavior:
+python/ray/autoscaler/_private/gcp/node_provider.py)."""
+
+import json
+import threading
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    NodeTypeConfig,
+)
+from ray_tpu.autoscaler.gce import (
+    PROVIDER_LABEL,
+    GCEApiError,
+    GCENodeType,
+    GCETPUNodeProvider,
+)
+from ray_tpu.core.protocol import Endpoint
+
+
+class FakeGCE:
+    """Minimal fake of the two REST surfaces the provider drives."""
+
+    def __init__(self):
+        self.calls: list[tuple] = []
+        self.tpu_nodes: dict[str, dict] = {}  # name -> node resource
+        self.instances: dict[str, dict] = {}  # name -> instance resource
+        self.lock = threading.Lock()
+
+    def __call__(self, method, url, body=None):
+        with self.lock:
+            self.calls.append((method, url, body))
+            if "tpu.googleapis.com" in url:
+                return self._tpu(method, url, body)
+            return self._gce(method, url, body)
+
+    def _tpu(self, method, url, body):
+        if method == "POST":
+            name = url.split("nodeId=")[1]
+            self.tpu_nodes[name] = {
+                "name": f"projects/p/locations/z/nodes/{name}",
+                "state": "CREATING",
+                "labels": body.get("labels", {}),
+                "metadata": body.get("metadata", {}),
+                **{
+                    k: body[k]
+                    for k in ("acceleratorType", "acceleratorConfig")
+                    if k in body
+                },
+            }
+            return {"name": "operations/op-1"}
+        if method == "GET":
+            return {"nodes": list(self.tpu_nodes.values())}
+        if method == "DELETE":
+            name = url.rsplit("/", 1)[-1]
+            if name not in self.tpu_nodes:
+                raise GCEApiError(404, "not found")
+            del self.tpu_nodes[name]
+            return {"name": "operations/op-2"}
+        raise AssertionError(f"unexpected {method} {url}")
+
+    def _gce(self, method, url, body):
+        if method == "POST":
+            self.instances[body["name"]] = {
+                "name": body["name"],
+                "status": "PROVISIONING",
+                "labels": body.get("labels", {}),
+            }
+            return {"name": "op"}
+        if method == "GET":
+            return {"items": list(self.instances.values())}
+        if method == "DELETE":
+            name = url.rsplit("/", 1)[-1]
+            if name not in self.instances:
+                raise GCEApiError(404, "not found")
+            del self.instances[name]
+            return {}
+        raise AssertionError(f"unexpected {method} {url}")
+
+
+NODE_TYPES = {
+    "tpu-v5e-8": GCENodeType(
+        "tpu", accelerator_type="v5litepod-8", preemptible=True
+    ),
+    "cpu-worker": GCENodeType("compute", machine_type="n2-standard-4"),
+}
+
+
+def make_provider(fake=None):
+    fake = fake or FakeGCE()
+    return (
+        GCETPUNodeProvider(
+            "proj",
+            "us-central2-b",
+            "testcluster",
+            NODE_TYPES,
+            head_address="10.0.0.2:6379",
+            transport=fake,
+        ),
+        fake,
+    )
+
+
+def test_create_tpu_node_issues_expected_call():
+    provider, fake = make_provider()
+    pid = provider.create_node("tpu-v5e-8", {"TPU": 8.0}, {"zone": "b"})
+    method, url, body = fake.calls[0]
+    assert method == "POST"
+    assert f"nodeId={pid}" in url and "tpu.googleapis.com/v2" in url
+    assert body["acceleratorType"] == "v5litepod-8"
+    assert body["schedulingConfig"]["preemptible"] is True
+    assert body["labels"]["ray-cluster"] == "testcluster"
+    assert body["labels"]["ray-node-type"] == "tpu-v5e-8"
+    # The startup script must register the provider-id label the
+    # reconciler joins on.
+    script = body["metadata"]["startup-script"]
+    assert "raytpu start --address=10.0.0.2:6379" in script
+    assert json.dumps({PROVIDER_LABEL: pid}) in script
+
+
+def test_topology_config_form():
+    provider, fake = make_provider()
+    provider.node_types["tpu-4x4"] = GCENodeType(
+        "tpu", topology="4x4", accelerator_version="V5LITE_POD"
+    )
+    provider.create_node("tpu-4x4", {}, {})
+    body = fake.calls[0][2]
+    assert body["acceleratorConfig"] == {
+        "type": "V5LITE_POD",
+        "topology": "4x4",
+    }
+    assert "acceleratorType" not in body
+
+
+def test_nodes_listed_while_live_and_gone_when_terminal():
+    provider, fake = make_provider()
+    pid = provider.create_node("tpu-v5e-8", {}, {})
+    assert pid in provider.non_terminated_nodes()  # CREATING counts
+    fake.tpu_nodes[pid]["state"] = "READY"
+    assert pid in provider.non_terminated_nodes()
+    fake.tpu_nodes[pid]["state"] = "PREEMPTED"
+    # A node that listed live once and then went terminal must NOT be
+    # resurrected from creation memory — preempted capacity is gone and the
+    # reconciler needs to see that to launch a replacement.
+    assert pid not in provider.non_terminated_nodes()
+
+
+def test_eventual_consistency_window_counts_created_node():
+    provider, fake = make_provider()
+    pid = provider.create_node("cpu-worker", {}, {})
+    del fake.instances[pid]  # as if list lags the insert
+    nodes = provider.non_terminated_nodes()
+    assert nodes[pid]["node_type"] == "cpu-worker"
+
+
+def test_terminate_is_idempotent_on_404():
+    provider, fake = make_provider()
+    pid = provider.create_node("tpu-v5e-8", {}, {})
+    provider.terminate_node(pid)
+    provider.terminate_node(pid)  # second delete sees 404 -> swallowed
+    assert pid not in provider.non_terminated_nodes()
+
+
+def test_failed_delete_keeps_instance_visible_for_retry():
+    provider, fake = make_provider()
+    pid = provider.create_node("tpu-v5e-8", {}, {})
+    orig = fake._tpu
+
+    def failing_tpu(method, url, body):
+        if method == "DELETE":
+            raise GCEApiError(429, "quota")
+        return orig(method, url, body)
+
+    fake._tpu = failing_tpu
+    with pytest.raises(GCEApiError):
+        provider.terminate_node(pid)
+    # Still visible -> the reconciler will retry the terminate, not leak it.
+    assert pid in provider.non_terminated_nodes()
+    fake._tpu = orig
+    provider.terminate_node(pid)
+    assert pid not in provider.non_terminated_nodes()
+
+
+def test_observe_cluster_nodes_joins_by_label():
+    provider, _ = make_provider()
+    pid = provider.create_node("tpu-v5e-8", {}, {})
+    assert provider.cluster_node_id(pid) is None
+    provider.observe_cluster_nodes(
+        [{"node_id": "runtime-node-1", "labels": {PROVIDER_LABEL: pid}}]
+    )
+    assert provider.cluster_node_id(pid) == "runtime-node-1"
+    assert (
+        provider.non_terminated_nodes()[pid]["cluster_node_id"]
+        == "runtime-node-1"
+    )
+
+
+class StubGCS:
+    """A bare Endpoint answering just the RPCs reconcile_once makes —
+    the autoscaler sees a 'cluster' without any real nodes running."""
+
+    def __init__(self):
+        self.endpoint = Endpoint("stub-gcs")
+        self.nodes: list = []
+        self.pending: list = []
+        self.drained: list = []
+        self.endpoint.register("gcs.get_autoscaler_state", self._state)
+        self.endpoint.register("gcs.kv_get", self._kv_get)
+        self.endpoint.register("gcs.drain_node", self._drain)
+        self.addr = self.endpoint.start()
+
+    async def _state(self, conn, p):
+        return {"nodes": self.nodes, "pending": self.pending}
+
+    async def _kv_get(self, conn, p):
+        return None
+
+    async def _drain(self, conn, p):
+        self.drained.append(p["node_id"])
+        return True
+
+    def stop(self):
+        self.endpoint.stop()
+
+
+@pytest.fixture
+def stub_gcs():
+    gcs = StubGCS()
+    yield gcs
+    gcs.stop()
+
+
+def test_reconcile_launches_and_scales_down_via_mocked_gce(stub_gcs):
+    """E2E: pending demand -> TPU-VM create call; instance joins (by label)
+    -> no relaunch; long idle -> drain + DELETE call."""
+    provider, fake = make_provider()
+    autoscaler = Autoscaler(
+        AutoscalingConfig(
+            node_types={
+                "tpu-v5e-8": NodeTypeConfig(
+                    resources={"TPU": 8.0, "CPU": 8.0}, max_workers=2
+                )
+            },
+            idle_timeout_s=5.0,
+        ),
+        provider,
+        stub_gcs.addr,
+    )
+    try:
+        # Tick 1: unmet TPU demand -> exactly one launch.
+        stub_gcs.pending = [{"TPU": 8.0}]
+        result = autoscaler.reconcile_once()
+        assert len(result["launched"]) == 1
+        pid = result["launched"][0]
+        assert pid in fake.tpu_nodes
+
+        # Tick 2: instance still CREATING counts as capacity -> no relaunch.
+        result = autoscaler.reconcile_once()
+        assert result["launched"] == []
+
+        # Instance becomes READY and its runtime node joins with the
+        # provider-id label (what the startup script arranges).
+        fake.tpu_nodes[pid]["state"] = "READY"
+        stub_gcs.pending = []
+        stub_gcs.nodes = [
+            {
+                "node_id": "rt-1",
+                "alive": True,
+                "total": {"TPU": 8.0, "CPU": 8.0},
+                "available": {"TPU": 8.0, "CPU": 8.0},
+                "labels": {PROVIDER_LABEL: pid},
+                "pending_demand": [],
+                "idle_s": 60.0,
+            }
+        ]
+        # Tick 3: idle past timeout -> drained via GCS then deleted via GCE.
+        result = autoscaler.reconcile_once()
+        assert result["terminated"] == [pid]
+        assert stub_gcs.drained == ["rt-1"]
+        assert pid not in fake.tpu_nodes
+    finally:
+        autoscaler.stop()
