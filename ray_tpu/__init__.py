@@ -34,6 +34,7 @@ _CORE_API = (
     "available_resources",
     "get_runtime_context",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
 )
 

@@ -303,13 +303,26 @@ class GCETPUNodeProvider(NodeProvider):
                 return cfg.kind
         return "compute"
 
+    def _list_all(self, base_url: str, items_key: str) -> list:
+        """Follow nextPageToken to exhaustion — a cluster bigger than one
+        API page must not have its tail misread as dead capacity."""
+        out: list = []
+        token = None
+        while True:
+            sep = "&" if "?" in base_url else "?"
+            url = f"{base_url}{sep}pageToken={token}" if token else base_url
+            listing = self.transport("GET", url)
+            out.extend(listing.get(items_key, []))
+            token = listing.get("nextPageToken")
+            if not token:
+                return out
+
     def non_terminated_nodes(self) -> dict:
         live: dict[str, dict] = {}  # name -> labels (from the live listings)
         label_filter = f"labels.ray-cluster={self.cluster}"
         kinds = {c.kind for c in self.node_types.values()}
         if "tpu" in kinds:
-            listing = self.transport("GET", f"{self._tpu_base()}/nodes")
-            for node in listing.get("nodes", []):
+            for node in self._list_all(f"{self._tpu_base()}/nodes", "nodes"):
                 name = node.get("name", "").rsplit("/", 1)[-1]
                 lbls = node.get("labels", {})
                 if lbls.get("ray-cluster") != self.cluster:
@@ -318,11 +331,10 @@ class GCETPUNodeProvider(NodeProvider):
                     continue
                 live[name] = lbls
         if "compute" in kinds:
-            listing = self.transport(
-                "GET",
+            for inst in self._list_all(
                 f"{self._gce_base()}/instances?filter={label_filter}",
-            )
-            for inst in listing.get("items", []):
+                "items",
+            ):
                 name = inst.get("name", "")
                 if inst.get("status") not in _GCE_LIVE_STATES:
                     continue

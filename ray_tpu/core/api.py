@@ -24,6 +24,7 @@ from ray_tpu.core.errors import RayTpuError
 from ray_tpu.core.gcs import GcsServer
 from ray_tpu.core.node import NodeManager
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.streaming import ObjectRefGenerator
 
 __all__ = [
     "init",
@@ -42,6 +43,7 @@ __all__ = [
     "available_resources",
     "get_runtime_context",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
 ]
 
@@ -354,7 +356,9 @@ class RemoteFunction:
             pg=pg,
             runtime_env=_runtime_env_from_opts(opts, worker),
         )
-        return refs[0] if opts.get("num_returns", 1) == 1 else refs
+        num_returns = opts.get("num_returns", 1)
+        # 1 -> the ref; "streaming" -> the ObjectRefGenerator; n -> ref list
+        return refs[0] if num_returns in (1, "streaming") else refs
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -469,7 +473,7 @@ class ActorHandle:
             raise AttributeError(name)
         return ActorMethod(self, name)
 
-    def _invoke(self, method: str, args, kwargs, num_returns: int = 1):
+    def _invoke(self, method: str, args, kwargs, num_returns=1):
         worker = _require_worker()
         refs = worker.submit_actor_task(
             self._actor_id,
@@ -480,7 +484,7 @@ class ActorHandle:
             name=f"{self._class_name}.{method}",
             max_task_retries=self._max_task_retries,
         )
-        return refs[0] if num_returns == 1 else refs
+        return refs[0] if num_returns in (1, "streaming") else refs
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id[:12]}…)"
@@ -619,7 +623,7 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
         worker.gcs.call("kill_actor", payload)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+def cancel(ref, *, force: bool = False) -> None:
     """Cancel the task producing ``ref`` (reference: worker.py:3302).
 
     Queued tasks are removed from the submission queue; running tasks get a
@@ -627,7 +631,10 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     thread). ``force=True`` kills the executing worker process instead.
     ``get()`` on the ref then raises TaskCancelledError. Cancelling an
     already-finished task is a no-op; actor tasks are not cancellable (kill
-    the actor instead)."""
+    the actor instead). An ``ObjectRefGenerator`` may be passed to cancel
+    its streaming task mid-stream."""
+    if isinstance(ref, ObjectRefGenerator):
+        ref = ref.completed()
     _require_worker().cancel(ref, force=force)
 
 

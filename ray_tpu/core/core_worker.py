@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import contextlib
+import inspect
 import os
 import threading
 import time
@@ -70,6 +72,7 @@ class TaskSpec:
     cancelled: bool = False  # set by cancel(); suppresses push and retries
     completed: bool = False  # finished at least once (spec kept for lineage)
     lineage_attempts: int = 0  # reconstruction resubmissions so far
+    streaming: bool = False  # num_returns="streaming": yields stream items
     # actor fields
     actor_id: str | None = None
     method: str | None = None
@@ -144,6 +147,9 @@ class CoreWorker:
         self._actor_semaphore: asyncio.Semaphore | None = None
         self._actor_seq: dict[str, int] = {}  # caller -> next expected seq
         self._actor_buffer: dict[tuple, Any] = {}  # (caller, seq) -> pending
+
+        # owner side: streaming tasks (task_id -> StreamState)
+        self._streams: dict[str, Any] = {}
 
         # actor-client side: per-actor ordered submitters
         self._actor_submitters: dict[str, _ActorSubmitter] = {}
@@ -751,7 +757,7 @@ class CoreWorker:
         kwargs: dict,
         *,
         name: str,
-        num_returns: int = 1,
+        num_returns: "int | str" = 1,
         resources: dict | None = None,
         max_retries: int | None = None,
         label_selector: dict | None = None,
@@ -760,14 +766,18 @@ class CoreWorker:
         func_payload: bytes | None = None,
         pg: tuple | None = None,
         runtime_env: dict | None = None,
-    ) -> list[ObjectRef]:
+    ) -> list:
         # NB: an explicitly empty dict means "no resource demand" (e.g.
         # num_cpus=0 probes) — only None gets the 1-CPU default.
         resources = dict(resources) if resources is not None else {"CPU": 1.0}
         if max_retries is None:
             max_retries = GLOBAL_CONFIG.default_max_retries
+        streaming = num_returns == "streaming"
         task_id = TaskID.random().hex()
-        return_ids = [ObjectID.random().hex() for _ in range(num_returns)]
+        # A streaming task has ONE fixed return: the completion sentinel
+        # (stream items get dynamic, deterministic ids as they arrive).
+        n_returns = 1 if streaming else num_returns
+        return_ids = [ObjectID.random().hex() for _ in range(n_returns)]
         if func_payload is None:
             func_payload = cloudpickle.dumps(func)
         spec = TaskSpec(
@@ -784,6 +794,7 @@ class CoreWorker:
             policy=policy,
             pg=pg,
             runtime_env=dict(runtime_env or {}),
+            streaming=streaming,
         )
         refs = [
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, name)
@@ -797,6 +808,8 @@ class CoreWorker:
         self._task_event(
             task_id, "PENDING_SCHEDULING", name=name, kind="task", **tfields
         )
+        if streaming:
+            refs = [self._make_stream(task_id, refs[0])]
         self._run_on_loop(self._enqueue_task(spec))
         return refs
 
@@ -943,6 +956,7 @@ class CoreWorker:
             "owner_addr": tuple(self.endpoint.address),
             "pg": spec.pg,
             "trace_ctx": spec.trace_ctx,
+            "streaming": spec.streaming,
         }
         self._inflight_push[spec.task_id] = tuple(grant["worker_addr"])
         self._task_event(
@@ -1031,6 +1045,9 @@ class CoreWorker:
         # (reference: task_manager.h:229 ResubmitTask; GC in _maybe_free).
         spec.completed = True
         failed = any(r[0] == "error" for r in results)
+        if spec.streaming:
+            err = next((r[1] for r in results if r[0] == "error"), None)
+            self._finish_stream(spec.task_id, err)
         self._task_event(
             spec.task_id,
             "FAILED" if failed else "FINISHED",
@@ -1061,9 +1078,136 @@ class CoreWorker:
         for oid in spec.return_ids:
             self.owner_store.put_error(oid, error)
         self._task_specs.pop(spec.task_id, None)
+        if spec.streaming:
+            self._finish_stream(spec.task_id, error)
         self._task_event(
             spec.task_id, "FAILED", name=spec.name, error=str(error)[:500]
         )
+
+    # -- streaming (owner side) ----------------------------------------------
+    # Reference: python/ray/_private/object_ref_generator.py:32 + the
+    # streaming-generator item-report protocol in src/ray/core_worker.
+
+    def _make_stream(self, task_id: str, sentinel_ref: ObjectRef):
+        from ray_tpu.core.streaming import ObjectRefGenerator, StreamState
+
+        self._streams[task_id] = StreamState()
+        return ObjectRefGenerator(task_id, self, sentinel_ref)
+
+    def _finish_stream(
+        self, task_id: str, error: Exception | None
+    ) -> None:
+        stream = self._streams.get(task_id)
+        if stream is None or stream.done:
+            return
+        stream.error = error
+        stream.done = True
+        stream.wake()
+
+    async def _h_owner_stream_item(self, conn, p):
+        """One yielded item from an executing streaming task. The reply is
+        the producer's permission to continue (backpressure: at most one
+        unacked item in flight per task).
+
+        Re-reports are IDEMPOTENT by design (deterministic item oids): a
+        lineage-reconstruction rerun re-reports indexes the stream already
+        delivered, and those must refresh the object's location (the old
+        copy died with its node) rather than be discarded — and the rerun
+        must not be stopped early, or the lost item never gets re-created."""
+        from ray_tpu.core.streaming import stream_item_oid
+
+        task_id, index = p["task_id"], p["index"]
+        stream = self._streams.get(task_id)
+        spec = self._task_specs.get(task_id)
+        reconstructing = bool(spec is not None and spec.lineage_attempts)
+        oid = stream_item_oid(task_id, index)
+        is_new = (
+            stream is not None
+            and not stream.done
+            and index == len(stream.item_refs)
+        )
+        existing = self.owner_store.objects.get(oid)
+        if not is_new and existing is None:
+            # Duplicate report of an item nobody holds anymore: skip it, and
+            # stop the producer outright when no reconstruction is running
+            # and no live stream wants future items.
+            ended = stream is None or stream.done
+            return {"accepted": False, "stop": ended and not reconstructing}
+        obj = self.owner_store.ensure(oid)
+        if is_new:
+            obj.local_refs += 1
+            obj.producing_task = task_id
+            obj.actor_task = True  # items are not individually cancellable
+        res = p["result"]
+        if res[0] == "inline":
+            self.owner_store.put_inline(oid, res[1])
+        else:  # ("location", node_id, size, oid)
+            self.owner_store.put_location(oid, res[1], res[2])
+        if is_new:
+            stream.item_refs.append(
+                ObjectRef(
+                    ObjectID.from_hex(oid),
+                    self.endpoint.address,
+                    spec.name if spec else "stream_item",
+                )
+            )
+            stream.wake()
+        return {"accepted": True, "stop": False}
+
+    async def _stream_next_async(self, task_id: str, cursor: int):
+        """The cursor-th item ref, waiting for it to arrive; None at a clean
+        end of stream; raises the task's error at a failed one."""
+        stream = self._streams.get(task_id)
+        if stream is None:
+            raise RayTpuError(
+                f"stream for task {task_id[:8]} is gone (generator dropped "
+                "or owner restarted)"
+            )
+        while True:
+            if cursor < len(stream.item_refs):
+                return stream.item_refs[cursor]
+            if stream.done:
+                if stream.error is not None:
+                    raise stream.error
+                return None
+            ev = asyncio.Event()
+            stream.waiters.append(ev)
+            await ev.wait()
+
+    async def stream_next_async(self, task_id: str, cursor: int):
+        return await self._stream_next_async(task_id, cursor)
+
+    def stream_next(self, task_id: str, cursor: int):
+        if self.on_endpoint_loop():
+            raise RuntimeError(
+                "blocking stream iteration on the endpoint loop would "
+                "deadlock; use `async for` here"
+            )
+        return self.endpoint.submit(
+            self._stream_next_async(task_id, cursor)
+        ).result()
+
+    def drop_stream(self, task_id: str) -> None:
+        """Generator GC: forget the stream. Item refs the user still holds
+        stay valid (their own ref counts keep the objects alive)."""
+        if self._stopped:
+            return
+        try:
+            self.endpoint.submit(self._drop_stream_async(task_id))
+        except Exception:
+            pass
+
+    async def _drop_stream_async(self, task_id: str) -> None:
+        stream = self._streams.pop(task_id, None)
+        if stream is None:
+            return
+        stream.done = True
+        stream.wake()
+        # Just drop the list: each item ObjectRef's own __del__ (the
+        # ref-deleted hook) releases its count once the user also lets go —
+        # an explicit release here would double-decrement refs the user
+        # still holds.
+        stream.item_refs.clear()
 
     # -- cancellation --------------------------------------------------------
 
@@ -1180,12 +1324,14 @@ class CoreWorker:
         args: tuple,
         kwargs: dict,
         *,
-        num_returns: int = 1,
+        num_returns: "int | str" = 1,
         name: str = "",
         max_task_retries: int = 0,
-    ) -> list[ObjectRef]:
+    ) -> list:
+        streaming = num_returns == "streaming"
         task_id = TaskID.random().hex()
-        return_ids = [ObjectID.random().hex() for _ in range(num_returns)]
+        n_returns = 1 if streaming else num_returns
+        return_ids = [ObjectID.random().hex() for _ in range(n_returns)]
         spec = TaskSpec(
             task_id=task_id,
             name=name or method,
@@ -1197,11 +1343,14 @@ class CoreWorker:
             retries_left=max_task_retries,
             actor_id=actor_id,
             method=method,
+            streaming=streaming,
         )
         refs = [
             ObjectRef(ObjectID.from_hex(oid), self.endpoint.address, spec.name)
             for oid in return_ids
         ]
+        if streaming:
+            refs = [self._make_stream(task_id, refs[0])]
         from ray_tpu.util import tracing
 
         tfields = tracing.submission_fields()
@@ -1368,41 +1517,19 @@ class CoreWorker:
         task_id = p.get("task_id")
 
         def run():
-            with self._cancel_lock:
-                if task_id in self._cancelled_tasks:
-                    # cancel arrived before execution started (e.g. during
-                    # the arg-resolve window) — never run the fn.
-                    raise TaskCancelledError(f"task {p['name']} cancelled")
-                self._running_tasks[task_id] = threading.get_ident()
-            try:
+            with self._sync_task_slot(task_id, p["name"]):
                 from ray_tpu.util import tracing
 
                 with tracing.execution_scope(p.get("trace_ctx")):
                     with _bind_ambient_pg(pginfo):
                         return func(*args, **kwargs)
-            finally:
-                with self._cancel_lock:
-                    self._running_tasks.pop(task_id, None)
-                    absorb = self._interrupt_sent == task_id
-                    if absorb:
-                        self._interrupt_sent = None
-                if absorb:
-                    # An async exception was sent for THIS task but may not
-                    # have fired inside the fn (it races completion). Absorb
-                    # it here — if it escaped run(), it would kill the
-                    # executor pool thread or poison the next task.
-                    try:
-                        for _ in range(200_000):
-                            pass
-                    except TaskCancelledError:
-                        pass
-                done = self._interrupt_done.pop(task_id, None)
-                if done is not None:
-                    # ACK to the waiting cancel_task handler: the interrupt
-                    # resolved (fired inside the fn, or was absorbed above).
-                    done.set()
 
         try:
+            if p.get("streaming"):
+                results = await self._execute_streaming(
+                    p, func, args, kwargs, pginfo, self._executor
+                )
+                return {"results": results, "exec": self._exec_span(t_exec0)}
             if asyncio.iscoroutinefunction(func):
                 with self._cancel_lock:
                     if task_id in self._cancelled_tasks:
@@ -1435,6 +1562,181 @@ class CoreWorker:
         finally:
             with self._cancel_lock:
                 self._cancelled_tasks.discard(task_id)
+
+    @contextlib.contextmanager
+    def _sync_task_slot(self, task_id, name, register: bool = True):
+        """Executor-thread bracket for one sync task: cancel-flag check +
+        interrupt registration on entry; async-exception absorption and the
+        cancel-handler ACK on exit (see _h_worker_cancel_task)."""
+        if not register:
+            yield
+            return
+        with self._cancel_lock:
+            if task_id in self._cancelled_tasks:
+                # cancel arrived before execution started (e.g. during
+                # the arg-resolve window) — never run the fn.
+                raise TaskCancelledError(f"task {name} cancelled")
+            self._running_tasks[task_id] = threading.get_ident()
+        try:
+            yield
+        finally:
+            with self._cancel_lock:
+                self._running_tasks.pop(task_id, None)
+                absorb = self._interrupt_sent == task_id
+                if absorb:
+                    self._interrupt_sent = None
+            if absorb:
+                # An async exception was sent for THIS task but may not
+                # have fired inside the fn (it races completion). Absorb
+                # it here — if it escaped, it would kill the executor
+                # pool thread or poison the next task.
+                try:
+                    for _ in range(200_000):
+                        pass
+                except TaskCancelledError:
+                    pass
+            done = self._interrupt_done.pop(task_id, None)
+            if done is not None:
+                # ACK to the waiting cancel_task handler: the interrupt
+                # resolved (fired inside the fn, or was absorbed above).
+                done.set()
+
+    # -- streaming (executor side) -------------------------------------------
+
+    async def _report_stream_item(self, p, index: int, value) -> bool:
+        """Encode + report one yielded item to the owner; the ack is the
+        license to produce the next one (backpressure). False = owner says
+        stop (generator dropped or stream already ended)."""
+        from ray_tpu.core.streaming import stream_item_oid
+
+        oid = stream_item_oid(p["task_id"], index)
+        res = self._encode_one(oid, value)
+        if res[0] == "location":
+            await self.endpoint.acall(
+                self.node_addr,
+                "node.object_created",
+                {"oid": oid, "size": res[2]},
+            )
+        reply = await self.endpoint.acall(
+            tuple(p["owner_addr"]),
+            "owner.stream_item",
+            {"task_id": p["task_id"], "index": index, "result": res},
+        )
+        return not reply.get("stop")
+
+    async def _execute_streaming(
+        self, p, func, args, kwargs, pginfo, executor, semaphore=None
+    ) -> list:
+        """Drive a streaming task: iterate the user generator, report each
+        item, and return the sentinel results (item count on success).
+
+        Supports sync/async generator *functions*, plus plain/coroutine
+        functions that RETURN a (sync or async) generator — the shape Serve
+        replicas produce — falling back to a single-item stream for a plain
+        value."""
+        from ray_tpu.util.placement_group import _bind_ambient_pg
+
+        loop = asyncio.get_running_loop()
+        task_id = p.get("task_id")
+        register = p.get("actor_id") is None  # actor tasks aren't cancellable
+
+        def drive_sync_gen(gen_factory):
+            def run_gen():
+                with self._sync_task_slot(task_id, p["name"], register):
+                    from ray_tpu.util import tracing
+
+                    with tracing.execution_scope(p.get("trace_ctx")):
+                        with _bind_ambient_pg(pginfo):
+                            gen = gen_factory()
+                            count = 0
+                            for value in gen:
+                                keep_going = asyncio.run_coroutine_threadsafe(
+                                    self._report_stream_item(p, count, value),
+                                    loop,
+                                ).result()
+                                count += 1
+                                if not keep_going:
+                                    gen.close()
+                                    break
+                            return count
+
+            return loop.run_in_executor(executor, run_gen)
+
+        async def drive_async_gen(agen) -> int:
+            count = 0
+            with _bind_ambient_pg(pginfo):
+                try:
+                    async for value in agen:
+                        if not await self._report_stream_item(
+                            p, count, value
+                        ):
+                            count += 1
+                            await agen.aclose()
+                            break
+                        count += 1
+                except asyncio.CancelledError:
+                    raise TaskCancelledError(
+                        f"task {p['name']} cancelled"
+                    ) from None
+            return count
+
+        async def tracked(coro) -> int:
+            """Register the driving coroutine so cancel() can interrupt an
+            async streaming task mid-stream."""
+            coro_task = asyncio.ensure_future(coro)
+            if register:
+                with self._cancel_lock:
+                    if task_id in self._cancelled_tasks:
+                        coro_task.cancel()
+                    self._running_async[task_id] = coro_task
+            try:
+                return await coro_task
+            except asyncio.CancelledError:
+                raise TaskCancelledError(
+                    f"task {p['name']} cancelled"
+                ) from None
+            finally:
+                if register:
+                    self._running_async.pop(task_id, None)
+
+        async def stream_result_value(result) -> int:
+            """Stream whatever a non-generator fn produced: an async
+            generator object, a sync generator/iterator, or a single
+            value (single-chunk stream)."""
+            if inspect.isasyncgen(result):
+                return await tracked(drive_async_gen(result))
+            if inspect.isgenerator(result):
+                # Same bracketed driver as a generator fn: the body runs
+                # lazily here, so it needs the task slot (cancellability),
+                # trace scope, and ambient pg just the same.
+                return await drive_sync_gen(lambda: result)
+            await self._report_stream_item(p, 0, result)
+            return 1
+
+        gate = semaphore if semaphore is not None else contextlib.nullcontext()
+        if inspect.isasyncgenfunction(func):
+            async with gate:
+                count = await tracked(drive_async_gen(func(*args, **kwargs)))
+        elif inspect.isgeneratorfunction(func):
+            count = await drive_sync_gen(lambda: func(*args, **kwargs))
+        elif asyncio.iscoroutinefunction(func):
+            # e.g. an async handler that returns an async generator object
+            async with gate:
+                result = await tracked(func(*args, **kwargs))
+                count = await stream_result_value(result)
+        else:
+            def run_plain():
+                with self._sync_task_slot(task_id, p["name"], register):
+                    with _bind_ambient_pg(pginfo):
+                        return func(*args, **kwargs)
+
+            result = await loop.run_in_executor(executor, run_plain)
+            count = await stream_result_value(result)
+        # Sentinel: the item count (kept internal; consumers see the
+        # generator, not this object).
+        return self._encode_results(
+            {"return_ids": p["return_ids"], "name": p["name"]}, count
+        )
 
     async def _execute_actor_task(self, p) -> dict:
         # Per-caller ordering: calls START in sequence-number order (the
@@ -1484,6 +1786,26 @@ class CoreWorker:
                         return method(*args, **kwargs)
 
             try:
+                if p.get("streaming"):
+                    advance()
+                    results = await self._execute_streaming(
+                        p,
+                        method,
+                        args,
+                        kwargs,
+                        pginfo,
+                        self._executor,
+                        semaphore=(
+                            self._actor_semaphore
+                            if asyncio.iscoroutinefunction(method)
+                            or inspect.isasyncgenfunction(method)
+                            else None
+                        ),
+                    )
+                    return {
+                        "results": results,
+                        "exec": self._exec_span(t_exec0),
+                    }
                 if asyncio.iscoroutinefunction(method):
                     advance()  # start-order satisfied; allow interleaving
                     async with self._actor_semaphore:
@@ -1541,22 +1863,24 @@ class CoreWorker:
                     f"task {p['name']} returned {len(values)} values, "
                     f"expected {len(return_ids)}"
                 )
-        out = []
-        for oid, value in zip(return_ids, values):
-            payload, _ = serialization.dumps_oob(value)
-            framed = isinstance(payload, serialization.FramedPayload)
-            size = payload.nbytes if framed else len(payload)
-            if size <= GLOBAL_CONFIG.max_inline_object_bytes:
-                out.append(
-                    ("inline", payload.to_bytes() if framed else payload)
-                )
-            elif framed:
-                self.shm_writer.write_framed(oid, payload)
-                out.append(("location", self.node_id, size, oid))
-            else:
-                self.shm_writer.write(oid, payload)
-                out.append(("location", self.node_id, size, oid))
-        return out
+        return [
+            self._encode_one(oid, value)
+            for oid, value in zip(return_ids, values)
+        ]
+
+    def _encode_one(self, oid: str, value) -> tuple:
+        """("inline", bytes) or ("location", node_id, size, oid) — small
+        values ride the reply; big ones are sealed into this node's shm."""
+        payload, _ = serialization.dumps_oob(value)
+        framed = isinstance(payload, serialization.FramedPayload)
+        size = payload.nbytes if framed else len(payload)
+        if size <= GLOBAL_CONFIG.max_inline_object_bytes:
+            return ("inline", payload.to_bytes() if framed else payload)
+        if framed:
+            self.shm_writer.write_framed(oid, payload)
+        else:
+            self.shm_writer.write(oid, payload)
+        return ("location", self.node_id, size, oid)
 
     async def _flush_created(self, results: list) -> None:
         """Tell our node about sealed shm objects BEFORE the reply releases
@@ -1725,6 +2049,7 @@ class _ActorSubmitter:
             "return_ids": spec.return_ids,
             "owner_addr": tuple(self.worker.endpoint.address),
             "trace_ctx": spec.trace_ctx,
+            "streaming": spec.streaming,
         }
 
     async def _on_reply(self, spec: TaskSpec, fut: asyncio.Future) -> None:

@@ -32,6 +32,10 @@ class LLMServer:
         self._counter = itertools.count()
         self._finished: dict[str, object] = {}  # request_id -> _Request
         self._events: dict[str, asyncio.Event] = {}
+        # Token streaming: request_id -> queue of decoded token ids (None =
+        # end of stream), fed by the pump after each decode step.
+        self._token_queues: dict[str, asyncio.Queue] = {}
+        self._delivered: dict[str, int] = {}  # tokens pushed so far
         # Thread-safety: the engine is touched ONLY by the pump's executor
         # thread. The event loop enqueues admissions here; the pump drains
         # them into the engine at step boundaries (a direct add_request from
@@ -55,6 +59,23 @@ class LLMServer:
         more = self.engine.has_unfinished()
         return finished, more
 
+    def _push_new_tokens(self, finished: list) -> None:
+        """Between steps (engine quiescent): forward newly generated tokens
+        of streaming requests to their queues; None terminates a stream."""
+        live = list(self.engine.requests.values()) + list(finished)
+        for req in live:
+            q = self._token_queues.get(req.request_id)
+            if q is None:
+                continue
+            sent = self._delivered.get(req.request_id, 0)
+            for tok in req.generated[sent:]:
+                q.put_nowait(tok)
+            self._delivered[req.request_id] = len(req.generated)
+        for req in finished:
+            q = self._token_queues.get(req.request_id)
+            if q is not None:
+                q.put_nowait(None)
+
     async def _pump(self) -> None:
         """Engine loop: steps while work exists, yields to the event loop
         between steps so new requests can join the batch."""
@@ -63,6 +84,7 @@ class LLMServer:
             finished, more = await loop.run_in_executor(
                 None, self._step_with_admissions
             )
+            self._push_new_tokens(finished)
             for req in finished:
                 self._finished[req.request_id] = req
                 ev = self._events.pop(req.request_id, None)
@@ -72,12 +94,16 @@ class LLMServer:
                 if not more and not self._pending:
                     return
 
-    async def _generate(self, prompt, sampling: SamplingParams) -> dict:
+    def _admit(self, prompt, sampling: SamplingParams) -> str:
         rid = f"req-{next(self._counter)}"
-        ev = asyncio.Event()
-        self._events[rid] = ev
         with self._pending_lock:
             self._pending.append((rid, prompt, sampling))
+        return rid
+
+    async def _generate(self, prompt, sampling: SamplingParams) -> dict:
+        rid = self._admit(prompt, sampling)
+        ev = asyncio.Event()
+        self._events[rid] = ev
         self._ensure_pump()
         await ev.wait()
         req = self._finished.pop(rid)
@@ -88,6 +114,31 @@ class LLMServer:
             "num_generated": len(req.generated),
         }
 
+    async def _stream_tokens(self, prompt, sampling: SamplingParams):
+        """Async generator of decoded text pieces, one per generated token,
+        emitted as each decode step lands (true token streaming: the chip is
+        still decoding later tokens while early ones are on the wire)."""
+        rid = self._admit(prompt, sampling)
+        q: asyncio.Queue = asyncio.Queue()
+        self._token_queues[rid] = q
+        ev = asyncio.Event()
+        self._events[rid] = ev
+        self._ensure_pump()
+        try:
+            while True:
+                tok = await q.get()
+                if tok is None:
+                    break
+                req = self.engine.requests.get(rid) or self._finished.get(rid)
+                if req is not None and tok == req.stop_token:
+                    continue
+                yield self.engine.tokenizer.decode([tok])
+        finally:
+            self._token_queues.pop(rid, None)
+            self._delivered.pop(rid, None)
+            self._finished.pop(rid, None)
+            self._events.pop(rid, None)
+
     @staticmethod
     def _sampling(body: dict) -> SamplingParams:
         return SamplingParams(
@@ -95,7 +146,60 @@ class LLMServer:
             temperature=float(body.get("temperature", 0.0)),
         )
 
-    async def __call__(self, request: dict) -> dict:
+    def _stream_chunks(self, prompt, body: dict, created: int, chat: bool):
+        """OpenAI-convention chunk objects (chat.completion.chunk /
+        text_completion chunks), one per token, + a finish_reason tail."""
+
+        async def chunks():
+            idx = 0
+            async for piece in self._stream_tokens(
+                prompt, self._sampling(body)
+            ):
+                idx += 1
+                if chat:
+                    yield {
+                        "id": "chatcmpl-raytpu",
+                        "object": "chat.completion.chunk",
+                        "created": created,
+                        "model": self.config.model_id,
+                        "choices": [
+                            {
+                                "index": 0,
+                                "delta": {"content": piece},
+                                "finish_reason": None,
+                            }
+                        ],
+                    }
+                else:
+                    yield {
+                        "id": "cmpl-raytpu",
+                        "object": "text_completion",
+                        "created": created,
+                        "model": self.config.model_id,
+                        "choices": [
+                            {"index": 0, "text": piece,
+                             "finish_reason": None}
+                        ],
+                    }
+            tail_choice = (
+                {"index": 0, "delta": {}, "finish_reason": "stop"}
+                if chat
+                else {"index": 0, "text": "", "finish_reason": "stop"}
+            )
+            yield {
+                "id": "chatcmpl-raytpu" if chat else "cmpl-raytpu",
+                "object": (
+                    "chat.completion.chunk" if chat else "text_completion"
+                ),
+                "created": created,
+                "model": self.config.model_id,
+                "choices": [tail_choice],
+                "usage": {"completion_tokens": idx},
+            }
+
+        return chunks()
+
+    async def __call__(self, request: dict):
         path = request.get("path", "")
         body = request.get("body") or {}
         if not isinstance(body, dict):
@@ -107,6 +211,8 @@ class LLMServer:
                 f"{m.get('role', 'user')}: {m.get('content', '')}"
                 for m in msgs
             )
+            if body.get("stream"):
+                return self._stream_chunks(prompt, body, created, chat=True)
             out = await self._generate(prompt, self._sampling(body))
             return {
                 "id": "chatcmpl-raytpu",
@@ -127,6 +233,8 @@ class LLMServer:
             }
         # default: completions
         prompt = body.get("prompt", "")
+        if body.get("stream"):
+            return self._stream_chunks(prompt, body, created, chat=False)
         out = await self._generate(prompt, self._sampling(body))
         return {
             "id": "cmpl-raytpu",

@@ -8,20 +8,24 @@ model composition passes handles between replicas).
 
 from __future__ import annotations
 
-import concurrent.futures
-
 from ray_tpu.core import api as core_api
 from ray_tpu.serve.router import Router
 
 
 class DeploymentHandle:
-    def __init__(self, deployment: str, method: str = "__call__"):
+    def __init__(
+        self, deployment: str, method: str = "__call__", stream: bool = False
+    ):
         self._deployment = deployment
         self._method = method
+        self._stream = stream
         self._router: Router | None = None
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._deployment, self._method))
+        return (
+            DeploymentHandle,
+            (self._deployment, self._method, self._stream),
+        )
 
     async def _ensure_router(self) -> Router:
         if self._router is None:
@@ -32,17 +36,61 @@ class DeploymentHandle:
         return self._router
 
     def method(self, name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self._deployment, name)
+        h = DeploymentHandle(self._deployment, name, self._stream)
         h._router = self._router  # share routing state
         return h
 
+    def options(self, *, stream: bool | None = None) -> "DeploymentHandle":
+        """``stream=True``: remote() / remote_async() return an iterator of
+        response chunks instead of one value (reference:
+        serve/handle.py DeploymentHandle.options(stream=True))."""
+        h = DeploymentHandle(
+            self._deployment,
+            self._method,
+            self._stream if stream is None else stream,
+        )
+        h._router = self._router
+        return h
+
     async def remote_async(self, *args, **kwargs):
-        """Await the result (for async contexts: replicas, proxies)."""
+        """Await the result (for async contexts: replicas, proxies). With
+        stream=True this returns an async generator of chunks."""
         router = await self._ensure_router()
+        if self._stream:
+            return router.route_stream(self._method, args, kwargs)
         return await router.route(self._method, args, kwargs)
 
-    def remote(self, *args, **kwargs) -> concurrent.futures.Future:
-        """Route from a sync context (driver); returns a Future whose
-        .result() is the response value."""
+    def remote(self, *args, **kwargs):
+        """Route from a sync context (driver). Plain: a Future whose
+        .result() is the response value. stream=True: a blocking iterator
+        of response chunks."""
         worker = core_api._require_worker()
+        if self._stream:
+            return _SyncChunkIterator(worker, self, args, kwargs)
         return worker.endpoint.submit(self.remote_async(*args, **kwargs))
+
+
+class _SyncChunkIterator:
+    """Drives an async chunk generator from a non-loop thread."""
+
+    def __init__(self, worker, handle: DeploymentHandle, args, kwargs):
+        self._worker = worker
+        self._agen = None
+        self._handle = handle
+        self._call = (args, kwargs)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._agen is None:
+            args, kwargs = self._call
+            self._agen = self._worker.endpoint.submit(
+                self._handle.remote_async(*args, **kwargs)
+            ).result(timeout=60)
+        try:
+            return self._worker.endpoint.submit(
+                self._agen.__anext__()
+            ).result()
+        except StopAsyncIteration:
+            raise StopIteration

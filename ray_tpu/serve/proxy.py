@@ -67,8 +67,14 @@ class HTTPProxyActor:
                     body = await reader.readexactly(
                         int(headers["content-length"])
                     )
+                parsed = self._parse_body(body)
+                if self._wants_stream(headers, parsed):
+                    await self._route_stream(
+                        writer, method, target, headers, parsed
+                    )
+                    return  # streamed responses close the connection
                 status, payload = await self._route(
-                    method, target, headers, body
+                    method, target, headers, parsed
                 )
                 keep = (
                     headers.get("connection", "keep-alive").lower() != "close"
@@ -84,20 +90,14 @@ class HTTPProxyActor:
             except Exception:
                 pass
 
-    async def _route(
-        self, method: str, target: str, headers: dict, body: bytes
-    ):
-        from ray_tpu.serve.router import DeploymentNotFoundError
-
+    @staticmethod
+    def _parse(method: str, target: str, headers: dict, parsed):
+        """(request_dict, deployment, error): the user-callable request shape
+        shared by the buffered and streaming paths."""
         url = urlparse(target)
         parts = [p for p in url.path.split("/") if p]
         if not parts:
-            return 404, {"error": "no deployment in path"}
-        deployment = parts[0]
-        try:
-            parsed = json.loads(body) if body else None
-        except ValueError:
-            parsed = body.decode("utf-8", "replace")
+            return None, None, "no deployment in path"
         request = {
             "method": method,
             "path": "/" + "/".join(parts[1:]),
@@ -105,6 +105,18 @@ class HTTPProxyActor:
             "headers": dict(headers),
             "body": parsed,
         }
+        return request, parts[0], None
+
+    async def _route(
+        self, method: str, target: str, headers: dict, parsed
+    ):
+        from ray_tpu.serve.router import DeploymentNotFoundError
+
+        request, deployment, err = self._parse(
+            method, target, headers, parsed
+        )
+        if err is not None:
+            return 404, {"error": err}
         try:
             result = await self._handle_for(deployment).remote_async(request)
             return 200, result
@@ -112,6 +124,83 @@ class HTTPProxyActor:
             return 404, {"error": str(e)}
         except Exception as e:  # noqa: BLE001 — user errors are 500s
             return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    @staticmethod
+    def _parse_body(body: bytes):
+        """Parse the payload ONCE; JSON when it is JSON, else raw text."""
+        if not body:
+            return None
+        try:
+            return json.loads(body)
+        except ValueError:
+            return body.decode("utf-8", "replace")
+
+    @staticmethod
+    def _wants_stream(headers: dict, parsed) -> bool:
+        """SSE streaming when the client asks for it: an event-stream Accept
+        header, or the OpenAI convention of {"stream": true} in the JSON
+        body (reference: serve/_private/proxy.py:710 streaming path)."""
+        if "text/event-stream" in headers.get("accept", ""):
+            return True
+        return bool(isinstance(parsed, dict) and parsed.get("stream"))
+
+    async def _route_stream(self, writer, method, target, headers, parsed):
+        """Route to the deployment's streaming path and write each chunk as
+        a server-sent event the moment it arrives; terminate with
+        `data: [DONE]` (the OpenAI wire convention). The first chunk is
+        pulled BEFORE the status line goes out, so routing failures (unknown
+        deployment, no replicas) surface as proper HTTP errors instead of a
+        200 that then errors mid-stream."""
+        from ray_tpu.serve.router import DeploymentNotFoundError
+
+        request, deployment, err = self._parse(
+            method, target, headers, parsed
+        )
+        if err is not None:
+            await self._respond(writer, 404, {"error": err})
+            return
+        handle = self._handle_for(deployment).options(stream=True)
+        first = None
+        exhausted = False
+        try:
+            chunks = await handle.remote_async(request)
+            try:
+                first = await chunks.__anext__()
+            except StopAsyncIteration:
+                exhausted = True
+        except DeploymentNotFoundError as e:
+            await self._respond(writer, 404, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — pre-stream errors are 500s
+            await self._respond(
+                writer, 500, {"error": f"{type(e).__name__}: {e}"}
+            )
+            return
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: text/event-stream\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Connection: close\r\n"
+            b"\r\n"
+        )
+        await writer.drain()
+        try:
+            if not exhausted:
+                writer.write(
+                    f"data: {json.dumps(first, default=str)}\n\n".encode()
+                )
+                await writer.drain()
+                async for chunk in chunks:
+                    data = json.dumps(chunk, default=str)
+                    writer.write(f"data: {data}\n\n".encode())
+                    await writer.drain()
+        except Exception as e:  # noqa: BLE001 — mid-stream errors as events
+            payload = {"error": f"{type(e).__name__}: {e}"}
+            writer.write(f"data: {json.dumps(payload)}\n\n".encode())
+        # Always terminate the stream so OpenAI-style read-until-[DONE]
+        # clients never hang on an errored stream.
+        writer.write(b"data: [DONE]\n\n")
+        await writer.drain()
 
     async def _respond(self, writer, status: int, payload, keep=False):
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(

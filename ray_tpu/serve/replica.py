@@ -47,24 +47,71 @@ class ReplicaActor:
     async def queue_len(self) -> int:
         return self._inflight
 
+    def _resolve(self, method: str):
+        if method == "__call__" and inspect.isroutine(self._callable):
+            return self._callable  # function deployment
+        # Bound method — also for instances' __call__, so coroutine
+        # detection sees the method, not the (non-coroutine) instance.
+        return getattr(self._callable, method)
+
     async def handle(self, method: str, payload: bytes):
         """Execute one request. Requests are (method, pickled (args, kwargs));
         sync user code runs in the worker's executor thread so the replica
         keeps answering pings while busy."""
         args, kwargs = serialization.loads(payload)[0]
-        if method == "__call__" and inspect.isroutine(self._callable):
-            fn = self._callable  # function deployment
-        else:
-            # Bound method — also for instances' __call__, so coroutine
-            # detection sees the method, not the (non-coroutine) instance.
-            fn = getattr(self._callable, method)
+        fn = self._resolve(method)
         self._inflight += 1
         try:
             if inspect.iscoroutinefunction(fn):
-                return await fn(*args, **kwargs)
-            loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(
-                None, lambda: fn(*args, **kwargs)
-            )
+                result = await fn(*args, **kwargs)
+            else:
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(
+                    None, lambda: fn(*args, **kwargs)
+                )
+            if inspect.isasyncgen(result):
+                # Streaming callable invoked non-streaming: drain to a list
+                # (buffer-everything is the only non-streaming semantics).
+                return [item async for item in result]
+            if inspect.isgenerator(result):
+                return list(result)
+            return result
+        finally:
+            self._inflight -= 1
+
+    async def handle_streaming(self, method: str, payload: bytes):
+        """Streaming twin of ``handle``: an async generator the router
+        invokes with num_returns="streaming", so each yielded chunk flows
+        to the caller as its own stream item (reference:
+        serve/_private/proxy.py:710 streaming responses). Works for async/
+        sync generator methods, methods RETURNING a generator, and plain
+        methods (single-chunk stream)."""
+        args, kwargs = serialization.loads(payload)[0]
+        fn = self._resolve(method)
+        self._inflight += 1
+        try:
+            if inspect.isasyncgenfunction(fn):
+                async for item in fn(*args, **kwargs):
+                    yield item
+                return
+            if inspect.isgeneratorfunction(fn):
+                for item in fn(*args, **kwargs):
+                    yield item
+                return
+            if inspect.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(
+                    None, lambda: fn(*args, **kwargs)
+                )
+            if inspect.isasyncgen(result):
+                async for item in result:
+                    yield item
+            elif inspect.isgenerator(result):
+                for item in result:
+                    yield item
+            else:
+                yield result
         finally:
             self._inflight -= 1

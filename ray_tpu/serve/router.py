@@ -116,3 +116,49 @@ class Router:
             f"routing to {self._deployment!r} failed after "
             f"{ROUTE_RETRIES} attempts"
         )
+
+    async def route_stream(self, method: str, args: tuple, kwargs: dict):
+        """Route one STREAMING request; an async generator of response
+        chunks. Dead-replica retry only before the first chunk arrives —
+        once items flowed, a failure surfaces to the caller (the reference
+        behaves the same: a stream is not transparently restartable)."""
+        payload = serialization.dumps((args, kwargs))[0]
+        last_err: Exception | None = None
+        for attempt in range(ROUTE_RETRIES):
+            if self._version < -1 or not self._replicas:
+                await self._refresh(force=attempt > 0)
+                if not self._replicas:
+                    await asyncio.sleep(0.2)
+                    continue
+            replica = self._pick()
+            rid = replica._actor_id
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            delivered = False
+            try:
+                gen = replica.handle_streaming.options(
+                    num_returns="streaming"
+                ).remote(method, payload)
+                async for ref in gen:
+                    value = await core_api.get_async(ref)
+                    delivered = True
+                    yield value
+                return
+            except (ActorDiedError, ActorUnavailableError) as e:
+                if delivered:
+                    raise
+                import time
+
+                last_err = e
+                self._recently_dead[rid] = time.monotonic()
+                self._replicas = [
+                    r for r in self._replicas if r._actor_id != rid
+                ]
+                self._version = -2
+                await asyncio.sleep(min(0.1 * (attempt + 1), 1.0))
+            finally:
+                if rid in self._inflight:
+                    self._inflight[rid] -= 1
+        raise last_err or RuntimeError(
+            f"streaming route to {self._deployment!r} failed after "
+            f"{ROUTE_RETRIES} attempts"
+        )

@@ -1,0 +1,187 @@
+"""Streaming generators: num_returns="streaming" over tasks and actors
+(reference surface: python/ray/_private/object_ref_generator.py:32,
+test_streaming_generator.py)."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.errors import TaskCancelledError, TaskError
+
+pytestmark = pytest.mark.timeout(120)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_task_generator_streams_incrementally(rt):
+    """Items must arrive while the producer is still running — the defining
+    property that separates streaming from buffer-everything."""
+
+    @ray_tpu.remote
+    def produce(n):
+        for i in range(n):
+            yield {"i": i, "t": time.time()}
+
+    gen = produce.options(num_returns="streaming").remote(5)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    first_ref = next(gen)
+    first = ray_tpu.get(first_ref, timeout=30)
+    assert first["i"] == 0
+    rest = [ray_tpu.get(r, timeout=30)["i"] for r in gen]
+    assert rest == [1, 2, 3, 4]
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_streaming_overlaps_with_production(rt):
+    """The first item is consumable BEFORE the generator finishes (the
+    producer blocks until a marker file appears after its first yield)."""
+
+    import os
+    import tempfile
+
+    gate = os.path.join(tempfile.mkdtemp(), "gate")
+
+    @ray_tpu.remote
+    def produce(gate_path):
+        yield "head"
+        deadline = time.time() + 30
+        while not os.path.exists(gate_path):
+            if time.time() > deadline:
+                raise TimeoutError("gate never opened")
+            time.sleep(0.02)
+        yield "tail"
+
+    gen = produce.options(num_returns="streaming").remote(gate)
+    assert ray_tpu.get(next(gen), timeout=30) == "head"  # producer still live
+    with open(gate, "w") as f:
+        f.write("go")
+    assert ray_tpu.get(next(gen), timeout=30) == "tail"
+
+
+def test_async_generator_task(rt):
+    @ray_tpu.remote
+    async def aproduce(n):
+        for i in range(n):
+            await asyncio.sleep(0.01)
+            yield i * 10
+
+    gen = aproduce.options(num_returns="streaming").remote(3)
+    got = [ray_tpu.get(r, timeout=30) for r in gen]
+    assert got == [0, 10, 20]
+
+
+def test_generator_error_mid_stream_surfaces_after_items(rt):
+    @ray_tpu.remote
+    def explode_after_two():
+        yield 1
+        yield 2
+        raise ValueError("boom at item 3")
+
+    gen = explode_after_two.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(gen), timeout=30) == 1
+    assert ray_tpu.get(next(gen), timeout=30) == 2
+    with pytest.raises(TaskError, match="boom at item 3"):
+        for _ in gen:
+            pass
+
+
+def test_actor_async_generator_streaming(rt):
+    @ray_tpu.remote
+    class Chat:
+        async def tokens(self, text):
+            for tok in text.split():
+                await asyncio.sleep(0.005)
+                yield tok
+
+    actor = Chat.remote()
+    gen = actor.tokens.options(num_returns="streaming").remote("a b c d")
+    toks = [ray_tpu.get(r, timeout=30) for r in gen]
+    assert toks == ["a", "b", "c", "d"]
+    ray_tpu.kill(actor)
+
+
+def test_actor_sync_generator_streaming(rt):
+    @ray_tpu.remote
+    class Counter:
+        def upto(self, n):
+            for i in range(n):
+                yield i
+
+    actor = Counter.remote()
+    gen = actor.upto.options(num_returns="streaming").remote(4)
+    assert [ray_tpu.get(r, timeout=30) for r in gen] == [0, 1, 2, 3]
+    ray_tpu.kill(actor)
+
+
+def test_plain_value_streams_single_item(rt):
+    @ray_tpu.remote
+    def just_a_value():
+        return 42
+
+    gen = just_a_value.options(num_returns="streaming").remote()
+    assert [ray_tpu.get(r, timeout=30) for r in gen] == [42]
+
+
+def test_large_items_go_through_shm(rt):
+    import numpy as np
+
+    @ray_tpu.remote
+    def big(n):
+        for i in range(n):
+            yield np.full((256, 1024), i, dtype=np.float32)  # 1 MiB each
+
+    gen = big.options(num_returns="streaming").remote(3)
+    for i, ref in enumerate(gen):
+        arr = ray_tpu.get(ref, timeout=30)
+        assert arr.shape == (256, 1024) and float(arr[0, 0]) == float(i)
+
+
+def test_cancel_streaming_task(rt):
+    @ray_tpu.remote
+    def slow_stream():
+        for i in range(1000):
+            yield i
+            time.sleep(0.05)
+
+    gen = slow_stream.options(num_returns="streaming").remote()
+    assert ray_tpu.get(next(gen), timeout=30) == 0
+    ray_tpu.cancel(gen)
+    with pytest.raises(TaskCancelledError):
+        # Remaining iteration must fail with cancellation, not hang.
+        deadline = time.time() + 30
+        for _ in gen:
+            assert time.time() < deadline, "cancel never surfaced"
+
+
+def test_completed_sentinel_resolves(rt):
+    @ray_tpu.remote
+    def quick():
+        yield "x"
+
+    gen = quick.options(num_returns="streaming").remote()
+    assert [ray_tpu.get(r, timeout=30) for r in gen] == ["x"]
+    # Sentinel resolves once the stream is done (value is internal).
+    ray_tpu.get(gen.completed(), timeout=30)
+
+
+def test_generator_not_serializable(rt):
+    @ray_tpu.remote
+    def produce():
+        yield 1
+
+    @ray_tpu.remote
+    def consume(g):
+        return None
+
+    gen = produce.options(num_returns="streaming").remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(consume.remote(gen), timeout=30)
+    list(gen)
