@@ -19,15 +19,20 @@ from ray_tpu.serve.api import (
     shutdown,
     status,
 )
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application",
     "Deployment",
     "DeploymentHandle",
+    "batch",
     "delete",
     "deployment",
     "get_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "shutdown",
     "status",

@@ -13,8 +13,13 @@ import cloudpickle
 
 import ray_tpu
 from ray_tpu.core import serialization
+from ray_tpu.serve.batching import batch  # noqa: F401 (serve.batch)
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.multiplex import (  # noqa: F401 (serve.multiplexed)
+    get_multiplexed_model_id,
+    multiplexed,
+)
 
 
 @dataclasses.dataclass
@@ -144,6 +149,11 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
+    from ray_tpu.serve import handle as _handle_mod
+
+    # Cached routers hold handles into the controller being torn down; a
+    # later serve.run() in this process must start routing fresh.
+    _handle_mod._routers.clear()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:

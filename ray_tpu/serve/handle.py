@@ -11,43 +11,72 @@ from __future__ import annotations
 from ray_tpu.core import api as core_api
 from ray_tpu.serve.router import Router
 
+# Process-wide router cache: deployment name -> Router (see _ensure_router).
+_routers: dict = {}
+
 
 class DeploymentHandle:
     def __init__(
-        self, deployment: str, method: str = "__call__", stream: bool = False
+        self,
+        deployment: str,
+        method: str = "__call__",
+        stream: bool = False,
+        multiplexed_model_id: str = "",
     ):
         self._deployment = deployment
         self._method = method
         self._stream = stream
+        self._model_id = multiplexed_model_id
         self._router: Router | None = None
 
     def __reduce__(self):
         return (
             DeploymentHandle,
-            (self._deployment, self._method, self._stream),
+            (self._deployment, self._method, self._stream, self._model_id),
         )
 
     async def _ensure_router(self) -> Router:
         if self._router is None:
-            from ray_tpu.serve.controller import CONTROLLER_NAME
+            # One router per deployment per process, shared across ALL
+            # handles (and their .options() clones): routing state — load
+            # estimates, dead-replica memory, model-affinity — must
+            # accumulate across calls, not reset per handle.
+            router = _routers.get(self._deployment)
+            if router is None:
+                from ray_tpu.serve.controller import CONTROLLER_NAME
 
-            controller = await core_api.get_actor_async(CONTROLLER_NAME)
-            self._router = Router(controller, self._deployment)
+                controller = await core_api.get_actor_async(CONTROLLER_NAME)
+                router = _routers.setdefault(
+                    self._deployment, Router(controller, self._deployment)
+                )
+            self._router = router
         return self._router
 
     def method(self, name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self._deployment, name, self._stream)
+        h = DeploymentHandle(
+            self._deployment, name, self._stream, self._model_id
+        )
         h._router = self._router  # share routing state
         return h
 
-    def options(self, *, stream: bool | None = None) -> "DeploymentHandle":
+    def options(
+        self,
+        *,
+        stream: bool | None = None,
+        multiplexed_model_id: str | None = None,
+    ) -> "DeploymentHandle":
         """``stream=True``: remote() / remote_async() return an iterator of
-        response chunks instead of one value (reference:
-        serve/handle.py DeploymentHandle.options(stream=True))."""
+        response chunks instead of one value. ``multiplexed_model_id``:
+        route to a replica with that model resident and bind
+        serve.get_multiplexed_model_id() there (reference: serve/handle.py
+        DeploymentHandle.options)."""
         h = DeploymentHandle(
             self._deployment,
             self._method,
             self._stream if stream is None else stream,
+            self._model_id
+            if multiplexed_model_id is None
+            else multiplexed_model_id,
         )
         h._router = self._router
         return h
@@ -57,8 +86,10 @@ class DeploymentHandle:
         stream=True this returns an async generator of chunks."""
         router = await self._ensure_router()
         if self._stream:
-            return router.route_stream(self._method, args, kwargs)
-        return await router.route(self._method, args, kwargs)
+            return router.route_stream(
+                self._method, args, kwargs, self._model_id
+            )
+        return await router.route(self._method, args, kwargs, self._model_id)
 
     def remote(self, *args, **kwargs):
         """Route from a sync context (driver). Plain: a Future whose

@@ -118,7 +118,11 @@ class HTTPProxyActor:
         if err is not None:
             return 404, {"error": err}
         try:
-            result = await self._handle_for(deployment).remote_async(request)
+            handle = self._handle_for(deployment)
+            model_id = headers.get("serve_multiplexed_model_id", "")
+            if model_id:
+                handle = handle.options(multiplexed_model_id=model_id)
+            result = await handle.remote_async(request)
             return 200, result
         except DeploymentNotFoundError as e:
             return 404, {"error": str(e)}
@@ -159,7 +163,12 @@ class HTTPProxyActor:
         if err is not None:
             await self._respond(writer, 404, {"error": err})
             return
-        handle = self._handle_for(deployment).options(stream=True)
+        handle = self._handle_for(deployment).options(
+            stream=True,
+            multiplexed_model_id=headers.get(
+                "serve_multiplexed_model_id", ""
+            ),
+        )
         first = None
         exhausted = False
         try:

@@ -9,6 +9,7 @@ resources via the deployment's ray_actor_options.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import inspect
 
 import cloudpickle
@@ -54,20 +55,25 @@ class ReplicaActor:
         # detection sees the method, not the (non-coroutine) instance.
         return getattr(self._callable, method)
 
-    async def handle(self, method: str, payload: bytes):
+    async def handle(self, method: str, payload: bytes, model_id: str = ""):
         """Execute one request. Requests are (method, pickled (args, kwargs));
         sync user code runs in the worker's executor thread so the replica
-        keeps answering pings while busy."""
+        keeps answering pings while busy. ``model_id`` (multiplexing) binds
+        serve.get_multiplexed_model_id() for the duration of the call."""
+        from ray_tpu.serve.multiplex import _set_model_id
+
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
+        _set_model_id(model_id)
         self._inflight += 1
         try:
             if inspect.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
             else:
                 loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
                 result = await loop.run_in_executor(
-                    None, lambda: fn(*args, **kwargs)
+                    None, lambda: ctx.run(fn, *args, **kwargs)
                 )
             if inspect.isasyncgen(result):
                 # Streaming callable invoked non-streaming: drain to a list
@@ -79,15 +85,20 @@ class ReplicaActor:
         finally:
             self._inflight -= 1
 
-    async def handle_streaming(self, method: str, payload: bytes):
+    async def handle_streaming(
+        self, method: str, payload: bytes, model_id: str = ""
+    ):
         """Streaming twin of ``handle``: an async generator the router
         invokes with num_returns="streaming", so each yielded chunk flows
         to the caller as its own stream item (reference:
         serve/_private/proxy.py:710 streaming responses). Works for async/
         sync generator methods, methods RETURNING a generator, and plain
         methods (single-chunk stream)."""
+        from ray_tpu.serve.multiplex import _set_model_id
+
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
+        _set_model_id(model_id)
         self._inflight += 1
         try:
             if inspect.isasyncgenfunction(fn):
@@ -102,8 +113,9 @@ class ReplicaActor:
                 result = await fn(*args, **kwargs)
             else:
                 loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
                 result = await loop.run_in_executor(
-                    None, lambda: fn(*args, **kwargs)
+                    None, lambda: ctx.run(fn, *args, **kwargs)
                 )
             if inspect.isasyncgen(result):
                 async for item in result:

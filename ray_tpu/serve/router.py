@@ -36,6 +36,12 @@ class Router:
         # them (the table it serves can be stale by one health-check
         # period).
         self._recently_dead: dict[str, float] = {}
+        # Multiplexing affinity: model_id -> replica ids this router
+        # recently routed that model to (their HBM likely holds the
+        # weights). Router-local heuristic (reference keeps it in replica
+        # info pushed via the controller; a local cache converges the same
+        # way without the control-plane round trip).
+        self._model_replicas: dict[str, list] = {}
 
     async def _refresh(self, force: bool = False) -> None:
         table = await core_api.get_async(
@@ -68,10 +74,30 @@ class Router:
                 for r in self._replicas
             }
 
-    def _pick(self):
-        """Power of two choices on the local in-flight estimates."""
+    def _pick(self, model_id: str = ""):
+        """Power of two choices on the local in-flight estimates; with a
+        model id, prefer replicas that model was recently routed to (its
+        weights are probably still resident — reference: multiplexed
+        routing in python/ray/serve/_private/replica_scheduler)."""
         if len(self._replicas) == 1:
             return self._replicas[0]
+        if model_id:
+            alive = {r._actor_id: r for r in self._replicas}
+            known = [
+                alive[rid]
+                for rid in self._model_replicas.get(model_id, [])
+                if rid in alive
+            ]
+            if known:
+                load = lambda r: self._inflight.get(r._actor_id, 0)  # noqa
+                best = min(known, key=load)
+                others = [r for r in self._replicas if r not in known]
+                # Affinity holds only while the model's replicas aren't
+                # clearly hotter than the rest: a saturated hot model must
+                # SPILL to a fresh replica (which loads the weights and
+                # joins the affinity set) rather than cap at one replica.
+                if not others or load(best) <= min(map(load, others)) + 2:
+                    return best
         a, b = random.sample(self._replicas, 2)
         return (
             a
@@ -80,7 +106,19 @@ class Router:
             else b
         )
 
-    async def route(self, method: str, args: tuple, kwargs: dict):
+    def _note_model(self, model_id: str, rid: str) -> None:
+        if not model_id:
+            return
+        reps = self._model_replicas.setdefault(model_id, [])
+        if rid in reps:
+            return
+        reps.append(rid)
+        if len(reps) > 4:  # bound the memory per model
+            reps.pop(0)
+
+    async def route(
+        self, method: str, args: tuple, kwargs: dict, model_id: str = ""
+    ):
         """Route one request; returns the result value."""
         payload = serialization.dumps((args, kwargs))[0]
         last_err: Exception | None = None
@@ -90,12 +128,14 @@ class Router:
                 if not self._replicas:
                     await asyncio.sleep(0.2)
                     continue
-            replica = self._pick()
+            replica = self._pick(model_id)
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             try:
-                ref = replica.handle.remote(method, payload)
-                return await core_api.get_async(ref)
+                ref = replica.handle.remote(method, payload, model_id)
+                result = await core_api.get_async(ref)
+                self._note_model(model_id, rid)
+                return result
             except (ActorDiedError, ActorUnavailableError) as e:
                 # Replica died mid-request: drop it locally, force-refresh
                 # membership, back off (the controller may still be
@@ -117,7 +157,9 @@ class Router:
             f"{ROUTE_RETRIES} attempts"
         )
 
-    async def route_stream(self, method: str, args: tuple, kwargs: dict):
+    async def route_stream(
+        self, method: str, args: tuple, kwargs: dict, model_id: str = ""
+    ):
         """Route one STREAMING request; an async generator of response
         chunks. Dead-replica retry only before the first chunk arrives —
         once items flowed, a failure surfaces to the caller (the reference
@@ -130,16 +172,18 @@ class Router:
                 if not self._replicas:
                     await asyncio.sleep(0.2)
                     continue
-            replica = self._pick()
+            replica = self._pick(model_id)
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             delivered = False
             try:
                 gen = replica.handle_streaming.options(
                     num_returns="streaming"
-                ).remote(method, payload)
+                ).remote(method, payload, model_id)
                 async for ref in gen:
                     value = await core_api.get_async(ref)
+                    if not delivered:
+                        self._note_model(model_id, rid)
                     delivered = True
                     yield value
                 return
