@@ -7,8 +7,13 @@ the core surface: function trainables reporting intermediate metrics, grid +
 random search, FIFO/ASHA scheduling, bounded concurrency, ResultGrid.
 """
 
+from ray_tpu.train.config import RunConfig
 from ray_tpu.tune.result_grid import ResultGrid, TrialResult
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune.search import (
     choice,
     grid_search,
@@ -16,16 +21,26 @@ from ray_tpu.tune.search import (
     randint,
     uniform,
 )
-from ray_tpu.tune.tuner import TuneConfig, Tuner, report
+from ray_tpu.tune.tuner import (
+    TuneConfig,
+    Tuner,
+    get_trial_dir,
+    get_trial_id,
+    report,
+)
 
 __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
+    "PopulationBasedTraining",
     "ResultGrid",
+    "RunConfig",
     "TrialResult",
     "TuneConfig",
     "Tuner",
     "choice",
+    "get_trial_dir",
+    "get_trial_id",
     "grid_search",
     "loguniform",
     "randint",

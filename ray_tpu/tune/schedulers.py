@@ -1,16 +1,22 @@
-"""Trial schedulers: FIFO and ASHA early stopping.
+"""Trial schedulers: FIFO, ASHA early stopping, and PBT.
 
 Reference parity: python/ray/tune/schedulers/async_hyperband.py
 (AsyncHyperBandScheduler/ASHA): rungs at grace_period * reduction_factor^k;
 at each rung a trial continues only if its metric is in the top
-1/reduction_factor of everything recorded at that rung.
+1/reduction_factor of everything recorded at that rung. PBT:
+python/ray/tune/schedulers/pbt.py — bottom-quantile trials periodically
+EXPLOIT a top-quantile peer (clone its config + checkpoint) and EXPLORE by
+mutating hyperparameters.
 """
 
 from __future__ import annotations
 
+import random
+
 CONTINUE = "CONTINUE"
 STOP = "STOP"  # early-stopped: a loser at a rung
 COMPLETE = "COMPLETE"  # budget (max_t) reached: counts as full completion
+EXPLOIT = "EXPLOIT"  # PBT: restart from a winner's config + checkpoint
 
 
 class FIFOScheduler:
@@ -66,3 +72,100 @@ class ASHAScheduler:
                 if not good:
                     decision = STOP
         return decision
+
+
+class PopulationBasedTraining:
+    """PBT (reference: python/ray/tune/schedulers/pbt.py:27). Every
+    ``perturbation_interval`` iterations a trial's latest metric is ranked
+    against the population; bottom-quantile trials get EXPLOIT — the Tuner
+    then clones a top-quantile trial's config + checkpoint into the loser
+    and restarts it — with hyperparameters EXPLORED via
+    ``hyperparam_mutations`` (a list of values, a tune sampler, or a
+    0-arg callable per key): resampled with ``resample_probability``, else
+    nudged x1.2 / x0.8 (numeric) or to a neighbor (list)."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: dict | None = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        time_attr: str = "training_iteration",
+        seed: int | None = None,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        if not 0.0 < quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]")
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations or {})
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._latest: dict[str, float] = {}  # trial_id -> last metric value
+        self._last_perturb: dict[str, int] = {}
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        self._latest[trial_id] = value
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        lower, upper = self._quantiles()
+        if trial_id in lower and upper:
+            return EXPLOIT
+        return CONTINUE
+
+    def _quantiles(self) -> tuple[list, list]:
+        """(bottom trial ids, top trial ids) by latest metric."""
+        if len(self._latest) < 2:
+            return [], []
+        ordered = sorted(
+            self._latest, key=self._latest.get, reverse=(self.mode == "max")
+        )
+        n = max(1, int(len(ordered) * self.quantile))
+        if len(ordered) < 2 * n:
+            n = len(ordered) // 2
+        return ordered[-n:] if n else [], ordered[:n] if n else []
+
+    def choose_exploit(
+        self, trial_id: str, configs: dict
+    ) -> "tuple[str, dict] | None":
+        """Pick a top-quantile source and build the loser's mutated config.
+        ``configs``: trial_id -> current config for the live population."""
+        _, upper = self._quantiles()
+        upper = [tid for tid in upper if tid != trial_id and tid in configs]
+        if not upper:
+            return None
+        source = self._rng.choice(upper)
+        new_config = dict(configs[source])
+        for key, spec in self.mutations.items():
+            new_config[key] = self._explore(new_config.get(key), spec)
+        return source, new_config
+
+    def _explore(self, current, spec):
+        from ray_tpu.tune.search import _Sampler
+
+        resample = current is None or self._rng.random() < self.resample_p
+        if isinstance(spec, list):
+            if resample or current not in spec:
+                return self._rng.choice(spec)
+            i = spec.index(current)
+            return spec[
+                max(0, min(len(spec) - 1, i + self._rng.choice((-1, 1))))
+            ]
+        if isinstance(spec, _Sampler):
+            if not resample and isinstance(current, (int, float)):
+                return current * self._rng.choice((1.2, 0.8))
+            return spec.fn(self._rng)
+        if callable(spec):
+            return spec()
+        return spec

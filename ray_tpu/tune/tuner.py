@@ -1,15 +1,25 @@
-"""Tuner — concurrent trial loop with scheduler-driven early stopping.
+"""Tuner — concurrent trial loop with scheduler-driven early stopping,
+experiment-level checkpoint/resume, and PBT exploit/explore restarts.
 
-Reference parity: python/ray/tune/tuner.py:43 (Tuner.fit :312) +
-execution/tune_controller.py:68, compressed: trials run as actors executing
-the user function in a worker thread; `tune.report(**metrics)` streams
-intermediate results to the driver loop, which feeds the scheduler and
-kills early-stopped trials.
+Reference parity: python/ray/tune/tuner.py:43 (Tuner.fit :312,
+Tuner.restore :43) + execution/tune_controller.py:68 (experiment state
+persistence + trial resume) + schedulers/pbt.py, compressed: trials run as
+actors executing the user function in a worker thread; `tune.report(**m)`
+streams intermediate results to the driver loop, which feeds the scheduler
+and kills / restarts early-stopped trials. Experiment state (trial table +
+scheduler internals) persists to ``run_config.storage_path/name`` on every
+change, so a preempted tuning run — the normal failure mode on preemptible
+TPU capacity — resumes with ``Tuner.restore(path)``: finished trials keep
+their results, unfinished ones re-run (from their own trial dir, where
+self-checkpointing trainables find their last state).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
+import pickle
+import shutil
 import threading
 import time
 import uuid
@@ -19,7 +29,7 @@ import cloudpickle
 
 import ray_tpu
 from ray_tpu.tune.result_grid import ResultGrid, TrialResult
-from ray_tpu.tune.schedulers import COMPLETE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import COMPLETE, EXPLOIT, STOP, FIFOScheduler
 from ray_tpu.tune.search import generate_variants
 
 _trial_ctx = threading.local()
@@ -38,6 +48,24 @@ def report(**metrics) -> None:
     runner._record(metrics)
 
 
+def get_trial_dir() -> str:
+    """This trial's private directory (reference: train.get_context()
+    .get_trial_dir()). Self-checkpointing trainables write state here; it
+    survives tuner restarts and is cloned from the winner on a PBT
+    exploit."""
+    runner = getattr(_trial_ctx, "runner", None)
+    if runner is None or not runner._trial_dir:
+        raise RuntimeError("get_trial_dir() called outside a stored trial")
+    return runner._trial_dir
+
+
+def get_trial_id() -> str:
+    runner = getattr(_trial_ctx, "runner", None)
+    if runner is None:
+        raise RuntimeError("get_trial_id() called outside a trial")
+    return runner._trial_id
+
+
 class TrialRunner:
     """Actor hosting one trial. The user fn runs in the worker's executor
     thread; `drain` (async, on the loop) streams reports to the driver."""
@@ -47,9 +75,23 @@ class TrialRunner:
         self._reports: list[dict] = []
         self._iteration = 0
         self._stopped = False
+        self._trial_dir = ""
+        self._trial_id = ""
 
-    def run(self, fn_payload: bytes, config: dict) -> str:
+    def run(
+        self,
+        fn_payload: bytes,
+        config: dict,
+        trial_id: str = "",
+        trial_dir: str = "",
+        start_iteration: int = 0,
+    ) -> str:
         fn = cloudpickle.loads(fn_payload)
+        self._trial_id = trial_id
+        self._trial_dir = trial_dir
+        self._iteration = start_iteration
+        if trial_dir:
+            os.makedirs(trial_dir, exist_ok=True)
         _trial_ctx.runner = self
         try:
             fn(config)
@@ -93,6 +135,74 @@ class TuneConfig:
     )
 
 
+class _ExperimentStore:
+    """On-disk experiment state (reference: tune_controller.py experiment
+    checkpointing). Layout under <storage_path>/<name>/:
+      tuner.pkl       — trainable payload + param space + TuneConfig (once)
+      trials.pkl      — trial table snapshot (atomic rewrite on change)
+      scheduler.pkl   — scheduler internals (ASHA rungs / PBT population)
+      <trial_id>/     — the trial's private dir (user checkpoints)
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def exists(self) -> bool:
+        return os.path.exists(os.path.join(self.path, "tuner.pkl"))
+
+    def _atomic_write(self, name: str, payload: bytes) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        tmp = os.path.join(self.path, f".{name}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, name))
+
+    def save_meta(self, payload, param_space, tune_cfg) -> None:
+        # The scheduler is persisted separately (save_scheduler, with a
+        # graceful fallback) — strip it here so an unpicklable custom
+        # scheduler degrades resume fidelity instead of crashing fit().
+        self._atomic_write(
+            "tuner.pkl",
+            cloudpickle.dumps(
+                {
+                    "payload": payload,
+                    "param_space": param_space,
+                    "tune_config": dataclasses.replace(
+                        tune_cfg, scheduler=None
+                    ),
+                }
+            ),
+        )
+
+    def save_trials(self, trials: list) -> None:
+        self._atomic_write("trials.pkl", cloudpickle.dumps(trials))
+
+    def save_scheduler(self, scheduler) -> None:
+        try:
+            self._atomic_write("scheduler.pkl", cloudpickle.dumps(scheduler))
+        except Exception:
+            pass  # an unpicklable custom scheduler degrades resume fidelity
+
+    def load(self) -> dict:
+        out = {}
+        with open(os.path.join(self.path, "tuner.pkl"), "rb") as f:
+            out["meta"] = pickle.load(f)
+        trials_path = os.path.join(self.path, "trials.pkl")
+        if os.path.exists(trials_path):
+            with open(trials_path, "rb") as f:
+                out["trials"] = pickle.load(f)
+        sched_path = os.path.join(self.path, "scheduler.pkl")
+        if os.path.exists(sched_path):
+            with open(sched_path, "rb") as f:
+                out["scheduler"] = pickle.load(f)
+        return out
+
+    def trial_dir(self, trial_id: str) -> str:
+        return os.path.join(self.path, trial_id)
+
+
 class Tuner:
     def __init__(
         self,
@@ -100,40 +210,120 @@ class Tuner:
         *,
         param_space: dict,
         tune_config: Optional[TuneConfig] = None,
+        run_config: Any = None,  # ray_tpu.train.RunConfig(name, storage_path)
     ):
         self._trainable = trainable
         self._param_space = dict(param_space)
         self._cfg = tune_config or TuneConfig()
+        self._store: Optional[_ExperimentStore] = None
+        self._restored: Optional[dict] = None
+        if run_config is not None and getattr(run_config, "name", None):
+            self._store = _ExperimentStore(
+                os.path.join(run_config.storage_path, run_config.name)
+            )
+
+    @classmethod
+    def restore(cls, path: str) -> "Tuner":
+        """Resume an interrupted experiment from its storage directory
+        (reference: python/ray/tune/tuner.py:43 Tuner.restore). Finished
+        trials keep their recorded results; PENDING/RUNNING trials re-run
+        with their original trial ids, configs, and trial dirs; scheduler
+        state (ASHA rungs, PBT population) is restored so decisions stay
+        consistent with the pre-interrupt history."""
+        store = _ExperimentStore(path)
+        if not store.exists():
+            raise FileNotFoundError(f"no experiment state under {path!r}")
+        state = store.load()
+        meta = state["meta"]
+        tuner = cls.__new__(cls)
+        tuner._trainable = None  # payload reused as-is
+        tuner._param_space = meta["param_space"]
+        tuner._cfg = meta["tune_config"]
+        tuner._store = store
+        tuner._restored = state
+        return tuner
+
+    # -- the trial loop -------------------------------------------------------
 
     def fit(self, poll_interval_s: float = 0.1) -> ResultGrid:
         cfg = self._cfg
-        scheduler = cfg.scheduler or FIFOScheduler()
-        payload = cloudpickle.dumps(self._trainable)
-        variants = generate_variants(
-            self._param_space, cfg.num_samples, cfg.seed
-        )
-        trials = [
-            TrialResult(trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:4]}",
-                        config=v)
-            for i, v in enumerate(variants)
-        ]
-        pending = list(trials)
-        running: dict[str, dict] = {}  # trial_id -> {actor, ref, trial}
-        done: list[TrialResult] = []
+        if self._restored is not None:
+            payload = self._restored["meta"]["payload"]
+            scheduler = self._restored.get("scheduler") or (
+                cfg.scheduler or FIFOScheduler()
+            )
+            all_trials: list[TrialResult] = self._restored.get("trials", [])
+            end_states = ("TERMINATED", "STOPPED", "ERROR")
+            done = [t for t in all_trials if t.status in end_states]
+            pending = [t for t in all_trials if t.status not in end_states]
+            for t in pending:
+                t.status = "PENDING"
+        else:
+            scheduler = cfg.scheduler or FIFOScheduler()
+            payload = cloudpickle.dumps(self._trainable)
+            variants = generate_variants(
+                self._param_space, cfg.num_samples, cfg.seed
+            )
+            all_trials = [
+                TrialResult(
+                    trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:4]}",
+                    config=v,
+                )
+                for i, v in enumerate(variants)
+            ]
+            done = []
+            pending = list(all_trials)
+            if self._store is not None:
+                self._store.save_meta(payload, self._param_space, cfg)
 
+        running: dict[str, dict] = {}  # trial_id -> {actor, ref, trial}
         actor_cls = ray_tpu.remote(TrialRunner)
+
+        def persist():
+            if self._store is not None:
+                self._store.save_trials(all_trials)
+                self._store.save_scheduler(scheduler)
+
+        def launch(trial: TrialResult):
+            actor = actor_cls.options(
+                resources=dict(cfg.resources_per_trial),
+                max_concurrency=4,
+            ).remote()
+            trial_dir = (
+                self._store.trial_dir(trial.trial_id) if self._store else ""
+            )
+            # Resume the iteration clock from the last number the SCHEDULER
+            # saw, not the history length: the two differ when a kill landed
+            # between the trainable reporting and the driver draining, and
+            # the scheduler's restored rung/perturb state is keyed on the
+            # former. (Self-checkpointing trainables that skip ahead should
+            # report training_iteration explicitly.)
+            start_iter = (
+                trial.metrics_history[-1].get(
+                    "training_iteration", len(trial.metrics_history)
+                )
+                if trial.metrics_history
+                else 0
+            )
+            ref = actor.run.remote(
+                payload,
+                trial.config,
+                trial.trial_id,
+                trial_dir,
+                start_iter,
+            )
+            trial.status = "RUNNING"
+            running[trial.trial_id] = {
+                "actor": actor, "ref": ref, "trial": trial,
+            }
+
+        persist()
+        dirty = True
+        last_persist = time.monotonic()
         while pending or running:
             while pending and len(running) < cfg.max_concurrent_trials:
-                trial = pending.pop(0)
-                actor = actor_cls.options(
-                    resources=dict(cfg.resources_per_trial),
-                    max_concurrency=4,
-                ).remote()
-                ref = actor.run.remote(payload, trial.config)
-                trial.status = "RUNNING"
-                running[trial.trial_id] = {
-                    "actor": actor, "ref": ref, "trial": trial,
-                }
+                launch(pending.pop(0))
+                dirty = True
             # Drain reports (all refs fired first — one slow actor must not
             # head-of-line-block the others), then feed the scheduler.
             drain_refs = {
@@ -147,10 +337,22 @@ class Tuner:
                 except Exception:
                     reports = []
                 for rec in reports:
+                    dirty = True
                     trial.metrics_history.append(rec)
                     trial.metrics = rec
                     decision = scheduler.on_result(tid, rec)
-                    if decision in (STOP, COMPLETE):
+                    if decision == EXPLOIT:
+                        # PBT: restart this trial from a winner. Pick the
+                        # source now (population state is current), copy
+                        # config; the checkpoint clone happens at reap.
+                        live_configs = {
+                            t: e["trial"].config for t, e in running.items()
+                        }
+                        chosen = scheduler.choose_exploit(tid, live_configs)
+                        if chosen is not None:
+                            entry["exploit"] = chosen
+                            entry["actor"].stop.remote()
+                    elif decision in (STOP, COMPLETE):
                         # Cooperative stop; run() unwinds with STOPPED.
                         # COMPLETE (max_t budget reached) is a full run,
                         # not an early stop — relabel at reap time.
@@ -167,6 +369,7 @@ class Tuner:
             for tid, entry in list(running.items()):
                 if entry["ref"] not in finished_set:
                     continue
+                dirty = True
                 trial = entry["trial"]
                 try:
                     trial.status = ray_tpu.get(entry["ref"], timeout=10)
@@ -185,8 +388,49 @@ class Tuner:
                 except Exception:
                     pass
                 ray_tpu.kill(entry["actor"])
-                done.append(trial)
                 del running[tid]
+                if entry.get("exploit") and trial.status == "STOPPED":
+                    # PBT exploit/explore: clone the winner's checkpoint
+                    # dir + mutated config, then REQUEUE the same trial.
+                    source_tid, new_config = entry["exploit"]
+                    self._clone_trial_dir(source_tid, tid)
+                    trial.config = new_config
+                    trial.status = "PENDING"
+                    pending.append(trial)
+                else:
+                    done.append(trial)
+            # Persistence is throttled: re-pickling every trial's full
+            # metrics history each 0.1s poll tick would grow O(total
+            # reports) per tick and fsync-stall the driver loop.
+            if dirty and time.monotonic() - last_persist >= 1.0:
+                persist()
+                dirty = False
+                last_persist = time.monotonic()
             if running or pending:
                 time.sleep(poll_interval_s)
+        persist()
         return ResultGrid(done, metric=cfg.metric, mode=cfg.mode)
+
+    def _clone_trial_dir(self, source_tid: str, target_tid: str) -> None:
+        """Replace the loser's trial dir with a snapshot of the winner's.
+
+        REPLACE, not merge: stale loser checkpoints surviving a merge would
+        win any newest-file tiebreak and silently undo the exploit. The
+        source may still be written by the live winner — trainables must
+        write checkpoints atomically (tmp + rename) for the snapshot to be
+        consistent; a copy error here degrades to restarting the loser from
+        its own last state rather than crashing the experiment."""
+        if self._store is None:
+            return
+        src = self._store.trial_dir(source_tid)
+        dst = self._store.trial_dir(target_tid)
+        if not os.path.isdir(src):
+            return
+        try:
+            tmp = dst + ".clone-tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.copytree(src, tmp)
+            shutil.rmtree(dst, ignore_errors=True)
+            os.replace(tmp, dst)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)

@@ -1,0 +1,194 @@
+"""Tune experiment checkpoint/resume + PBT
+(reference: python/ray/tune/tuner.py:43 Tuner.restore,
+tune/execution/tune_controller.py:68 experiment state,
+tune/schedulers/pbt.py)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+pytestmark = pytest.mark.timeout(300)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+RUNNER_SCRIPT = """
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {repo!r})
+import ray_tpu
+from ray_tpu import tune
+
+ray_tpu.init(num_cpus=4)
+
+def trainable(config):
+    d = tune.get_trial_dir()
+    # Count executions of this trial (restore must not re-run finished ones)
+    runs_file = os.path.join(d, "runs")
+    runs = int(open(runs_file).read()) if os.path.exists(runs_file) else 0
+    open(runs_file, "w").write(str(runs + 1))
+    state_file = os.path.join(d, "iter")
+    start = int(open(state_file).read()) if os.path.exists(state_file) else 0
+    for i in range(start, 6):
+        tune.report(score=config["x"] * (i + 1))
+        open(state_file, "w").write(str(i + 1))
+        time.sleep(config["sleep"])
+
+tuner = tune.Tuner(
+    trainable,
+    param_space={{"x": tune.grid_search([1, 2, 3, 4]), "sleep": {sleep}}},
+    tune_config=tune.TuneConfig(
+        metric="score", mode="max", max_concurrent_trials=2
+    ),
+    run_config=tune.RunConfig(name="exp", storage_path={storage!r}),
+)
+grid = tuner.fit()
+print("FIT-DONE", len(grid))
+"""
+
+
+def test_tuner_restore_after_kill(rt, tmp_path):
+    """Kill the tuner process mid-run; Tuner.restore completes the
+    remaining trials without re-running finished ones."""
+    storage = str(tmp_path)
+    exp_dir = os.path.join(storage, "exp")
+    script = RUNNER_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        storage=storage,
+        sleep=0.35,
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    # Wait until at least one trial finished (its dir has 6 iters), then
+    # kill the whole process hard — a preemption.
+    deadline = time.time() + 120
+    killed = False
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break  # finished before we killed: retry with more trials? fail
+        trials_path = os.path.join(exp_dir, "trials.pkl")
+        if os.path.exists(trials_path):
+            import pickle
+
+            try:
+                with open(trials_path, "rb") as f:
+                    trials = pickle.load(f)
+            except Exception:
+                trials = []
+            statuses = [t.status for t in trials]
+            if "TERMINATED" in statuses and (
+                "RUNNING" in statuses or "PENDING" in statuses
+            ):
+                proc.send_signal(signal.SIGKILL)
+                killed = True
+                break
+        time.sleep(0.1)
+    proc.wait(timeout=30)
+    assert killed, "tuner finished before the kill; slow it down"
+
+    # Stray trial-runner workers from the killed cluster die with it, but
+    # give the OS a moment.
+    time.sleep(1.0)
+
+    restored = tune.Tuner.restore(exp_dir)
+    grid = restored.fit()
+    assert len(grid) == 4
+    assert all(t.status == "TERMINATED" for t in grid)
+    best = grid.get_best_result()
+    assert best.config["x"] == 4 and best.metrics["score"] == 24
+
+    # Finished-before-kill trials must NOT have re-run; every trial ran at
+    # most twice (once before the kill, once after).
+    for t in grid:
+        runs_file = os.path.join(exp_dir, t.trial_id, "runs")
+        runs = int(open(runs_file).read())
+        assert 1 <= runs <= 2, (t.trial_id, runs)
+    finished_first = [
+        t for t in grid
+        if int(open(os.path.join(exp_dir, t.trial_id, "runs")).read()) == 1
+    ]
+    assert finished_first, "expected at least one trial to survive the kill"
+
+    # Iteration-level resume: trials resumed mid-way continued from their
+    # persisted iter state, so no trial recorded more than 6 iterations in
+    # its own state file.
+    for t in grid:
+        iters = int(open(os.path.join(exp_dir, t.trial_id, "iter")).read())
+        assert iters == 6
+
+
+def test_restore_missing_dir_raises(rt, tmp_path):
+    with pytest.raises(FileNotFoundError):
+        tune.Tuner.restore(str(tmp_path / "nope"))
+
+
+def test_pbt_exploits_winner(rt, tmp_path):
+    """Losers clone the winner's checkpoint + mutated config and end up
+    with scores only reachable through the exploit."""
+
+    def trainable(config):
+        d = tune.get_trial_dir()
+        exp_dir = os.path.dirname(d)
+        # Start barrier: worker spawns serialize on this 1-core box, so
+        # without it early trials can FINISH before late ones begin and no
+        # exploit can ever land. Each (re)start re-arms its own marker.
+        marker = os.path.join(exp_dir, f"ready-{os.path.basename(d)}")
+        open(marker, "w").write("up")
+        deadline = time.time() + 60
+        while (
+            len([f for f in os.listdir(exp_dir) if f.startswith("ready-")])
+            < 4
+            and time.time() < deadline
+        ):
+            time.sleep(0.05)
+        state = os.path.join(d, "state.json")
+        score = (
+            json.load(open(state))["score"] if os.path.exists(state) else 0.0
+        )
+        for _ in range(25):
+            score += config["lr"]
+            json.dump({"score": score}, open(state, "w"))
+            tune.report(score=score)
+            time.sleep(0.25)
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.01, 0.02, 1.0, 1.1]},
+        quantile_fraction=0.25,
+        seed=7,
+    )
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 0.02, 1.0, 1.1])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=pbt,
+            max_concurrent_trials=4,
+        ),
+        run_config=tune.RunConfig(name="pbt", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    scores = sorted(t.metrics["score"] for t in grid)
+    # Without exploit, the lr=0.01 trial tops out at 0.25; after cloning a
+    # winner's state plus >= 25 more steps at a mutated-healthy lr it lands
+    # far above 1.
+    assert scores[0] > 1.0, f"no exploit happened: {scores}"
+    assert scores[-1] >= 25 * 1.0
