@@ -122,6 +122,17 @@ def _default_labels() -> dict:
         return {}
 
 
+class _ClientRuntime:
+    """Driver's view when connected in client mode: no cluster membership,
+    just the one connection (stopped via shutdown())."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def stop(self) -> None:
+        pass  # the worker (the ClientWorker itself) is stopped by shutdown()
+
+
 class _AttachedRuntime:
     """Driver's view of a cluster it joined via ``init(address=...)``:
     shutdown() disconnects this driver but never tears the cluster down
@@ -176,18 +187,48 @@ def init(
     resources: dict | None = None,
     labels: dict | None = None,
     ignore_reinit_error: bool = True,
+    mode: str | None = None,
+    token: str | None = None,
     _system_config: dict | None = None,
 ) -> "Runtime":
     """Start a local cluster (GCS + head node) and connect this process as
     the driver — or, with ``address="host:port"``, join an existing cluster
     started with the `raytpu start` CLI (reference: worker.py:1407
-    init(address=...))."""
+    init(address=...)).
+
+    ``mode="client"`` connects as a REMOTE driver (reference:
+    python/ray/util/client — `ray.init("ray://...")`): this process is not
+    a cluster member; a proxy worker on the head (the `raytpu start --head`
+    client server, whose address is the CLI's printed client_address)
+    executes calls on its behalf over one authenticated TCP connection."""
     global _runtime, _worker
     with _lock:
         if _runtime is not None:
             if ignore_reinit_error:
                 return _runtime
             raise RayTpuError("ray_tpu already initialized")
+        if mode is not None and mode != "client":
+            raise ValueError(f'mode must be "client" or None, got {mode!r}')
+        if mode == "client":
+            if address is None:
+                raise ValueError('mode="client" requires address=')
+            if (
+                num_cpus is not None
+                or resources is not None
+                or labels is not None
+            ):
+                raise ValueError(
+                    "num_cpus/resources/labels cannot be combined with "
+                    "client mode: a remote driver contributes no resources"
+                )
+            from ray_tpu.core.client import ClientWorker
+
+            client = ClientWorker(_parse_address(address), token=token)
+            runtime_c: Any = _ClientRuntime(client)
+            _runtime = runtime_c
+            _worker = client
+            atexit.register(shutdown)
+            return runtime_c
         if address is None:
             # Submitted jobs' drivers join the submitting cluster
             # (reference: RAY_ADDRESS env honored by ray.init).
@@ -387,6 +428,11 @@ def _runtime_env_from_opts(opts: dict, worker: CoreWorker) -> dict:
     renv = opts.get("runtime_env")
     if not renv:
         return {}
+    if not isinstance(worker, CoreWorker):
+        raise RayTpuError(
+            "runtime_env with packages is not supported in client mode "
+            "yet (working_dir/py_modules upload needs cluster KV access)"
+        )
     import json as _json
 
     from ray_tpu import runtime_env as _re
@@ -715,4 +761,10 @@ class RuntimeContext:
 
 
 def get_runtime_context() -> RuntimeContext:
-    return RuntimeContext(_require_worker())
+    worker = _require_worker()
+    if not isinstance(worker, CoreWorker):
+        raise RayTpuError(
+            "get_runtime_context() is not available in client mode: a "
+            "remote driver has no node/worker identity in the cluster"
+        )
+    return RuntimeContext(worker)

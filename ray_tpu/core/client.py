@@ -1,0 +1,581 @@
+"""Remote-driver client: drive a cluster from OUTSIDE its network.
+
+Reference parity: python/ray/util/client/ (the Ray Client) +
+src/ray/protobuf/ray_client.proto — surface, not implementation. A laptop
+(or CI job, or notebook) that is not a cluster member connects to the head's
+client server over one authenticated TCP connection; a dedicated proxy
+CoreWorker on the head executes every call on the client's behalf, and the
+client holds opaque ObjectRefs owned by that proxy. Ref lifetimes mirror
+client-side handle lifetimes through new/del notifications; everything the
+session owned is torn down when the connection drops.
+
+    ray_tpu.init(address="head:port", mode="client", token="s3cr3t")
+    @ray_tpu.remote
+    def f(x): return x + 1
+    ray_tpu.get(f.remote(41))  # == 42, executed inside the cluster
+
+Server side: ``ClientServer`` is started by ``raytpu start --head``
+(--client-port / --client-token) next to the GCS.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu.core import object_ref as object_ref_mod
+from ray_tpu.core import serialization
+from ray_tpu.core.errors import RayTpuError
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.protocol import Connection, Endpoint
+
+# GCS RPCs a client may call through the passthrough (the read/monitor/
+# coordination surface the public API uses — not the whole control plane).
+_ALLOWED_GCS = {
+    "get_actor",
+    "kill_actor",
+    "get_cluster_view",
+    "list_nodes",
+    "get_autoscaler_state",
+    # placement groups (ray_tpu.util.placement_group)
+    "create_placement_group",
+    "get_placement_group",
+    "remove_placement_group",
+    "list_placement_groups",
+    # state API (ray_tpu.util.state)
+    "list_actors",
+    "list_task_events",
+    "dump_metrics",
+}
+
+
+class AuthError(RayTpuError):
+    pass
+
+
+class _Session:
+    """Server-side state for one connected client: its claims on objects
+    owned by the SHARED proxy worker (released wholesale on disconnect)."""
+
+    def __init__(self):
+        self.session_id = uuid.uuid4().hex[:12]
+        self.claims: dict[str, int] = {}  # oid -> count
+
+
+class ClientServer:
+    """Hosts ONE shared proxy CoreWorker serving every connected client
+    (reference: ray/util/client/server/proxier.py — the reference runs one
+    SpecificServer per client; here the per-process ObjectRef hooks force a
+    single proxy, so sessions are isolated by per-session ref claims
+    instead of per-session workers)."""
+
+    def __init__(
+        self,
+        gcs_addr: tuple,
+        node_addr: tuple,
+        token: Optional[str] = None,
+    ):
+        self.gcs_addr = tuple(gcs_addr)
+        self.node_addr = tuple(node_addr)
+        self.token = token
+        self.endpoint = Endpoint("client-server")
+        self._worker = None  # shared proxy CoreWorker, created lazily
+        self._worker_init = None  # in-flight creation (asyncio task)
+        self._sessions: dict[int, _Session] = {}  # id(conn) -> session
+        for name in (
+            "connect",
+            "submit_task",
+            "create_actor",
+            "submit_actor_task",
+            "get",
+            "put",
+            "wait",
+            "cancel",
+            "kill",
+            "gcs_call",
+            "ref_new",
+            "ref_del",
+        ):
+            self.endpoint.register(
+                f"client.{name}", getattr(self, f"_h_{name}")
+            )
+        self.endpoint.on_connection_lost = self._conn_lost
+        self.addr: tuple | None = None
+
+    def start(self, host: str | None = None, port: int = 0) -> tuple:
+        self.addr = self.endpoint.start(host=host, port=port)
+        return self.addr
+
+    def stop(self) -> None:
+        self._sessions.clear()
+        if self._worker is not None:
+            try:
+                self._worker.stop()
+            except Exception:
+                pass
+        self.endpoint.stop()
+
+    def _conn_lost(self, conn: Connection) -> None:
+        session = self._sessions.pop(id(conn), None)
+        if session is None or self._worker is None:
+            return
+        worker, claims = self._worker, dict(session.claims)
+        session.claims.clear()
+
+        async def release_all():
+            for oid, count in claims.items():
+                for _ in range(count):
+                    await worker._release_local_ref(oid)
+
+        # The client is gone: drop every claim its session held so its
+        # objects free (tasks already submitted run to completion).
+        try:
+            worker.endpoint.submit(release_all())
+        except Exception:
+            pass
+
+    def _session(self, conn) -> _Session:
+        session = self._sessions.get(id(conn))
+        if session is None:
+            raise AuthError("not connected (send client.connect first)")
+        return session
+
+    @property
+    def worker(self):
+        if self._worker is None:
+            raise AuthError("no client has connected yet")
+        return self._worker
+
+    # -- handlers ------------------------------------------------------------
+    # NB: handlers run on the ClientServer's OWN event loop; the proxy
+    # CoreWorker's coroutines and store live on the worker's loop. Every
+    # worker coroutine is therefore submitted to the worker loop and
+    # awaited via wrap_future — touching loop-bound asyncio state across
+    # loops is undefined behavior. Blocking worker entry points (submit,
+    # put, create) run in an executor so one slow call cannot stall every
+    # other session's RPCs.
+
+    @staticmethod
+    async def _on_worker(worker, coro):
+        import asyncio
+
+        return await asyncio.wrap_future(worker.endpoint.submit(coro))
+
+    @staticmethod
+    async def _blocking(fn, *args, **kwargs):
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: fn(*args, **kwargs)
+        )
+
+    async def _claim_refs(self, session: _Session, refs) -> None:
+        """Take the session's claim on refs being shipped to the client
+        BEFORE the handler's local ObjectRef copies are GC'd — otherwise
+        the ref-deleted hook frees the object in the race window before the
+        client's own ref_new arrives."""
+        worker = self.worker
+
+        async def bump():
+            for ref in refs:
+                worker.owner_store.ensure(ref.hex()).local_refs += 1
+
+        for ref in refs:
+            session.claims[ref.hex()] = session.claims.get(ref.hex(), 0) + 1
+        await self._on_worker(worker, bump())
+
+    async def _init_worker(self) -> None:
+        from ray_tpu.core.core_worker import CoreWorker
+
+        worker = CoreWorker(self.gcs_addr, self.node_addr, kind="driver")
+        await self._blocking(worker.start)
+        self._worker = worker
+
+    async def _h_connect(self, conn, p):
+        import asyncio
+
+        if self.token is not None and p.get("token") != self.token:
+            raise AuthError("bad client token")
+        if self._worker is None:
+            # Single-flight creation (handlers share one loop — a plain
+            # lock held across await would deadlock it).
+            if self._worker_init is None or (
+                self._worker_init.done()
+                and self._worker_init.exception() is not None
+            ):
+                self._worker_init = asyncio.ensure_future(
+                    self._init_worker()
+                )
+            await asyncio.shield(self._worker_init)
+        session = _Session()
+        self._sessions[id(conn)] = session
+        return {"session_id": session.session_id}
+
+    async def _h_submit_task(self, conn, p):
+        session = self._session(conn)
+        worker = self.worker
+        args, kwargs = serialization.loads(p["call"])[0]
+        refs = await self._blocking(
+            worker.submit_task,
+            None,
+            args,
+            kwargs,
+            name=p["name"],
+            num_returns=p["num_returns"],
+            resources=p.get("resources"),
+            max_retries=p.get("max_retries"),
+            label_selector=p.get("label_selector"),
+            soft_label_selector=p.get("soft_label_selector"),
+            policy=p.get("policy", "hybrid"),
+            func_payload=p["func"],
+            pg=p.get("pg"),
+            runtime_env=p.get("runtime_env"),
+        )
+        await self._claim_refs(session, refs)
+        return serialization.dumps(refs)[0]
+
+    async def _h_create_actor(self, conn, p):
+        self._session(conn)
+        worker = self.worker
+        args, kwargs = serialization.loads(p["call"])[0]
+        cls = cloudpickle.loads(p["cls"])
+        return await self._blocking(
+            worker.create_actor,
+            cls,
+            args,
+            kwargs,
+            name=p.get("name"),
+            resources=p.get("resources"),
+            max_restarts=p.get("max_restarts", 0),
+            max_concurrency=p.get("max_concurrency", 0),
+            label_selector=p.get("label_selector"),
+            soft_label_selector=p.get("soft_label_selector"),
+            policy=p.get("policy", "hybrid"),
+            pg=p.get("pg"),
+            runtime_env=p.get("runtime_env"),
+        )
+
+    async def _h_submit_actor_task(self, conn, p):
+        session = self._session(conn)
+        worker = self.worker
+        args, kwargs = serialization.loads(p["call"])[0]
+        refs = await self._blocking(
+            worker.submit_actor_task,
+            p["actor_id"],
+            p["method"],
+            args,
+            kwargs,
+            num_returns=p["num_returns"],
+            name=p.get("name", ""),
+            max_task_retries=p.get("max_task_retries", 0),
+        )
+        await self._claim_refs(session, refs)
+        return serialization.dumps(refs)[0]
+
+    async def _h_get(self, conn, p):
+        self._session(conn)
+        worker = self.worker
+        refs, _ = serialization.loads(p["refs"])
+        values = await self._on_worker(
+            worker, worker._get_async(refs, p.get("timeout"))
+        )
+        return serialization.dumps(values)[0]
+
+    async def _h_put(self, conn, p):
+        session = self._session(conn)
+        worker = self.worker
+        value, _ = serialization.loads(p["value"])
+        ref = await self._blocking(worker.put, value)
+        await self._claim_refs(session, [ref])
+        return serialization.dumps(ref)[0]
+
+    async def _h_wait(self, conn, p):
+        self._session(conn)
+        worker = self.worker
+        refs, _ = serialization.loads(p["refs"])
+        ready, not_ready = await self._on_worker(
+            worker,
+            worker._wait_async(refs, p["num_returns"], p.get("timeout")),
+        )
+        return serialization.dumps((ready, not_ready))[0]
+
+    async def _h_cancel(self, conn, p):
+        self._session(conn)
+        worker = self.worker
+        ref, _ = serialization.loads(p["ref"])
+        await self._on_worker(
+            worker, worker._cancel_async(ref, p.get("force", False))
+        )
+        return True
+
+    async def _h_kill(self, conn, p):
+        self._session(conn)
+        worker = self.worker
+        return await self._on_worker(
+            worker,
+            worker.gcs.acall(
+                "kill_actor",
+                {
+                    "actor_id": p["actor_id"],
+                    "allow_restart": p.get("allow_restart", False),
+                },
+            ),
+        )
+
+    async def _h_gcs_call(self, conn, p):
+        self._session(conn)
+        worker = self.worker
+        if p["method"] not in _ALLOWED_GCS:
+            raise RayTpuError(
+                f"gcs method {p['method']!r} not allowed over the client "
+                f"boundary"
+            )
+        return await self._on_worker(
+            worker, worker.gcs.acall(p["method"], p.get("payload") or {})
+        )
+
+    async def _h_ref_new(self, conn, p):
+        session = self._session(conn)
+        worker = self.worker
+        oid = p["oid"]
+
+        async def bump():
+            obj = worker.owner_store.objects.get(oid)
+            if obj is not None:
+                obj.local_refs += 1
+
+        session.claims[oid] = session.claims.get(oid, 0) + 1
+        await self._on_worker(worker, bump())
+        return True
+
+    async def _h_ref_del(self, conn, p):
+        session = self._session(conn)
+        worker = self.worker
+        oid = p["oid"]
+        if session.claims.get(oid, 0) > 0:
+            session.claims[oid] -= 1
+            if session.claims[oid] == 0:
+                del session.claims[oid]
+        await self._on_worker(worker, worker._release_local_ref(oid))
+        return True
+
+
+class _GcsShim:
+    """Looks like CoreWorker.gcs to api.py helpers (call/acall), routed
+    through the client connection's restricted passthrough."""
+
+    def __init__(self, client: "ClientWorker"):
+        self._client = client
+
+    def call(self, method: str, payload: dict | None = None, timeout=60):
+        return self._client._call(
+            "client.gcs_call", {"method": method, "payload": payload},
+            timeout=timeout,
+        )
+
+    async def acall(self, method: str, payload: dict | None = None):
+        return await self._client._acall(
+            "client.gcs_call", {"method": method, "payload": payload}
+        )
+
+
+class ClientWorker:
+    """The client-side stand-in for CoreWorker: same call surface the
+    public API uses, every operation one RPC to the head's client server."""
+
+    def __init__(self, server_addr: tuple, token: Optional[str] = None):
+        self.server_addr = tuple(server_addr)
+        self.endpoint = Endpoint("client")
+        self.endpoint.start()
+        self.gcs = _GcsShim(self)
+        self._stopped = False
+        self._lock = threading.Lock()
+        self._suppress = threading.local()
+        try:
+            reply = self._call(
+                "client.connect", {"token": token}, timeout=30
+            )
+        except BaseException:
+            # A failed connect (bad token, unreachable server) must not
+            # leak the just-started endpoint thread + socket.
+            self.endpoint.stop()
+            raise
+        self.session_id = reply["session_id"]
+        object_ref_mod.install_hooks(
+            self._on_ref_deserialized, self._on_ref_deleted
+        )
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _call(self, method: str, payload: dict, timeout=120):
+        return self.endpoint.call(
+            self.server_addr, method, payload, timeout=timeout
+        )
+
+    def _load_reply(self, reply: bytes):
+        """Deserialize an RPC reply WITHOUT firing the ref_new hook: refs in
+        replies already carry the session's server-side claim (the server
+        pre-claims before shipping); notifying again would double-count."""
+        self._suppress.flag = True
+        try:
+            return serialization.loads(reply)[0]
+        finally:
+            self._suppress.flag = False
+
+    async def _acall(self, method: str, payload: dict):
+        return await self.endpoint.acall(self.server_addr, method, payload)
+
+    def on_endpoint_loop(self) -> bool:
+        return self.endpoint.on_loop()
+
+    def stop(self) -> None:
+        self._stopped = True
+        object_ref_mod.clear_hooks()
+        self.endpoint.stop()
+
+    # -- ref lifetime mirroring ----------------------------------------------
+
+    def _on_ref_deserialized(self, ref: ObjectRef) -> None:
+        if self._stopped or getattr(self._suppress, "flag", False):
+            return
+        try:
+            self.endpoint.submit(
+                self._acall("client.ref_new", {"oid": ref.hex()})
+            )
+        except Exception:
+            pass
+
+    def _on_ref_deleted(self, ref: ObjectRef) -> None:
+        if self._stopped:
+            return
+        try:
+            self.endpoint.submit(
+                self._acall("client.ref_del", {"oid": ref.hex()})
+            )
+        except Exception:
+            pass
+
+    # -- the CoreWorker surface api.py drives --------------------------------
+
+    def submit_task(
+        self,
+        func: Any,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str,
+        num_returns=1,
+        resources=None,
+        max_retries=None,
+        label_selector=None,
+        soft_label_selector=None,
+        policy: str = "hybrid",
+        func_payload: bytes | None = None,
+        pg=None,
+        runtime_env=None,
+    ) -> list:
+        if num_returns == "streaming":
+            raise NotImplementedError(
+                "streaming generators are not supported over the client "
+                "boundary yet (the generator is owner-bound)"
+            )
+        if func_payload is None:
+            func_payload = cloudpickle.dumps(func)
+        reply = self._call(
+            "client.submit_task",
+            {
+                "func": func_payload,
+                "call": serialization.dumps((args, kwargs))[0],
+                "name": name,
+                "num_returns": num_returns,
+                "resources": resources,
+                "max_retries": max_retries,
+                "label_selector": label_selector,
+                "soft_label_selector": soft_label_selector,
+                "policy": policy,
+                "pg": pg,
+                "runtime_env": runtime_env,
+            },
+        )
+        return self._load_reply(reply)
+
+    def create_actor(self, cls, args, kwargs, **opts) -> dict:
+        return self._call(
+            "client.create_actor",
+            {
+                "cls": cloudpickle.dumps(cls),
+                "call": serialization.dumps((args, kwargs))[0],
+                **opts,
+            },
+        )
+
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method: str,
+        args,
+        kwargs,
+        *,
+        num_returns=1,
+        name: str = "",
+        max_task_retries: int = 0,
+    ) -> list:
+        if num_returns == "streaming":
+            raise NotImplementedError(
+                "streaming generators are not supported over the client "
+                "boundary yet (the generator is owner-bound)"
+            )
+        reply = self._call(
+            "client.submit_actor_task",
+            {
+                "actor_id": actor_id,
+                "method": method,
+                "call": serialization.dumps((args, kwargs))[0],
+                "num_returns": num_returns,
+                "name": name,
+                "max_task_retries": max_task_retries,
+            },
+        )
+        return self._load_reply(reply)
+
+    def get(self, refs: list, timeout: float | None = None):
+        reply = self._call(
+            "client.get",
+            {"refs": serialization.dumps(refs)[0], "timeout": timeout},
+            timeout=None if timeout is None else timeout + 10,
+        )
+        return self._load_reply(reply)
+
+    async def _get_async(self, refs: list, timeout: float | None = None):
+        reply = await self._acall(
+            "client.get",
+            {"refs": serialization.dumps(refs)[0], "timeout": timeout},
+        )
+        return self._load_reply(reply)
+
+    def put(self, value) -> ObjectRef:
+        reply = self._call(
+            "client.put", {"value": serialization.dumps(value)[0]}
+        )
+        return self._load_reply(reply)
+
+    def wait(self, refs: list, *, num_returns: int = 1, timeout=None):
+        reply = self._call(
+            "client.wait",
+            {
+                "refs": serialization.dumps(refs)[0],
+                "num_returns": num_returns,
+                "timeout": timeout,
+            },
+            timeout=None if timeout is None else timeout + 10,
+        )
+        return self._load_reply(reply)
+
+    def cancel(self, ref: ObjectRef, force: bool = False) -> None:
+        self._call(
+            "client.cancel",
+            {"ref": serialization.dumps(ref)[0], "force": force},
+        )

@@ -77,6 +77,18 @@ def cmd_start(args) -> int:
         "node_id": node.node_id,
         "node_address": f"{node_addr[0]}:{node_addr[1]}",
     }
+    client_server = None
+    if args.head and args.client_port is not None:
+        # Remote-driver ingress (reference: the Ray Client server that
+        # `ray start --head` hosts for ray://): external, non-member
+        # processes drive this cluster through a proxy worker here.
+        from ray_tpu.core.client import ClientServer
+
+        client_server = ClientServer(
+            gcs_addr, node_addr, token=args.client_token
+        )
+        caddr = client_server.start(host=args.host, port=args.client_port)
+        info["client_address"] = f"{caddr[0]}:{caddr[1]}"
     dashboard = None
     if args.head and args.dashboard_port is not None:
         # The dashboard queries through a driver connection to this cluster.
@@ -96,6 +108,8 @@ def cmd_start(args) -> int:
     try:
         if dashboard is not None:
             dashboard.stop()
+        if client_server is not None:
+            client_server.stop()
         node.stop()
     finally:
         if gcs is not None:
@@ -143,6 +157,18 @@ def main(argv: list[str] | None = None) -> int:
         "--gcs-storage",
         default=None,
         help="sqlite path for durable GCS tables (head only; enables GCS FT)",
+    )
+    p_start.add_argument(
+        "--client-port",
+        type=int,
+        default=None,
+        help="serve remote drivers (init(mode='client')) on this port "
+        "(head only; 0=ephemeral)",
+    )
+    p_start.add_argument(
+        "--client-token",
+        default=None,
+        help="shared secret remote drivers must present",
     )
     p_start.set_defaults(fn=cmd_start)
 

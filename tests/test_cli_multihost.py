@@ -71,11 +71,10 @@ def test_cli_cluster_forms_and_runs_tasks(two_host_cluster, tmp_path):
     assert ids == {head_info["node_id"], worker_info["node_id"]}
     assert ray_tpu.cluster_resources()["CPU"] == 6.0
 
-    # Tasks land on both hosts: at 2 CPUs each they can't fit one 3-CPU
-    # node concurrently, and the rendezvous forces them to RUN concurrently
-    # (a fixed sleep raced lease reuse under full-suite load: the first task
-    # could finish before the second was pushed, legally landing both on one
-    # node).
+    # Tasks run CONCURRENTLY on both hosts: pin one per node (affinity) and
+    # rendezvous through the shared FS — deterministic, unlike racing the
+    # hybrid policy's legal lease reuse. (Spread placement itself is covered
+    # by test_core_cluster's spread test.)
     rendezvous = str(tmp_path / "rendezvous")
     os.makedirs(rendezvous, exist_ok=True)
 
@@ -85,15 +84,19 @@ def test_cli_cluster_forms_and_runs_tasks(two_host_cluster, tmp_path):
 
         with open(os.path.join(rv_dir, str(rank)), "w") as f:
             f.write("here")
-        deadline = _t.monotonic() + 30
+        deadline = _t.monotonic() + 60
         while not os.path.exists(os.path.join(rv_dir, str(peer))):
             if _t.monotonic() > deadline:
                 raise TimeoutError(f"peer {peer} never arrived")
             _t.sleep(0.05)
         return ray_tpu.get_runtime_context().node_id
 
+    target_nodes = [head_info["node_id"], worker_info["node_id"]]
     refs = [
-        where.options(num_cpus=2).remote(r, 1 - r, rendezvous)
+        where.options(
+            num_cpus=2,
+            scheduling_strategy=f"node_affinity:{target_nodes[r]}",
+        ).remote(r, 1 - r, rendezvous)
         for r in range(2)
     ]
     got = set(ray_tpu.get(refs, timeout=60))

@@ -1,0 +1,175 @@
+"""Remote-driver client mode: a process that is NOT a cluster member drives
+a daemon cluster over localhost TCP (reference surface:
+python/ray/util/client, ray.init("ray://...")).
+
+The test process never joins the cluster (no init(address=) membership, no
+node daemon here): everything flows through the head's client server."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.errors import TaskCancelledError
+
+pytestmark = pytest.mark.timeout(240)
+
+TOKEN = "s3cr3t-token"
+
+
+@pytest.fixture(scope="module")
+def head_daemon():
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "ray_tpu",
+            "start",
+            "--head",
+            "--num-cpus",
+            "4",
+            "--client-port",
+            "0",
+            "--client-token",
+            TOKEN,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("head daemon produced no address line")
+    info = json.loads(line)
+    assert "client_address" in info, info
+    try:
+        yield info
+    finally:
+        ray_tpu.shutdown()
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.fixture(scope="module")
+def client(head_daemon):
+    ray_tpu.init(
+        address=head_daemon["client_address"], mode="client", token=TOKEN
+    )
+    return head_daemon
+
+
+def test_bad_token_rejected(head_daemon):
+    from ray_tpu.core.client import ClientWorker
+    from ray_tpu.core.api import _parse_address
+
+    with pytest.raises(Exception, match="bad client token"):
+        ClientWorker(
+            _parse_address(head_daemon["client_address"]), token="wrong"
+        )
+
+
+def test_client_task_roundtrip(client):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    ref = add.remote(20, 22)
+    assert ray_tpu.get(ref, timeout=60) == 42
+    # Refs compose: pass a ref as an argument.
+    ref2 = add.remote(ref, 8)
+    assert ray_tpu.get(ref2, timeout=60) == 50
+
+
+def test_client_put_get_wait(client):
+    import numpy as np
+
+    arr = np.arange(1000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref, timeout=30)
+    assert (got == arr).all()
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(2.0)
+        return "late"
+
+    fast_ref = ray_tpu.put("fast")
+    slow_ref = slow.remote()
+    ready, not_ready = ray_tpu.wait(
+        [fast_ref, slow_ref], num_returns=1, timeout=10
+    )
+    assert ready == [fast_ref] and not_ready == [slow_ref]
+    assert ray_tpu.get(slow_ref, timeout=30) == "late"
+
+
+def test_client_actor_lifecycle(client):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 11
+    assert ray_tpu.get(c.incr.remote(5), timeout=30) == 16
+    ray_tpu.kill(c)
+
+
+def test_client_named_actor(client):
+    @ray_tpu.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="reg").remote()
+    handle = ray_tpu.get_actor("reg")
+    assert ray_tpu.get(handle.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(handle)
+
+
+def test_client_cancel(client):
+    @ray_tpu.remote
+    def sleeper():
+        for _ in range(600):
+            time.sleep(0.05)
+        return "done"
+
+    ref = sleeper.remote()
+    time.sleep(1.5)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_client_cluster_introspection(client):
+    ns = ray_tpu.nodes()
+    assert len(ns) == 1 and ns[0]["Alive"]
+    assert ray_tpu.cluster_resources()["CPU"] == 4.0
+
+
+def test_client_gcs_passthrough_is_restricted(client):
+    from ray_tpu.core import api as core_api
+
+    with pytest.raises(Exception, match="not allowed"):
+        core_api._require_worker().gcs.call("kv_put", {"k": "x", "v": b"y"})
+
+
+def test_client_streaming_rejected_clearly(client):
+    @ray_tpu.remote
+    def gen():
+        yield 1
+
+    with pytest.raises(NotImplementedError, match="client"):
+        gen.options(num_returns="streaming").remote()
