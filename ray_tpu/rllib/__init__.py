@@ -18,15 +18,20 @@ single_agent_env_runner.py:67), redesigned TPU-first:
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, QModule
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import MLPModule, RLModule
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "DQN",
+    "DQNConfig",
+    "DQNLearner",
     "EnvRunner",
     "Learner",
     "LearnerGroup",
@@ -34,6 +39,8 @@ __all__ = [
     "PPO",
     "PPOConfig",
     "PPOLearner",
+    "QModule",
+    "ReplayBuffer",
     "RLModule",
     "SampleBatch",
 ]

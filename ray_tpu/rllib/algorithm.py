@@ -93,10 +93,12 @@ def _env_maker(env):
 
 
 class Algorithm:
-    """Base driver. Subclasses set ``learner_cls`` and may override
+    """Base driver. Subclasses set ``learner_cls`` (and possibly
+    ``env_runner_cls`` + :meth:`env_runner_kwargs`) and may override
     :meth:`default_module`."""
 
     learner_cls: type = None  # type: ignore[assignment]
+    env_runner_cls: type = EnvRunner
 
     def __init__(self, config: AlgorithmConfig):
         import ray_tpu
@@ -120,21 +122,24 @@ class Algorithm:
         )
         runner_opts = config.env_runner_resources or {"num_cpus": 1}
         self.env_runners = [
-            ray_tpu.remote(EnvRunner)
+            ray_tpu.remote(self.env_runner_cls)
             .options(**runner_opts)
-            .remote(
-                maker,
-                self.module,
-                num_envs=config.num_envs_per_env_runner,
-                rollout_fragment_length=config.rollout_fragment_length,
-                gamma=config.gamma,
-                lambda_=config.lambda_,
-                seed=config.seed,
-                worker_index=i,
-            )
+            .remote(maker, self.module, **self.env_runner_kwargs(config, i))
             for i in range(config.num_env_runners)
         ]
         self._sync_weights()
+
+    def env_runner_kwargs(self, config: AlgorithmConfig, i: int) -> dict:
+        """Per-runner constructor kwargs; algorithms with different rollout
+        needs (e.g. DQN's epsilon-greedy transition collector) override."""
+        return dict(
+            num_envs=config.num_envs_per_env_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            gamma=config.gamma,
+            lambda_=config.lambda_,
+            seed=config.seed,
+            worker_index=i,
+        )
 
     # -- overridables -------------------------------------------------------
     def default_module(self, maker, config: AlgorithmConfig) -> RLModule:

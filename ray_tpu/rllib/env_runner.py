@@ -51,11 +51,12 @@ def compute_gae(
     return adv, adv + values
 
 
-class EnvRunner:
-    """Samples fixed-length fragments from a gymnasium vector env.
-
-    Run as a ray_tpu actor: ``remote(EnvRunner).options(...).remote(...)``.
-    """
+class RolloutBase:
+    """Shared rollout-actor machinery: vector env, CPU-backend pinning,
+    gymnasium NEXT_STEP autoreset bookkeeping, episode accounting, weight
+    sync. Subclasses implement :meth:`sample` — the on-policy EnvRunner
+    (dist-sampled actions + GAE) and DQN's epsilon-greedy transition
+    collector differ ONLY there."""
 
     def __init__(
         self,
@@ -64,8 +65,6 @@ class EnvRunner:
         *,
         num_envs: int = 1,
         rollout_fragment_length: int = 200,
-        gamma: float = 0.99,
-        lambda_: float = 0.95,
         seed: int = 0,
         worker_index: int = 0,
     ):
@@ -74,12 +73,9 @@ class EnvRunner:
         self.module = module
         self.num_envs = num_envs
         self.fragment_len = rollout_fragment_length
-        self.gamma = gamma
-        self.lam = lambda_
         self._envs = gym.vector.SyncVectorEnv(
             [env_maker for _ in range(num_envs)]
         )
-        self._key = jax.random.key(seed * 100003 + worker_index)
         self._params = None
         self._obs, _ = self._envs.reset(seed=seed * 7919 + worker_index)
         # Envs that finished on the previous step: gymnasium >=1.0 NEXT_STEP
@@ -93,18 +89,6 @@ class EnvRunner:
             self._cpu = jax.local_devices(backend="cpu")[0]
         except RuntimeError:  # pragma: no cover - no CPU backend
             self._cpu = None
-
-        @jax.jit
-        def _policy_step(params, obs, key):
-            out = self.module.forward(params, obs)
-            actions = self.module.dist_sample(out, key)
-            logp = self.module.dist_logp(out, actions)
-            return actions, logp, out["vf"]
-
-        self._policy_step = _policy_step
-        self._vf = jax.jit(
-            lambda params, obs: self.module.forward(params, obs)["vf"]
-        )
         # Per-env running episode accounting + a window of finished episodes.
         self._ep_return = np.zeros(num_envs, np.float64)
         self._ep_len = np.zeros(num_envs, np.int64)
@@ -128,6 +112,83 @@ class EnvRunner:
 
     def ping(self) -> bool:
         return True
+
+    def _record_episode_step(self, rew, live, term, trunc) -> np.ndarray:
+        """Advance episode accounting for one vector step; returns the done
+        mask (also the next step's autoreset set)."""
+        self._ep_return += rew * live
+        self._ep_len += live
+        done = np.logical_or(term, trunc)
+        for i in np.flatnonzero(done):
+            self._episode_returns.append(self._ep_return[i])
+            self._episode_lengths.append(int(self._ep_len[i]))
+            self._ep_return[i] = 0.0
+            self._ep_len[i] = 0
+        self._autoreset = done
+        return done
+
+    def sample(self) -> SampleBatch:
+        raise NotImplementedError
+
+    def metrics(self) -> dict:
+        rets = list(self._episode_returns)
+        return {
+            "num_env_steps_sampled": self._total_steps,
+            "num_episodes": len(rets),
+            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
+            "episode_return_max": float(np.max(rets)) if rets else np.nan,
+            "episode_len_mean": (
+                float(np.mean(self._episode_lengths))
+                if self._episode_lengths
+                else np.nan
+            ),
+        }
+
+    def stop(self) -> None:
+        self._envs.close()
+
+
+class EnvRunner(RolloutBase):
+    """Samples fixed-length fragments from a gymnasium vector env.
+
+    Run as a ray_tpu actor: ``remote(EnvRunner).options(...).remote(...)``.
+    """
+
+    def __init__(
+        self,
+        env_maker: Callable,
+        module: RLModule,
+        *,
+        num_envs: int = 1,
+        rollout_fragment_length: int = 200,
+        gamma: float = 0.99,
+        lambda_: float = 0.95,
+        seed: int = 0,
+        worker_index: int = 0,
+    ):
+        super().__init__(
+            env_maker,
+            module,
+            num_envs=num_envs,
+            rollout_fragment_length=rollout_fragment_length,
+            seed=seed,
+            worker_index=worker_index,
+        )
+        self.gamma = gamma
+        self.lam = lambda_
+        self._key = jax.random.key(seed * 100003 + worker_index)
+
+        @jax.jit
+        def _policy_step(params, obs, key):
+            out = self.module.forward(params, obs)
+            actions = self.module.dist_sample(out, key)
+            logp = self.module.dist_logp(out, actions)
+            return actions, logp, out["vf"]
+
+        self._policy_step = _policy_step
+        self._vf = jax.jit(
+            lambda params, obs: self.module.forward(params, obs)["vf"]
+        )
 
     # -- sampling -----------------------------------------------------------
     def sample(self) -> SampleBatch:
@@ -161,15 +222,7 @@ class EnvRunner:
             rew_buf[t] = rew
             term_buf[t] = term
             trunc_buf[t] = trunc
-            self._ep_return += rew * live
-            self._ep_len += live
-            done = np.logical_or(term, trunc)
-            for i in np.flatnonzero(done):
-                self._episode_returns.append(self._ep_return[i])
-                self._episode_lengths.append(int(self._ep_len[i]))
-                self._ep_return[i] = 0.0
-                self._ep_len[i] = 0
-            self._autoreset = done
+            self._record_episode_step(rew, live, term, trunc)
             self._obs = next_obs
         self._total_steps += int(mask_buf.sum())
 
@@ -192,20 +245,3 @@ class EnvRunner:
                 sb.LOSS_MASK: flat(mask_buf),
             }
         )
-
-    def metrics(self) -> dict:
-        rets = list(self._episode_returns)
-        return {
-            "num_env_steps_sampled": self._total_steps,
-            "num_episodes": len(rets),
-            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
-            "episode_return_max": float(np.max(rets)) if rets else np.nan,
-            "episode_len_mean": (
-                float(np.mean(self._episode_lengths))
-                if self._episode_lengths
-                else np.nan
-            ),
-        }
-
-    def stop(self) -> None:
-        self._envs.close()

@@ -1,0 +1,111 @@
+"""Replay buffer actor: off-policy experience storage.
+
+Reference parity: rllib/utils/replay_buffers/replay_buffer.py
+(ReplayBuffer, storage_unit=timesteps) run as an actor the way the
+reference's multi-agent replay shards are. Uniform sampling over a
+fixed-capacity ring of numpy columns: storage stays host-side (cheap CPU
+RAM), only sampled train batches travel to the learner's device mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class ReplayBuffer:
+    """Fixed-capacity uniform replay over SampleBatch columns. Use as an
+    actor: ``ray_tpu.remote(ReplayBuffer).remote(capacity=50_000)``."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._cols: dict[str, np.ndarray] | None = None  # ring storage
+        self._write = 0
+        self._size = 0
+        self._added = 0
+        self._rng = np.random.default_rng(seed)
+
+    def _ensure_storage(self, batch: SampleBatch) -> None:
+        if self._cols is not None:
+            return
+        self._cols = {
+            k: np.empty((self.capacity,) + v.shape[1:], v.dtype)
+            for k, v in batch.items()
+        }
+
+    def add(self, batch: SampleBatch) -> int:
+        """Append timesteps (oldest entries overwritten once full).
+        Returns the buffer size after the add."""
+        n = len(batch)
+        if n == 0:
+            return self._size
+        self._ensure_storage(batch)
+        assert self._cols is not None
+        if set(batch.keys()) != set(self._cols.keys()):
+            raise ValueError(
+                f"batch columns {sorted(batch)} != buffer columns "
+                f"{sorted(self._cols)}"
+            )
+        if n >= self.capacity:  # keep only the newest capacity rows
+            for k, v in batch.items():
+                self._cols[k][:] = v[-self.capacity:]
+            self._write, self._size = 0, self.capacity
+        else:
+            end = self._write + n
+            for k, v in batch.items():
+                if end <= self.capacity:
+                    self._cols[k][self._write:end] = v
+                else:
+                    split = self.capacity - self._write
+                    self._cols[k][self._write:] = v[:split]
+                    self._cols[k][: end - self.capacity] = v[split:]
+            self._write = end % self.capacity
+            self._size = min(self.capacity, self._size + n)
+        self._added += n
+        return self._size
+
+    def sample(self, num_items: int) -> SampleBatch:
+        """Uniform sample WITH replacement (matches the reference's default
+        uniform replay; replacement keeps sampling O(n) and exact-size)."""
+        if self._size == 0:
+            raise ValueError("cannot sample from an empty buffer")
+        assert self._cols is not None
+        idx = self._rng.integers(0, self._size, size=num_items)
+        return SampleBatch({k: v[idx].copy() for k, v in self._cols.items()})
+
+    def size(self) -> int:
+        return self._size
+
+    def stats(self) -> dict:
+        return {
+            "size": self._size,
+            "capacity": self.capacity,
+            "added_lifetime": self._added,
+        }
+
+    # -- checkpointing (DQN.save/restore carries the buffer) -----------------
+
+    def get_state(self) -> dict:
+        cols = None
+        if self._cols is not None:
+            # Only the live rows, in ring order — compact and
+            # capacity-change-tolerant on restore.
+            idx = (self._write - self._size + np.arange(self._size)) % (
+                self.capacity
+            )
+            cols = {k: v[idx].copy() for k, v in self._cols.items()}
+        return {"cols": cols, "added": self._added, "rng": self._rng}
+
+    def set_state(self, state: dict) -> bool:
+        self._cols, self._write, self._size = None, 0, 0
+        self._added = 0
+        if state.get("cols"):
+            self.add(SampleBatch(state["cols"]))
+        self._added = state.get("added", self._added)
+        rng = state.get("rng")
+        if rng is not None:
+            self._rng = rng
+        return True

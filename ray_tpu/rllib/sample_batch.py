@@ -14,6 +14,7 @@ import numpy as np
 
 # Canonical column names (reference: rllib/policy/sample_batch.py columns).
 OBS = "obs"
+NEXT_OBS = "next_obs"
 ACTIONS = "actions"
 REWARDS = "rewards"
 TERMINATEDS = "terminateds"

@@ -1,0 +1,166 @@
+"""DQN: replay-buffer off-policy learning on the shared Learner/EnvRunner
+plumbing (reference: rllib/algorithms/dqn/, rllib/utils/replay_buffers/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import DQN, DQNConfig, DQNLearner, QModule, ReplayBuffer
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.dqn import TD_TARGETS, DQNParams
+from ray_tpu.rllib.learner import LearnerHyperparams
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+pytestmark = [
+    pytest.mark.filterwarnings("ignore"),
+    pytest.mark.timeout(600),
+]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def _transitions(rng, n, obs_dim=4, n_act=2):
+    obs = rng.normal(size=(n, obs_dim)).astype(np.float32)
+    return SampleBatch(
+        {
+            sb.OBS: obs,
+            sb.ACTIONS: rng.integers(0, n_act, size=(n,)),
+            sb.REWARDS: rng.normal(size=(n,)).astype(np.float32),
+            sb.NEXT_OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+            sb.TERMINATEDS: (rng.random(n) < 0.1).astype(np.float32),
+        }
+    )
+
+
+# -- replay buffer (plain object; the algorithm runs it as an actor) ---------
+
+
+def test_replay_buffer_ring_and_sampling():
+    rng = np.random.default_rng(0)
+    buf = ReplayBuffer(capacity=100, seed=0)
+    assert buf.add(_transitions(rng, 30)) == 30
+    assert buf.add(_transitions(rng, 90)) == 100  # wrapped
+    out = buf.sample(64)
+    assert len(out) == 64 and set(out.keys()) == {
+        sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS, sb.TERMINATEDS,
+    }
+    assert buf.stats()["added_lifetime"] == 120
+    # Oversized add keeps only the newest capacity rows.
+    big = _transitions(rng, 250)
+    assert buf.add(big) == 100
+    np.testing.assert_array_equal(buf.sample(1)[sb.OBS].shape, (1, 4))
+
+
+def test_replay_buffer_rejects_mismatched_columns():
+    rng = np.random.default_rng(1)
+    buf = ReplayBuffer(capacity=10)
+    buf.add(_transitions(rng, 5))
+    with pytest.raises(ValueError, match="columns"):
+        buf.add(SampleBatch({sb.OBS: np.zeros((2, 4), np.float32)}))
+
+
+# -- learner unit: TD targets + target network -------------------------------
+
+
+def test_dqn_learner_td_and_target_sync():
+    module = QModule(obs_dim=4, num_actions=2, hidden=(16,))
+    learner = DQNLearner(
+        module,
+        LearnerHyperparams(
+            lr=1e-3, num_sgd_epochs=1, minibatch_size=32, seed=0
+        ),
+        DQNParams(gamma=0.9, target_network_update_freq=2),
+    )
+    learner.build()
+    rng = np.random.default_rng(2)
+    batch = _transitions(rng, 32)
+
+    # TD targets: terminal rows must not bootstrap.
+    targets = np.asarray(
+        learner._td_targets(
+            learner.params,
+            learner.target_params,
+            batch[sb.NEXT_OBS],
+            batch[sb.REWARDS],
+            batch[sb.TERMINATEDS],
+        )
+    )
+    terminal = batch[sb.TERMINATEDS] == 1.0
+    np.testing.assert_allclose(
+        targets[terminal], batch[sb.REWARDS][terminal], rtol=1e-5
+    )
+
+    w0 = learner.get_weights()
+    stats = learner.update(batch)
+    assert np.isfinite(stats["total_loss"])
+    w1 = learner.get_weights()
+    assert any(
+        not np.allclose(a["w"], b["w"]) for a, b in zip(w0["q"], w1["q"])
+    )
+    # freq=2 grad steps: the single step above didn't sync; one more does.
+    t_before = learner.get_state()["target_params"]
+    learner.update(batch)
+    t_after = learner.get_state()["target_params"]
+    assert any(
+        not np.allclose(a["w"], b["w"])
+        for a, b in zip(t_before["q"], t_after["q"])
+    )
+
+
+def test_dqn_state_roundtrip_includes_target():
+    module = QModule(obs_dim=4, num_actions=2, hidden=(8,))
+    learner = DQNLearner(
+        module, LearnerHyperparams(minibatch_size=16, num_sgd_epochs=1)
+    )
+    learner.build()
+    rng = np.random.default_rng(3)
+    learner.update(_transitions(rng, 16))
+    state = learner.get_state()
+    assert "target_params" in state
+
+    learner2 = DQNLearner(
+        module, LearnerHyperparams(minibatch_size=16, num_sgd_epochs=1)
+    )
+    learner2.build()
+    learner2.set_state(state)
+    for a, b in zip(
+        state["target_params"]["q"],
+        learner2.get_state()["target_params"]["q"],
+    ):
+        np.testing.assert_array_equal(a["w"], b["w"])
+
+
+# -- end to end: CartPole learns ---------------------------------------------
+
+
+def test_dqn_cartpole_learns(cluster):
+    """DQN beats the random policy (~20) on CartPole within a short budget —
+    the round-2 verdict's 'second algorithm family' done-criterion."""
+    config = DQNConfig(
+        num_env_runners=2,
+        num_envs_per_env_runner=4,
+        rollout_fragment_length=64,
+        lr=1e-3,
+        hidden=(64, 64),
+        seed=0,
+        epsilon_anneal_steps=3_000,
+        learning_starts=500,
+        train_batch_size=64,
+        num_train_batches_per_iteration=64,
+        target_network_update_freq=200,
+    ).environment("CartPole-v1")
+    algo = config.build()
+    first = algo.train()
+    result = first
+    for _ in range(29):
+        result = algo.train()
+    assert result["training_iteration"] == 30
+    assert result["replay_buffer_size"] > 0
+    assert result["epsilon"] < first["epsilon"]  # anneal actually happened
+    assert result["episode_return_mean"] > 45, result
+    algo.stop()
