@@ -25,6 +25,7 @@ _REDUCE_LAX = {
     ReduceOp.SUM: "psum",
     ReduceOp.MAX: "pmax",
     ReduceOp.MIN: "pmin",
+    ReduceOp.PRODUCT: "pprod",  # no lax primitive; reducescatter emulates
 }
 
 
@@ -162,13 +163,50 @@ class XlaGroup(Communicator):
 
                 out_spec = P()
             elif kind == "reducescatter":
+                red = static.get("op", "psum")
+                if red == "psum":
 
-                def body(x):
-                    import jax.lax as lax
+                    def body(x):
+                        import jax.lax as lax
 
-                    return lax.psum_scatter(
-                        x[0], "ranks", scatter_dimension=0, tiled=True
-                    )
+                        return lax.psum_scatter(
+                            x[0], "ranks", scatter_dimension=0, tiled=True
+                        )
+
+                else:
+                    # MIN/MAX/PRODUCT: no fused lax scatter-reduce exists;
+                    # all-gather + elementwise reduce + take this rank's
+                    # tile. Costs one all-gather more than psum_scatter —
+                    # fine for these rare ops.
+                    import jax.numpy as jnp
+
+                    reducer = {
+                        "pmin": jnp.min,
+                        "pmax": jnp.max,
+                        "pprod": jnp.prod,
+                    }[red]
+
+                    def body(x):
+                        import jax.lax as lax
+
+                        full = reducer(
+                            lax.all_gather(x[0], "ranks"), axis=0
+                        )
+                        if full.shape[0] % self._world_size:
+                            # Match the SUM path and the cpu backend: an
+                            # indivisible dim0 must raise, never silently
+                            # truncate.
+                            raise ValueError(
+                                f"reducescatter dim0 {full.shape[0]} not "
+                                f"divisible by world {self._world_size}"
+                            )
+                        chunk = full.shape[0] // self._world_size
+                        return lax.dynamic_slice_in_dim(
+                            full,
+                            lax.axis_index("ranks") * chunk,
+                            chunk,
+                            axis=0,
+                        )
 
                 out_spec = P("ranks")
             else:
@@ -234,12 +272,9 @@ class XlaGroup(Communicator):
         import jax.numpy as jnp
 
         op = ReduceOp(op)
-        if op not in (ReduceOp.SUM,):
-            raise NotImplementedError(
-                "XLA reducescatter supports SUM (psum_scatter); use the cpu "
-                "backend for other ops"
-            )
-        return jnp.asarray(self._run("reducescatter", tensor))
+        return jnp.asarray(
+            self._run("reducescatter", tensor, op=_REDUCE_LAX[op])
+        )
 
     def send(self, tensor, dst_rank: int) -> None:
         import ray_tpu
