@@ -566,6 +566,7 @@ class ActorClass:
             # 0 = auto: sync methods serialize; async methods cap at 1000
             # (the reference's async-actor default).
             max_concurrency=opts.get("max_concurrency", 0),
+            concurrency_groups=opts.get("concurrency_groups"),
             label_selector=label_selector,
             soft_label_selector=soft_sel,
             policy=policy,

@@ -251,6 +251,7 @@ class ClientServer:
             resources=p.get("resources"),
             max_restarts=p.get("max_restarts", 0),
             max_concurrency=p.get("max_concurrency", 0),
+            concurrency_groups=p.get("concurrency_groups"),
             label_selector=p.get("label_selector"),
             soft_label_selector=p.get("soft_label_selector"),
             policy=p.get("policy", "hybrid"),

@@ -35,6 +35,16 @@ class Config:
     # Verify node-to-node transfers with a native FNV-1a fingerprint
     # (opt-in: trades ~1 GB/s of hashing per side for corruption detection).
     verify_transfers: bool = False
+    # Transfer admission control (reference: push_manager.h chunk in-flight
+    # caps + pull_manager.h admission): max object-chunk requests a node
+    # SERVES concurrently (a 50-node broadcast must queue here, not
+    # stampede), and max distinct objects a node PULLS concurrently.
+    object_serve_concurrency: int = 8
+    object_pull_concurrency: int = 4
+    # Per-chunk transfer deadline: generous for an 8 MiB chunk on a loaded
+    # source (admission-queued serves included), but bounded so a wedged
+    # source can't pin a pull slot forever.
+    object_chunk_timeout_s: float = 120.0
     # Worker pool (reference: worker_pool.h maximum_startup_concurrency +
     # idle worker killing). max_worker_processes caps TASK workers per node
     # (0 = auto: max(4, 2 * host cores)); actors bypass the cap (they hold

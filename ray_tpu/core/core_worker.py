@@ -20,6 +20,7 @@ import concurrent.futures
 import contextlib
 import inspect
 import os
+import random
 import threading
 import time
 import traceback
@@ -145,6 +146,7 @@ class CoreWorker:
         self._actor_init_error: Exception | None = None
         self._actor_lock: threading.Lock = threading.Lock()
         self._actor_semaphore: asyncio.Semaphore | None = None
+        self._concurrency_groups: dict[str, dict] = {}  # name -> exec/sem
         self._actor_seq: dict[str, int] = {}  # caller -> next expected seq
         self._actor_buffer: dict[tuple, Any] = {}  # (caller, seq) -> pending
 
@@ -224,6 +226,8 @@ class CoreWorker:
                 pass
         if self._executor is not None:
             self._executor.shutdown(wait=False, cancel_futures=True)
+        for grp in self._concurrency_groups.values():
+            grp["executor"].shutdown(wait=False, cancel_futures=True)
         self.endpoint.stop()
 
     # -- task events ---------------------------------------------------------
@@ -414,7 +418,9 @@ class CoreWorker:
             # the rerun's copy is a fresh blob even if it landed on an
             # excluded node.
             avail = obj.locations if reconstructed else obj.locations - exclude
-            node_id = next(iter(avail), None)
+            # Random copy: concurrent borrowers spread over all replicas
+            # instead of stampeding whichever location iterates first.
+            node_id = random.choice(tuple(avail)) if avail else None
             if node_id is None:
                 obj.locations -= exclude
                 try:
@@ -456,7 +462,12 @@ class CoreWorker:
         return True
 
     async def _h_owner_add_location(self, conn, p):
-        self.owner_store.put_location(p["oid"], p["node_id"], p["size"])
+        """A borrower's node finished pulling a copy: record it so later
+        fetchers spread across copies (BitTorrent-style broadcast scaling —
+        the role the reference's push manager plays for hot objects).
+        Freed entries must NOT be resurrected."""
+        if p["oid"] in self.owner_store.objects:
+            self.owner_store.put_location(p["oid"], p["node_id"], p["size"])
         return True
 
     # -- cluster view helpers ------------------------------------------------
@@ -558,10 +569,16 @@ class CoreWorker:
                     raise obj.error
                 if obj.inline is not None:
                     return obj.inline
-                node_id = next(iter(obj.locations), None)
+                locs = tuple(obj.locations)
+                # A local copy wins outright (no node RPC); otherwise a
+                # random replica spreads concurrent fetch load.
+                if self.node_id in obj.locations:
+                    node_id = self.node_id
+                else:
+                    node_id = random.choice(locs) if locs else None
                 if node_id is not None:
                     try:
-                        return await self._fetch_from_location(
+                        data = await self._fetch_from_location(
                             oid,
                             {
                                 "node_id": node_id,
@@ -570,6 +587,11 @@ class CoreWorker:
                                 "shm_root": None,
                             },
                         )
+                        if node_id != self.node_id:
+                            # The pull left a copy on OUR node: record it so
+                            # other fetchers can ride it (broadcast spread).
+                            obj.locations.add(self.node_id)
+                        return data
                     except (GetTimeoutError, TaskCancelledError):
                         raise
                     except Exception:
@@ -604,13 +626,30 @@ class CoreWorker:
                 return reply["inline"]
             loc = reply["location"]
             try:
-                return await self._fetch_from_location(oid, loc)
+                data = await self._fetch_from_location(oid, loc)
             except (GetTimeoutError, TaskCancelledError):
                 raise
             except Exception:
                 if loc["node_id"] in exclude:
                     raise
                 exclude.append(loc["node_id"])
+                continue
+            if loc["node_id"] != self.node_id:
+                # Tell the owner our node now holds a copy: later borrowers
+                # spread across replicas instead of stampeding the source.
+                try:
+                    await self.endpoint.anotify(
+                        ref.owner_addr,
+                        "owner.add_location",
+                        {
+                            "oid": oid,
+                            "node_id": self.node_id,
+                            "size": loc["size"],
+                        },
+                    )
+                except Exception:
+                    pass
+            return data
 
     async def _reconstruct(self, oid: str) -> None:
         """Resubmit the producing task of a lost owned object (lineage
@@ -1279,6 +1318,7 @@ class CoreWorker:
         resources: dict | None = None,
         max_restarts: int = 0,
         max_concurrency: int = 0,  # 0 = auto (sync serial, async 1000)
+        concurrency_groups: dict | None = None,
         label_selector: dict | None = None,
         soft_label_selector: dict | None = None,
         policy: str = "hybrid",
@@ -1295,6 +1335,7 @@ class CoreWorker:
             "resources": dict(resources) if resources is not None else {"CPU": 1.0},
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,
+            "concurrency_groups": dict(concurrency_groups or {}),
             "label_selector": dict(label_selector or {}),
             "soft_label_selector": dict(soft_label_selector or {}),
             "policy": policy,
@@ -1405,6 +1446,27 @@ class CoreWorker:
         self._actor_semaphore = asyncio.Semaphore(
             max_conc if max_conc > 0 else 1000
         )
+        # Named concurrency groups (reference: core_worker fiber.h /
+        # concurrency_groups): each group gets its OWN sync thread pool and
+        # async semaphore, so e.g. long "compute" calls can't starve "io"
+        # health checks. Methods opt in via @ray_tpu.method(
+        # concurrency_group="io"); resolution happens here (executor side)
+        # from the method attribute — no protocol change.
+        self._concurrency_groups = {}
+        for gname, limit in (spec.get("concurrency_groups") or {}).items():
+            limit = int(limit)
+            if limit < 1:
+                raise ValueError(
+                    f"concurrency group {gname!r} limit must be >= 1, "
+                    f"got {limit}"
+                )
+            self._concurrency_groups[gname] = {
+                "executor": concurrent.futures.ThreadPoolExecutor(
+                    max_workers=limit,
+                    thread_name_prefix=f"actor-{gname}",
+                ),
+                "semaphore": asyncio.Semaphore(limit),
+            }
         loop = asyncio.get_running_loop()
         self._actor_id = p["actor_id"]
         self._actor_pg = tuple(spec["pg"]) if spec.get("pg") else None
@@ -1777,6 +1839,22 @@ class CoreWorker:
             loop = asyncio.get_running_loop()
             pginfo = self._actor_pg
             t_exec0 = time.time()
+            # Named concurrency group (set by @ray_tpu.method): its own
+            # thread pool + semaphore instead of the actor-wide defaults.
+            group = getattr(method, "_ray_tpu_method_opts", {}).get(
+                "concurrency_group"
+            )
+            grp = self._concurrency_groups.get(group) if group else None
+            if group and grp is None:
+                # A typo here would silently void the isolation the user
+                # configured (the reference raises too).
+                raise ValueError(
+                    f"method {p['method']!r} names unknown concurrency "
+                    f"group {group!r} (declared: "
+                    f"{sorted(self._concurrency_groups) or 'none'})"
+                )
+            executor = grp["executor"] if grp else self._executor
+            semaphore = grp["semaphore"] if grp else self._actor_semaphore
 
             def run_method():
                 from ray_tpu.util import tracing
@@ -1794,9 +1872,9 @@ class CoreWorker:
                         args,
                         kwargs,
                         pginfo,
-                        self._executor,
+                        executor,
                         semaphore=(
-                            self._actor_semaphore
+                            semaphore
                             if asyncio.iscoroutinefunction(method)
                             or inspect.isasyncgenfunction(method)
                             else None
@@ -1808,13 +1886,13 @@ class CoreWorker:
                     }
                 if asyncio.iscoroutinefunction(method):
                     advance()  # start-order satisfied; allow interleaving
-                    async with self._actor_semaphore:
+                    async with semaphore:
                         with _bind_ambient_pg(pginfo):
                             result = await method(*args, **kwargs)
                 else:
                     advance()  # executor thread serializes sync methods
                     result = await loop.run_in_executor(
-                        self._executor, run_method
+                        executor, run_method
                     )
                 results = self._encode_results(p, result)
                 await self._flush_created(results)

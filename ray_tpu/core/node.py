@@ -113,6 +113,16 @@ class NodeManager:
         self._idle_waiters: list = []  # futures waiting for an idle worker
         self._terminated_procs: list = []  # reaped, awaiting exit collection
         self._inflight_pulls: dict[str, asyncio.Future] = {}
+        # Transfer admission control (reference: push_manager.h /
+        # pull_manager.h): bound concurrent chunk SERVES (a broadcast of one
+        # hot object to N nodes queues here instead of stampeding this
+        # node's store + loop) and concurrent distinct-object PULLS.
+        self._serve_slots = asyncio.Semaphore(
+            GLOBAL_CONFIG.object_serve_concurrency
+        )
+        self._pull_slots = asyncio.Semaphore(
+            GLOBAL_CONFIG.object_pull_concurrency
+        )
         self._spread_rr = 0
         self._last_view_refresh = 0.0
         self._view_since = -1  # versioned-delta cursor (-1: nothing seen)
@@ -990,20 +1000,24 @@ class NodeManager:
         return False
 
     async def _h_fetch_object(self, conn, p):
-        """Peer node requests a chunk of a sealed object."""
-        if not await self._store_call(self.store.contains, p["oid"]):
-            # The sealed file is ground truth; a local worker may have sealed
-            # it before its object_created notification reached us.
-            path = os.path.join(self.shm_root, p["oid"])
-            if os.path.exists(path):
-                await self._store_call(
-                    self.store.adopt, p["oid"], os.path.getsize(path)
-                )
-        # read_range copies under the store lock — a concurrent spill can't
-        # invalidate the view mid-slice.
-        return await self._store_call(
-            self.store.read_range, p["oid"], p["offset"], p["length"]
-        )
+        """Peer node requests a chunk of a sealed object. Admission: at most
+        object_serve_concurrency chunk reads in flight — excess requesters
+        queue on the semaphore (their RPC just completes later)."""
+        async with self._serve_slots:
+            if not await self._store_call(self.store.contains, p["oid"]):
+                # The sealed file is ground truth; a local worker may have
+                # sealed it before its object_created notification reached
+                # us.
+                path = os.path.join(self.shm_root, p["oid"])
+                if os.path.exists(path):
+                    await self._store_call(
+                        self.store.adopt, p["oid"], os.path.getsize(path)
+                    )
+            # read_range copies under the store lock — a concurrent spill
+            # can't invalidate the view mid-slice.
+            return await self._store_call(
+                self.store.read_range, p["oid"], p["offset"], p["length"]
+            )
 
     async def _h_pull_object(self, conn, p):
         """A local worker asks us to fetch an object from a remote node.
@@ -1018,7 +1032,10 @@ class NodeManager:
         fut = asyncio.get_running_loop().create_future()
         self._inflight_pulls[oid] = fut
         try:
-            result = await self._do_pull(oid, tuple(p["from_addr"]), p["size"])
+            async with self._pull_slots:  # pull admission control
+                result = await self._do_pull(
+                    oid, tuple(p["from_addr"]), p["size"]
+                )
             fut.set_result(result)
             return result
         except Exception as e:
@@ -1036,10 +1053,16 @@ class NodeManager:
             off = 0
             while off < size:
                 ln = min(chunk, size - off)
-                data = await self.endpoint.acall(
-                    src_addr,
-                    "node.fetch_object",
-                    {"oid": oid, "offset": off, "length": ln},
+                # Per-chunk deadline: a TCP-alive-but-wedged source must
+                # fail the pull and release its admission slot, not hold it
+                # (and every queued pull behind it) forever.
+                data = await asyncio.wait_for(
+                    self.endpoint.acall(
+                        src_addr,
+                        "node.fetch_object",
+                        {"oid": oid, "offset": off, "length": ln},
+                    ),
+                    timeout=GLOBAL_CONFIG.object_chunk_timeout_s,
                 )
                 buf[off : off + ln] = data
                 off += ln

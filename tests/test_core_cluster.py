@@ -167,3 +167,39 @@ def test_freed_object_fetch_errors_not_hangs(cluster):
     out = ray_tpu.get(f.fetch.remote(payload), timeout=30)
     assert "ObjectLostError" in out or "freed" in out, out
     ray_tpu.kill(f)
+
+
+def test_pulled_copies_register_and_spread(cluster):
+    """After a node pulls a remote object, the owner learns the new copy
+    (reference role: push_manager.h broadcast scaling — here pulled copies
+    become additional sources, so broadcasts spread instead of stampeding
+    the original)."""
+    runtime, node2, node3 = cluster
+
+    @ray_tpu.remote(resources={"accel": 1.0}, num_cpus=0)
+    def produce():
+        return np.arange(600_000, dtype=np.float32)  # ~2.4 MB: shm path
+
+    ref = produce.remote()
+    ray_tpu.get(ref, timeout=60)  # driver (head node) pulled a copy
+    owner = core_api._require_worker()
+    obj = owner.owner_store.objects[ref.hex()]
+    assert node2.node_id in obj.locations  # sealed where it was produced
+    assert owner.node_id in obj.locations  # the pull registered our copy
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=f"node_affinity:{node3.node_id}")
+    def consume(x):
+        import ray_tpu as rr
+
+        return float(x[0]), rr.get_runtime_context().node_id
+
+    # Under module load affinity may place elsewhere — assert on the node
+    # the task ACTUALLY ran on: whichever node fetched must end up a
+    # registered source.
+    val, exec_node = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert val == 0.0
+    deadline = time.monotonic() + 10
+    while exec_node not in obj.locations:
+        assert time.monotonic() < deadline, (exec_node, obj.locations)
+        time.sleep(0.1)
+    assert len(obj.locations) >= 2  # every toucher is now a source
