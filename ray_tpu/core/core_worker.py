@@ -2037,6 +2037,48 @@ class CoreWorker:
             return {"cancelled": True, "delivered": bool(delivered)}
         return {"cancelled": tid is not None}
 
+    # -- live profiling (reference: dashboard reporter profile_manager) ------
+
+    async def _h_worker_profile(self, conn, p):
+        """Sampled CPU profile of this process (collapsed stacks); runs on
+        an executor thread so the sampler sees the loop working."""
+        from ray_tpu.util import profiling
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: profiling.sample_collapsed_stacks(
+                float(p.get("duration_s", 5.0)),
+                float(p.get("interval_s", 0.01)),
+            ),
+        )
+
+    async def _h_worker_dump_stacks(self, conn, p):
+        from ray_tpu.util import profiling
+
+        return profiling.collect_stack_dump()
+
+    async def _h_worker_jax_trace(self, conn, p):
+        """Capture a jax.profiler (XPlane) trace of this process — device
+        ops included when this worker drives a TPU (SURVEY §5.1)."""
+        import tempfile
+
+        from ray_tpu.util import profiling
+
+        # Disk-backed default, never /dev/shm: xplane traces can be hundreds
+        # of MB and must not eat the RAM the object store accounts for.
+        trace_dir = p.get("trace_dir") or os.path.join(
+            tempfile.gettempdir(),
+            "raytpu_jax_traces",
+            f"{self.session_id or 'session'}_{self.worker_id[:8]}",
+        )
+        trace_dir = os.path.abspath(trace_dir)
+        return await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: profiling.capture_jax_trace(
+                trace_dir, float(p.get("duration_s", 3.0))
+            ),
+        )
+
     async def _h_worker_shutdown(self, conn, p):
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return True

@@ -1296,4 +1296,16 @@ class NodeManager:
             "labels": self.labels,
             "shm_root": self.shm_root,
             "num_workers": len(self.workers),
+            "workers": [
+                {
+                    "worker_id": w.worker_id,
+                    "state": w.state,
+                    "pid": w.proc.pid if w.proc is not None else None,
+                    "actor_ids": list(w.actor_ids),
+                    # None until the worker registers (profiling targets
+                    # must skip STARTING workers)
+                    "addr": tuple(w.addr) if w.addr else None,
+                }
+                for w in self.workers.values()
+            ],
         }

@@ -115,10 +115,14 @@ class DashboardHead:
                 pass
 
     async def _route(self, method: str, target: str, body: bytes):
-        path = urlparse(target).path.rstrip("/")
+        from urllib.parse import parse_qs
+
+        url = urlparse(target)
+        path = url.path.rstrip("/")
+        query = {k: v[-1] for k, v in parse_qs(url.query).items()}
         try:
             data = await asyncio.get_running_loop().run_in_executor(
-                None, self._handle, method, path, body
+                None, self._handle, method, path, body, query
             )
         except KeyError as e:
             return "404 Not Found", "application/json", json.dumps(
@@ -146,9 +150,11 @@ class DashboardHead:
             self._job_manager = JobManager()
         return self._job_manager
 
-    def _handle(self, method: str, path: str, body: bytes):
+    def _handle(self, method: str, path: str, body: bytes, query=None):
         import ray_tpu
         from ray_tpu.util import state
+
+        query = query or {}
 
         if path == "/api/version":
             from ray_tpu._version import __version__
@@ -170,6 +176,25 @@ class DashboardHead:
             }
             if path in simple:
                 return _jsonable(simple[path]())
+        if path in (
+            "/api/profile",
+            "/api/profile/dump",
+            "/api/profile/jax_trace",
+        ):
+            # Live profiling (reference: dashboard reporter
+            # profile_manager.py py-spy routes; plus the TPU-side
+            # jax.profiler capture SURVEY 5.1 names).
+            from ray_tpu.util import profiling
+
+            worker_id = query.get("worker_id", "driver")
+            duration = float(query.get("duration", 5.0))
+            if path == "/api/profile/dump":
+                return {"stacks": profiling.dump_worker_stacks(worker_id)}
+            if path == "/api/profile/jax_trace":
+                return profiling.capture_worker_jax_trace(
+                    worker_id, duration_s=duration
+                )
+            return profiling.profile_worker(worker_id, duration_s=duration)
         if path == "/api/jobs":
             if method == "POST":
                 req = json.loads(body or b"{}")

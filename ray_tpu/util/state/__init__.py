@@ -1,3 +1,8 @@
+from ray_tpu.util.profiling import (
+    capture_worker_jax_trace,
+    dump_worker_stacks,
+    profile_worker,
+)
 from ray_tpu.util.state.api import (
     cluster_metrics_text,
     list_actors,
@@ -11,13 +16,16 @@ from ray_tpu.util.state.api import (
 )
 
 __all__ = [
+    "capture_worker_jax_trace",
     "cluster_metrics_text",
+    "dump_worker_stacks",
     "list_actors",
     "list_nodes",
     "list_objects",
     "list_placement_groups",
     "list_tasks",
     "list_workers",
+    "profile_worker",
     "summarize_tasks",
     "timeline",
 ]

@@ -63,6 +63,8 @@ def summarize_tasks() -> dict:
 
 
 def list_workers(limit: int = 1000) -> list[dict]:
+    """Per-worker rows (worker_id/state/pid/node) — worker ids feed the
+    profiling endpoints (state.profile_worker, /api/profile)."""
     w = _worker()
     out = []
     for node in list_nodes():
@@ -74,13 +76,15 @@ def list_workers(limit: int = 1000) -> list[dict]:
             )
         except Exception:
             continue
-        out.append(
-            {
-                "node_id": node["NodeID"],
-                "num_workers": info.get("num_workers"),
-                "addr": node.get("Address"),
-            }
-        )
+        for rec in info.get("workers", []):
+            out.append({"node_id": node["NodeID"], **rec})
+        if not info.get("workers"):
+            out.append(
+                {
+                    "node_id": node["NodeID"],
+                    "num_workers": info.get("num_workers"),
+                }
+            )
     return out[:limit]
 
 
