@@ -633,10 +633,22 @@ class NodeManager:
             if target != self.node_id:
                 view = self.cluster_view.get(target)
                 if view is None:
-                    await self._refresh_cluster_view()
+                    await self._refresh_cluster_view(force=True)
                     view = self.cluster_view.get(target)
                 alive = view is not None and view.alive
                 if strict:
+                    # A just-registered target can lag our delta-synced view
+                    # by a heartbeat; wait out the lag (up to the lease
+                    # deadline) ONLY while the view has never seen the node
+                    # (view None). A present-but-dead view is the GCS saying
+                    # the node died — fail fast. Unforced refreshes share
+                    # the 1s throttle, so K waiters cost one GCS RPC/s
+                    # total, not 5K/s.
+                    while view is None and time.monotonic() < deadline:
+                        await asyncio.sleep(0.2)
+                        await self._refresh_cluster_view()
+                        view = self.cluster_view.get(target)
+                    alive = view is not None and view.alive
                     if not alive:
                         raise SchedulingError(
                             f"node {target} for strict affinity is gone"

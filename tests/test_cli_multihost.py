@@ -95,7 +95,10 @@ def test_cli_cluster_forms_and_runs_tasks(two_host_cluster, tmp_path):
     refs = [
         where.options(
             num_cpus=2,
-            scheduling_strategy=f"node_affinity:{target_nodes[r]}",
+            # STRICT: the soft policy may fall back onto one node under
+            # load, which deadlocks the rendezvous (2x2-CPU tasks can't
+            # coexist on a 3-CPU node).
+            scheduling_strategy=f"strict_node_affinity:{target_nodes[r]}",
         ).remote(r, 1 - r, rendezvous)
         for r in range(2)
     ]
