@@ -2037,6 +2037,16 @@ class CoreWorker:
             return {"cancelled": True, "delivered": bool(delivered)}
         return {"cancelled": tid is not None}
 
+    async def _h_worker_chan_push(self, conn, p):
+        """One value pushed over a cross-host compiled-DAG channel into this
+        process's mailbox (see dag/channel.py RpcChannel). accepted=False =
+        mailbox occupied — the sender's retry loop IS the backpressure."""
+        from ray_tpu.dag import channel as dag_channel
+
+        return {
+            "accepted": dag_channel.deliver_push(p["chan_id"], p["payload"])
+        }
+
     # -- live profiling (reference: dashboard reporter profile_manager) ------
 
     async def _h_worker_profile(self, conn, p):

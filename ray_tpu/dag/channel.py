@@ -3,16 +3,21 @@ without the task-submission path.
 
 Reference parity: python/ray/experimental/channel/shared_memory_channel.py
 (mutable plasma objects + experimental_mutable_object_manager in the core
-worker). Redesigned: an SPSC ring of one slot in a plain mmap file —
-seq/ack counters make writer backpressure and reader blocking a pair of
-spin-waits, no IPC at all on the data path. Cross-process visibility comes
-from /dev/shm; cross-node pairs use an RPC channel over the same endpoint
-fabric instead (the reference's NCCL channel role falls to XLA collectives
-inside SPMD programs, SURVEY §2.4 — host-side DAGs only move small control
-values between hosts).
+worker) + torch_tensor_accelerator_channel.py:49 (the cross-host channel).
+Redesigned two ways:
 
-Layout: [seq u64 | ack u64 | len u64 | payload...]. Writer: wait ack==seq,
-write payload+len, seq+=1. Reader: wait seq>ack, read, ack=seq.
+- Same host: an SPSC ring of one slot in a plain mmap file — seq/ack
+  counters make writer backpressure and reader blocking a pair of
+  spin-waits, no IPC at all on the data path.
+- Cross host: ``RpcChannel`` — a one-slot mailbox registered in the READER
+  process, written by acknowledged ``chan.push`` RPCs over the endpoint
+  fabric (a rejected push IS the backpressure). The reference's NCCL
+  channel role for device tensors falls to XLA collectives inside SPMD
+  programs (SURVEY §2.4); host-side cross-node edges move control values
+  and host arrays.
+
+Shm layout: [seq u64 | ack u64 | len u64 | payload...]. Writer: wait
+ack==seq, write payload+len, seq+=1. Reader: wait seq>ack, read, ack=seq.
 """
 
 from __future__ import annotations
@@ -21,6 +26,7 @@ import mmap
 import os
 import pickle
 import struct
+import threading
 import time
 import uuid
 
@@ -65,8 +71,23 @@ class ShmChannel:
         return cls(path, capacity, create=True)
 
     @classmethod
+    def make_spec(cls, capacity: int = 1 << 20) -> dict:
+        """A spec WITHOUT creating the file: the first opener creates it
+        (the driver can't create files on a remote host — actor-to-actor
+        edges on another node must materialize there)."""
+        return {
+            "kind": "shm",
+            "path": os.path.join(_chan_root(), f"chan-{uuid.uuid4().hex[:16]}"),
+            "capacity": capacity,
+        }
+
+    @classmethod
     def open(cls, spec: dict) -> "ShmChannel":
-        return cls(spec["path"], spec["capacity"], create=False)
+        # Create-if-missing: openers race only before any data flows (DAG
+        # loops install before the first execute), and truncating to the
+        # same size twice is harmless.
+        create = not os.path.exists(spec["path"])
+        return cls(spec["path"], spec["capacity"], create=create)
 
     def spec(self) -> dict:
         return {"kind": "shm", "path": self.path, "capacity": self.capacity}
@@ -130,7 +151,164 @@ class ShmChannel:
                 pass
 
 
-def open_channel(spec: dict):
+# -- cross-host channel -------------------------------------------------------
+
+
+class _Mailbox:
+    """One-slot SPSC mailbox: the reader-process end of an RpcChannel."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slot: list = []  # 0 or 1 pickled payloads
+        self._ready = threading.Event()
+        self.closed = False
+
+    def deliver(self, payload: bytes) -> bool:
+        with self._lock:
+            if self.closed:
+                raise ChannelClosed("mailbox closed")
+            if self._slot:
+                return False  # occupied: writer must retry (backpressure)
+            self._slot.append(payload)
+            self._ready.set()
+            return True
+
+    def take(self, timeout: float | None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.closed:
+                raise ChannelClosed("mailbox closed")
+            with self._lock:
+                if self._slot:
+                    payload = self._slot.pop()
+                    self._ready.clear()
+                    return payload
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                raise ChannelTimeout("rpc channel read")
+            self._ready.wait(
+                _SPIN_S * 50 if remaining is None
+                else min(remaining, _SPIN_S * 50)
+            )
+
+
+_MAILBOXES: dict[str, _Mailbox] = {}
+_MAILBOXES_LOCK = threading.Lock()
+
+
+def _mailbox(chan_id: str) -> _Mailbox:
+    with _MAILBOXES_LOCK:
+        box = _MAILBOXES.get(chan_id)
+        if box is None:
+            box = _MAILBOXES[chan_id] = _Mailbox()
+        return box
+
+
+def deliver_push(chan_id: str, payload: bytes) -> bool:
+    """Endpoint-handler hook (worker.chan_push): deposit one value into the
+    local mailbox; False = occupied, sender retries."""
+    return _mailbox(chan_id).deliver(payload)
+
+
+def close_mailbox(chan_id: str) -> None:
+    """Close in place, keeping a TOMBSTONE: a racing in-flight chan_push
+    after close must see ChannelClosed, not silently recreate a fresh
+    mailbox and 'accept' a value nobody will read. (One small object per
+    torn-down edge per process lifetime — bounded by edges ever created.)"""
+    with _MAILBOXES_LOCK:
+        box = _MAILBOXES.get(chan_id)
+    if box is not None:
+        box.closed = True
+        box._ready.set()
+
+
+class RpcChannel:
+    """SPSC channel across hosts: writes are acknowledged chan.push RPCs to
+    the reader process's mailbox (reference role:
+    torch_tensor_accelerator_channel.py:49, for host values)."""
+
+    def __init__(self, spec: dict, mode: str):
+        self.chan_id = spec["chan_id"]
+        self.reader_addr = tuple(spec["reader_addr"])
+        self.capacity = spec.get("capacity", 1 << 20)
+        self._spec = dict(spec)
+        self._mode = mode
+        self._closed = False
+        if mode == "read":
+            self._box = _mailbox(self.chan_id)
+        else:
+            self._box = None
+            self._endpoint = None  # resolved lazily (needs the CoreWorker)
+
+    @classmethod
+    def make_spec(
+        cls, reader_addr: tuple, capacity: int = 1 << 20
+    ) -> dict:
+        return {
+            "kind": "rpc",
+            "chan_id": f"rchan-{uuid.uuid4().hex[:16]}",
+            "reader_addr": tuple(reader_addr),
+            "capacity": capacity,
+        }
+
+    def spec(self) -> dict:
+        return dict(self._spec)
+
+    def _ep(self):
+        if self._endpoint is None:
+            from ray_tpu.core import api as core_api
+
+            self._endpoint = core_api._require_worker().endpoint
+        return self._endpoint
+
+    def write(self, value, timeout: float | None = None) -> None:
+        if self._mode != "write":
+            raise RuntimeError("read-end of an RpcChannel cannot write")
+        payload = pickle.dumps(value, protocol=5)
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"value of {len(payload)}B exceeds channel capacity "
+                f"{self.capacity}B — raise buffer_size at compile time"
+            )
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ep = self._ep()
+        while True:
+            if self._closed:
+                raise ChannelClosed(self.chan_id)
+            reply = ep.call(
+                self.reader_addr,
+                "worker.chan_push",
+                {"chan_id": self.chan_id, "payload": payload},
+                timeout=30,
+            )
+            if reply.get("accepted"):
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeout(f"write {self.chan_id}")
+            time.sleep(_SPIN_S * 10)
+
+    def read(self, timeout: float | None = None):
+        if self._mode != "read":
+            raise RuntimeError("write-end of an RpcChannel cannot read")
+        if self._closed:
+            raise ChannelClosed(self.chan_id)
+        return pickle.loads(self._box.take(timeout))
+
+    def close(self, unlink: bool = False) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._mode == "read":
+            close_mailbox(self.chan_id)
+
+
+def open_channel(spec: dict, mode: str = "read"):
+    """Open one end of a channel by spec. ``mode`` matters only for rpc
+    channels (the mailbox lives reader-side); shm ends are symmetric."""
     if spec["kind"] == "shm":
         return ShmChannel.open(spec)
+    if spec["kind"] == "rpc":
+        return RpcChannel(spec, mode)
     raise ValueError(f"unknown channel kind {spec['kind']!r}")

@@ -9,9 +9,11 @@ output channels. No per-call task submission, no owner-store entries, no
 leases (the reference's motivation, achieved with ~1/20th the machinery
 because the channel is a 24-byte header on mmap).
 
-Scope: all participants must share a host (shm visibility) — the
-cross-host story in this framework is XLA collectives inside SPMD programs
-(SURVEY §2.4), not host-level DAG channels.
+Channel selection is per edge: same cluster node -> SPSC mmap channel;
+different nodes -> RpcChannel into the consumer's mailbox over the endpoint
+fabric (reference: torch_tensor_accelerator_channel.py:49's cross-host
+role, for host values — DEVICE tensors cross hosts as XLA collectives
+inside SPMD programs, SURVEY §2.4, which is the TPU-correct split).
 """
 
 from __future__ import annotations
@@ -96,6 +98,7 @@ class CompiledDAG:
     def __init__(self, root: DAGNode, *, buffer_size: int = 1 << 20):
         import ray_tpu
         from ray_tpu.core import api as core_api
+        from ray_tpu.dag.channel import RpcChannel, open_channel
 
         self._worker = core_api._require_worker()
         self.dag_id = f"dag-{next(_dag_ids)}"
@@ -115,28 +118,62 @@ class CompiledDAG:
             ):
                 raise TypeError(f"cannot compile node {n!r}")
 
-        # -- channel per (producer -> consumer arg slot) edge ---------------
-        # chans[(producer_id, consumer_id, slot)] = ShmChannel (driver holds
-        # every channel object only for creation; actors open by spec).
-        self._chans: dict[tuple, ShmChannel] = {}
+        method_nodes = [n for n in nodes if isinstance(n, ClassMethodNode)]
 
-        def chan_for(producer: DAGNode, consumer_id: int, slot) -> ShmChannel:
-            # One channel per edge, whether first seen from the producer's
-            # out_specs or the consumer's arg side.
-            key = (producer.node_id, consumer_id, slot)
-            ch = self._chans.get(key)
-            if ch is None:
-                ch = ShmChannel.create(self.buffer_size)
-                self._chans[key] = ch
-            return ch
+        # -- where does each participant live? -------------------------------
+        # Edge kind is chosen per (producer process, consumer process): same
+        # node -> mmap shm channel; different nodes -> RpcChannel into the
+        # consumer's mailbox (reference: the accelerator-channel split in
+        # compiled graphs, torch_tensor_accelerator_channel.py:49).
+        self._actor_addrs: dict[str, tuple] = {}
+        actor_nodes: dict[str, str] = {}
+        for n in method_nodes:
+            aid = n.actor._actor_id
+            if aid in self._actor_addrs:
+                continue
+            info = self._worker.gcs.call("get_actor", {"actor_id": aid})
+            if info is None or info.get("addr") is None:
+                raise RuntimeError(f"actor {aid} not alive")
+            self._actor_addrs[aid] = tuple(info["addr"])
+            actor_nodes[aid] = info.get("node_id")
+        driver_loc = (self._worker.node_id, tuple(self._worker.endpoint.address))
+
+        def loc_of(node: DAGNode) -> tuple:
+            """(cluster_node_id, process_addr) of the process running a DAG
+            node; InputNode/driver outputs live in the driver."""
+            if isinstance(node, ClassMethodNode):
+                aid = node.actor._actor_id
+                return (actor_nodes[aid], self._actor_addrs[aid])
+            return driver_loc
+
+        # -- channel per (producer -> consumer arg slot) edge ---------------
+        # chans[(producer_id, consumer_id, slot)] = spec dict; the driver
+        # additionally holds OBJECTS for the ends it owns (input writers /
+        # output readers); actors open the rest by spec.
+        self._chans: dict[tuple, dict] = {}
+
+        def edge_spec(producer: DAGNode, consumer_loc: tuple, key) -> dict:
+            spec = self._chans.get(key)
+            if spec is not None:
+                return spec
+            prod_node = loc_of(producer)[0]
+            if prod_node == consumer_loc[0]:
+                # Same node: mmap channel; the FIRST OPENER creates the
+                # file (it may live on a remote host the driver can't
+                # touch).
+                spec = ShmChannel.make_spec(self.buffer_size)
+            else:
+                spec = RpcChannel.make_spec(
+                    consumer_loc[1], capacity=self.buffer_size
+                )
+            self._chans[key] = spec
+            return spec
 
         # Per-actor task lists, in topological order.
         per_actor: dict[str, list[dict]] = {}
-        actor_handles: dict[str, Any] = {}
-        self._driver_inputs: list[ShmChannel] = []
-        self._output_chans: list[ShmChannel] = []
+        self._driver_inputs: list = []  # write ends held by the driver
+        self._output_chans: list = []  # read ends held by the driver
 
-        method_nodes = [n for n in nodes if isinstance(n, ClassMethodNode)]
         consumers_of: dict[int, list] = {}
         for n in method_nodes:
             for slot, v in enumerate(n.args):
@@ -156,42 +193,47 @@ class CompiledDAG:
         # Output channels keyed by declared output POSITION (topological
         # iteration order would silently permute results, and one leaf may
         # appear at several output positions).
-        out_chans_by_pos: dict[int, ShmChannel] = {}
+        out_chans_by_pos: dict[int, Any] = {}
 
         for n in method_nodes:
             arg_specs = []
             for slot, v in enumerate(n.args):
                 if isinstance(v, DAGNode):
-                    ch = chan_for(v, n.node_id, slot)
+                    spec = edge_spec(v, loc_of(n), (v.node_id, n.node_id, slot))
                     if isinstance(v, InputNode):
-                        self._driver_inputs.append(ch)
-                    arg_specs.append(("chan", ch.spec()))
+                        self._driver_inputs.append(
+                            open_channel(spec, mode="write")
+                        )
+                    arg_specs.append(("chan", spec))
                 else:
                     arg_specs.append(("const", v))
             kwarg_specs = {}
             for k, v in n.kwargs.items():
                 if isinstance(v, DAGNode):
-                    ch = chan_for(v, n.node_id, k)
+                    spec = edge_spec(v, loc_of(n), (v.node_id, n.node_id, k))
                     if isinstance(v, InputNode):
-                        self._driver_inputs.append(ch)
-                    kwarg_specs[k] = ("chan", ch.spec())
+                        self._driver_inputs.append(
+                            open_channel(spec, mode="write")
+                        )
+                    kwarg_specs[k] = ("chan", spec)
                 else:
                     kwarg_specs[k] = ("const", v)
             out_specs = []
             # consumers of this node's output
             for consumer, slot in consumers_of.get(n.node_id, []):
-                # created later/earlier depending on topo order; create now
-                key = (n.node_id, consumer.node_id, slot)
-                if key not in self._chans:
-                    self._chans[key] = ShmChannel.create(self.buffer_size)
-                out_specs.append(self._chans[key].spec())
+                out_specs.append(
+                    edge_spec(
+                        n, loc_of(consumer),
+                        (n.node_id, consumer.node_id, slot),
+                    )
+                )
             for li, leaf in enumerate(out_leaves):
                 if leaf is n:
-                    ch = ShmChannel.create(self.buffer_size)
-                    out_chans_by_pos[li] = ch
-                    out_specs.append(ch.spec())
+                    # producer = leaf actor, consumer = the DRIVER.
+                    spec = edge_spec(n, driver_loc, (n.node_id, "out", li))
+                    out_chans_by_pos[li] = open_channel(spec, mode="read")
+                    out_specs.append(spec)
             aid = n.actor._actor_id
-            actor_handles[aid] = n.actor
             per_actor.setdefault(aid, []).append(
                 {
                     "method": n.method_name,
@@ -204,18 +246,6 @@ class CompiledDAG:
         self._output_chans = [
             out_chans_by_pos[li] for li in range(len(out_leaves))
         ]
-        # chan_for may have created the producer->consumer channel twice
-        # (once as consumer arg, once in out_specs): arg side creates first
-        # (consumers appear after producers in per-node loops above only if
-        # topo order puts them later). Reconcile: arg side always uses the
-        # same keyed channel.
-        self._actor_addrs = {}
-        for aid in per_actor:
-            info = self._worker.gcs.call("get_actor", {"actor_id": aid})
-            if info is None or info.get("addr") is None:
-                raise RuntimeError(f"actor {aid} not alive")
-            self._actor_addrs[aid] = tuple(info["addr"])
-
         for aid, tasks in per_actor.items():
             self._worker.endpoint.call(
                 self._actor_addrs[aid],
@@ -231,6 +261,12 @@ class CompiledDAG:
 
     # -- execution ------------------------------------------------------------
     def execute(self, value: Any) -> DAGRef:
+        """Submit one execution. The pipeline is BOUNDED (one value per
+        edge, as the reference bounds buffered results): with more than
+        ~pipeline-depth submissions in flight and no one consuming refs,
+        this blocks on the input channel until a downstream ref is
+        fetched — submit-and-fetch with a small window, don't fire
+        thousands blind."""
         if self._torn_down:
             raise RuntimeError("DAG was torn down")
         for ch in self._driver_inputs:
@@ -267,10 +303,23 @@ class CompiledDAG:
                 )
             except Exception:
                 pass
-        for ch in self._chans.values():
+        # Driver-held ends; actor-held ends (incl. remote shm files) are
+        # closed/unlinked by their DagLoop.stop.
+        for ch in self._driver_inputs:
             ch.close(unlink=True)
         for ch in self._output_chans:
             ch.close(unlink=True)
+        # Backstop for DEAD actors whose stop_dag_loop failed above: unlink
+        # every shm path reachable from this host (remote paths ENOENT —
+        # harmless), or crashed actors would leak /dev/shm files forever.
+        import os as _os
+
+        for spec in self._chans.values():
+            if spec.get("kind") == "shm":
+                try:
+                    _os.unlink(spec["path"])
+                except OSError:
+                    pass
 
     def __del__(self):
         try:

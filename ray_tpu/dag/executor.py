@@ -32,15 +32,23 @@ class DagLoop:
             self.tasks.append(
                 {
                     "method": t["method"],
+                    # Operand channels are READ here; result channels are
+                    # WRITTEN (rpc channels are mailbox-reader vs
+                    # push-writer — the role matters).
                     "args": [
-                        (k, open_channel(v) if k == "chan" else v)
+                        (k, open_channel(v, mode="read") if k == "chan" else v)
                         for k, v in t["args"]
                     ],
                     "kwargs": {
-                        name: (k, open_channel(v) if k == "chan" else v)
+                        name: (
+                            k,
+                            open_channel(v, mode="read") if k == "chan" else v,
+                        )
                         for name, (k, v) in t["kwargs"].items()
                     },
-                    "outputs": [open_channel(s) for s in t["outputs"]],
+                    "outputs": [
+                        open_channel(s, mode="write") for s in t["outputs"]
+                    ],
                 }
             )
         self._stop = threading.Event()
@@ -55,14 +63,17 @@ class DagLoop:
         self._stop.set()
         self._thread.join(timeout=5)
         for t in self.tasks:
+            # unlink=True: actor-to-actor shm files live on THIS host and
+            # nobody else can clean them; double-unlink is a swallowed
+            # ENOENT, and rpc channels ignore the flag.
             for k, v in t["args"]:
                 if k == "chan":
-                    v.close()
+                    v.close(unlink=True)
             for k, v in t["kwargs"].values():
                 if k == "chan":
-                    v.close()
+                    v.close(unlink=True)
             for ch in t["outputs"]:
-                ch.close()
+                ch.close(unlink=True)
 
     def _read(self, ch):
         while not self._stop.is_set():
