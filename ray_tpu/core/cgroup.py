@@ -1,0 +1,214 @@
+"""Cgroup resource isolation for worker processes.
+
+Reference parity: src/ray/common/cgroup2/cgroup_manager.h:28 +
+sysfs_cgroup_driver.h — the reference moves system processes and workers
+into separate cgroup subtrees so a runaway worker cannot starve the
+raylet. Redesign: a small driver that speaks BOTH hierarchies (pure
+cgroup-v2 via /sys/fs/cgroup/cgroup.controllers, hybrid v1 via the
+memory/cpu controller mounts — dev containers are still routinely
+hybrid), degrades to a no-op when the hierarchy isn't writable (non-root,
+read-only sysfs), and is opt-in via ``GLOBAL_CONFIG.enable_worker_cgroups``
+exactly because the reference gates its cgroup manager behind a flag too.
+
+Layout: <root>/raytpu_<session>/<worker_id>/ per worker, with optional
+``memory.max`` (v2) / ``memory.limit_in_bytes`` (v1) and cpu weight.
+The node manager places each spawned worker into its group and removes
+the group when the worker dies; the session subtree is removed at node
+stop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_V2_ROOT = "/sys/fs/cgroup"
+_V1_MEMORY = "/sys/fs/cgroup/memory"
+_V1_CPU = "/sys/fs/cgroup/cpu"
+
+
+def _writable_dir(path: str) -> bool:
+    return os.path.isdir(path) and os.access(path, os.W_OK)
+
+
+class CgroupManager:
+    """Per-session cgroup subtree for worker processes. Every method is
+    safe to call when unsupported (mode "none"): it just does nothing."""
+
+    def __init__(self, session_id: str):
+        self.session = f"raytpu_{session_id[:12]}"
+        self.mode = "none"
+        self._roots: dict[str, str] = {}
+        self._roots_made = False  # session dirs are created LAZILY: merely
+        # probing support (constructing a manager) must not mutate the host
+        if os.path.exists(os.path.join(_V2_ROOT, "cgroup.controllers")):
+            controllers = self._read(
+                os.path.join(_V2_ROOT, "cgroup.controllers")
+            ).split()
+            if controllers and _writable_dir(_V2_ROOT):
+                self.mode = "v2"
+                self._roots["unified"] = os.path.join(
+                    _V2_ROOT, self.session
+                )
+        if self.mode == "none":
+            # Hybrid v1: memory and cpu are separate hierarchies.
+            if _writable_dir(_V1_MEMORY):
+                self._roots["memory"] = os.path.join(
+                    _V1_MEMORY, self.session
+                )
+            if _writable_dir(_V1_CPU):
+                self._roots["cpu"] = os.path.join(_V1_CPU, self.session)
+            if self._roots:
+                self.mode = "v1"
+
+    def _ensure_roots(self) -> bool:
+        if self._roots_made:
+            return True
+        for root in self._roots.values():
+            try:
+                os.makedirs(root, exist_ok=True)
+            except OSError:
+                self.mode = "none"
+                self._roots = {}
+                return False
+        if self.mode == "v2":
+            # Delegate controllers to the session subtree so child groups
+            # can set limits.
+            avail = self._read(
+                os.path.join(_V2_ROOT, "cgroup.controllers")
+            ).split()
+            want = [c for c in ("memory", "cpu") if c in avail]
+            if want:
+                self._write(
+                    os.path.join(
+                        self._roots["unified"], "cgroup.subtree_control"
+                    ),
+                    " ".join(f"+{c}" for c in want),
+                )
+        self._roots_made = True
+        return True
+
+    # -- tiny fs helpers -----------------------------------------------------
+    @staticmethod
+    def _read(path: str) -> str:
+        try:
+            with open(path) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    @staticmethod
+    def _write(path: str, value: str) -> bool:
+        try:
+            with open(path, "w") as f:
+                f.write(value)
+            return True
+        except OSError:
+            return False
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "none"
+
+    # -- worker groups -------------------------------------------------------
+
+    def _worker_dirs(self, worker_id: str) -> list[str]:
+        return [
+            os.path.join(root, worker_id[:16])
+            for root in self._roots.values()
+        ]
+
+    def create_worker_group(
+        self,
+        worker_id: str,
+        *,
+        memory_bytes: Optional[int] = None,
+        cpu_weight: Optional[int] = None,
+    ) -> bool:
+        """Make the worker's group (all hierarchies) and apply limits.
+        cpu_weight is the v2 scale (1..10000, default 100); mapped onto v1
+        cpu.shares (x10.24 ~ the kernel's own conversion)."""
+        if not self.enabled or not self._ensure_roots():
+            return False
+        ok = False
+        for d in self._worker_dirs(worker_id):
+            try:
+                os.makedirs(d, exist_ok=True)
+                ok = True
+            except OSError:
+                continue
+            if memory_bytes:
+                if self.mode == "v2":
+                    self._write(
+                        os.path.join(d, "memory.max"), str(memory_bytes)
+                    )
+                elif d.startswith(_V1_MEMORY):
+                    self._write(
+                        os.path.join(d, "memory.limit_in_bytes"),
+                        str(memory_bytes),
+                    )
+            if cpu_weight:
+                if self.mode == "v2":
+                    self._write(
+                        os.path.join(d, "cpu.weight"), str(cpu_weight)
+                    )
+                elif d.startswith(_V1_CPU):
+                    self._write(
+                        os.path.join(d, "cpu.shares"),
+                        str(max(2, int(cpu_weight * 10.24))),
+                    )
+        return ok
+
+    def add_pid(self, worker_id: str, pid: int) -> bool:
+        if not self.enabled:
+            return False
+        ok = False
+        for d in self._worker_dirs(worker_id):
+            ok = self._write(
+                os.path.join(d, "cgroup.procs"), str(pid)
+            ) or ok
+        return ok
+
+    def pids_in_group(self, worker_id: str) -> list[int]:
+        out: set[int] = set()
+        for d in self._worker_dirs(worker_id):
+            for line in self._read(
+                os.path.join(d, "cgroup.procs")
+            ).splitlines():
+                if line.strip().isdigit():
+                    out.add(int(line))
+        return sorted(out)
+
+    def remove_worker_group(self, worker_id: str) -> bool:
+        """True once every hierarchy's dir is gone. EBUSY (zombie member
+        not yet reaped) leaves the dir — callers retry via retire_pass."""
+        gone = True
+        for d in self._worker_dirs(worker_id):
+            try:
+                os.rmdir(d)
+            except FileNotFoundError:
+                continue
+            except OSError:
+                gone = False
+        return gone
+
+    def retire_pass(self, worker_ids: set) -> set:
+        """Retry removal for retired workers; returns the ids still
+        pending (kernel hasn't reaped their members yet)."""
+        return {
+            wid for wid in worker_ids if not self.remove_worker_group(wid)
+        }
+
+    def shutdown(self) -> None:
+        if not self._roots_made:
+            return
+        for root in self._roots.values():
+            try:
+                for child in os.listdir(root):
+                    try:
+                        os.rmdir(os.path.join(root, child))
+                    except OSError:
+                        pass
+                os.rmdir(root)
+            except OSError:
+                pass

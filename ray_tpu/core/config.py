@@ -45,6 +45,12 @@ class Config:
     # source (admission-queued serves included), but bounded so a wedged
     # source can't pin a pull slot forever.
     object_chunk_timeout_s: float = 120.0
+    # Opt-in cgroup isolation for spawned workers (reference:
+    # cgroup_manager.h behind a feature flag): each worker gets its own
+    # cgroup under raytpu_<session>/; 0 = no limit for either knob.
+    enable_worker_cgroups: bool = False
+    worker_cgroup_memory_bytes: int = 0
+    worker_cgroup_cpu_weight: int = 0
     # Worker pool (reference: worker_pool.h maximum_startup_concurrency +
     # idle worker killing). max_worker_processes caps TASK workers per node
     # (0 = auto: max(4, 2 * host cores)); actors bypass the cap (they hold

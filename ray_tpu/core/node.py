@@ -123,6 +123,13 @@ class NodeManager:
         self._pull_slots = asyncio.Semaphore(
             GLOBAL_CONFIG.object_pull_concurrency
         )
+        # Opt-in cgroup isolation for worker processes (reference:
+        # src/ray/common/cgroup2/cgroup_manager.h; no-op when the cgroup
+        # hierarchy isn't writable or the flag is off). Created lazily at
+        # first spawn — join-mode nodes learn their session id on start.
+        self._cgroups = None
+        self._cgroups_checked = False
+        self._cgroup_pending: set = set()  # retired groups awaiting rmdir
         self._spread_rr = 0
         self._last_view_refresh = 0.0
         self._view_since = -1  # versioned-delta cursor (-1: nothing seen)
@@ -209,6 +216,10 @@ class NodeManager:
                     except Exception:
                         pass
         self.endpoint.stop()
+        if self._cgroups is not None:
+            for wid in list(self.workers) + list(self._cgroup_pending):
+                self._cgroups.remove_worker_group(wid)
+            self._cgroups.shutdown()
         if self.store is not None:  # join-mode node that never started
             self.store.close()
 
@@ -328,6 +339,12 @@ class NodeManager:
                     await self._on_worker_death(wid, f"exit {w.proc.returncode}")
             self._reap_idle_workers()
             self._collect_terminated()
+            if self._cgroups is not None and self._cgroup_pending:
+                # rmdir succeeds only after the kernel reaps the members;
+                # keep retrying so no group dir leaks on the host.
+                self._cgroup_pending = self._cgroups.retire_pass(
+                    self._cgroup_pending
+                )
 
     def _reap_idle_workers(self) -> None:
         """Kill workers idle past their TTL, keeping a warm floor so the
@@ -348,11 +365,17 @@ class NodeManager:
                 return  # the rest are younger
             self.idle_workers.remove(wid)
             del self.workers[wid]
+            self._cgroup_retire(wid)
             if w.proc is not None and w.proc.poll() is None:
                 w.proc.terminate()
                 # Collect the exit status later (no zombie accumulation in
                 # long-lived daemons); monitor loop polls this list.
                 self._terminated_procs.append(w.proc)
+
+    def _cgroup_retire(self, worker_id: str) -> None:
+        if self._cgroups is not None:
+            if not self._cgroups.remove_worker_group(worker_id):
+                self._cgroup_pending.add(worker_id)
 
     def _collect_terminated(self) -> None:
         self._terminated_procs = [
@@ -363,6 +386,7 @@ class NodeManager:
         w = self.workers.pop(worker_id, None)
         if w is None:
             return
+        self._cgroup_retire(worker_id)
         self._worker_metric_snaps.pop(worker_id, None)
         if worker_id in self.idle_workers:
             self.idle_workers.remove(worker_id)
@@ -432,6 +456,24 @@ class NodeManager:
         for f in (out_f, err_f):
             if hasattr(f, "close"):
                 f.close()
+        if not self._cgroups_checked:
+            self._cgroups_checked = True
+            if GLOBAL_CONFIG.enable_worker_cgroups:
+                from ray_tpu.core.cgroup import CgroupManager
+
+                mgr = CgroupManager(self.session_id or "session")
+                self._cgroups = mgr if mgr.enabled else None
+        if self._cgroups is not None:
+            # Opt-in isolation (reference: cgroup_manager.h) — the group
+            # exists before the worker does real work; a runaway worker is
+            # bounded by its own memory limit instead of taking the node.
+            self._cgroups.create_worker_group(
+                worker_id,
+                memory_bytes=GLOBAL_CONFIG.worker_cgroup_memory_bytes
+                or None,
+                cpu_weight=GLOBAL_CONFIG.worker_cgroup_cpu_weight or None,
+            )
+            self._cgroups.add_pid(worker_id, proc.pid)
         info = WorkerInfo(
             worker_id=worker_id,
             proc=proc,
@@ -518,6 +560,7 @@ class NodeManager:
                 victim = self.workers.get(self.idle_workers.pop(0))
                 if victim is not None:
                     self.workers.pop(victim.worker_id, None)
+                    self._cgroup_retire(victim.worker_id)
                     if victim.proc is not None and victim.proc.poll() is None:
                         victim.proc.kill()
                         self._terminated_procs.append(victim.proc)
@@ -533,6 +576,7 @@ class NodeManager:
                     if info.proc is not None:
                         info.proc.kill()
                     self.workers.pop(info.worker_id, None)
+                    self._cgroup_retire(info.worker_id)
                     raise SchedulingError("worker failed to start in time")
                 # Registration put the new worker in the idle pool; we are
                 # claiming it, so take it back out (else the next lease

@@ -220,7 +220,8 @@ def close_mailbox(chan_id: str) -> None:
     with _MAILBOXES_LOCK:
         box = _MAILBOXES.get(chan_id)
     if box is not None:
-        box.closed = True
+        with box._lock:  # a deliver() past its closed-check must not win
+            box.closed = True
         box._ready.set()
 
 
@@ -274,6 +275,9 @@ class RpcChannel:
             )
         deadline = None if timeout is None else time.monotonic() + timeout
         ep = self._ep()
+        backoff = _SPIN_S * 10  # 2ms first retry, doubling to a 50ms cap:
+        # re-pushing the full payload every 2ms would hammer the reader's
+        # endpoint loop with ~500 RPCs/s per backpressured edge.
         while True:
             if self._closed:
                 raise ChannelClosed(self.chan_id)
@@ -287,7 +291,8 @@ class RpcChannel:
                 return
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeout(f"write {self.chan_id}")
-            time.sleep(_SPIN_S * 10)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.05)
 
     def read(self, timeout: float | None = None):
         if self._mode != "read":

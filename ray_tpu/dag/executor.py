@@ -81,6 +81,11 @@ class DagLoop:
                 return ch.read(timeout=_POLL_S)
             except ChannelTimeout:
                 continue
+            except Exception:
+                # Transport death (peer process gone, mailbox closed): the
+                # loop must STOP cleanly, not die as an unhandled thread
+                # exception that silently wedges the DAG.
+                raise _StopLoop
         raise _StopLoop
 
     def _run(self) -> None:
@@ -116,8 +121,16 @@ class DagLoop:
                                 break
                             except ChannelTimeout:
                                 continue
+                            except Exception:
+                                raise _StopLoop  # peer gone: stop cleanly
         except _StopLoop:
             pass
+        except Exception:  # pragma: no cover — last-resort visibility
+            import logging
+
+            logging.getLogger("ray_tpu").exception(
+                "compiled-DAG loop died"
+            )
 
 
 class _StopLoop(Exception):

@@ -1,0 +1,96 @@
+"""Cgroup worker isolation (reference: src/ray/common/cgroup2/
+cgroup_manager.h behind its feature flag)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu.core.cgroup import CgroupManager
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def _supported() -> bool:
+    return CgroupManager("probe").enabled
+
+
+needs_cgroups = pytest.mark.skipif(
+    not _supported(), reason="cgroup hierarchy not writable here"
+)
+
+
+@needs_cgroups
+def test_worker_group_lifecycle():
+    mgr = CgroupManager("testsession")
+    assert mgr.enabled
+    wid = "w" * 16
+    try:
+        assert mgr.create_worker_group(wid, memory_bytes=256 * 1024 * 1024)
+        # A real child process lands in the group's procs file.
+        child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+        try:
+            assert mgr.add_pid(wid, child.pid)
+            assert child.pid in mgr.pids_in_group(wid)
+            # The memory limit was actually applied in whichever hierarchy
+            # this box exposes.
+            applied = False
+            for d in mgr._worker_dirs(wid):
+                for fname in ("memory.max", "memory.limit_in_bytes"):
+                    val = mgr._read(os.path.join(d, fname))
+                    if val and val.isdigit() and int(val) == 256 * 1024 * 1024:
+                        applied = True
+            assert applied
+        finally:
+            child.kill()
+            child.wait(timeout=10)
+    finally:
+        deadline = time.monotonic() + 10
+        while True:  # rmdir succeeds once the kernel reaps the member
+            mgr.remove_worker_group(wid)
+            if not any(os.path.isdir(d) for d in mgr._worker_dirs(wid)):
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        mgr.shutdown()
+
+
+def test_disabled_manager_is_noop(monkeypatch):
+    mgr = CgroupManager("whatever")
+    mgr.mode = "none"
+    mgr._roots = {}
+    assert not mgr.enabled
+    assert mgr.create_worker_group("x") is False
+    assert mgr.add_pid("x", os.getpid()) is False
+    mgr.remove_worker_group("x")
+    mgr.shutdown()
+
+
+@needs_cgroups
+def test_node_places_workers_into_cgroups():
+    """E2E: with the flag on, a spawned worker's pid appears in its own
+    cgroup, and the group is cleaned up on shutdown."""
+    import ray_tpu
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    old = GLOBAL_CONFIG.enable_worker_cgroups
+    GLOBAL_CONFIG.enable_worker_cgroups = True
+    try:
+        rt = ray_tpu.init(num_cpus=2)
+
+        @ray_tpu.remote
+        def whoami():
+            return os.getpid()
+
+        pid = ray_tpu.get(whoami.remote(), timeout=60)
+        node = rt.head
+        assert node._cgroups is not None
+        tracked = {
+            wid: node._cgroups.pids_in_group(wid) for wid in node.workers
+        }
+        assert any(pid in pids for pids in tracked.values()), tracked
+    finally:
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.enable_worker_cgroups = old
