@@ -72,18 +72,24 @@ class CgroupManager:
                 self._roots = {}
                 return False
         if self.mode == "v2":
-            # Delegate controllers to the session subtree so child groups
-            # can set limits.
+            # Delegation must hold at BOTH levels: the root's
+            # subtree_control gates what the session dir sees in its own
+            # cgroup.controllers, and the session's subtree_control gates
+            # the worker dirs. Containers often ship the root undelegated.
             avail = self._read(
                 os.path.join(_V2_ROOT, "cgroup.controllers")
             ).split()
             want = [c for c in ("memory", "cpu") if c in avail]
             if want:
+                enable = " ".join(f"+{c}" for c in want)
+                self._write(
+                    os.path.join(_V2_ROOT, "cgroup.subtree_control"), enable
+                )
                 self._write(
                     os.path.join(
                         self._roots["unified"], "cgroup.subtree_control"
                     ),
-                    " ".join(f"+{c}" for c in want),
+                    enable,
                 )
         self._roots_made = True
         return True
@@ -131,6 +137,8 @@ class CgroupManager:
         if not self.enabled or not self._ensure_roots():
             return False
         ok = False
+        mem_applied = not memory_bytes
+        cpu_applied = not cpu_weight
         for d in self._worker_dirs(worker_id):
             try:
                 os.makedirs(d, exist_ok=True)
@@ -139,24 +147,38 @@ class CgroupManager:
                 continue
             if memory_bytes:
                 if self.mode == "v2":
-                    self._write(
+                    mem_applied |= self._write(
                         os.path.join(d, "memory.max"), str(memory_bytes)
                     )
                 elif d.startswith(_V1_MEMORY):
-                    self._write(
+                    mem_applied |= self._write(
                         os.path.join(d, "memory.limit_in_bytes"),
                         str(memory_bytes),
                     )
             if cpu_weight:
                 if self.mode == "v2":
-                    self._write(
+                    cpu_applied |= self._write(
                         os.path.join(d, "cpu.weight"), str(cpu_weight)
                     )
                 elif d.startswith(_V1_CPU):
-                    self._write(
+                    cpu_applied |= self._write(
                         os.path.join(d, "cpu.shares"),
                         str(max(2, int(cpu_weight * 10.24))),
                     )
+        if ok and not (mem_applied and cpu_applied):
+            # A limit the operator configured did NOT take (undelegated
+            # controller, read-only knob): say so — silently unbounded
+            # workers defeat the whole point of the flag.
+            import logging
+
+            logging.getLogger("ray_tpu").warning(
+                "cgroup limits for worker %s not fully applied "
+                "(mem=%s cpu=%s, mode=%s) — controller not delegated?",
+                worker_id[:8],
+                mem_applied,
+                cpu_applied,
+                self.mode,
+            )
         return ok
 
     def add_pid(self, worker_id: str, pid: int) -> bool:

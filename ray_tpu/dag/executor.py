@@ -84,7 +84,13 @@ class DagLoop:
             except Exception:
                 # Transport death (peer process gone, mailbox closed): the
                 # loop must STOP cleanly, not die as an unhandled thread
-                # exception that silently wedges the DAG.
+                # exception — but loudly, or the driver's eventual timeout
+                # has no diagnosis.
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "compiled-DAG loop stopping: operand channel died"
+                )
                 raise _StopLoop
         raise _StopLoop
 
@@ -122,6 +128,12 @@ class DagLoop:
                             except ChannelTimeout:
                                 continue
                             except Exception:
+                                import logging
+
+                                logging.getLogger("ray_tpu").exception(
+                                    "compiled-DAG loop stopping: result "
+                                    "channel died"
+                                )
                                 raise _StopLoop  # peer gone: stop cleanly
         except _StopLoop:
             pass

@@ -134,6 +134,13 @@ def proxy_port() -> int:
     return ray_tpu.get(controller.ensure_proxy.remote("127.0.0.1", 0))
 
 
+def grpc_port(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Ensure the gRPC ingress (reference: ray.serve gRPC proxy) and return
+    its bound port; see ray_tpu.serve.grpc_ingress for the wire contract."""
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.ensure_grpc.remote(host, port))
+
+
 def get_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 

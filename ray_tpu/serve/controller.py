@@ -31,6 +31,7 @@ class ServeController:
         self._loop_running = False
         self._proxy = None
         self._proxy_port = None
+        self._grpc_port = None
         self._proxy_lock = asyncio.Lock()
 
     # -- control plane API ----------------------------------------------------
@@ -334,6 +335,23 @@ class ServeController:
             self._proxy = proxy
             return self._proxy_port
 
+    async def ensure_grpc(self, host: str, port: int) -> int:
+        """Start (or return) the gRPC ingress on the proxy actor (which is
+        started first if needed); returns the bound port (reference:
+        serve/_private/proxy.py:534 gRPCProxy)."""
+        await self.ensure_proxy(host, 0)
+        async with self._proxy_lock:
+            if self._grpc_port is not None:
+                if port not in (0, self._grpc_port):
+                    raise RuntimeError(
+                        f"serve gRPC ingress already on port "
+                        f"{self._grpc_port}; cannot rebind to {port}"
+                    )
+                return self._grpc_port
+            ref = self._proxy.start_grpc.remote(host, port)
+            self._grpc_port = await core_api.get_async(ref, timeout=30)
+            return self._grpc_port
+
     async def shutdown_serve(self) -> bool:
         for name in list(self._deployments):
             await self.delete_deployment(name)
@@ -343,4 +361,7 @@ class ServeController:
             except Exception:
                 pass
             self._proxy = None
+            self._proxy_port = None
+            self._grpc_port = None  # a reused controller must restart the
+            # ingress on the NEW proxy, not hand out the dead port
         return True

@@ -33,6 +33,18 @@ class HTTPProxyActor:
         self._port = self._server.sockets[0].getsockname()[1]
         return self._port
 
+    async def start_grpc(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """gRPC ingress next to HTTP, same routing/handles (reference:
+        serve/_private/proxy.py:534 gRPCProxy; see grpc_ingress.py)."""
+        if getattr(self, "_grpc_server", None) is not None:
+            return self._grpc_port
+        from ray_tpu.serve.grpc_ingress import start_grpc_server
+
+        self._grpc_server, self._grpc_port = await start_grpc_server(
+            self, host, port
+        )
+        return self._grpc_port
+
     async def ping(self) -> bool:
         return True
 

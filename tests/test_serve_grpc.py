@@ -1,0 +1,78 @@
+"""Serve gRPC ingress (reference: serve/_private/proxy.py:534 gRPCProxy;
+redesigned stub-free — see ray_tpu/serve/grpc_ingress.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.serve import api as serve
+from ray_tpu.serve import grpc_ingress
+
+pytestmark = pytest.mark.timeout(240)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@serve.deployment(num_replicas=1)
+class Echoes:
+    @serve.multiplexed(max_num_models_per_replica=2)
+    async def get_model(self, model_id):
+        return f"M[{model_id}]"
+
+    async def __call__(self, request):
+        mid = serve.get_multiplexed_model_id()
+        model = await self.get_model(mid) if mid else None
+        return {"echo": request, "model": model}
+
+
+@serve.deployment(num_replicas=1)
+class Tokens:
+    async def __call__(self, request):
+        async def gen():
+            import asyncio
+
+            for tok in str(request).split():
+                await asyncio.sleep(0.01)
+                yield {"tok": tok}
+
+        return gen()
+
+
+def test_grpc_unary_call(cluster):
+    serve.run(Echoes.bind())
+    port = serve.grpc_port()
+    out = grpc_ingress.call(
+        f"127.0.0.1:{port}", "Echoes", {"x": [1, 2, 3]}
+    )
+    assert out == {"echo": {"x": [1, 2, 3]}, "model": None}
+    # Multiplexed model id rides the request envelope.
+    out = grpc_ingress.call(
+        f"127.0.0.1:{port}", "Echoes", "hi", multiplexed_model_id="m7"
+    )
+    assert out["model"] == "M[m7]"
+
+
+def test_grpc_streaming_call(cluster):
+    serve.run(Tokens.bind())
+    port = serve.grpc_port()
+    chunks = list(
+        grpc_ingress.stream_call(
+            f"127.0.0.1:{port}", "Tokens", "alpha beta gamma"
+        )
+    )
+    assert [c["tok"] for c in chunks] == ["alpha", "beta", "gamma"]
+
+
+def test_grpc_unknown_deployment_is_not_found(cluster):
+    import grpc
+
+    serve.run(Echoes.bind())
+    port = serve.grpc_port()
+    with pytest.raises(grpc.RpcError) as err:
+        grpc_ingress.call(f"127.0.0.1:{port}", "NoSuchApp", {})
+    assert err.value.code() == grpc.StatusCode.NOT_FOUND
