@@ -21,6 +21,7 @@ import os
 import signal
 import sys
 import threading
+import time as _time
 import uuid
 
 
@@ -134,6 +135,160 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_stop(args) -> int:
+    """Kill every raytpu daemon and worker on THIS host (reference:
+    `ray stop`, scripts.py — process-pattern based, SIGTERM then SIGKILL
+    after a grace period)."""
+    me = os.getpid()
+    victims = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit() or int(entry) == me:
+            continue
+        try:
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                argv = [
+                    a.decode("utf-8", "replace")
+                    for a in f.read().split(b"\x00")
+                    if a
+                ]
+        except OSError:
+            continue
+        # STRUCTURAL argv match, never substring-over-the-whole-cmdline: a
+        # shell whose arguments merely MENTION 'ray_tpu' must not die.
+        if not argv or "python" not in os.path.basename(argv[0]):
+            continue
+        # Daemons and workers ONLY: a concurrent CLI *client* (submit
+        # tail to a remote cluster, status, memory) must survive.
+        is_daemon = (
+            len(argv) >= 4
+            and argv[1] == "-m"
+            and argv[2] in ("ray_tpu", "ray_tpu.scripts.cli")
+            and argv[3] == "start"
+        )
+        is_worker = (
+            len(argv) >= 3
+            and argv[1] == "-m"
+            and argv[2] == "ray_tpu.core.worker_main"
+        )
+        if is_daemon or is_worker:
+            victims.append(int(entry))
+    for pid in victims:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    def _alive(pid: int) -> bool:
+        # Zombies keep their /proc entry until reaped by a parent we don't
+        # control — count them as dead or the grace wait always expires.
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return f.read().rsplit(")", 1)[1].split()[0] != "Z"
+        except (OSError, IndexError):
+            return False
+
+    deadline = _time.monotonic() + args.grace_period
+    while _time.monotonic() < deadline:
+        if not any(_alive(p) for p in victims):
+            break
+        _time.sleep(0.2)
+    killed = 0
+    for pid in victims:
+        if _alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed += 1
+            except OSError:
+                pass
+    print(
+        json.dumps(
+            {"stopped": len(victims), "force_killed": killed}
+        )
+    )
+    return 0
+
+
+def cmd_submit(args) -> int:
+    """Submit a job and optionally tail it to completion (reference:
+    `ray job submit`, dashboard/modules/job/cli.py)."""
+    import shlex
+
+    from ray_tpu.job.manager import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    # The entrypoint runs through a shell: re-quote each argv token or
+    # `submit -- python -c "print('x')"` arrives syntactically mangled.
+    entrypoint = " ".join(shlex.quote(tok) for tok in args.entrypoint)
+    runtime_env = json.loads(args.runtime_env) if args.runtime_env else None
+    job_id = client.submit_job(
+        entrypoint=entrypoint, runtime_env=runtime_env
+    )
+    print(json.dumps({"job_id": job_id}), flush=True)
+    if args.no_wait:
+        return 0
+    last_len = 0
+    while True:
+        status = client.get_job_status(job_id)
+        logs = client.get_job_logs(job_id)
+        if len(logs) < last_len:
+            # The supervisor trims its buffer on very chatty jobs; resync
+            # rather than slicing at a stale offset into shifted text.
+            sys.stdout.write("\n[...log buffer trimmed...]\n")
+            last_len = 0
+        if len(logs) > last_len:
+            sys.stdout.write(logs[last_len:])
+            sys.stdout.flush()
+            last_len = len(logs)
+        if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+            print(json.dumps({"job_id": job_id, "status": status}))
+            return 0 if status == "SUCCEEDED" else 1
+        _time.sleep(0.5)
+
+
+def cmd_timeline(args) -> int:
+    """Dump a chrome-trace of cluster task events (reference:
+    `ray timeline`)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=args.address)
+    out = args.output or f"raytpu-timeline-{int(_time.time())}.json"
+    state.timeline(out)
+    print(json.dumps({"timeline": os.path.abspath(out)}))
+    return 0
+
+
+def cmd_memory(args) -> int:
+    """Cluster object-plane summary: per-node store usage + largest
+    objects (reference: `ray memory`)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=args.address)
+    objects = state.list_objects(limit=args.limit)
+    nodes = [
+        {"node_id": n["NodeID"], "resources": n["Resources"]}
+        for n in state.list_nodes()
+        if n.get("Alive")
+    ]
+    objects.sort(key=lambda o: o.get("size", 0) or 0, reverse=True)
+    total = sum(o.get("size", 0) or 0 for o in objects)
+    print(
+        json.dumps(
+            {
+                "num_objects": len(objects),
+                "total_bytes": total,
+                # Counts are lower bounds once the listing hit the cap.
+                "truncated": len(objects) >= args.limit,
+                "largest": objects[:20],
+                "nodes": nodes,
+            },
+            indent=2,
+            default=str,
+        )
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="raytpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -175,6 +330,31 @@ def main(argv: list[str] | None = None) -> int:
     p_status = sub.add_parser("status", help="print the cluster view")
     p_status.add_argument("--address", required=True)
     p_status.set_defaults(fn=cmd_status)
+
+    p_stop = sub.add_parser(
+        "stop", help="kill all raytpu daemons/workers on this host"
+    )
+    p_stop.add_argument("--grace-period", type=float, default=10.0)
+    p_stop.set_defaults(fn=cmd_stop)
+
+    p_submit = sub.add_parser("submit", help="submit a job entrypoint")
+    p_submit.add_argument("--address", required=True)
+    p_submit.add_argument("--runtime-env", help="JSON runtime env")
+    p_submit.add_argument(
+        "--no-wait", action="store_true", help="don't tail to completion"
+    )
+    p_submit.add_argument("entrypoint", nargs="+")
+    p_submit.set_defaults(fn=cmd_submit)
+
+    p_tl = sub.add_parser("timeline", help="dump a chrome-trace of tasks")
+    p_tl.add_argument("--address", required=True)
+    p_tl.add_argument("--output", "-o", default=None)
+    p_tl.set_defaults(fn=cmd_timeline)
+
+    p_mem = sub.add_parser("memory", help="object-plane summary")
+    p_mem.add_argument("--address", required=True)
+    p_mem.add_argument("--limit", type=int, default=10000)
+    p_mem.set_defaults(fn=cmd_memory)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,119 @@
+"""CLI subcommands: submit / timeline / memory / stop (reference:
+python/ray/scripts/scripts.py `ray job submit`, `ray timeline`,
+`ray memory`, `ray stop`)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.timeout(300)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "4"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=REPO,
+    )
+    info = json.loads(proc.stdout.readline())
+    yield info
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_submit_tails_to_success(daemon):
+    out = _cli(
+        "submit", "--address", daemon["gcs_address"], "--",
+        sys.executable, "-c", "print('hello-from-job')",
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "hello-from-job" in out.stdout
+    assert '"status": "SUCCEEDED"' in out.stdout
+
+
+def test_submit_failure_exit_code(daemon):
+    out = _cli(
+        "submit", "--address", daemon["gcs_address"], "--",
+        sys.executable, "-c", "raise SystemExit(3)",
+    )
+    assert out.returncode == 1
+    assert '"status": "FAILED"' in out.stdout
+
+
+def test_timeline_and_memory(daemon, tmp_path):
+    # Generate some task events first (as a separate joined driver).
+    gen = subprocess.run(
+        [sys.executable, "-c", f"""
+import sys; sys.path.insert(0, {REPO!r})
+import ray_tpu
+ray_tpu.init(address={daemon['gcs_address']!r})
+
+@ray_tpu.remote
+def f(x): return x * 2
+print(ray_tpu.get([f.remote(i) for i in range(3)], timeout=60))
+ray_tpu.put(list(range(200000)))
+import time; time.sleep(2.5)  # let task events flush to the GCS
+ray_tpu.shutdown()
+"""],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert gen.returncode == 0, gen.stderr[-800:]
+
+    tl_path = str(tmp_path / "tl.json")
+    out = _cli(
+        "timeline", "--address", daemon["gcs_address"], "-o", tl_path
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    events = json.load(open(tl_path))
+    assert isinstance(events, list) and len(events) >= 1
+
+    out = _cli("memory", "--address", daemon["gcs_address"])
+    assert out.returncode == 0, out.stderr[-800:]
+    summary = json.loads(out.stdout)
+    assert summary["nodes"] and "num_objects" in summary
+
+
+def test_stop_kills_daemons():
+    """`raytpu stop` takes down daemons + workers on the host. Runs against
+    its OWN daemon (pattern-based kill would take out any other test
+    cluster too — which is exactly its documented job)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "2"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=REPO,
+    )
+    json.loads(proc.stdout.readline())
+    out = _cli("stop")
+    assert out.returncode == 0, out.stderr[-800:]
+    summary = json.loads(out.stdout)
+    assert summary["stopped"] >= 1
+    deadline = time.monotonic() + 15
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert proc.poll() is not None, "daemon survived raytpu stop"
