@@ -36,6 +36,7 @@ class Dataset:
         self._plan = plan
         self._shard = shard
         self._limit = limit
+        self._last_executor: Optional[StreamingExecutor] = None
 
     # -- transforms (lazy) ---------------------------------------------------
 
@@ -147,9 +148,25 @@ class Dataset:
     # -- execution -----------------------------------------------------------
 
     def _executor(self) -> StreamingExecutor:
-        return StreamingExecutor(
+        ex = StreamingExecutor(
             self._plan, shard=self._shard, limit=self._limit
         )
+        # Retained so stats() reports the most recent execution of THIS
+        # dataset object (reference: Dataset.stats()/DatasetStats).
+        self._last_executor = ex
+        return ex
+
+    def stats(self) -> str:
+        """Per-operator execution statistics of the most recent execution
+        (materialize/take/iter_*) of this dataset (reference:
+        Dataset.stats()). Empty string if it never executed."""
+        ex = self._last_executor
+        return ex.stats.summary() if ex is not None else ""
+
+    def stats_dict(self) -> list[dict]:
+        """The same stats as structured rows (one per stage/barrier)."""
+        ex = self._last_executor
+        return ex.stats.as_dicts() if ex is not None else []
 
     def iter_internal_block_refs(self):
         yield from self._executor().iter_blocks()
@@ -183,7 +200,12 @@ class Dataset:
 
     def take(self, n: int = 20) -> list[dict]:
         out: list[dict] = []
-        for ref, _ in self.limit(n)._executor().iter_blocks():
+        limited = self.limit(n)
+        ex = limited._executor()
+        # stats() on THIS object must cover take() per its contract — the
+        # executor ran on a derived (limited) dataset.
+        self._last_executor = ex
+        for ref, _ in ex.iter_blocks():
             out.extend(BlockAccessor(ray_tpu.get(ref)).take_rows(n - len(out)))
             if len(out) >= n:
                 break

@@ -11,6 +11,7 @@ materialize the stage boundary's refs.
 
 from __future__ import annotations
 
+import time
 from typing import Iterator, Optional
 
 import cloudpickle
@@ -144,6 +145,47 @@ def _trim_task(block, n: int):
     return out, out.num_rows
 
 
+class StageStats:
+    """Execution record of one streamed stage or barrier (reference:
+    DatasetStats / _StatsActor per-operator rows in ray.data)."""
+
+    __slots__ = ("name", "kind", "blocks_in", "blocks_out", "rows_out",
+                 "wall_s")
+
+    def __init__(self, name: str, kind: str):
+        self.name = name
+        self.kind = kind  # "map" | "barrier"
+        self.blocks_in = 0
+        self.blocks_out = 0
+        self.rows_out = 0
+        self.wall_s = 0.0
+
+    def as_dict(self) -> dict:
+        return {s: getattr(self, s) for s in self.__slots__}
+
+
+class ExecutionStats:
+    """Per-execution operator stats; rendered by Dataset.stats()."""
+
+    def __init__(self):
+        self.stages: list[StageStats] = []
+        self.total_wall_s = 0.0
+
+    def summary(self) -> str:
+        lines = []
+        for i, s in enumerate(self.stages):
+            lines.append(
+                f"Stage {i} [{s.kind}] {s.name}: "
+                f"{s.blocks_in}->{s.blocks_out} blocks, "
+                f"{s.rows_out} rows, {s.wall_s:.3f}s"
+            )
+        lines.append(f"Total wall time: {self.total_wall_s:.3f}s")
+        return "\n".join(lines)
+
+    def as_dicts(self) -> list[dict]:
+        return [s.as_dict() for s in self.stages]
+
+
 class StreamingExecutor:
     def __init__(
         self,
@@ -156,6 +198,7 @@ class StreamingExecutor:
         self._window = max_in_flight or _default_in_flight()
         self._shard = shard
         self._limit = limit
+        self.stats = ExecutionStats()
 
     # Each yielded item is (block_ref, num_rows).
     def iter_blocks(self) -> Iterator[tuple]:
@@ -218,6 +261,45 @@ class StreamingExecutor:
             is_read = False
 
     def _stream_stage(self, chain, sources, is_read, apply_shard, apply_limit):
+        sources = list(sources)
+        rec = StageStats(
+            "+".join(type(op).__name__ for op in chain) or "(passthrough)",
+            "map",
+        )
+        if apply_shard and self._shard is not None:
+            # Report THIS RANK's inputs, matching what the stage submits.
+            world, rank = self._shard
+            rec.blocks_in = sum(
+                1 for j in range(len(sources)) if j % world == rank
+            )
+        else:
+            rec.blocks_in = len(sources)
+        self.stats.stages.append(rec)
+        inner = self._stream_stage_inner(
+            chain, sources, is_read, apply_shard, apply_limit
+        )
+        # Charge ONLY time spent inside the pipeline: a slow consumer
+        # between next() calls (e.g. a training step per batch) must not
+        # read as data-stage wall time.
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(inner)
+                except StopIteration:
+                    rec.wall_s += time.perf_counter() - t0
+                    break
+                rec.wall_s += time.perf_counter() - t0
+                rec.blocks_out += 1
+                rec.rows_out += item[1]
+                yield item
+        finally:
+            inner.close()
+            self.stats.total_wall_s += rec.wall_s
+
+    def _stream_stage_inner(
+        self, chain, sources, is_read, apply_shard, apply_limit
+    ):
         remote_chain = ray_tpu.remote(_run_chain)
         payload = cloudpickle.dumps(chain)
         if apply_shard and self._shard is not None:
@@ -303,6 +385,20 @@ class StreamingExecutor:
     def _apply_barrier(self, op, sources) -> list:
         """sources: block refs (interior stages always materialize to refs).
         Returns new list of block refs."""
+        sources = list(sources)
+        rec = StageStats(type(op).__name__, "barrier")
+        rec.blocks_in = len(sources)
+        self.stats.stages.append(rec)
+        t0 = time.perf_counter()
+        try:
+            out = self._apply_barrier_inner(op, sources)
+            rec.blocks_out = len(out)
+            return out
+        finally:
+            rec.wall_s = time.perf_counter() - t0
+            self.stats.total_wall_s += rec.wall_s
+
+    def _apply_barrier_inner(self, op, sources) -> list:
         refs = list(sources)
         if isinstance(op, RepartitionOp):
             rows = ray_tpu.remote(_block_rows)

@@ -215,3 +215,29 @@ def test_to_pandas_and_from_pandas(cluster):
     out = ds.to_pandas()
     assert list(out["a"]) == [1, 2, 3]
     assert list(out["b"]) == ["x", "y", "z"]
+
+
+def test_dataset_stats(cluster):
+    """Per-operator execution stats (reference: Dataset.stats())."""
+    import ray_tpu.data as rd
+
+    ds = (
+        rd.range(200, parallelism=4)
+        .map(lambda r: {"id": r["id"], "x": r["id"] * 2})
+        .filter(lambda r: r["x"] % 4 == 0)
+    )
+    assert ds.stats() == ""  # not executed yet
+    total = ds.count()
+    assert total == 100
+    summary = ds.stats()
+    assert "Stage 0" in summary and "rows" in summary
+    rows = ds.stats_dict()
+    assert rows and rows[-1]["rows_out"] == 100
+    assert sum(r["blocks_out"] for r in rows if r["kind"] == "map") == 4
+    assert all(r["wall_s"] >= 0 for r in rows)
+
+    # Barriers (sort) appear as their own stage rows.
+    ds2 = rd.range(50, parallelism=2).sort("id", descending=True)
+    ds2.materialize()
+    kinds = {r["kind"] for r in ds2.stats_dict()}
+    assert "barrier" in kinds, ds2.stats()
