@@ -19,6 +19,13 @@ single_agent_env_runner.py:67), redesigned TPU-first:
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, QModule
+from ray_tpu.rllib.offline import (
+    BC,
+    BCConfig,
+    BCLearner,
+    read_experience,
+    write_experience,
+)
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
@@ -29,6 +36,11 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
+    "BCLearner",
+    "read_experience",
+    "write_experience",
     "DQN",
     "DQNConfig",
     "DQNLearner",

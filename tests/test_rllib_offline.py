@@ -1,0 +1,91 @@
+"""Offline RL: experience datasets + behavior cloning (reference:
+rllib/offline/, rllib/algorithms/bc)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import BC, BCConfig, write_experience
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+pytestmark = [
+    pytest.mark.filterwarnings("ignore"),
+    pytest.mark.timeout(420),
+]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def _expert_cartpole_batches(n_steps=3000, seed=0):
+    """A decent scripted CartPole policy (push toward the pole's lean +
+    angular velocity) — enough signal for BC to beat random by a lot."""
+    import gymnasium as gym
+
+    env = gym.make("CartPole-v1")
+    rng = np.random.default_rng(seed)
+    obs_rows, act_rows, rew_rows, next_rows, term_rows = [], [], [], [], []
+    obs, _ = env.reset(seed=seed)
+    for _ in range(n_steps):
+        angle, ang_vel = obs[2], obs[3]
+        action = int(angle + 0.5 * ang_vel > 0)
+        if rng.random() < 0.05:  # tiny exploration noise
+            action = 1 - action
+        next_obs, rew, term, trunc, _ = env.step(action)
+        obs_rows.append(obs)
+        act_rows.append(action)
+        rew_rows.append(rew)
+        next_rows.append(next_obs)
+        term_rows.append(float(term))
+        obs = next_obs
+        if term or trunc:
+            obs, _ = env.reset()
+    env.close()
+    return [
+        SampleBatch(
+            {
+                sb.OBS: np.asarray(obs_rows, np.float32),
+                sb.ACTIONS: np.asarray(act_rows, np.int64),
+                sb.REWARDS: np.asarray(rew_rows, np.float32),
+                sb.NEXT_OBS: np.asarray(next_rows, np.float32),
+                sb.TERMINATEDS: np.asarray(term_rows, np.float32),
+            }
+        )
+    ]
+
+
+def test_experience_roundtrip(cluster, tmp_path):
+    path = write_experience(
+        _expert_cartpole_batches(n_steps=300), str(tmp_path / "exp")
+    )
+    from ray_tpu.rllib import read_experience
+
+    ds = read_experience(path)
+    assert ds.count() == 300
+    row = ds.take(1)[0]
+    assert sb.OBS in row and sb.ACTIONS in row and sb.NEXT_OBS in row
+
+
+def test_bc_learns_cartpole_from_offline_data(cluster, tmp_path):
+    """Pure offline: no environment interaction during training; the cloned
+    policy then clearly beats random (~20) in evaluation."""
+    path = write_experience(
+        _expert_cartpole_batches(n_steps=4000), str(tmp_path / "exp")
+    )
+    bc = BCConfig(
+        input_path=path, lr=1e-2, train_batch_size=512, seed=0
+    ).build()
+    first = bc.train()
+    assert first["num_rows_trained"] == 4000
+    loss_first = first["learner"]["neg_logp"]
+    result = first
+    for _ in range(7):
+        result = bc.train()
+    assert result["learner"]["neg_logp"] < loss_first  # actually fitting
+    ev = bc.evaluate("CartPole-v1", episodes=5)
+    assert ev["episode_return_mean"] > 80, ev
