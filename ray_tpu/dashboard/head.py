@@ -138,6 +138,8 @@ class DashboardHead:
             )
         if data is None:
             return "404 Not Found", "application/json", b'{"error": "no route"}'
+        if isinstance(data, _Html):
+            return "200 OK", "text/html; charset=utf-8", data.text.encode()
         if isinstance(data, str):
             return "200 OK", "text/plain; version=0.0.4", data.encode()
         return "200 OK", "application/json", json.dumps(data).encode()
@@ -156,6 +158,10 @@ class DashboardHead:
 
         query = query or {}
 
+        if not path:  # "/" arrives rstrip("/")-ed
+            from ray_tpu.dashboard.ui import PAGE
+
+            return _Html(PAGE)
         if path == "/api/version":
             from ray_tpu._version import __version__
 
@@ -226,3 +232,10 @@ def _jsonable(obj):
     if isinstance(obj, dict):
         return {str(k): _jsonable(v) for k, v in obj.items()}
     return obj
+
+
+class _Html:
+    """Marker wrapper: route payloads rendered as text/html."""
+
+    def __init__(self, text: str):
+        self.text = text

@@ -1,0 +1,117 @@
+"""Dashboard UI: one self-contained HTML page over the REST API.
+
+Reference parity role: the reference ships a built React/TypeScript
+frontend (python/ray/dashboard/client); this framework serves ONE
+dependency-free page (inline CSS/JS, fetch() against /api/*) — a cluster
+overview that needs no build toolchain, no node_modules, and works from
+curl'd-up clusters. Panels: nodes (resources/liveness), actors, task
+summary, jobs, placement groups, workers (with one-click profile links),
+auto-refreshing.
+"""
+
+PAGE = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: ui-monospace, Menlo, monospace; margin: 1.2rem;
+         background: #0d1117; color: #c9d1d9; }
+  h1 { font-size: 1.2rem; } h2 { font-size: 1.0rem; margin: 1.2rem 0 .4rem; }
+  table { border-collapse: collapse; width: 100%; font-size: .82rem; }
+  th, td { border: 1px solid #30363d; padding: .25rem .5rem;
+           text-align: left; vertical-align: top; }
+  th { background: #161b22; }
+  .ok { color: #3fb950; } .bad { color: #f85149; }
+  .muted { color: #8b949e; font-size: .75rem; }
+  a { color: #58a6ff; }
+</style>
+</head>
+<body>
+<h1>ray_tpu cluster <span id="version" class="muted"></span>
+    <span id="refreshed" class="muted"></span></h1>
+<h2>Resources</h2><div id="resources"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Task summary</h2><table id="tasks"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Workers</h2><table id="workers"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Placement groups</h2><table id="pgs"></table>
+<script>
+async function j(path) {
+  // One failing endpoint must not abort the whole refresh tick.
+  try {
+    const r = await fetch(path); if (!r.ok) return null; return r.json();
+  } catch (e) { return null; }
+}
+function esc(v) {
+  // Cluster state is attacker-influenced (job entrypoints, labels):
+  // escape everything interpolated into innerHTML.
+  return String(v ?? "").replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
+  })[c]);
+}
+function row(cells, tag) {
+  tag = tag || "td";
+  return "<tr>" + cells.map(c => `<${tag}>${c}</${tag}>`).join("") + "</tr>";
+}
+function fmtRes(r) {
+  return esc(Object.entries(r || {}).map(([k, v]) => `${k}:${v}`).join(" "));
+}
+async function refresh() {
+  const [ver, nodes, actors, tasks, jobs, pgs, workers, total, avail] =
+    await Promise.all([
+      j("/api/version"), j("/api/nodes"), j("/api/actors"),
+      j("/api/task_summary"), j("/api/jobs"), j("/api/placement_groups"),
+      j("/api/workers"), j("/api/cluster_resources"),
+      j("/api/available_resources")]);
+  document.getElementById("version").textContent =
+    ver ? "v" + ver.version : "";
+  document.getElementById("refreshed").textContent =
+    " refreshed " + new Date().toLocaleTimeString();
+  document.getElementById("resources").innerHTML =
+    `<span class="muted">available / total:</span> ` +
+    Object.keys(total || {}).map(k =>
+      `${k}: ${(avail||{})[k] ?? "?"} / ${total[k]}`).join(" &nbsp; ");
+  const nt = document.getElementById("nodes");
+  nt.innerHTML = row(["node", "alive", "resources", "labels"], "th") +
+    (nodes || []).map(n => row([
+      esc(n.NodeID.slice(0, 12)),
+      n.Alive ? '<span class="ok">alive</span>'
+              : '<span class="bad">dead</span>',
+      fmtRes(n.Resources), esc(JSON.stringify(n.Labels || {}))])).join("");
+  const tt = document.getElementById("tasks");
+  const ts = tasks || {};
+  tt.innerHTML = row(["state", "count"], "th") +
+    Object.entries(ts).map(([k, v]) => row([esc(k), esc(v)])).join("");
+  const at = document.getElementById("actors");
+  at.innerHTML = row(["actor", "class", "state", "node", "restarts"], "th") +
+    (actors || []).map(a => row([
+      esc((a.actor_id || "").slice(0, 12)), esc(a.class_name || ""),
+      esc(a.state || ""), esc((a.node_id || "").slice(0, 12)),
+      esc(a.restarts ?? 0)])).join("");
+  const wt = document.getElementById("workers");
+  wt.innerHTML = row(["worker", "node", "state", "pid", "profile"], "th") +
+    (workers || []).filter(w => w.worker_id).map(w => row([
+      esc(w.worker_id.slice(0, 12)), esc((w.node_id || "").slice(0, 12)),
+      esc(w.state || ""), esc(w.pid ?? ""),
+      `<a href="/api/profile?worker_id=${encodeURIComponent(w.worker_id)}&duration=2">cpu</a> ` +
+      `<a href="/api/profile/dump?worker_id=${encodeURIComponent(w.worker_id)}">stacks</a>`
+      ])).join("");
+  const jt = document.getElementById("jobs");
+  jt.innerHTML = row(["job", "status", "entrypoint"], "th") +
+    (jobs || []).map(x => row([
+      esc(x.submission_id || x.job_id || ""), esc(x.status || ""),
+      esc((x.entrypoint || "").slice(0, 80))])).join("");
+  const pt = document.getElementById("pgs");
+  pt.innerHTML = row(["pg", "state", "bundles"], "th") +
+    (pgs || []).map(p => row([
+      esc((p.pg_id || "").slice(0, 12)), esc(p.state || ""),
+      esc(JSON.stringify(p.bundles || []))])).join("");
+}
+refresh();
+setInterval(refresh, 5000);
+</script>
+</body>
+</html>
+"""

@@ -150,3 +150,19 @@ def test_dashboard_profile_routes(cluster):
         assert out["samples"] > 0
     finally:
         dash.stop()
+
+def test_dashboard_ui_page(cluster):
+    """The root path serves the self-contained HTML UI (the reference's
+    React frontend role, dependency-free)."""
+    from ray_tpu.dashboard import DashboardHead
+
+    dash = DashboardHead(host="127.0.0.1", port=0)
+    port = dash.start()
+    try:
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers.get("Content-Type", "").startswith("text/html")
+            page = r.read().decode()
+        assert "ray_tpu cluster" in page and "/api/nodes" in page
+    finally:
+        dash.stop()
