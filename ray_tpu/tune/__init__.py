@@ -21,6 +21,11 @@ from ray_tpu.tune.search import (
     randint,
     uniform,
 )
+from ray_tpu.tune.search_alg import (
+    FunctionSearcher,
+    RandomSearcher,
+    Searcher,
+)
 from ray_tpu.tune.tuner import (
     TuneConfig,
     Tuner,
@@ -32,6 +37,9 @@ from ray_tpu.tune.tuner import (
 __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
+    "FunctionSearcher",
+    "RandomSearcher",
+    "Searcher",
     "PopulationBasedTraining",
     "ResultGrid",
     "RunConfig",

@@ -48,6 +48,21 @@ def randint(low: int, high: int) -> _Sampler:
     return _Sampler(lambda rng: rng.randrange(low, high))
 
 
+def sample_config(param_space: dict, rng: random.Random) -> dict:
+    """ONE config drawn from the space: grids sampled uniformly, samplers
+    drawn, literals passed through (shared by RandomSearcher and variant
+    generation — one place to extend when sampler types grow)."""
+    cfg = {}
+    for k, v in param_space.items():
+        if isinstance(v, _Grid):
+            cfg[k] = rng.choice(v.values)
+        elif isinstance(v, _Sampler):
+            cfg[k] = v.fn(rng)
+        else:
+            cfg[k] = v
+    return cfg
+
+
 def generate_variants(
     param_space: dict, num_samples: int = 1, seed: int | None = None
 ) -> list[dict]:

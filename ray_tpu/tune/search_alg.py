@@ -1,0 +1,75 @@
+"""Search algorithms: the pluggable suggest/observe seam.
+
+Reference parity: python/ray/tune/search/searcher.py (Searcher ABC:
+suggest/on_trial_complete, save/restore) + basic_variant.py. The Tuner
+asks the searcher for a config whenever a trial slot frees (incremental —
+a model-based searcher sees every completed result before proposing the
+next point), reports completions back, and persists searcher state with
+the experiment so Tuner.restore resumes the search where it stopped.
+
+Built-ins: RandomSearcher (independent draws from the param space) and
+FunctionSearcher (wrap any ``fn(trial_id, history) -> config | None``).
+External libraries plug in by subclassing Searcher — the surface is three
+methods.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional
+
+from ray_tpu.tune.search import sample_config
+
+
+class Searcher:
+    """ABC (reference: tune/search/searcher.py:34)."""
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        """The next config to try, or None when the search is exhausted."""
+        raise NotImplementedError
+
+    def on_trial_complete(
+        self, trial_id: str, result: Optional[dict] = None
+    ) -> None:
+        """Called with the trial's final metrics (None on error)."""
+
+    # State rides the experiment checkpoint via pickle by default;
+    # override for searchers wrapping unpicklable library state.
+    def save_state(self) -> dict:
+        return self.__dict__.copy()
+
+    def restore_state(self, state: dict) -> None:
+        self.__dict__.update(state)
+
+
+class RandomSearcher(Searcher):
+    """Independent random draws from the param space; grid keys are
+    sampled uniformly from their values (pure random search has no
+    cross-product budget)."""
+
+    def __init__(self, param_space: dict, seed: Optional[int] = None):
+        self.param_space = dict(param_space)
+        self._rng = random.Random(seed)
+        self.history: dict[str, dict] = {}  # trial_id -> final metrics
+
+    def suggest(self, trial_id: str) -> dict:
+        return sample_config(self.param_space, self._rng)
+
+    def on_trial_complete(self, trial_id, result=None) -> None:
+        if result is not None:
+            self.history[trial_id] = dict(result)
+
+
+class FunctionSearcher(Searcher):
+    """Wrap a plain function as a searcher:
+    ``fn(trial_id, history: {tid: final_metrics}) -> config | None``."""
+
+    def __init__(self, fn: Callable[[str, dict], Optional[dict]]):
+        self._fn = fn
+        self.history: dict[str, dict] = {}
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        return self._fn(trial_id, dict(self.history))
+
+    def on_trial_complete(self, trial_id, result=None) -> None:
+        self.history[trial_id] = dict(result) if result else {}

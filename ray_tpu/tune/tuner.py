@@ -129,6 +129,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    # Pluggable search algorithm (tune.search_alg.Searcher); None = the
+    # BasicVariant grid/sample cross-product over param_space.
+    search_alg: Any = None
     seed: Optional[int] = None
     resources_per_trial: dict = dataclasses.field(
         default_factory=lambda: {"CPU": 1.0}
@@ -160,9 +163,9 @@ class _ExperimentStore:
         os.replace(tmp, os.path.join(self.path, name))
 
     def save_meta(self, payload, param_space, tune_cfg) -> None:
-        # The scheduler is persisted separately (save_scheduler, with a
-        # graceful fallback) — strip it here so an unpicklable custom
-        # scheduler degrades resume fidelity instead of crashing fit().
+        # Scheduler AND searcher persist separately (save_dynamic, with a
+        # graceful fallback) — strip them here so an unpicklable custom
+        # one degrades resume fidelity instead of crashing fit().
         self._atomic_write(
             "tuner.pkl",
             cloudpickle.dumps(
@@ -170,7 +173,7 @@ class _ExperimentStore:
                     "payload": payload,
                     "param_space": param_space,
                     "tune_config": dataclasses.replace(
-                        tune_cfg, scheduler=None
+                        tune_cfg, scheduler=None, search_alg=None
                     ),
                 }
             ),
@@ -179,11 +182,28 @@ class _ExperimentStore:
     def save_trials(self, trials: list) -> None:
         self._atomic_write("trials.pkl", cloudpickle.dumps(trials))
 
-    def save_scheduler(self, scheduler) -> None:
+    def save_dynamic(self, scheduler, searcher=None) -> None:
+        # Components persist INDEPENDENTLY: an unpicklable searcher must
+        # not take the scheduler checkpoint down with it. Searchers also
+        # get the save_state() escape hatch (wrapping unpicklable library
+        # state) — its dict is tried separately from the whole object.
+        blob: dict = {}
+        for key, value in (("scheduler", scheduler), ("searcher", searcher)):
+            try:
+                blob[key] = cloudpickle.dumps(value)
+            except Exception:
+                blob[key] = None
+        if searcher is not None:
+            try:
+                blob["searcher_state"] = cloudpickle.dumps(
+                    searcher.save_state()
+                )
+            except Exception:
+                blob["searcher_state"] = None
         try:
-            self._atomic_write("scheduler.pkl", cloudpickle.dumps(scheduler))
+            self._atomic_write("scheduler.pkl", pickle.dumps(blob))
         except Exception:
-            pass  # an unpicklable custom scheduler degrades resume fidelity
+            pass
 
     def load(self) -> dict:
         out = {}
@@ -196,7 +216,17 @@ class _ExperimentStore:
         sched_path = os.path.join(self.path, "scheduler.pkl")
         if os.path.exists(sched_path):
             with open(sched_path, "rb") as f:
-                out["scheduler"] = pickle.load(f)
+                dyn = pickle.load(f)
+            if isinstance(dyn, dict) and "scheduler" in dyn:
+                for key in ("scheduler", "searcher", "searcher_state"):
+                    raw = dyn.get(key)
+                    if raw is not None:
+                        try:
+                            out[key] = pickle.loads(raw)
+                        except Exception:
+                            pass
+            else:  # pre-searcher checkpoint layout
+                out["scheduler"] = dyn
         return out
 
     def trial_dir(self, trial_id: str) -> str:
@@ -252,6 +282,16 @@ class Tuner:
             scheduler = self._restored.get("scheduler") or (
                 cfg.scheduler or FIFOScheduler()
             )
+            searcher = self._restored.get("searcher") or cfg.search_alg
+            state = self._restored.get("searcher_state")
+            if (
+                searcher is not None
+                and state is not None
+                and self._restored.get("searcher") is None
+            ):
+                # The object itself didn't pickle; the user-supplied
+                # searcher resumes through its save_state escape hatch.
+                searcher.restore_state(state)
             all_trials: list[TrialResult] = self._restored.get("trials", [])
             end_states = ("TERMINATED", "STOPPED", "ERROR")
             done = [t for t in all_trials if t.status in end_states]
@@ -260,21 +300,29 @@ class Tuner:
                 t.status = "PENDING"
         else:
             scheduler = cfg.scheduler or FIFOScheduler()
+            searcher = cfg.search_alg
             payload = cloudpickle.dumps(self._trainable)
-            variants = generate_variants(
-                self._param_space, cfg.num_samples, cfg.seed
-            )
-            all_trials = [
-                TrialResult(
-                    trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:4]}",
-                    config=v,
+            if searcher is not None:
+                # Suggest-driven: trials are created INCREMENTALLY as slots
+                # free, so the searcher observes completed results before
+                # proposing the next point (reference: SearchGenerator).
+                all_trials = []
+            else:
+                variants = generate_variants(
+                    self._param_space, cfg.num_samples, cfg.seed
                 )
-                for i, v in enumerate(variants)
-            ]
+                all_trials = [
+                    TrialResult(
+                        trial_id=f"trial_{i:04d}_{uuid.uuid4().hex[:4]}",
+                        config=v,
+                    )
+                    for i, v in enumerate(variants)
+                ]
             done = []
             pending = list(all_trials)
             if self._store is not None:
                 self._store.save_meta(payload, self._param_space, cfg)
+        searcher_exhausted = False
 
         running: dict[str, dict] = {}  # trial_id -> {actor, ref, trial}
         actor_cls = ray_tpu.remote(TrialRunner)
@@ -282,7 +330,7 @@ class Tuner:
         def persist():
             if self._store is not None:
                 self._store.save_trials(all_trials)
-                self._store.save_scheduler(scheduler)
+                self._store.save_dynamic(scheduler, searcher)
 
         def launch(trial: TrialResult):
             actor = actor_cls.options(
@@ -317,10 +365,35 @@ class Tuner:
                 "actor": actor, "ref": ref, "trial": trial,
             }
 
+        def next_suggested() -> bool:
+            nonlocal searcher_exhausted
+            if (
+                searcher is None
+                or searcher_exhausted
+                or len(all_trials) >= cfg.num_samples
+            ):
+                return False
+            tid = f"trial_{len(all_trials):04d}_{uuid.uuid4().hex[:4]}"
+            suggestion = searcher.suggest(tid)
+            if suggestion is None:
+                searcher_exhausted = True
+                return False
+            trial = TrialResult(trial_id=tid, config=dict(suggestion))
+            all_trials.append(trial)
+            pending.append(trial)
+            return True
+
         persist()
         dirty = True
         last_persist = time.monotonic()
-        while pending or running:
+        while True:
+            while (
+                len(running) + len(pending) < cfg.max_concurrent_trials
+                and next_suggested()
+            ):
+                dirty = True
+            if not (pending or running):
+                break
             while pending and len(running) < cfg.max_concurrent_trials:
                 launch(pending.pop(0))
                 dirty = True
@@ -389,6 +462,15 @@ class Tuner:
                     pass
                 ray_tpu.kill(entry["actor"])
                 del running[tid]
+                if searcher is not None and not (
+                    entry.get("exploit") and trial.status == "STOPPED"
+                ):
+                    # Contract: None on error — a stale last report must
+                    # not register a crashing config as a good observation.
+                    searcher.on_trial_complete(
+                        tid,
+                        None if trial.status == "ERROR" else trial.metrics,
+                    )
                 if entry.get("exploit") and trial.status == "STOPPED":
                     # PBT exploit/explore: clone the winner's checkpoint
                     # dir + mutated config, then REQUEUE the same trial.

@@ -192,3 +192,48 @@ def test_pbt_exploits_winner(rt, tmp_path):
     # far above 1.
     assert scores[0] > 1.0, f"no exploit happened: {scores}"
     assert scores[-1] >= 25 * 1.0
+
+def test_random_searcher_drives_trials(rt, tmp_path):
+    """Suggest-driven search: the searcher proposes configs incrementally
+    and observes completions (reference: tune/search/searcher.py)."""
+
+    def trainable(config):
+        tune.report(score=config["x"] * 2)
+
+    searcher = tune.RandomSearcher({"x": tune.uniform(0, 1)}, seed=3)
+    grid = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=5,
+            max_concurrent_trials=2, search_alg=searcher,
+        ),
+    ).fit()
+    assert len(grid) == 5
+    assert all(t.status == "TERMINATED" for t in grid)
+    assert all(0 <= t.config["x"] <= 1 for t in grid)
+    # The searcher observed every completion.
+    assert len(searcher.history) == 5
+    assert all("score" in m for m in searcher.history.values())
+
+
+def test_function_searcher_exhaustion(rt):
+    """A searcher returning None ends the search early."""
+
+    def trainable(config):
+        tune.report(score=config["x"])
+
+    def suggest(trial_id, history):
+        return {"x": len(history)} if len(history) < 3 else None
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=100,
+            max_concurrent_trials=1,
+            search_alg=tune.FunctionSearcher(suggest),
+        ),
+    ).fit()
+    assert len(grid) == 3  # exhausted long before num_samples
+    assert sorted(t.config["x"] for t in grid) == [0, 1, 2]
