@@ -105,6 +105,15 @@ class Runtime:
 def _default_resources(num_cpus: float | None) -> dict:
     resources = {"CPU": float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))}
     try:
+        # Schedulable memory (reference: nodes advertise memory so
+        # ray_remote_args memory= demands have something to fit against).
+        page = os.sysconf("SC_PAGE_SIZE")
+        phys = os.sysconf("SC_PHYS_PAGES")
+        if page > 0 and phys > 0:
+            resources["memory"] = float(page * phys)
+    except (ValueError, OSError, AttributeError):
+        pass
+    try:
         from ray_tpu.accelerators import tpu as tpu_accel
 
         resources.update(tpu_accel.detect_resources())

@@ -54,11 +54,18 @@ class Dataset:
         batch_format: str = "numpy",
         fn_kwargs: Optional[dict] = None,
         compute=None,
+        num_cpus: Optional[float] = None,
+        memory: Optional[int] = None,
+        resources: Optional[dict] = None,
         **_compat,
     ) -> "Dataset":
         """compute: None (stateless tasks), "actors", an int pool size, or
         an ActorPoolStrategy — actor pools amortize expensive per-process
-        setup across blocks (reference: Dataset.map_batches compute=)."""
+        setup across blocks (reference: Dataset.map_batches compute=).
+        num_cpus/memory/resources: this operator's per-task resource
+        budget (reference: map_batches ray_remote_args) — the scheduler
+        places the stage's tasks under these demands, so e.g. a 4-CPU
+        preprocessing fn can't oversubscribe a node."""
         from ray_tpu.data.plan import ActorPoolStrategy
 
         if compute == "actors":
@@ -67,9 +74,21 @@ class Dataset:
             compute = ActorPoolStrategy(size=compute)
         elif compute is not None and not isinstance(compute, ActorPoolStrategy):
             raise TypeError(f"bad compute= value {compute!r}")
+        remote_args: dict = {}
+        if num_cpus is not None:
+            remote_args["num_cpus"] = num_cpus
+        if memory is not None:
+            remote_args["resources"] = dict(
+                remote_args.get("resources", {}), memory=float(memory)
+            )
+        if resources:
+            remote_args["resources"] = dict(
+                remote_args.get("resources", {}), **resources
+            )
         return self._with_op(
             MapBatchesOp(
-                fn, batch_size, batch_format, fn_kwargs or {}, compute
+                fn, batch_size, batch_format, fn_kwargs or {}, compute,
+                remote_args,
             )
         )
 

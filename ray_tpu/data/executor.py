@@ -312,13 +312,35 @@ class StreamingExecutor:
             c = getattr(op, "compute", None)
             if c is not None and (strategy is None or c.size > strategy.size):
                 strategy = c
+        # Per-op resource budgets (reference: map_batches ray_remote_args):
+        # the fused stage schedules under the LARGEST demand of any op in
+        # its chain (a stage is one task — its footprint is its hungriest
+        # operator's). Ops without an explicit budget implicitly demand the
+        # default 1 CPU, so fusing a num_cpus=0.25 op with a plain map
+        # cannot shrink the stage below the default; a stage where EVERY op
+        # explicitly says num_cpus=0 genuinely reserves none.
+        stage_opts: dict = {}
+        cpu_demands = []
+        for op in chain:
+            args = getattr(op, "ray_remote_args", None) or {}
+            cpu_demands.append(
+                args["num_cpus"] if "num_cpus" in args else 1.0
+            )
+            for k, v in (args.get("resources") or {}).items():
+                res = stage_opts.setdefault("resources", {})
+                res[k] = max(res.get(k, 0), v)
+        if cpu_demands and any(c != 1.0 for c in cpu_demands):
+            stage_opts["num_cpus"] = max(cpu_demands)
         pool: list = []
         window = self._window
         if strategy is not None:
             size = max(1, min(strategy.size, max(len(sources), 1)))
+            actor_opts = {"num_cpus": stage_opts.get("num_cpus", 1)}
+            if stage_opts.get("resources"):
+                actor_opts["resources"] = stage_opts["resources"]
             pool = [
                 ray_tpu.remote(_ChainActor)
-                .options(num_cpus=1)
+                .options(**actor_opts)
                 .remote(payload)
                 for _ in range(size)
             ]
@@ -345,7 +367,7 @@ class StreamingExecutor:
                         ).remote(src, is_read)
                     else:
                         block_ref, meta_ref = remote_chain.options(
-                            num_returns=2
+                            num_returns=2, **stage_opts
                         ).remote(payload, src, is_read)
                     submitted += 1
                     pending.append((block_ref, meta_ref))

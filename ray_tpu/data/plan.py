@@ -40,6 +40,10 @@ class MapBatchesOp:
     batch_format: str = "numpy"
     fn_kwargs: dict = field(default_factory=dict)
     compute: Optional[ActorPoolStrategy] = None
+    # Per-operator resource budget (reference: map_batches num_cpus=/
+    # memory=/resources= ray_remote_args): the fused stage's tasks/actors
+    # are scheduled with the LARGEST demand of any op in the chain.
+    ray_remote_args: dict = field(default_factory=dict)
 
 
 @dataclass

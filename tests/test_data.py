@@ -5,6 +5,8 @@ Reference parity: python/ray/data/tests/ (test_map.py, test_consumption.py,
 test_parquet.py patterns, compressed to the core behaviors).
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -241,3 +243,44 @@ def test_dataset_stats(cluster):
     ds2.materialize()
     kinds = {r["kind"] for r in ds2.stats_dict()}
     assert "barrier" in kinds, ds2.stats()
+
+
+def test_map_batches_resource_budget(cluster):
+    """Per-operator resource budgets (reference: map_batches
+    ray_remote_args): a stage demanding a custom resource only runs on
+    nodes providing it, and its num_cpus bounds concurrency."""
+    import ray_tpu.data as rd
+
+    runtime = cluster
+    node = runtime.add_node(
+        {"CPU": 2.0, "etl": 2.0}, name="etl-node"
+    )
+    time.sleep(0.5)
+
+    def tag_node(batch):
+        import ray_tpu as rr
+
+        batch["node"] = np.asarray(
+            [rr.get_runtime_context().node_id] * len(batch["id"])
+        )
+        return batch
+
+    ds = rd.range(40, parallelism=4).map_batches(
+        tag_node, resources={"etl": 1.0}
+    )
+    rows = ds.take_all()
+    assert len(rows) == 40
+    assert {r["node"] for r in rows} == {node.node_id}
+    node.stop()
+
+
+def test_map_batches_memory_budget_schedules(cluster):
+    """memory= demands fit against the node-advertised memory resource
+    (default nodes advertise host RAM)."""
+    import ray_tpu.data as rd
+
+    assert ray_tpu.cluster_resources().get("memory", 0) > 0
+    ds = rd.range(20, parallelism=2).map_batches(
+        lambda b: b, memory=64 * 1024 * 1024
+    )
+    assert ds.count() == 20
