@@ -163,10 +163,14 @@ def run_bench() -> dict:
 def _probe_backend() -> str:
     """Check jax can enumerate devices, in a killable subprocess with a hard
     timeout (a wedged axon tunnel makes jax.devices() hang forever, with no
-    error).  Retries once: the first touch after an idle period sometimes
-    times out while the tunnel re-establishes.
+    error).
 
-    Returns "ok", "wedged" (any attempt hung — environmental, skip cleanly)
+    The tunnel wedges in windows: one dead probe does not mean a dead round.
+    So the probe runs up to RAY_TPU_BENCH_PROBE_ROUNDS rounds (default 3),
+    spaced RAY_TPU_BENCH_PROBE_SPACING_S apart (default 300 s), and only
+    writes the skip record after the whole ~15-minute window comes up dry.
+
+    Returns "ok", "wedged" (every round hung — environmental, skip cleanly)
     or "broken" (fast nonzero exits — a jax/plugin/install regression that
     must fail the gate, not silently skip)."""
     code = (
@@ -175,8 +179,10 @@ def _probe_backend() -> str:
         "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
         "print(len(jax.devices()), jax.default_backend())"
     )
-    saw_timeout = False
-    for attempt in (1, 2):
+    rounds = int(os.environ.get("RAY_TPU_BENCH_PROBE_ROUNDS", "3"))
+    spacing = float(os.environ.get("RAY_TPU_BENCH_PROBE_SPACING_S", "300"))
+    last_outcome = "broken"
+    for attempt in range(1, rounds + 1):
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -189,13 +195,22 @@ def _probe_backend() -> str:
                 return "ok"
             tail = "\n".join(r.stderr.strip().splitlines()[-3:])
             _log(f"backend probe attempt {attempt} rc={r.returncode}: {tail}")
+            # A fast nonzero exit is deterministic breakage, not a wedge
+            # window: report it now instead of sleeping out the window, and
+            # let the LAST completed attempt decide the verdict (a tunnel
+            # that recovers mid-window into a crashing plugin must go red,
+            # not green-skip).
+            return "broken"
         except subprocess.TimeoutExpired:
-            saw_timeout = True
+            last_outcome = "wedged"
             _log(
-                f"backend probe attempt {attempt} timed out after "
+                f"backend probe attempt {attempt}/{rounds} timed out after "
                 f"{PROBE_TIMEOUT_S}s (tunnel wedged?)"
             )
-    return "wedged" if saw_timeout else "broken"
+        if attempt < rounds:
+            _log(f"waiting {spacing:.0f}s before probe attempt {attempt + 1}")
+            time.sleep(spacing)
+    return last_outcome
 
 
 def _skip(reason: str) -> dict:
