@@ -179,7 +179,7 @@ def _probe_backend() -> str:
         "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
         "print(len(jax.devices()), jax.default_backend())"
     )
-    rounds = int(os.environ.get("RAY_TPU_BENCH_PROBE_ROUNDS", "3"))
+    rounds = max(1, int(os.environ.get("RAY_TPU_BENCH_PROBE_ROUNDS", "3")))
     spacing = float(os.environ.get("RAY_TPU_BENCH_PROBE_SPACING_S", "300"))
     last_outcome = "broken"
     for attempt in range(1, rounds + 1):
@@ -195,21 +195,25 @@ def _probe_backend() -> str:
                 return "ok"
             tail = "\n".join(r.stderr.strip().splitlines()[-3:])
             _log(f"backend probe attempt {attempt} rc={r.returncode}: {tail}")
-            # A fast nonzero exit is deterministic breakage, not a wedge
-            # window: report it now instead of sleeping out the window, and
-            # let the LAST completed attempt decide the verdict (a tunnel
-            # that recovers mid-window into a crashing plugin must go red,
-            # not green-skip).
-            return "broken"
+            # A fast nonzero exit looks like deterministic breakage, but a
+            # dropping tunnel can also fail fast (connection refused): keep
+            # retrying on a SHORT delay (no point sleeping out the wedge
+            # window), and let the LAST completed attempt decide — a
+            # transient blip recovers on a later attempt, while a tunnel
+            # that recovers mid-window into a crashing plugin still ends
+            # on "broken" and goes red rather than green-skipping.
+            last_outcome = "broken"
+            delay = min(15.0, spacing)
         except subprocess.TimeoutExpired:
             last_outcome = "wedged"
+            delay = spacing
             _log(
                 f"backend probe attempt {attempt}/{rounds} timed out after "
                 f"{PROBE_TIMEOUT_S}s (tunnel wedged?)"
             )
         if attempt < rounds:
-            _log(f"waiting {spacing:.0f}s before probe attempt {attempt + 1}")
-            time.sleep(spacing)
+            _log(f"waiting {delay:.0f}s before probe attempt {attempt + 1}")
+            time.sleep(delay)
     return last_outcome
 
 

@@ -438,10 +438,20 @@ def _runtime_env_from_opts(opts: dict, worker: CoreWorker) -> dict:
     if not renv:
         return {}
     if not isinstance(worker, CoreWorker):
-        raise RayTpuError(
-            "runtime_env with packages is not supported in client mode "
-            "yet (working_dir/py_modules upload needs cluster KV access)"
+        # Client mode: env_vars (and already-uploaded pkg: URIs) need no
+        # upload and pass straight through; only a LOCAL-directory upload
+        # needs direct cluster KV access the client boundary lacks.
+        wd = renv.get("working_dir")
+        mods = renv.get("py_modules") or []
+        needs_upload = (wd and not str(wd).startswith("pkg:")) or any(
+            not str(m).startswith("pkg:") for m in mods
         )
+        if needs_upload:
+            raise RayTpuError(
+                "runtime_env working_dir/py_modules local-directory upload "
+                "is not supported in client mode yet (it needs cluster KV "
+                "access); pass a pkg: URI or use env_vars only"
+            )
     import json as _json
 
     from ray_tpu import runtime_env as _re

@@ -356,11 +356,14 @@ class ClientServer:
         session = self._session(conn)
         worker = self.worker
         oid = p["oid"]
+        # Only touch the shared worker's refcount when THIS session holds a
+        # claim: a duplicate/spurious ref_del from one session must not be
+        # able to free an object another session still claims.
         if session.claims.get(oid, 0) > 0:
             session.claims[oid] -= 1
             if session.claims[oid] == 0:
                 del session.claims[oid]
-        await self._on_worker(worker, worker._release_local_ref(oid))
+            await self._on_worker(worker, worker._release_local_ref(oid))
         return True
 
 

@@ -193,7 +193,14 @@ class DashboardHead:
             from ray_tpu.util import profiling
 
             worker_id = query.get("worker_id", "driver")
+            # Clamp: these run synchronously on a dashboard executor thread
+            # (plus the target worker's), and the links are plain GETs any
+            # browser prefetch can hit — an unbounded duration would tie
+            # both up for that long.
             duration = float(query.get("duration", 5.0))
+            if not (duration == duration):  # NaN bypasses min/max clamping
+                duration = 5.0
+            duration = min(max(duration, 0.1), 60.0)
             if path == "/api/profile/dump":
                 return {"stacks": profiling.dump_worker_stacks(worker_id)}
             if path == "/api/profile/jax_trace":

@@ -67,11 +67,17 @@ class _ModelCache:
         try:
             # Make room BEFORE the load: the cap bounds device memory, and
             # uploading a (max+1)-th model while max are still resident
-            # would OOM exactly the workload the cap was sized for.
-            while len(self._models) >= self._max:
-                self._models.popitem(last=False)  # GC frees its HBM arrays
+            # would OOM exactly the workload the cap was sized for.  The
+            # capacity check counts in-flight loads too (including this
+            # one), so N concurrent cold-model requests cannot each see a
+            # half-empty cache and leave max+N models resident.
+            self._evict_for_capacity()
             model = await self._loader(model_id)
+            self._loading.pop(model_id, None)
             self._models[model_id] = model
+            # Re-trim: another load may have filled the cache while ours
+            # was in flight.
+            self._evict_for_capacity()
             fut.set_result(model)
             return model
         except BaseException as e:
@@ -85,6 +91,14 @@ class _ModelCache:
             raise
         finally:
             self._loading.pop(model_id, None)
+
+    def _evict_for_capacity(self) -> None:
+        # GC of a popped entry frees its HBM arrays.
+        while (
+            self._models
+            and len(self._models) + len(self._loading) > self._max
+        ):
+            self._models.popitem(last=False)
 
     def loaded_ids(self) -> list[str]:
         return list(self._models)

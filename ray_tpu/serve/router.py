@@ -44,12 +44,27 @@ class Router:
         self._model_replicas: dict[str, list] = {}
 
     async def _refresh(self, force: bool = False) -> None:
-        table = await core_api.get_async(
-            self._controller.get_routing.remote(
-                self._deployment, -1 if force else self._version
-            ),
-            timeout=30,
-        )
+        try:
+            table = await core_api.get_async(
+                self._controller.get_routing.remote(
+                    self._deployment, -1 if force else self._version
+                ),
+                timeout=30,
+            )
+        except (ActorDiedError, ActorUnavailableError):
+            # Controller crashed and was re-created WITHOUT serve.shutdown()
+            # (so the process-wide router cache was never cleared): the
+            # cached handle points at the dead incarnation. Re-resolve by
+            # name and retry once so every cached handle recovers.
+            from ray_tpu.serve.controller import CONTROLLER_NAME
+
+            self._controller = await core_api.get_actor_async(
+                CONTROLLER_NAME
+            )
+            table = await core_api.get_async(
+                self._controller.get_routing.remote(self._deployment, -1),
+                timeout=30,
+            )
         if table.get("missing"):
             raise DeploymentNotFoundError(
                 f"no deployment named {self._deployment!r}"

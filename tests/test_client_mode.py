@@ -173,3 +173,61 @@ def test_client_streaming_rejected_clearly(client):
 
     with pytest.raises(NotImplementedError, match="client"):
         gen.options(num_returns="streaming").remote()
+
+
+def test_client_env_vars_runtime_env_passes_through(client):
+    """env_vars-only runtime envs need no package upload, so they work over
+    the client boundary (only local-dir working_dir/py_modules are gated)."""
+
+    @ray_tpu.remote
+    def read_env():
+        import os
+
+        return os.environ.get("CLIENT_RENV", "")
+
+    ref = read_env.options(
+        runtime_env={"env_vars": {"CLIENT_RENV": "yes"}}
+    ).remote()
+    assert ray_tpu.get(ref, timeout=60) == "yes"
+
+    with pytest.raises(Exception, match="client mode"):
+        read_env.options(runtime_env={"working_dir": "."}).remote()
+
+
+def test_client_ref_del_respects_session_claims(head_daemon):
+    """A spurious/duplicate ref_del from one session must not free an object
+    another session still claims (all sessions share one proxy worker)."""
+    from ray_tpu.core import object_ref as orm
+    from ray_tpu.core import serialization
+    from ray_tpu.core.api import _parse_address
+    from ray_tpu.core.client import ClientWorker
+
+    saved_hooks = (orm._on_ref_deserialized, orm._on_ref_deleted)
+    addr = _parse_address(head_daemon["client_address"])
+    a = ClientWorker(addr, token=TOKEN)
+    b = ClientWorker(addr, token=TOKEN)
+    try:
+        ref = a._load_reply(
+            a._call(
+                "client.put", {"value": serialization.dumps("shared")[0]}
+            )
+        )
+        oid = ref.hex()
+        # B takes its own claim on the same object.
+        assert b._call("client.ref_new", {"oid": oid}) is True
+        # A sends one real release plus two spurious ones: only the claim
+        # A actually held may touch the shared worker's refcount.
+        for _ in range(3):
+            a._call("client.ref_del", {"oid": oid})
+        got = b._load_reply(
+            b._call(
+                "client.get",
+                {"refs": serialization.dumps([ref])[0], "timeout": 30},
+            )
+        )
+        assert got == ["shared"]
+    finally:
+        a.stop()
+        b.stop()
+        # stop() clears the process-wide hooks; restore the module client's.
+        orm.install_hooks(*saved_hooks)

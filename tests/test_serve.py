@@ -209,3 +209,35 @@ def test_autoscaling_up_and_down(cluster):
         assert replica_count() == 1
     finally:
         serve.delete("autoscaled")
+
+
+def test_handle_survives_controller_restart(cluster):
+    """The controller dying and being re-created WITHOUT serve.shutdown()
+    (crash path) must not strand cached routers: Router._refresh re-resolves
+    the controller by name on ActorDiedError."""
+    handle = serve.run(Doubler.bind(1))
+    assert handle.remote({"body": {"x": 1}}).result(timeout=60) == {"y": 3}
+
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    from ray_tpu.serve.handle import _routers
+
+    router = _routers["Doubler"]
+    ray_tpu.kill(ray_tpu.get_actor(CONTROLLER_NAME))
+    # Re-create the controller (fresh incarnation) — retry while the dead
+    # name entry is being purged.
+    deadline = time.time() + 30
+    while True:
+        try:
+            handle2 = serve.run(Doubler.bind(1))
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.3)
+    assert handle2.remote({"body": {"x": 5}}).result(timeout=60) == {"y": 11}
+    # Force the CACHED router (old controller handle inside) through a
+    # refresh: without the by-name re-resolve this raises ActorDiedError.
+    router._version = -2
+    router._replicas = []
+    out = handle.remote({"body": {"x": 2}}).result(timeout=60)
+    assert out == {"y": 5}

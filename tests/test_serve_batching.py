@@ -125,6 +125,33 @@ def test_multiplex_lru_and_single_flight():
     asyncio.run(main())
 
 
+def test_multiplex_concurrent_cold_loads_respect_cap():
+    """N concurrent cold-model requests must not leave more than
+    max_num_models_per_replica models resident (the cap bounds HBM): the
+    capacity check has to count in-flight loads, not just finished ones."""
+
+    class M:
+        @multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id):
+            await asyncio.sleep(0.02)
+            return f"model:{model_id}"
+
+    m = M()
+
+    async def main():
+        await m.get_model("a")
+        await m.get_model("b")
+        cache = m.get_model.cache
+        assert sorted(cache.loaded_ids()) == ["a", "b"]
+        # Two concurrent COLD loads against a full cache.
+        r = await asyncio.gather(m.get_model("c"), m.get_model("d"))
+        assert set(r) == {"model:c", "model:d"}
+        assert len(cache.loaded_ids()) <= 2
+        assert not cache._loading
+
+    asyncio.run(main())
+
+
 # -- e2e: batched deployment throughput --------------------------------------
 
 
