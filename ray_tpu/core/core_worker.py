@@ -1524,9 +1524,62 @@ class CoreWorker:
         )
 
     async def _h_worker_rdt_free(self, conn, p):
+        from ray_tpu.experimental import transfer as _xfer
         from ray_tpu.experimental.device_objects import store
 
-        return store().free(p["oid"])
+        freed = store().free(p["oid"])
+        # Release armed fabric copies unconditionally: a budget-exhausted
+        # object is already gone from the store (freed=False) but its
+        # staged array may still sit armed.
+        if _xfer._fabric is not None:
+            _xfer.fabric().release_armed(p["oid"])
+        return freed
+
+    async def _h_worker_rdt_done(self, conn, p):
+        """Consumer ack: the pull for this uuid completed — drop the staged
+        copy so the producer does not retain HBM for it."""
+        from ray_tpu.experimental import transfer as _xfer
+
+        if _xfer._fabric is not None:
+            _xfer.fabric().release_uuid(p["uuid"])
+        return True
+
+    async def _h_worker_rdt_unarm(self, conn, p):
+        """Consumer's pull failed after a successful arm: drop the staged
+        copy AND refund the fetch budget by restoring the entry to the
+        store (values identical; layout is the staged decomposition)."""
+        from ray_tpu.experimental import transfer as _xfer
+        from ray_tpu.experimental.device_objects import store
+
+        if _xfer._fabric is None:
+            return False
+        entry = _xfer.fabric().release_uuid(p["uuid"])
+        if entry is None:
+            return False
+        oid, staged = entry
+        store().restore_arm(oid, staged)
+        return True
+
+    async def _h_worker_rdt_arm(self, conn, p):
+        """Stage a device object on the transfer fabric for one direct
+        device-to-device pull (consumer-chosen shard decomposition). Returns
+        the pull descriptor, or {"gone": True} / {"unsupported": reason} so
+        the caller can fall back to the host path."""
+
+        def _arm():
+            from ray_tpu.experimental import transfer as _xfer
+            from ray_tpu.experimental.device_objects import store
+
+            entry = store().take_for_arm(p["oid"])
+            if entry is None:
+                return {"gone": True}
+            try:
+                return _xfer.fabric().arm(p["oid"], entry, p["partitions"])
+            except Exception as e:  # fabric unavailable on this platform
+                store().restore_arm(p["oid"], entry)
+                return {"unsupported": f"{type(e).__name__}: {e}"}
+
+        return await asyncio.get_running_loop().run_in_executor(None, _arm)
 
     # -- compiled graphs (reference: compiled_dag_node.py ExecutableTask) ----
 

@@ -2,7 +2,9 @@
 
 Reference parity: python/ray/experimental/gpu_object_manager/ (Ray Direct
 Transport: GPU objects stay on-device, moved by NCCL/NIXL). TPU-native
-redesign in :mod:`ray_tpu.experimental.device_objects`.
+redesign in :mod:`ray_tpu.experimental.device_objects` (refs + store) and
+:mod:`ray_tpu.experimental.transfer` (device-to-device pull fabric over
+`jax.experimental.transfer` — the NIXL-role transport).
 """
 
 from ray_tpu.experimental.device_objects import (
@@ -13,12 +15,18 @@ from ray_tpu.experimental.device_objects import (
     device_store_stats,
     enable_device_objects,
 )
+from ray_tpu.experimental.transfer import (
+    decomposition_of,
+    transfer_stats,
+)
 
 __all__ = [
     "DeviceRef",
+    "decomposition_of",
     "device_free",
     "device_get",
     "device_put",
     "device_store_stats",
     "enable_device_objects",
+    "transfer_stats",
 ]

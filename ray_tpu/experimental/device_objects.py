@@ -60,6 +60,12 @@ class DeviceObjectStore:
 
     def fetch_host(self, oid: str) -> Optional[np.ndarray]:
         """Device -> host for shipping; applies the fetch budget."""
+        array = self.take_for_arm(oid)
+        return None if array is None else np.asarray(array)
+
+    def take_for_arm(self, oid: str):
+        """Like fetch_host but returns the DEVICE array for staging on the
+        transfer fabric (applies the same fetch budget)."""
         with self._lock:
             entry = self._objects.get(oid)
             if entry is None:
@@ -68,8 +74,16 @@ class DeviceObjectStore:
                 entry.fetches_left -= 1
                 if entry.fetches_left == 0:
                     del self._objects[oid]
-            array = entry.array
-        return np.asarray(array)
+            return entry.array
+
+    def restore_arm(self, oid: str, array) -> None:
+        """Undo a take_for_arm whose staging failed (budget refund)."""
+        with self._lock:
+            entry = self._objects.get(oid)
+            if entry is None:
+                self._objects[oid] = _Entry(array, 1)
+            elif entry.fetches_left > 0:
+                entry.fetches_left += 1
 
     def free(self, oid: str) -> bool:
         with self._lock:
@@ -137,9 +151,20 @@ def device_put(value, *, fetches_before_free: int = 0) -> DeviceRef:
     )
 
 
-def device_get(ref: DeviceRef, *, to_device: bool = True):
-    """Resolve a DeviceRef: local hit returns the original array;
-    otherwise fetch host bytes from the owner and put on a local device."""
+def device_get(ref: DeviceRef, *, to_device: bool = True, sharding=None):
+    """Resolve a DeviceRef: local hit returns the original array; otherwise
+    transfer from the owner.
+
+    The default path is device-to-device over the JAX transfer fabric
+    (:mod:`ray_tpu.experimental.transfer`): the owner stages the array in a
+    consumer-chosen shard decomposition and the buffers move directly
+    between XLA runtimes — no host pickle. ``sharding`` (a local
+    NamedSharding) selects where the result lands; without it the pull
+    spreads dim0 across local devices. Host-staged RPC remains the fallback
+    (non-array values, fabric-less platforms, RAY_TPU_RDT_FABRIC=0).
+    """
+    import os
+
     local = _store.get_local(ref.oid)
     if local is not None:
         return local
@@ -152,6 +177,61 @@ def device_get(ref: DeviceRef, *, to_device: bool = True):
             "device_get called on the endpoint event loop; fetch from the "
             "task/actor execution thread instead"
         )
+    if (
+        to_device
+        and ref.dtype  # empty dtype = non-array value: host path directly
+        and os.environ.get("RAY_TPU_RDT_FABRIC", "1") != "0"
+    ):
+        from ray_tpu.experimental import transfer as _xfer
+
+        try:
+            if sharding is not None:
+                partitions = _xfer.decomposition_of(sharding, ref.shape)
+            else:
+                partitions = _xfer.max_local_decomposition(ref.shape)
+            desc = worker.endpoint.call(
+                tuple(ref.owner_addr),
+                "worker.rdt_arm",
+                {"oid": ref.oid, "partitions": tuple(partitions)},
+                timeout=120,
+            )
+        except Exception:
+            desc = None  # owner predates rdt_arm or RPC failed: host path
+        if desc is not None and desc.get("gone"):
+            raise KeyError(
+                f"device object {ref.oid} is gone from its owner (freed or "
+                f"fetch budget exhausted)"
+            )
+        if desc is not None and not desc.get("unsupported"):
+            try:
+                out = _xfer.fabric().pull(desc, target_sharding=sharding)
+            except Exception:
+                # Refund the fetch budget the arm consumed (and drop the
+                # staged copy) so the host fallback below still finds the
+                # object — without this, a budget-1 ref would read as
+                # "gone" even though the data sits armed at the owner.
+                try:
+                    worker.endpoint.call(
+                        tuple(ref.owner_addr),
+                        "worker.rdt_unarm",
+                        {"uuid": desc["uuid"]},
+                        timeout=30,
+                    )
+                except Exception:
+                    pass
+                _xfer.fabric().count_fallback()
+            else:
+                # Ack so the owner drops its staged HBM copy now rather
+                # than holding it until cap eviction.
+                try:
+                    worker.endpoint.notify_sync(
+                        tuple(ref.owner_addr),
+                        "worker.rdt_done",
+                        {"uuid": desc["uuid"]},
+                    )
+                except Exception:
+                    pass
+                return out
     host = worker.endpoint.call(
         tuple(ref.owner_addr),
         "worker.rdt_fetch",
@@ -165,18 +245,13 @@ def device_get(ref: DeviceRef, *, to_device: bool = True):
         )
     if not to_device:
         return host
-    import os
+    from ray_tpu.experimental.transfer import _repin_platform
 
+    _repin_platform()
     import jax
 
-    # Honor JAX_PLATFORMS even where a TPU plugin overrides it at import
-    # (same guard as the LLM engine / worker bootstrap).
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        try:
-            jax.config.update("jax_platforms", plat)
-        except Exception:
-            pass
+    if sharding is not None:
+        return jax.device_put(host, sharding)
     return jax.device_put(host)
 
 

@@ -1,0 +1,280 @@
+"""Device-to-device tensor transfer between separately initialized JAX
+programs (SPMD "worlds") — no host staging, no pickle of device buffers.
+
+Reference parity: python/ray/experimental/channel/torch_tensor_accelerator_channel.py:49
+(NCCL P2P between compiled programs) and
+python/ray/experimental/gpu_object_manager/nixl_tensor_transport.py (RDMA-style
+point-to-point tensor pull). TPU-native redesign: instead of a NCCL/NIXL
+communicator pair, each process runs one `jax.experimental.transfer` server —
+XLA's cross-host transfer engine (DCN-backed on real TPU pods, socket-backed
+elsewhere). The consumer *pulls*: buffers move directly between XLA device
+runtimes; the control plane only carries a tiny "arm" RPC.
+
+Protocol (one producer process -> one consumer process):
+
+1. Consumer picks a shard *decomposition* — per-dimension partition counts,
+   e.g. ``(1, 4)`` = dim1 split 4 ways — typically derived from the sharding
+   it wants the array to land in (:func:`decomposition_of`).
+2. Consumer sends ``worker.rdt_arm {oid, partitions}`` to the owner.
+3. Owner re-lays-out the array to that decomposition *on its own devices*
+   (``jax.device_put`` — an on-device XLA reshard, ICI-local), schedules it
+   with ``server.await_pull(uuid, ...)``, and replies
+   ``{uuid, address, shape, dtype, partitions}``.
+4. Consumer builds the byte-identical decomposition over *its* devices and
+   ``connection.pull``s: each shard travels device-to-device through the
+   transfer engine. A final local ``device_put`` moves the result into the
+   consumer's target sharding if it differs.
+
+The fabric requires the shard layouts on both ends to match byte-for-byte
+(the engine moves shards, it does not reshard) — that is why the producer
+re-lays-out first. Arrays must be fully addressable in the owner process
+(one-controller worlds; each process of a multi-controller world owns its
+own addressable shards and would run this protocol per process).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import uuid as _uuid
+from typing import Any, Optional, Sequence
+
+_AXIS_PREFIX = "_xfer"
+
+
+def _repin_platform() -> None:
+    """Honor JAX_PLATFORMS where a TPU plugin overrides it at import time
+    (same guard as device_objects / the LLM engine / worker bootstrap)."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+
+class _Fabric:
+    """Per-process transfer server + connection cache (lazily started)."""
+
+    # Bound on retained armed entries: a consumer that pulls but whose
+    # completion notify is lost (or that dies mid-pull) must not pin staged
+    # HBM copies forever. Oldest-armed evicts first.
+    ARMED_CAP = 16
+
+    def __init__(self):
+        import collections
+        import os
+
+        self._lock = threading.Lock()
+        self._server = None
+        self._conns: dict[str, Any] = {}
+        # Keep armed arrays alive until pulled-or-freed: uuid -> (oid, array).
+        self._armed: "collections.OrderedDict[int, tuple[str, Any]]" = (
+            collections.OrderedDict()
+        )
+        self._armed_cap = int(
+            os.environ.get("RAY_TPU_XFER_ARMED_CAP", str(self.ARMED_CAP))
+        )
+        self._stats = {"arms": 0, "pulls": 0, "fallbacks": 0}
+
+    # -- server ----------------------------------------------------------------
+
+    def _ensure_server(self):
+        if self._server is not None:
+            return self._server
+        with self._lock:
+            if self._server is None:
+                _repin_platform()
+                import jax
+                from jax.experimental import transfer
+
+                from ray_tpu.util.net import local_ip
+
+                ip = local_ip()
+                client = jax.local_devices()[0].client
+                # Explicit socket transport addresses: the default local bulk
+                # transport only pairs processes created by one runtime and
+                # aborts across unrelated ones.
+                self._server = transfer.start_transfer_server(
+                    client, f"{ip}:0", [f"{ip}:0"]
+                )
+        return self._server
+
+    def address(self) -> str:
+        return self._ensure_server().address()
+
+    def _connect(self, address: str):
+        server = self._ensure_server()
+        with self._lock:
+            conn = self._conns.get(address)
+            if conn is None:
+                conn = server.connect(address)
+                self._conns[address] = conn
+            return conn
+
+    # -- producer side ---------------------------------------------------------
+
+    def arm(self, oid: str, array, partitions: Sequence[int]) -> dict:
+        """Re-layout ``array`` to ``partitions`` on local devices and schedule
+        it for one remote pull. Returns the pull descriptor."""
+        _repin_platform()
+        import jax
+
+        partitions = _normalize_partitions(array.shape, partitions)
+        if math.prod(partitions) > len(jax.local_devices()):
+            # Consumer asked for more shards than this world has devices:
+            # stage single-device; the consumer re-lays-out after the pull.
+            partitions = (1,) * len(array.shape)
+        sharding = _decomposed_sharding(partitions)
+        staged = jax.device_put(array, sharding)
+        uid = _uuid.uuid4().int >> 65  # 63-bit
+        self._ensure_server().await_pull(uid, [staged])
+        evicted = []
+        with self._lock:
+            self._armed[uid] = (oid, staged)
+            while len(self._armed) > self._armed_cap:
+                evicted.append(self._armed.popitem(last=False)[1])
+            self._stats["arms"] += 1
+        # A cap-evicted entry's fetch budget was consumed at arm time;
+        # refund it so the object is not lost if its pull never lands
+        # (every other failure path refunds the same way).
+        if evicted:
+            from ray_tpu.experimental.device_objects import store
+
+            for ev_oid, ev_staged in evicted:
+                store().restore_arm(ev_oid, ev_staged)
+        return {
+            "uuid": uid,
+            "address": self.address(),
+            "shape": tuple(array.shape),
+            "dtype": str(array.dtype),
+            "partitions": tuple(partitions),
+        }
+
+    def release_armed(self, oid: str) -> None:
+        """Drop armed entries for an oid (object freed before any pull)."""
+        with self._lock:
+            for uid in [u for u, (o, _) in self._armed.items() if o == oid]:
+                del self._armed[uid]
+
+    def release_uuid(self, uid: int):
+        """Drop one armed entry (pull completed, or consumer unarms after a
+        failed pull). Returns (oid, staged_array) or None."""
+        with self._lock:
+            return self._armed.pop(int(uid), None)
+
+    # -- consumer side ---------------------------------------------------------
+
+    def pull(self, desc: dict, target_sharding=None):
+        """Pull an armed array from ``desc`` into local devices; optionally
+        re-layout into ``target_sharding`` afterwards (on-device)."""
+        _repin_platform()
+        import jax
+        import jax.numpy as jnp
+
+        dtype = jnp.dtype(desc["dtype"])
+        shape = tuple(desc["shape"])
+        sharding = _decomposed_sharding(desc["partitions"])
+        spec = jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+        conn = self._connect(desc["address"])
+        [out] = conn.pull(desc["uuid"], [spec])
+        with self._lock:
+            self._stats["pulls"] += 1
+        if target_sharding is not None and out.sharding != target_sharding:
+            out = jax.device_put(out, target_sharding)
+        return out
+
+    def count_fallback(self) -> None:
+        with self._lock:
+            self._stats["fallbacks"] += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats, armed=len(self._armed))
+
+
+_fabric: Optional[_Fabric] = None
+_fabric_lock = threading.Lock()
+
+
+def fabric() -> _Fabric:
+    global _fabric
+    if _fabric is None:
+        with _fabric_lock:
+            if _fabric is None:
+                _fabric = _Fabric()
+    return _fabric
+
+
+def transfer_stats() -> dict:
+    """Counters for tests/observability ({arms, pulls, fallbacks, armed})."""
+    return fabric().stats() if _fabric is not None else {
+        "arms": 0, "pulls": 0, "fallbacks": 0, "armed": 0,
+    }
+
+
+# -- decomposition helpers -----------------------------------------------------
+
+
+def _normalize_partitions(shape, partitions) -> tuple[int, ...]:
+    partitions = tuple(int(p) for p in partitions)
+    if len(partitions) != len(shape):
+        raise ValueError(
+            f"partitions {partitions} rank != array rank {len(shape)}"
+        )
+    if any(p < 1 for p in partitions):
+        raise ValueError(f"partitions must be >= 1, got {partitions}")
+    return partitions
+
+
+def _decomposed_sharding(partitions: Sequence[int]):
+    """A NamedSharding over this process's local devices realizing the given
+    per-dim partition counts, with deterministic (row-major) shard order —
+    identical construction on both ends makes shard lists line up 1:1."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    partitions = tuple(int(p) for p in partitions)
+    devices = jax.local_devices()
+    if not partitions:  # rank-0 array: single-device on both ends
+        return jax.sharding.SingleDeviceSharding(devices[0])
+    k = math.prod(partitions)
+    if k > len(devices):
+        raise ValueError(
+            f"decomposition {partitions} needs {k} devices; this process "
+            f"has {len(devices)}"
+        )
+    names = tuple(f"{_AXIS_PREFIX}{i}" for i in range(len(partitions)))
+    mesh = Mesh(np.array(devices[:k]).reshape(partitions), names)
+    return NamedSharding(mesh, P(*names))
+
+
+def decomposition_of(sharding, shape) -> tuple[int, ...]:
+    """Per-dimension partition counts of ``sharding`` applied to ``shape``
+    (the decomposition a consumer asks the producer to stage)."""
+    shard = sharding.shard_shape(tuple(shape))
+    return tuple(
+        -(-int(g) // int(s)) if s else 1 for g, s in zip(shape, shard)
+    )
+
+
+def max_local_decomposition(shape) -> tuple[int, ...]:
+    """Largest power-of-two split of dim0 that fits this process's devices —
+    a reasonable default when the consumer has no target sharding: spreads
+    the pull across devices (parallel transfer streams) without exceeding
+    either side's device count."""
+    _repin_platform()  # often the first jax touch on this path: pin BEFORE
+    import jax  # the backend initializes, or the repin can never take
+
+    n = len(jax.local_devices())
+    if not shape:
+        return ()
+    split = 1
+    while split * 2 <= n and shape[0] % (split * 2) == 0:
+        split *= 2
+    return (split,) + (1,) * (len(shape) - 1)

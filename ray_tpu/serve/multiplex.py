@@ -76,8 +76,10 @@ class _ModelCache:
             self._loading.pop(model_id, None)
             self._models[model_id] = model
             # Re-trim: another load may have filled the cache while ours
-            # was in flight.
-            self._evict_for_capacity()
+            # was in flight. Never evict the model just inserted — that
+            # would discard the upload this call was made for; concurrent
+            # in-flight loads each re-trim when they land.
+            self._evict_for_capacity(protect=model_id)
             fut.set_result(model)
             return model
         except BaseException as e:
@@ -92,13 +94,18 @@ class _ModelCache:
         finally:
             self._loading.pop(model_id, None)
 
-    def _evict_for_capacity(self) -> None:
+    def _evict_for_capacity(self, protect: str | None = None) -> None:
         # GC of a popped entry frees its HBM arrays.
         while (
             self._models
             and len(self._models) + len(self._loading) > self._max
         ):
-            self._models.popitem(last=False)
+            victim = next(iter(self._models))
+            if victim == protect:
+                if len(self._models) == 1:
+                    break  # only the protected model resident: nothing to do
+                victim = next(k for k in self._models if k != protect)
+            self._models.pop(victim)
 
     def loaded_ids(self) -> list[str]:
         return list(self._models)

@@ -1,0 +1,186 @@
+"""Device-to-device tensor handoff between SPMD worlds over the transfer
+fabric (jax.experimental.transfer) — the round-4 top missing component.
+
+Reference parity: python/ray/experimental/channel/torch_tensor_accelerator_channel.py
+(NCCL P2P between compiled programs) and
+python/ray/experimental/gpu_object_manager/nixl_tensor_transport.py.
+Here, each world is an actor process with its own 8-device virtual CPU
+platform; arrays move owner-world -> consumer-world as device buffers (the
+arm/pull counters prove the host-pickle path was never taken).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import device_get, device_put, transfer_stats
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class TrainWorld:
+    """Producer: params live sharded over this process's own mesh."""
+
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        self.jax, self.jnp = jax, jnp
+        devs = jax.local_devices()
+        self.mesh = Mesh(np.array(devs).reshape(4, 2), ("fsdp", "tp"))
+        self.shardings = {
+            "w": NamedSharding(self.mesh, P("fsdp", "tp")),
+            "b": NamedSharding(self.mesh, P("tp")),
+        }
+        self.params = {
+            "w": jax.device_put(
+                jnp.arange(64.0, dtype=jnp.float32).reshape(8, 8),
+                self.shardings["w"],
+            ),
+            "b": jax.device_put(
+                jnp.ones((8,), jnp.float32), self.shardings["b"]
+            ),
+        }
+
+    def train_step(self):
+        """One 'update' so the consumer observably sees NEW weights."""
+        self.params = self.jax.tree.map(lambda p: p + 1.0, self.params)
+        return float(self.params["w"][0, 0])
+
+    def publish(self, fetches: int = 0):
+        return {
+            k: device_put(v, fetches_before_free=fetches)
+            for k, v in self.params.items()
+        }
+
+    def expected(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def xfer_stats(self):
+        return transfer_stats()
+
+
+@ray_tpu.remote
+class ServeWorld:
+    """Consumer: pulls weights into its OWN (different) mesh layout."""
+
+    def __init__(self):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = jax.local_devices()
+        self.mesh = Mesh(np.array(devs[:2]), ("tp",))
+        self.target = {
+            "w": NamedSharding(self.mesh, P(None, "tp")),
+            "b": NamedSharding(self.mesh, P("tp")),
+        }
+        self.weights = None
+
+    def refresh(self, refs):
+        self.weights = {
+            k: device_get(r, sharding=self.target[k])
+            for k, r in refs.items()
+        }
+        return transfer_stats()
+
+    def infer(self, x):
+        import jax.numpy as jnp
+
+        w, b = self.weights["w"], self.weights["b"]
+        return np.asarray(jnp.asarray(x, jnp.float32) @ w + b)
+
+    def weight_layouts(self):
+        return {
+            k: str(v.sharding.spec) for k, v in self.weights.items()
+        }
+
+
+def test_weight_refresh_train_to_serve_no_host_staging(cluster):
+    """Train world updates params; serve world pulls them device-to-device
+    into its own sharding. The arms/pulls counters on both ends prove the
+    buffers rode the fabric, not the host-pickle fallback."""
+    train = TrainWorld.options(num_cpus=0).remote()
+    serve = ServeWorld.options(num_cpus=0).remote()
+    ray_tpu.get(train.train_step.remote())
+    refs = ray_tpu.get(train.publish.remote())
+    consumer_stats = ray_tpu.get(serve.refresh.remote(refs))
+    assert consumer_stats["pulls"] == 2, consumer_stats
+    assert consumer_stats["fallbacks"] == 0, consumer_stats
+    producer_stats = ray_tpu.get(train.xfer_stats.remote())
+    assert producer_stats["arms"] == 2, producer_stats
+    # The consumer's rdt_done ack released the staged HBM copies (the ack
+    # is async; allow a beat for it to land).
+    for _ in range(50):
+        if ray_tpu.get(train.xfer_stats.remote())["armed"] == 0:
+            break
+        time.sleep(0.1)
+    assert ray_tpu.get(train.xfer_stats.remote())["armed"] == 0
+
+    expected = ray_tpu.get(train.expected.remote())
+    x = np.eye(8, dtype=np.float32)
+    out = ray_tpu.get(serve.infer.remote(x))
+    np.testing.assert_allclose(out, expected["w"] + expected["b"])
+
+    # The result landed in the CONSUMER's requested layout.
+    layouts = ray_tpu.get(serve.weight_layouts.remote())
+    assert "tp" in layouts["w"]
+
+    # Second refresh after another step: serve sees the new values.
+    ray_tpu.get(train.train_step.remote())
+    refs2 = ray_tpu.get(train.publish.remote())
+    stats2 = ray_tpu.get(serve.refresh.remote(refs2))
+    assert stats2["pulls"] == 4
+    out2 = ray_tpu.get(serve.infer.remote(x))
+    np.testing.assert_allclose(out2, out + 2.0)
+
+    for h in (train, serve):
+        ray_tpu.kill(h)
+
+
+def test_fabric_budget_and_gone(cluster):
+    train = TrainWorld.options(num_cpus=0).remote()
+    serve = ServeWorld.options(num_cpus=0).remote()
+    ray_tpu.get(train.train_step.remote())
+    refs = ray_tpu.get(train.publish.remote(1))  # fetch budget 1
+    ray_tpu.get(serve.refresh.remote(refs))
+    with pytest.raises(Exception, match="gone"):
+        ray_tpu.get(serve.refresh.remote(refs))
+    for h in (train, serve):
+        ray_tpu.kill(h)
+
+
+def test_driver_side_fabric_pull(cluster):
+    """The driver process is a world of its own: device_get from the driver
+    pulls over the fabric too (dim0 spread across local devices)."""
+    train = TrainWorld.options(num_cpus=0).remote()
+    refs = ray_tpu.get(train.publish.remote())
+    before = transfer_stats()["pulls"]
+    w = device_get(refs["w"])
+    assert float(np.asarray(w).sum()) == float(np.arange(64.0).sum())
+    assert transfer_stats()["pulls"] == before + 1
+    ray_tpu.kill(train)
+
+
+def test_fabric_disabled_falls_back_to_host_path(cluster):
+    import os
+
+    train = TrainWorld.options(num_cpus=0).remote()
+    refs = ray_tpu.get(train.publish.remote())
+    os.environ["RAY_TPU_RDT_FABRIC"] = "0"
+    try:
+        before = transfer_stats()["pulls"]
+        w = device_get(refs["w"])
+        assert float(np.asarray(w).sum()) == float(np.arange(64.0).sum())
+        assert transfer_stats()["pulls"] == before  # host path, no pull
+    finally:
+        del os.environ["RAY_TPU_RDT_FABRIC"]
+    ray_tpu.kill(train)
