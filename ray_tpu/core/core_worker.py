@@ -161,6 +161,10 @@ class CoreWorker:
         self._stopped = False
         self._view_cache: dict | None = None
         self._view_time = 0.0
+        # Device-object arm/free race markers (see _h_worker_rdt_arm);
+        # counted so concurrent arms of one oid all observe a mid-arm free.
+        self._rdt_arming: dict[str, int] = {}
+        self._rdt_freed_while_arming: set[str] = set()
 
         # Observability: buffered task lifecycle events, flushed to the GCS
         # on an interval (reference: task_event_buffer.h -> GcsTaskManager).
@@ -1527,6 +1531,10 @@ class CoreWorker:
         from ray_tpu.experimental import transfer as _xfer
         from ray_tpu.experimental.device_objects import store
 
+        if p["oid"] in self._rdt_arming:
+            # An arm is staging this object in the executor thread right
+            # now: mark it so the arm completion discards its descriptor.
+            self._rdt_freed_while_arming.add(p["oid"])
         freed = store().free(p["oid"])
         # Release armed fabric copies unconditionally: a budget-exhausted
         # object is already gone from the store (freed=False) but its
@@ -1556,7 +1564,7 @@ class CoreWorker:
         entry = _xfer.fabric().release_uuid(p["uuid"])
         if entry is None:
             return False
-        oid, staged = entry
+        oid, staged = entry[0], entry[1]
         store().restore_arm(oid, staged)
         return True
 
@@ -1564,22 +1572,49 @@ class CoreWorker:
         """Stage a device object on the transfer fabric for one direct
         device-to-device pull (consumer-chosen shard decomposition). Returns
         the pull descriptor, or {"gone": True} / {"unsupported": reason} so
-        the caller can fall back to the host path."""
+        the caller can fall back to the host path.
+
+        The staging itself (jax ops) runs in the executor thread; a
+        concurrent rdt_free landing on the loop mid-arm is detected via the
+        arming/freed marker sets (both handlers touch them loop-side only)
+        so a freed object can neither hand out a live descriptor nor be
+        resurrected into the store by a later unarm."""
+        oid = p["oid"]
 
         def _arm():
             from ray_tpu.experimental import transfer as _xfer
             from ray_tpu.experimental.device_objects import store
 
-            entry = store().take_for_arm(p["oid"])
+            entry = store().take_for_arm(oid)
             if entry is None:
                 return {"gone": True}
             try:
-                return _xfer.fabric().arm(p["oid"], entry, p["partitions"])
+                return _xfer.fabric().arm(oid, entry, p["partitions"])
             except Exception as e:  # fabric unavailable on this platform
-                store().restore_arm(p["oid"], entry)
+                store().restore_arm(oid, entry)
                 return {"unsupported": f"{type(e).__name__}: {e}"}
 
-        return await asyncio.get_running_loop().run_in_executor(None, _arm)
+        self._rdt_arming[oid] = self._rdt_arming.get(oid, 0) + 1
+        try:
+            res = await asyncio.get_running_loop().run_in_executor(
+                None, _arm
+            )
+            if oid in self._rdt_freed_while_arming:
+                from ray_tpu.experimental import transfer as _xfer
+                from ray_tpu.experimental.device_objects import store
+
+                if "uuid" in res:
+                    _xfer.fabric().release_uuid(res["uuid"])
+                store().free(oid)  # drop any restore the arm path made
+                return {"gone": True}
+            return res
+        finally:
+            n = self._rdt_arming.get(oid, 1) - 1
+            if n <= 0:
+                self._rdt_arming.pop(oid, None)
+                self._rdt_freed_while_arming.discard(oid)
+            else:
+                self._rdt_arming[oid] = n
 
     # -- compiled graphs (reference: compiled_dag_node.py ExecutableTask) ----
 

@@ -61,9 +61,15 @@ class _Fabric:
     """Per-process transfer server + connection cache (lazily started)."""
 
     # Bound on retained armed entries: a consumer that pulls but whose
-    # completion notify is lost (or that dies mid-pull) must not pin staged
-    # HBM copies forever. Oldest-armed evicts first.
+    # completion notify is lost (or that dies mid-pull) must not pin our
+    # bookkeeping forever. Only entries OLDER than ARMED_TTL_S are evicted
+    # (with a budget refund): a younger entry's pull may still be in
+    # flight — the transfer server cannot unschedule an await_pull, so
+    # evicting it would risk serving the pull AND refunding the budget
+    # (a double fetch). After the TTL (the consumer's arm RPC timeout) the
+    # pull has certainly failed or timed out.
     ARMED_CAP = 16
+    ARMED_TTL_S = 120.0
 
     def __init__(self):
         import collections
@@ -72,8 +78,9 @@ class _Fabric:
         self._lock = threading.Lock()
         self._server = None
         self._conns: dict[str, Any] = {}
-        # Keep armed arrays alive until pulled-or-freed: uuid -> (oid, array).
-        self._armed: "collections.OrderedDict[int, tuple[str, Any]]" = (
+        # Keep armed arrays alive until pulled-or-freed:
+        # uuid -> (oid, array, armed_at_monotonic).
+        self._armed: "collections.OrderedDict[int, tuple]" = (
             collections.OrderedDict()
         )
         self._armed_cap = int(
@@ -131,21 +138,28 @@ class _Fabric:
             partitions = (1,) * len(array.shape)
         sharding = _decomposed_sharding(partitions)
         staged = jax.device_put(array, sharding)
+        import time
+
         uid = _uuid.uuid4().int >> 65  # 63-bit
         self._ensure_server().await_pull(uid, [staged])
         evicted = []
+        now = time.monotonic()
         with self._lock:
-            self._armed[uid] = (oid, staged)
+            self._armed[uid] = (oid, staged, now)
             while len(self._armed) > self._armed_cap:
-                evicted.append(self._armed.popitem(last=False)[1])
+                old_uid, entry = next(iter(self._armed.items()))
+                if now - entry[2] < self.ARMED_TTL_S:
+                    break  # young entries: pull may still be in flight
+                del self._armed[old_uid]
+                evicted.append(entry)
             self._stats["arms"] += 1
-        # A cap-evicted entry's fetch budget was consumed at arm time;
-        # refund it so the object is not lost if its pull never lands
+        # A TTL-evicted entry's fetch budget was consumed at arm time and
+        # its pull can no longer land; refund it so the object is not lost
         # (every other failure path refunds the same way).
         if evicted:
             from ray_tpu.experimental.device_objects import store
 
-            for ev_oid, ev_staged in evicted:
+            for ev_oid, ev_staged, _t in evicted:
                 store().restore_arm(ev_oid, ev_staged)
         return {
             "uuid": uid,

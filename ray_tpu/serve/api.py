@@ -159,7 +159,13 @@ def shutdown() -> None:
     from ray_tpu.serve import handle as _handle_mod
 
     # Cached routers hold handles into the controller being torn down; a
-    # later serve.run() in this process must start routing fresh.
+    # later serve.run() in this process must start routing fresh. Their
+    # long-poll listeners are cancelled so no task keeps polling a corpse.
+    for router in _handle_mod._routers.values():
+        try:
+            router.close()
+        except Exception:
+            pass
     _handle_mod._routers.clear()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)

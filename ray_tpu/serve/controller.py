@@ -28,6 +28,15 @@ class ServeController:
         #          "next_replica_id": int}
         self._deployments: dict[str, dict] = {}
         self._version = 0
+        # Edge-triggered change signal for long-polls: waiters grab the
+        # CURRENT event; _bump replaces it and sets the old one, waking
+        # every waiter exactly once per change (reference:
+        # serve/_private/long_poll.py LongPollHost).
+        self._version_event: asyncio.Event | None = None
+        # replica_id -> (queue_len, monotonic): pushed by replicas so the
+        # autoscaler reads a table instead of fanning out queue_len RPCs
+        # every tick.
+        self._replica_metrics: dict[str, tuple[int, float]] = {}
         self._loop_running = False
         self._proxy = None
         self._proxy_port = None
@@ -136,6 +145,41 @@ class ServeController:
             "max_concurrent": dep["config"].get("max_concurrent_queries", 8),
         }
 
+    async def poll_routing(
+        self, name: str, version: int = -1, timeout_s: float = 30.0
+    ) -> dict:
+        """LONG-poll twin of get_routing: returns immediately when the
+        deployment's table differs from ``version``, otherwise blocks until
+        the next change (any _bump) or the timeout, then answers. Routers
+        hold one of these open instead of polling on a period — updates
+        push in one reconcile tick and an idle table costs zero round trips
+        (reference: python/ray/serve/_private/long_poll.py)."""
+        deadline = time.monotonic() + min(float(timeout_s), 60.0)
+        while True:
+            dep = self._deployments.get(name)
+            if dep is None or dep["version"] != version:
+                return await self.get_routing(name, version)
+            if self._version_event is None:
+                self._version_event = asyncio.Event()
+            ev = self._version_event
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"version": version}
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return {"version": version}
+
+    async def push_metrics(self, replica_id: str, queue_len: int) -> None:
+        """Replica-pushed autoscaling metric (replaces per-tick queue_len
+        fan-out; reference: replicas push autoscaling metrics to the
+        controller via the long-poll/metrics channel)."""
+        self._replica_metrics[replica_id] = (int(queue_len), time.monotonic())
+
+    async def get_replica_metrics(self) -> dict:
+        """Pushed queue-length table (replica_id -> len); observability."""
+        return {rid: m[0] for rid, m in self._replica_metrics.items()}
+
     async def status(self) -> dict:
         return {
             name: {
@@ -173,6 +217,17 @@ class ServeController:
                     log.exception(
                         "serve controller reconcile failed for %r", name
                     )
+            # Prune pushed metrics of replicas no longer in any deployment
+            # (the table must not grow with replica churn).
+            live = {
+                r._actor_id
+                for dep in self._deployments.values()
+                for r, _ in dep["replicas"]
+            }
+            for rid in [
+                r for r in self._replica_metrics if r not in live
+            ]:
+                del self._replica_metrics[rid]
             await asyncio.sleep(HEALTH_CHECK_PERIOD_S)
 
     async def _ping_all(self, entries: list) -> list:
@@ -220,6 +275,12 @@ class ServeController:
         current = max(len(dep["replicas"]), 1)
 
         async def one_len(r):
+            # Pushed metric first (replicas report on-change + heartbeat);
+            # RPC fallback only for replicas with no fresh push (e.g. still
+            # starting) so a silent replica cannot stall downscaling.
+            pushed = self._replica_metrics.get(r._actor_id)
+            if pushed is not None and time.monotonic() - pushed[1] < 7.0:
+                return pushed[0]
             try:
                 return await core_api.get_async(
                     r.queue_len.remote(), timeout=2.0
@@ -307,6 +368,10 @@ class ServeController:
 
     def _bump(self) -> int:
         self._version += 1
+        ev = self._version_event
+        if ev is not None:
+            self._version_event = None
+            ev.set()
         return self._version
 
     # -- ingress --------------------------------------------------------------

@@ -41,8 +41,50 @@ class ReplicaActor:
         ):
             self._callable.reconfigure(user_config)
         self._inflight = 0
+        self._reporter = None
+
+    def _ensure_reporter(self) -> None:
+        """Start the queue-length push loop (autoscaling metric) on the
+        first async entry point — __init__ may run off-loop, so the task
+        starts lazily from ping/handle."""
+        if self._reporter is None:
+            self._reporter = asyncio.ensure_future(self._report_loop())
+
+    async def _report_loop(self) -> None:
+        """Push queue_len to the controller when it changes (5 s heartbeat
+        otherwise) so autoscaling reads a table instead of fanning out
+        per-tick RPCs (reference: replicas push autoscaling metrics)."""
+        from ray_tpu.core import api as core_api
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        import time
+
+        try:
+            rid = core_api.get_runtime_context().actor_id
+        except Exception:
+            return  # not running as an actor (unit tests)
+        controller = None
+        last, last_t = None, 0.0
+        while True:
+            try:
+                now = time.monotonic()
+                cur = self._inflight  # capture: it can move during the push
+                if cur != last or now - last_t >= 5.0:
+                    if controller is None:
+                        controller = await core_api.get_actor_async(
+                            CONTROLLER_NAME
+                        )
+                    await core_api.get_async(
+                        controller.push_metrics.remote(rid, cur),
+                        timeout=5,
+                    )
+                    last, last_t = cur, now
+            except Exception:
+                controller = None  # re-resolve next round
+            await asyncio.sleep(1.0)
 
     async def ping(self) -> bool:
+        self._ensure_reporter()
         return True
 
     async def queue_len(self) -> int:
@@ -62,6 +104,7 @@ class ReplicaActor:
         serve.get_multiplexed_model_id() for the duration of the call."""
         from ray_tpu.serve.multiplex import _set_model_id
 
+        self._ensure_reporter()
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
         _set_model_id(model_id)
@@ -96,6 +139,7 @@ class ReplicaActor:
         methods (single-chunk stream)."""
         from ray_tpu.serve.multiplex import _set_model_id
 
+        self._ensure_reporter()
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
         _set_model_id(model_id)

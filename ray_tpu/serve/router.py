@@ -42,6 +42,90 @@ class Router:
         # info pushed via the controller; a local cache converges the same
         # way without the control-plane round trip).
         self._model_replicas: dict[str, list] = {}
+        # Long-poll listener: one open poll_routing call against the
+        # controller pushes table changes within a reconcile tick, so
+        # routers neither poll on a period nor serve stale membership
+        # (reference: serve/_private/long_poll.py LongPollClient).
+        self._listen_task: asyncio.Task | None = None
+
+    def close(self) -> None:
+        task = self._listen_task
+        self._listen_task = None
+        if task is not None:
+            # close() is called from the driver thread; the task lives on
+            # the endpoint loop — cancel must hop threads.
+            task.get_loop().call_soon_threadsafe(task.cancel)
+
+    def _ensure_listener(self) -> None:
+        if self._listen_task is None or self._listen_task.done():
+            self._listen_task = asyncio.ensure_future(self._listen_loop())
+
+    async def _listen_loop(self) -> None:
+        while True:
+            try:
+                table = await core_api.get_async(
+                    self._controller.poll_routing.remote(
+                        self._deployment, self._version, 30.0
+                    ),
+                    timeout=45,
+                )
+                if table.get("missing"):
+                    # Deployment deleted: stop listening; the next route()
+                    # raises DeploymentNotFoundError via _refresh.
+                    self._version = -2
+                    self._replicas = []
+                    return
+                self._apply(table)
+            except (ActorDiedError, ActorUnavailableError):
+                if not await self._reresolve_controller():
+                    # Controller gone for good (from this listener's view):
+                    # force the next route() through _refresh so it both
+                    # re-resolves and restarts a listener, instead of
+                    # serving this frozen table forever.
+                    self._version = -2
+                    return
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                await asyncio.sleep(1.0)
+
+    async def _reresolve_controller(self) -> bool:
+        """Controller crashed and was re-created WITHOUT serve.shutdown():
+        re-resolve the named actor so every cached handle recovers."""
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        for _ in range(10):
+            try:
+                self._controller = await core_api.get_actor_async(
+                    CONTROLLER_NAME
+                )
+                self._version = -2  # force a full table on next poll
+                return True
+            except Exception:
+                await asyncio.sleep(1.0)
+        return False
+
+    def _apply(self, table: dict) -> None:
+        if table.get("replicas") is None:
+            return
+        import time
+
+        now = time.monotonic()
+        self._recently_dead = {
+            rid: t
+            for rid, t in self._recently_dead.items()
+            if now - t < DEAD_MEMORY_S
+        }
+        self._replicas = [
+            r
+            for r in table["replicas"]
+            if r._actor_id not in self._recently_dead
+        ]
+        self._version = table["version"]
+        self._inflight = {
+            r._actor_id: self._inflight.get(r._actor_id, 0)
+            for r in self._replicas
+        }
 
     async def _refresh(self, force: bool = False) -> None:
         try:
@@ -69,25 +153,8 @@ class Router:
             raise DeploymentNotFoundError(
                 f"no deployment named {self._deployment!r}"
             )
-        if table.get("replicas") is not None:
-            import time
-
-            now = time.monotonic()
-            self._recently_dead = {
-                rid: t
-                for rid, t in self._recently_dead.items()
-                if now - t < DEAD_MEMORY_S
-            }
-            self._replicas = [
-                r
-                for r in table["replicas"]
-                if r._actor_id not in self._recently_dead
-            ]
-            self._version = table["version"]
-            self._inflight = {
-                r._actor_id: self._inflight.get(r._actor_id, 0)
-                for r in self._replicas
-            }
+        self._apply(table)
+        self._ensure_listener()
 
     def _pick(self, model_id: str = ""):
         """Power of two choices on the local in-flight estimates; with a
