@@ -378,6 +378,37 @@ class GCETPUNodeProvider(NodeProvider):
         with self._lock:
             return self._joined.get(provider_id)
 
+    def external_ip(self, provider_id: str) -> Optional[str]:
+        """Reachable IP of an instance (cluster launcher SSH target):
+        external IP when the instance has one, else the internal address.
+        None until the cloud assigns one."""
+        kind = self._guess_kind(provider_id)
+        try:
+            if kind == "tpu":
+                node = self.transport(
+                    "GET", f"{self._tpu_base()}/nodes/{provider_id}"
+                )
+                for ep in node.get("networkEndpoints") or []:
+                    access = ep.get("accessConfig") or {}
+                    ip = access.get("externalIp") or ep.get("ipAddress")
+                    if ip:
+                        return ip
+                return None
+            inst = self.transport(
+                "GET", f"{self._gce_base()}/instances/{provider_id}"
+            )
+            for iface in inst.get("networkInterfaces") or []:
+                for ac in iface.get("accessConfigs") or []:
+                    if ac.get("natIP"):
+                        return ac["natIP"]
+                if iface.get("networkIP"):
+                    return iface["networkIP"]
+            return None
+        except GCEApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
     def observe_cluster_nodes(self, state_nodes: list[dict]) -> None:
         """Join provider instances to runtime nodes via the provider-id
         label every instance's startup script registers with. Called by the

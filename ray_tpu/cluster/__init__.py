@@ -1,0 +1,19 @@
+"""ray_tpu.cluster — the cluster launcher (`raytpu up cluster.yaml`).
+
+Reference parity: python/ray/autoscaler/_private/commands.py (up/down/
+attach), command_runner.py (SSHCommandRunner), ray-schema.json (cluster
+YAML). TPU-native redesign: providers hand out *instances* with a command
+runner each; the launcher turns a YAML file + one command into a running
+head plus workers, and `raytpu down` tears it all back down.
+"""
+
+from ray_tpu.cluster.config import ClusterConfig, load_config
+from ray_tpu.cluster.launcher import cluster_down, cluster_status, cluster_up
+
+__all__ = [
+    "ClusterConfig",
+    "cluster_down",
+    "cluster_status",
+    "cluster_up",
+    "load_config",
+]

@@ -1,0 +1,152 @@
+"""Command runners: how the launcher executes on an instance.
+
+Reference parity: python/ray/autoscaler/_private/command_runner.py
+(SSHCommandRunner + the rsync file-mount path). Two implementations:
+
+- :class:`LocalCommandRunner` — subprocess on this host, one working dir
+  per instance (drives the `local` provider; also what CI exercises).
+- :class:`SSHCommandRunner` — ssh/scp with the config's auth block
+  (BatchMode, connect timeout, known-hosts off for ephemeral cloud IPs).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+
+class CommandRunner:
+    def run(
+        self,
+        cmd: str,
+        *,
+        env: Optional[dict] = None,
+        timeout: float = 600.0,
+        detach: bool = False,
+    ):
+        """Run a shell command on the instance. detach=True launches a
+        long-running process (daemon) and returns immediately with a
+        process handle/None; otherwise returns (rc, output)."""
+        raise NotImplementedError
+
+    def put(self, local_path: str, remote_path: str) -> None:
+        """Copy a local file/dir onto the instance."""
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunner):
+    def __init__(self, workdir: str):
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self._procs: list[subprocess.Popen] = []
+
+    def run(self, cmd, *, env=None, timeout=600.0, detach=False):
+        full_env = dict(os.environ)
+        if env:
+            full_env.update({k: str(v) for k, v in env.items()})
+        if detach:
+            log = open(os.path.join(self.workdir, "daemon.log"), "ab")
+            proc = subprocess.Popen(
+                cmd,
+                shell=True,
+                cwd=self.workdir,
+                env=full_env,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,  # survives the launcher exiting
+            )
+            self._procs.append(proc)
+            return proc
+        r = subprocess.run(
+            cmd,
+            shell=True,
+            cwd=self.workdir,
+            env=full_env,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+        return r.returncode, (r.stdout or "") + (r.stderr or "")
+
+    def put(self, local_path, remote_path):
+        dst = os.path.join(self.workdir, remote_path.lstrip("/"))
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, dst, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, dst)
+
+
+class SSHCommandRunner(CommandRunner):
+    """ssh-driven runner for real providers (GCE TPU-VMs).
+
+    Commands run under `bash -lc`; file mounts go over scp -r. The ssh
+    binary does the transport — no paramiko-style dependency.
+    """
+
+    def __init__(
+        self,
+        ip: str,
+        ssh_user: str,
+        ssh_key: Optional[str] = None,
+        port: int = 22,
+        connect_timeout_s: int = 15,
+    ):
+        self.ip = ip
+        self.user = ssh_user
+        self.key = os.path.expanduser(ssh_key) if ssh_key else None
+        self.port = port
+        self._base = [
+            "ssh",
+            "-o", "BatchMode=yes",
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+            "-o", f"ConnectTimeout={connect_timeout_s}",
+            "-p", str(port),
+        ]
+        if self.key:
+            self._base += ["-i", self.key]
+
+    def _target(self) -> str:
+        return f"{self.user}@{self.ip}" if self.user else self.ip
+
+    def run(self, cmd, *, env=None, timeout=600.0, detach=False):
+        env_prefix = ""
+        if env:
+            env_prefix = (
+                " ".join(f"{k}={_shquote(str(v))}" for k, v in env.items())
+                + " "
+            )
+        if detach:
+            # nohup + redirect: the daemon outlives the ssh session.
+            remote = (
+                f"nohup {env_prefix}{cmd} > daemon.log 2>&1 < /dev/null &"
+            )
+        else:
+            remote = env_prefix + cmd
+        argv = self._base + [self._target(), f"bash -lc {_shquote(remote)}"]
+        r = subprocess.run(
+            argv, timeout=timeout, capture_output=True, text=True
+        )
+        if detach:
+            return None
+        return r.returncode, (r.stdout or "") + (r.stderr or "")
+
+    def put(self, local_path, remote_path):
+        scp = ["scp", "-r", "-P", str(self.port),
+               "-o", "BatchMode=yes",
+               "-o", "StrictHostKeyChecking=no",
+               "-o", "UserKnownHostsFile=/dev/null"]
+        if self.key:
+            scp += ["-i", self.key]
+        subprocess.run(
+            scp + [local_path, f"{self._target()}:{remote_path}"],
+            check=True,
+            timeout=600,
+        )
+
+
+def _shquote(s: str) -> str:
+    return "'" + s.replace("'", "'\"'\"'") + "'"

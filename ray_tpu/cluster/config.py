@@ -1,0 +1,149 @@
+"""Cluster YAML schema + validation.
+
+Reference parity: python/ray/autoscaler/ray-schema.json (the `ray up`
+cluster file). Kept to the fields the launcher actually drives; unknown
+top-level keys are rejected so typos fail loudly instead of silently
+launching the wrong shape.
+
+Example:
+
+    cluster_name: demo
+    provider:
+      type: local            # local | gce
+      # gce: project_id / zone / extra REST config (see autoscaler/gce.py)
+    auth:
+      ssh_user: tpu          # ssh providers only
+      ssh_private_key: ~/.ssh/id_ed25519
+    head_node_type: head
+    available_node_types:
+      head:
+        resources: {CPU: 4}
+        min_workers: 0
+      worker:
+        resources: {CPU: 4, TPU: 4}
+        labels: {pool: tpu-v5e}
+        min_workers: 2
+        node_config: {}      # provider-specific (machine type etc.)
+    file_mounts:
+      /remote/path: ./local/path
+    setup_commands:
+      - echo setup
+    head_start_commands: []  # defaults to `raytpu start --head ...`
+    worker_start_commands: []  # defaults to `raytpu start --address ...`
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional
+
+_TOP_LEVEL_KEYS = {
+    "cluster_name",
+    "provider",
+    "auth",
+    "head_node_type",
+    "available_node_types",
+    "file_mounts",
+    "setup_commands",
+    "head_setup_commands",
+    "worker_setup_commands",
+    "head_start_commands",
+    "worker_start_commands",
+    "port",
+}
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    name: str
+    resources: dict
+    labels: dict
+    min_workers: int
+    node_config: dict
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    cluster_name: str
+    provider: dict
+    auth: dict
+    head_node_type: str
+    node_types: dict[str, NodeTypeConfig]
+    file_mounts: dict[str, str]
+    setup_commands: list[str]
+    head_setup_commands: list[str]
+    worker_setup_commands: list[str]
+    head_start_commands: list[str]
+    worker_start_commands: list[str]
+    port: int  # head GCS port (0 = ephemeral; local provider only)
+    path: Optional[str] = None  # source file, for state bookkeeping
+
+    @property
+    def worker_types(self) -> list[NodeTypeConfig]:
+        return [
+            t for n, t in self.node_types.items() if n != self.head_node_type
+        ]
+
+
+def _req(d: dict, key: str, path: str) -> Any:
+    if key not in d:
+        raise ValueError(f"cluster config: missing required key {path}{key}")
+    return d[key]
+
+
+def parse_config(raw: dict, path: str | None = None) -> ClusterConfig:
+    unknown = set(raw) - _TOP_LEVEL_KEYS
+    if unknown:
+        raise ValueError(
+            f"cluster config: unknown top-level keys {sorted(unknown)} "
+            f"(known: {sorted(_TOP_LEVEL_KEYS)})"
+        )
+    name = _req(raw, "cluster_name", "")
+    provider = dict(_req(raw, "provider", ""))
+    if "type" not in provider:
+        raise ValueError("cluster config: provider.type is required")
+    head_type = _req(raw, "head_node_type", "")
+    types_raw = _req(raw, "available_node_types", "")
+    if head_type not in types_raw:
+        raise ValueError(
+            f"cluster config: head_node_type {head_type!r} not in "
+            f"available_node_types {sorted(types_raw)}"
+        )
+    node_types = {}
+    for tname, t in types_raw.items():
+        t = dict(t or {})
+        node_types[tname] = NodeTypeConfig(
+            name=tname,
+            resources=dict(t.get("resources") or {}),
+            labels=dict(t.get("labels") or {}),
+            min_workers=int(t.get("min_workers", 0)),
+            node_config=dict(t.get("node_config") or {}),
+        )
+    return ClusterConfig(
+        cluster_name=str(name),
+        provider=provider,
+        auth=dict(raw.get("auth") or {}),
+        head_node_type=head_type,
+        node_types=node_types,
+        file_mounts={
+            str(k): str(v) for k, v in (raw.get("file_mounts") or {}).items()
+        },
+        setup_commands=list(raw.get("setup_commands") or []),
+        head_setup_commands=list(raw.get("head_setup_commands") or []),
+        worker_setup_commands=list(raw.get("worker_setup_commands") or []),
+        head_start_commands=list(raw.get("head_start_commands") or []),
+        worker_start_commands=list(raw.get("worker_start_commands") or []),
+        port=int(raw.get("port", 0)),
+        path=path,
+    )
+
+
+def load_config(path: str) -> ClusterConfig:
+    import yaml
+
+    with open(os.path.expanduser(path)) as f:
+        raw = yaml.safe_load(f)
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: cluster config must be a mapping")
+    return parse_config(raw, path=path)

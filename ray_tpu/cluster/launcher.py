@@ -1,0 +1,259 @@
+"""`raytpu up / down / status` — one command from YAML to running cluster.
+
+Reference parity: python/ray/autoscaler/_private/commands.py
+(create_or_update_cluster / teardown_cluster) with the SSH bootstrap of
+command_runner.py. Flow:
+
+1. Create the head instance; push file mounts; run setup commands; start
+   the head daemon (`raytpu start --head ...`) detached; read back its
+   printed JSON for the GCS address.
+2. Create each worker type's min_workers instances; bootstrap them with
+   the worker start command templated with the head address.
+3. Record everything in a state file
+   (``<state_dir>/<cluster_name>.cluster.json``) so `down` and `status`
+   work without re-reading the cloud.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from ray_tpu.cluster.config import ClusterConfig
+from ray_tpu.cluster.providers import InstanceProvider, make_provider
+
+DEFAULT_STATE_DIR = os.path.expanduser("~/.ray_tpu/clusters")
+
+
+def _state_path(config: ClusterConfig, state_dir: str) -> str:
+    return os.path.join(state_dir, f"{config.cluster_name}.cluster.json")
+
+
+def _load_state(config: ClusterConfig, state_dir: str) -> dict:
+    path = _state_path(config, state_dir)
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {"instances": {}, "head": None, "gcs_address": None}
+
+
+def _save_state(config: ClusterConfig, state_dir: str, state: dict) -> None:
+    os.makedirs(state_dir, exist_ok=True)
+    path = _state_path(config, state_dir)
+    with open(path + ".tmp", "w") as f:
+        json.dump(state, f, indent=2)
+    os.replace(path + ".tmp", path)
+
+
+def _bootstrap(runner, config: ClusterConfig, extra_cmds: list[str]) -> None:
+    for remote, local in config.file_mounts.items():
+        runner.put(os.path.expanduser(local), remote)
+    for cmd in list(config.setup_commands) + list(extra_cmds):
+        rc, out = runner.run(cmd, timeout=900)
+        if rc != 0:
+            raise RuntimeError(
+                f"setup command failed (rc={rc}): {cmd}\n{out[-2000:]}"
+            )
+
+
+def _head_start_command(config: ClusterConfig) -> str:
+    if config.head_start_commands:
+        return " && ".join(config.head_start_commands)
+    head_type = config.node_types[config.head_node_type]
+    cmd = (
+        f"python -m ray_tpu start --head --host 0.0.0.0 "
+        f"--port {config.port}"
+    )
+    if head_type.resources:
+        cmd += f" --resources {_shquote(json.dumps(head_type.resources))}"
+    if head_type.labels:
+        cmd += f" --labels {_shquote(json.dumps(head_type.labels))}"
+    return cmd
+
+
+def _worker_start_command(config: ClusterConfig, node_type, gcs_addr: str):
+    if config.worker_start_commands:
+        return " && ".join(
+            c.replace("{head_address}", gcs_addr)
+            for c in config.worker_start_commands
+        )
+    cmd = f"python -m ray_tpu start --address {gcs_addr}"
+    if node_type.resources:
+        cmd += f" --resources {_shquote(json.dumps(node_type.resources))}"
+    if node_type.labels:
+        cmd += f" --labels {_shquote(json.dumps(node_type.labels))}"
+    return cmd
+
+
+def _read_daemon_info(runner, timeout_s: float = 60.0) -> dict:
+    """The start daemon prints one JSON line to its log; poll for it."""
+    deadline = time.monotonic() + timeout_s
+    last = ""
+    while time.monotonic() < deadline:
+        rc, out = runner.run("cat daemon.log 2>/dev/null", timeout=15)
+        last = out
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{") and "gcs_address" in line:
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        time.sleep(0.5)
+    raise TimeoutError(
+        f"head daemon never printed its address; last log:\n{last[-2000:]}"
+    )
+
+
+def cluster_up(
+    config: ClusterConfig,
+    state_dir: str = DEFAULT_STATE_DIR,
+    provider: Optional[InstanceProvider] = None,
+) -> dict:
+    """Launch (or top up) the cluster; returns the state dict (head
+    instance, gcs_address, all instances)."""
+    provider = provider or make_provider(
+        config, os.path.join(state_dir, config.cluster_name)
+    )
+    state = _load_state(config, state_dir)
+
+    # -- head ---------------------------------------------------------------
+    if state.get("head") is None:
+        head_type = config.node_types[config.head_node_type]
+        head_id = provider.create(
+            config.head_node_type,
+            head_type.node_config,
+            resources=head_type.resources,
+            labels=head_type.labels,
+        )
+        # Persist the id BEFORE bootstrapping: a failed setup command must
+        # not leak an untracked (billed) instance that `down` cannot see.
+        state["instances"][head_id] = {"node_type": config.head_node_type}
+        _save_state(config, state_dir, state)
+        runner = provider.runner(head_id, config.auth)
+        _wait_ready(runner)
+        _bootstrap(runner, config, config.head_setup_commands)
+        runner.run(_head_start_command(config), detach=True)
+        info = _read_daemon_info(runner)
+        gcs_addr = info["gcs_address"]
+        host, _, port = gcs_addr.partition(":")
+        if host in ("127.0.0.1", "0.0.0.0", "localhost"):
+            # The daemon printed a loopback/wildcard bind; peers must dial
+            # the instance's reachable address.
+            gcs_addr = f"{provider.address(head_id)}:{port}"
+        state["head"] = head_id
+        state["gcs_address"] = gcs_addr
+        _save_state(config, state_dir, state)
+    gcs_addr = state["gcs_address"]
+
+    # -- workers ------------------------------------------------------------
+    for node_type in config.worker_types:
+        have = sum(
+            1
+            for inst in state["instances"].values()
+            if inst["node_type"] == node_type.name
+        )
+        for _ in range(max(node_type.min_workers - have, 0)):
+            wid = provider.create(
+                node_type.name,
+                node_type.node_config,
+                resources=node_type.resources,
+                labels=node_type.labels,
+            )
+            state["instances"][wid] = {"node_type": node_type.name}
+            _save_state(config, state_dir, state)
+            runner = provider.runner(wid, config.auth)
+            _wait_ready(runner)
+            _bootstrap(runner, config, config.worker_setup_commands)
+            runner.run(
+                _worker_start_command(config, node_type, gcs_addr),
+                detach=True,
+            )
+    return state
+
+
+def _wait_ready(runner, timeout_s: float = 300.0) -> None:
+    """Wait until the instance accepts commands: a fresh cloud VM has an
+    IP minutes before sshd answers (reference `ray up` retries the same
+    way). Local runners succeed on the first try."""
+    deadline = time.monotonic() + timeout_s
+    last = ""
+    while time.monotonic() < deadline:
+        try:
+            rc, out = runner.run("true", timeout=30)
+            if rc == 0:
+                return
+            last = out
+        except Exception as e:  # scp/ssh transport errors
+            last = str(e)
+        time.sleep(5.0)
+    raise TimeoutError(
+        f"instance never became command-ready in {timeout_s:.0f}s: "
+        f"{last[-500:]}"
+    )
+
+
+def cluster_down(
+    config: ClusterConfig,
+    state_dir: str = DEFAULT_STATE_DIR,
+    provider: Optional[InstanceProvider] = None,
+) -> int:
+    """Terminate every instance in the state file (workers first, head
+    last). Returns the number terminated."""
+    provider = provider or make_provider(
+        config, os.path.join(state_dir, config.cluster_name)
+    )
+    state = _load_state(config, state_dir)
+    n = 0
+    head = state.get("head")
+    order = [i for i in state["instances"] if i != head] + (
+        [head] if head else []
+    )
+    for instance_id in order:
+        try:
+            provider.terminate(instance_id)
+            n += 1
+        except Exception:
+            pass
+    state = {"instances": {}, "head": None, "gcs_address": None}
+    _save_state(config, state_dir, state)
+    return n
+
+
+def cluster_status(
+    config: ClusterConfig, state_dir: str = DEFAULT_STATE_DIR
+) -> dict:
+    """The launcher's view: state file + the head's live cluster view when
+    reachable."""
+    state = _load_state(config, state_dir)
+    out = {
+        "cluster_name": config.cluster_name,
+        "gcs_address": state.get("gcs_address"),
+        "instances": state.get("instances", {}),
+        "nodes": None,
+    }
+    if state.get("gcs_address"):
+        try:
+            import ray_tpu
+
+            rt = ray_tpu.init(address=state["gcs_address"])
+            try:
+                out["nodes"] = [
+                    {
+                        "NodeName": n.get("NodeName"),
+                        "Alive": n.get("Alive"),
+                        "Resources": n.get("Resources"),
+                    }
+                    for n in ray_tpu.nodes()
+                ]
+            finally:
+                ray_tpu.shutdown()
+        except Exception as e:
+            out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _shquote(s: str) -> str:
+    return "'" + s.replace("'", "'\"'\"'") + "'"

@@ -1,0 +1,233 @@
+"""Launcher-level instance providers.
+
+Distinct from :mod:`ray_tpu.autoscaler.node_provider` (which the in-cluster
+autoscaler drives to add capacity to a RUNNING cluster): these create raw
+instances the launcher then bootstraps over a command runner — the role of
+the reference's `NodeProvider.create_node` + `command_runner` pairing in
+`ray up` (python/ray/autoscaler/_private/commands.py).
+
+- :class:`LocalProcessProvider` — "instances" are working directories on
+  this host; daemons are real OS processes. E2E-testable cluster launch
+  on one machine.
+- :class:`GceInstanceProvider` — adapter over the GCE TPU-VM REST
+  machinery (ray_tpu/autoscaler/gce.py) + SSH command runners.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+import uuid
+from typing import Optional
+
+from ray_tpu.cluster.command_runner import (
+    CommandRunner,
+    LocalCommandRunner,
+    SSHCommandRunner,
+)
+
+
+class InstanceProvider:
+    def create(
+        self,
+        node_type: str,
+        node_config: dict,
+        resources: Optional[dict] = None,
+        labels: Optional[dict] = None,
+    ) -> str:
+        raise NotImplementedError
+
+    def address(self, instance_id: str) -> str:
+        """Reachable IP/host of the instance (may poll until assigned)."""
+        raise NotImplementedError
+
+    def runner(self, instance_id: str, auth: dict) -> CommandRunner:
+        raise NotImplementedError
+
+    def terminate(self, instance_id: str) -> None:
+        raise NotImplementedError
+
+    def list_instances(self) -> dict:
+        """instance_id -> {"node_type": ...}"""
+        raise NotImplementedError
+
+
+class LocalProcessProvider(InstanceProvider):
+    """Instances are dirs under ``state_dir``; daemons are local processes
+    whose pids are tracked in ``<dir>/pids`` for teardown."""
+
+    def __init__(self, state_dir: str):
+        self.state_dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+
+    def _dir(self, instance_id: str) -> str:
+        return os.path.join(self.state_dir, instance_id)
+
+    def create(self, node_type, node_config, resources=None, labels=None):
+        instance_id = f"{node_type}-{uuid.uuid4().hex[:8]}"
+        d = self._dir(instance_id)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump({"node_type": node_type}, f)
+        return instance_id
+
+    def address(self, instance_id: str) -> str:
+        return "127.0.0.1"
+
+    def runner(self, instance_id: str, auth: dict) -> CommandRunner:
+        # Daemons run with the instance dir as cwd; `python -m ray_tpu`
+        # must still resolve from a source checkout (real SSH instances
+        # have the package installed; local "instances" inherit ours).
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
+        return _PidTrackingLocalRunner(
+            self._dir(instance_id), pythonpath=pkg_root
+        )
+
+    def terminate(self, instance_id: str) -> None:
+        d = self._dir(instance_id)
+        pid_file = os.path.join(d, "pids")
+        if os.path.exists(pid_file):
+            with open(pid_file) as f:
+                pids = [int(line) for line in f if line.strip()]
+            for pid in pids:
+                _kill_tree(pid)
+        # Leave the dir for post-mortem logs; drop the instance marker.
+        meta = os.path.join(d, "meta.json")
+        if os.path.exists(meta):
+            os.rename(meta, os.path.join(d, "meta.terminated.json"))
+
+    def list_instances(self) -> dict:
+        out = {}
+        if not os.path.isdir(self.state_dir):
+            return out
+        for instance_id in os.listdir(self.state_dir):
+            meta = os.path.join(self._dir(instance_id), "meta.json")
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    out[instance_id] = json.load(f)
+        return out
+
+
+class _PidTrackingLocalRunner(LocalCommandRunner):
+    """LocalCommandRunner that records detached daemon pids for teardown
+    and injects the source checkout onto PYTHONPATH."""
+
+    def __init__(self, workdir: str, pythonpath: Optional[str] = None):
+        super().__init__(workdir)
+        self._pythonpath = pythonpath
+
+    def run(self, cmd, *, env=None, timeout=600.0, detach=False):
+        env = dict(env or {})
+        if self._pythonpath:
+            existing = env.get("PYTHONPATH") or os.environ.get(
+                "PYTHONPATH", ""
+            )
+            env["PYTHONPATH"] = (
+                f"{self._pythonpath}:{existing}"
+                if existing
+                else self._pythonpath
+            )
+        result = super().run(cmd, env=env, timeout=timeout, detach=detach)
+        if detach and result is not None:
+            with open(os.path.join(self.workdir, "pids"), "a") as f:
+                f.write(f"{result.pid}\n")
+        return result
+
+
+def _kill_tree(pid: int) -> None:
+    """TERM the process group (daemons start_new_session), then the pid."""
+    for target, sig in ((-pid, signal.SIGTERM), (pid, signal.SIGTERM)):
+        try:
+            os.kill(target, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return
+        time.sleep(0.1)
+    try:
+        os.kill(-pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+class GceInstanceProvider(InstanceProvider):
+    """TPU-VM instances through the GCE REST layer (injectable transport —
+    same seam the autoscaler provider uses, ray_tpu/autoscaler/gce.py).
+
+    Each node type's ``node_config`` carries the GCENodeType fields
+    (kind, accelerator_type, machine_type, ...)."""
+
+    def __init__(
+        self,
+        provider_config: dict,
+        node_types: dict | None = None,
+        transport=None,
+    ):
+        from ray_tpu.autoscaler.gce import GCENodeType, GCETPUNodeProvider
+
+        gce_types = {}
+        for name, t in (node_types or {}).items():
+            nc = dict(t.node_config or {"kind": "compute"})
+            # The launcher bootstraps over SSH itself; the provider's
+            # default join-the-cluster startup script would boot a broken
+            # duplicate daemon (no head address exists at create time).
+            nc.setdefault("startup_script", "#!/bin/bash\ntrue")
+            gce_types[name] = GCENodeType(**nc)
+        self._gce = GCETPUNodeProvider(
+            project=provider_config.get("project_id", ""),
+            zone=provider_config.get("zone", ""),
+            cluster_name=provider_config.get(
+                "cluster_name", "raytpu-cluster"
+            ),
+            node_types=gce_types,
+            transport=transport,
+        )
+
+    def create(self, node_type, node_config, resources=None, labels=None):
+        return self._gce.create_node(
+            node_type, dict(resources or {}), dict(labels or {})
+        )
+
+    def address(self, instance_id: str) -> str:
+        for _ in range(60):
+            ip = self._gce.external_ip(instance_id)
+            if ip:
+                return ip
+            time.sleep(5)
+        raise TimeoutError(f"instance {instance_id} never got an address")
+
+    def runner(self, instance_id: str, auth: dict) -> CommandRunner:
+        return SSHCommandRunner(
+            self.address(instance_id),
+            ssh_user=auth.get("ssh_user", ""),
+            ssh_key=auth.get("ssh_private_key"),
+        )
+
+    def terminate(self, instance_id: str) -> None:
+        self._gce.terminate_node(instance_id)
+
+    def list_instances(self) -> dict:
+        return self._gce.non_terminated_nodes()
+
+
+def make_provider(config, state_dir: str) -> InstanceProvider:
+    """Build the instance provider for a ClusterConfig."""
+    provider_config = config.provider
+    ptype = provider_config.get("type")
+    if ptype == "local":
+        return LocalProcessProvider(state_dir)
+    if ptype == "gce":
+        pc = dict(provider_config)
+        pc.setdefault("cluster_name", config.cluster_name)
+        return GceInstanceProvider(pc, node_types=config.node_types)
+    raise ValueError(
+        f"unknown provider type {ptype!r} (known: local, gce)"
+    )

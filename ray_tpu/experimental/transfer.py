@@ -172,7 +172,9 @@ class _Fabric:
     def release_armed(self, oid: str) -> None:
         """Drop armed entries for an oid (object freed before any pull)."""
         with self._lock:
-            for uid in [u for u, (o, _) in self._armed.items() if o == oid]:
+            for uid in [
+                u for u, entry in self._armed.items() if entry[0] == oid
+            ]:
                 del self._armed[uid]
 
     def release_uuid(self, uid: int):

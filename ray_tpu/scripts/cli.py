@@ -289,6 +289,49 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def _launcher_args(args) -> tuple:
+    from ray_tpu.cluster import load_config
+    from ray_tpu.cluster.launcher import DEFAULT_STATE_DIR
+
+    return load_config(args.config), args.state_dir or DEFAULT_STATE_DIR
+
+
+def cmd_up(args) -> int:
+    """`raytpu up cluster.yaml` (reference: `ray up`,
+    autoscaler/_private/commands.py create_or_update_cluster)."""
+    from ray_tpu.cluster.launcher import cluster_up
+
+    config, state_dir = _launcher_args(args)
+    state = cluster_up(config, state_dir=state_dir)
+    print(
+        json.dumps(
+            {
+                "cluster_name": config.cluster_name,
+                "gcs_address": state["gcs_address"],
+                "instances": len(state["instances"]),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.cluster.launcher import cluster_down
+
+    config, state_dir = _launcher_args(args)
+    n = cluster_down(config, state_dir=state_dir)
+    print(json.dumps({"terminated": n}))
+    return 0
+
+
+def cmd_cluster_status(args) -> int:
+    from ray_tpu.cluster.launcher import cluster_status
+
+    config, state_dir = _launcher_args(args)
+    print(json.dumps(cluster_status(config, state_dir=state_dir), indent=2))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="raytpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -355,6 +398,27 @@ def main(argv: list[str] | None = None) -> int:
     p_mem.add_argument("--address", required=True)
     p_mem.add_argument("--limit", type=int, default=10000)
     p_mem.set_defaults(fn=cmd_memory)
+
+    p_up = sub.add_parser(
+        "up", help="launch a cluster from a YAML config (head + workers)"
+    )
+    p_up.add_argument("config", help="cluster YAML path")
+    p_up.add_argument("--state-dir", default=None)
+    p_up.set_defaults(fn=cmd_up)
+
+    p_down = sub.add_parser(
+        "down", help="terminate every instance of a launched cluster"
+    )
+    p_down.add_argument("config", help="cluster YAML path")
+    p_down.add_argument("--state-dir", default=None)
+    p_down.set_defaults(fn=cmd_down)
+
+    p_cstat = sub.add_parser(
+        "cluster-status", help="launcher state + live node view"
+    )
+    p_cstat.add_argument("config", help="cluster YAML path")
+    p_cstat.add_argument("--state-dir", default=None)
+    p_cstat.set_defaults(fn=cmd_cluster_status)
 
     args = parser.parse_args(argv)
     return args.fn(args)
