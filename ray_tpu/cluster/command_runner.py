@@ -115,14 +115,19 @@ class SSHCommandRunner(CommandRunner):
     def run(self, cmd, *, env=None, timeout=600.0, detach=False):
         env_prefix = ""
         if env:
-            env_prefix = (
-                " ".join(f"{k}={_shquote(str(v))}" for k, v in env.items())
-                + " "
-            )
+            # `env` (not bare assignments): assignments after nohup would
+            # be parsed as the command name.
+            env_prefix = "env " + " ".join(
+                f"{k}={_shquote(str(v))}" for k, v in env.items()
+            ) + " "
         if detach:
-            # nohup + redirect: the daemon outlives the ssh session.
+            # Wrap the WHOLE command (it may be an `&&` chain) so nohup
+            # and the redirect cover every part; the daemon outlives the
+            # ssh session.
+            inner = env_prefix + cmd
             remote = (
-                f"nohup {env_prefix}{cmd} > daemon.log 2>&1 < /dev/null &"
+                f"nohup bash -c {_shquote(inner)} "
+                f"> daemon.log 2>&1 < /dev/null &"
             )
         else:
             remote = env_prefix + cmd

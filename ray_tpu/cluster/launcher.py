@@ -121,6 +121,18 @@ def cluster_up(
 
     # -- head ---------------------------------------------------------------
     if state.get("head") is None:
+        # A previous failed `up` may have left a created-but-unbootstrapped
+        # head instance tracked: terminate it before creating a fresh one.
+        for iid, inst in list(state["instances"].items()):
+            if inst["node_type"] == config.head_node_type and not inst.get(
+                "bootstrapped"
+            ):
+                try:
+                    provider.terminate(iid)
+                    del state["instances"][iid]
+                    _save_state(config, state_dir, state)
+                except Exception:
+                    pass
         head_type = config.node_types[config.head_node_type]
         head_id = provider.create(
             config.head_node_type,
@@ -145,15 +157,30 @@ def cluster_up(
             gcs_addr = f"{provider.address(head_id)}:{port}"
         state["head"] = head_id
         state["gcs_address"] = gcs_addr
+        state["instances"][head_id]["bootstrapped"] = True
         _save_state(config, state_dir, state)
     gcs_addr = state["gcs_address"]
 
     # -- workers ------------------------------------------------------------
     for node_type in config.worker_types:
+        # Count only workers that finished bootstrapping: a mid-`up`
+        # failure leaves the instance tracked (for `down`) but NOT counted,
+        # so a re-run tops the cluster back up to min_workers. The failed
+        # instance is terminated first to not pay for a zombie.
+        for wid, inst in list(state["instances"].items()):
+            if inst["node_type"] == node_type.name and not inst.get(
+                "bootstrapped"
+            ):
+                try:
+                    provider.terminate(wid)
+                    del state["instances"][wid]
+                    _save_state(config, state_dir, state)
+                except Exception:
+                    pass  # stays tracked; `down` retries
         have = sum(
             1
             for inst in state["instances"].values()
-            if inst["node_type"] == node_type.name
+            if inst["node_type"] == node_type.name and inst.get("bootstrapped")
         )
         for _ in range(max(node_type.min_workers - have, 0)):
             wid = provider.create(
@@ -171,6 +198,8 @@ def cluster_up(
                 _worker_start_command(config, node_type, gcs_addr),
                 detach=True,
             )
+            state["instances"][wid]["bootstrapped"] = True
+            _save_state(config, state_dir, state)
     return state
 
 
@@ -207,6 +236,7 @@ def cluster_down(
     )
     state = _load_state(config, state_dir)
     n = 0
+    failed: dict = {}
     head = state.get("head")
     order = [i for i in state["instances"] if i != head] + (
         [head] if head else []
@@ -215,10 +245,25 @@ def cluster_down(
         try:
             provider.terminate(instance_id)
             n += 1
-        except Exception:
-            pass
-    state = {"instances": {}, "head": None, "gcs_address": None}
+        except Exception as e:
+            # NEVER drop a failed termination from the state file: that
+            # would orphan a still-billing instance with no record. Keep it
+            # so a later `down` retries.
+            failed[instance_id] = dict(
+                state["instances"].get(instance_id) or {},
+                terminate_error=f"{type(e).__name__}: {e}",
+            )
+    state = {
+        "instances": failed,
+        "head": head if head in failed else None,
+        "gcs_address": state.get("gcs_address") if head in failed else None,
+    }
     _save_state(config, state_dir, state)
+    if failed:
+        raise RuntimeError(
+            f"terminated {n} instances but {len(failed)} failed and remain "
+            f"tracked: {sorted(failed)} — re-run `raytpu down`"
+        )
     return n
 
 

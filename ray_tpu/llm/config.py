@@ -39,6 +39,12 @@ class LLMConfig:
     # random init (tests; real deployments restore a checkpoint).
     weights_path: Optional[str] = None
     seed: int = 0
+    # Prefix caching (reference: vLLM paged-KV prefix reuse +
+    # serve prefix-aware routing): chunk-aligned prompt prefixes keep
+    # their KV in an HBM pool; a shared system prompt prefills once.
+    enable_prefix_caching: bool = True
+    prefix_chunk: int = 32  # alignment granularity (tokens)
+    max_prefix_cache_tokens: int = 4096  # pool HBM budget, LRU-evicted
 
     def build_model_config(self):
         from ray_tpu.models.gpt2 import GPT2Config

@@ -32,7 +32,12 @@ import numpy as np
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models import gpt2
-from ray_tpu.models.gpt2_decode import decode_step, init_kv_cache, prefill
+from ray_tpu.models.gpt2_decode import (
+    decode_step,
+    init_kv_cache,
+    prefill,
+    prefill_continue,
+)
 
 
 @dataclasses.dataclass
@@ -101,6 +106,24 @@ class LLMEngine:
         # prefill bucket + one for decode.
         self._prefill = jax.jit(functools.partial(self._prefill_impl, cfg=cfg))
         self._decode = jax.jit(functools.partial(self._decode_impl, cfg=cfg))
+        self._prefill_cont = jax.jit(
+            functools.partial(self._prefill_cont_impl, cfg=cfg)
+        )
+        self._copy_prefix_in = jax.jit(self._copy_prefix_in_impl)
+        self._copy_prefix_out = jax.jit(
+            self._copy_prefix_out_impl, static_argnames=("length",)
+        )
+        # Prefix pool: key (chunk-aligned token tuple hash) ->
+        # {"k","v": [L, 1, H, P_pad, Dh] device arrays, "len", "used"}.
+        # LRU within max_prefix_cache_tokens.
+        self._prefix_pool: dict = {}
+        self._prefix_tokens_cached = 0
+        self._prefix_clock = 0
+        self.stats = {
+            "prefill_tokens": 0,  # tokens that PAID prefill compute
+            "prefix_hits": 0,
+            "prefix_tokens_reused": 0,
+        }
         # Host-side slot state (numpy: mutated per step)
         self.positions = np.zeros(B, np.int32)  # next write position
         self.last_tokens = np.zeros(B, np.int32)
@@ -133,6 +156,47 @@ class LLMEngine:
     def _decode_impl(params, last_tokens, positions, cache, cfg):
         return decode_step(params, last_tokens, positions, cache, cfg)
 
+    @staticmethod
+    def _prefill_cont_impl(params, tokens, length, start, cache, slot, cfg):
+        """Prefill ONE slot's suffix on top of a cached prefix already
+        copied into that slot's rows [0, start)."""
+        sub = {
+            "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
+            "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
+        }
+        sub, logits = prefill_continue(
+            params, tokens, length[None], start, sub, cfg
+        )
+        cache = {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], sub["k"], slot, axis=1
+            ),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], sub["v"], slot, axis=1
+            ),
+        }
+        return cache, logits[0]
+
+    @staticmethod
+    def _copy_prefix_in_impl(cache, pk, pv, slot):
+        """Write a pooled prefix ([L, 1, H, P_pad, Dh]) into a slot's cache
+        rows [0, P_pad)."""
+        k = jax.lax.dynamic_update_slice(
+            cache["k"], pk, (0, slot, 0, 0, 0)
+        )
+        v = jax.lax.dynamic_update_slice(
+            cache["v"], pv, (0, slot, 0, 0, 0)
+        )
+        return {"k": k, "v": v}
+
+    @staticmethod
+    def _copy_prefix_out_impl(cache, slot, length):
+        """Read a slot's cache rows [0, length) as a pool entry (static
+        length: one compile per distinct chunk multiple actually cached)."""
+        k = jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1)
+        v = jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1)
+        return k[:, :, :, :length, :], v[:, :, :, :length, :]
+
     # -- admission -----------------------------------------------------------
     def add_request(
         self,
@@ -162,6 +226,73 @@ class LLMEngine:
             stop_token=stop,
         )
 
+    # -- prefix pool ---------------------------------------------------------
+
+    def _aligned_prefix_len(self, prompt_len: int) -> int:
+        """Longest chunk-aligned STRICT prefix (>= 1 token must remain to
+        prefill, or there are no last-logits to sample from)."""
+        chunk = self.config.prefix_chunk
+        return ((prompt_len - 1) // chunk) * chunk
+
+    def _chain_hashes(self, prompt: list) -> dict:
+        """Rolling per-chunk hash chain (vLLM-style): H_p = hash((H_{p-c},
+        chunk)). One O(len) pass serves every candidate length — no
+        per-candidate rehash of the whole prefix."""
+        chunk = self.config.prefix_chunk
+        chain: dict[int, int] = {}
+        h = 0
+        for p in range(chunk, self._aligned_prefix_len(len(prompt)) + 1, chunk):
+            h = hash((h, tuple(prompt[p - chunk : p])))
+            chain[p] = h
+        return chain
+
+    def _find_prefix(self, prompt: list):
+        """Longest pooled prefix of ``prompt``; returns (entry | None).
+        Hits are verified against the stored tokens, so a hash collision
+        can never serve another prompt's KV."""
+        if not self.config.enable_prefix_caching:
+            return None
+        chain = self._chain_hashes(prompt)
+        for p in sorted(chain, reverse=True):
+            entry = self._prefix_pool.get((chain[p], p))
+            if entry is not None and entry["tokens"] == tuple(prompt[:p]):
+                self._prefix_clock += 1
+                entry["used"] = self._prefix_clock
+                return entry
+        return None
+
+    def _insert_prefix(self, prompt: list, slot: int) -> None:
+        """Pool the prompt's longest aligned prefix from the (now filled)
+        slot rows, LRU-evicting to the token budget."""
+        if not self.config.enable_prefix_caching:
+            return
+        p = self._aligned_prefix_len(len(prompt))
+        if p < self.config.prefix_chunk or p > self.config.max_prefix_cache_tokens:
+            return
+        chain = self._chain_hashes(prompt)
+        key = (chain[p], p)
+        self._prefix_clock += 1
+        existing = self._prefix_pool.get(key)
+        if existing is not None and existing["tokens"] == tuple(prompt[:p]):
+            existing["used"] = self._prefix_clock
+            return
+        while (
+            self._prefix_pool
+            and self._prefix_tokens_cached + p
+            > self.config.max_prefix_cache_tokens
+        ):
+            victim = min(self._prefix_pool, key=lambda k: self._prefix_pool[k]["used"])
+            self._prefix_tokens_cached -= self._prefix_pool.pop(victim)["len"]
+        k, v = self._copy_prefix_out(self.cache, slot, length=p)
+        self._prefix_pool[key] = {
+            "k": k,
+            "v": v,
+            "len": p,
+            "used": self._prefix_clock,
+            "tokens": tuple(prompt[:p]),
+        }
+        self._prefix_tokens_cached += p
+
     def _admit_waiting(self) -> list:
         """Admit waiting requests into free slots; returns requests that
         finished DURING admission (max_tokens=1 / stop token at prefill) —
@@ -176,19 +307,50 @@ class LLMEngine:
             except ValueError:
                 return admit_finished
             T = len(req.prompt)
-            bucket = next(
-                (b for b in self.config.prefill_buckets if b >= T),
-                self.config.prefill_buckets[-1],
-            )
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :T] = req.prompt
-            self.cache, logits = self._prefill(
-                self.params,
-                jnp.asarray(toks),
-                jnp.asarray(T, jnp.int32),
-                self.cache,
-                slot,
-            )
+            entry = self._find_prefix(req.prompt)
+            if entry is not None:
+                # Prefix hit: copy the pooled KV into the slot, prefill
+                # only the suffix (the whole point: a shared system prompt
+                # pays prefill FLOPs once per pool lifetime, not per
+                # request).
+                P = entry["len"]
+                rem = T - P
+                bucket = next(
+                    (b for b in self.config.prefill_buckets if b >= rem),
+                    self.config.prefill_buckets[-1],
+                )
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :rem] = req.prompt[P:]
+                self.cache = self._copy_prefix_in(
+                    self.cache, entry["k"], entry["v"], slot
+                )
+                self.cache, logits = self._prefill_cont(
+                    self.params,
+                    jnp.asarray(toks),
+                    jnp.asarray(rem, jnp.int32),
+                    jnp.asarray(P, jnp.int32),
+                    self.cache,
+                    slot,
+                )
+                self.stats["prefill_tokens"] += rem
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_reused"] += P
+            else:
+                bucket = next(
+                    (b for b in self.config.prefill_buckets if b >= T),
+                    self.config.prefill_buckets[-1],
+                )
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :T] = req.prompt
+                self.cache, logits = self._prefill(
+                    self.params,
+                    jnp.asarray(toks),
+                    jnp.asarray(T, jnp.int32),
+                    self.cache,
+                    slot,
+                )
+                self.stats["prefill_tokens"] += T
+            self._insert_prefix(req.prompt, slot)
             tok = self._sample(np.asarray(logits), req)
             req.slot = slot
             req.generated.append(tok)

@@ -258,5 +258,10 @@ def build_openai_app(
         name=name,
         num_replicas=num_replicas,
         ray_actor_options=dict(config.placement),
+        # Same-prefix requests stick to a replica whose engine already
+        # pooled that prefix's KV (no re-prefill of shared system prompts).
+        request_affinity=(
+            "prompt_prefix" if config.enable_prefix_caching else None
+        ),
     )
     return dep.bind(config)

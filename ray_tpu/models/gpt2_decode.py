@@ -100,6 +100,64 @@ def prefill(
     return cache, logits
 
 
+def prefill_continue(
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32 — the tokens AFTER the cached prefix
+    lengths: jax.Array,  # [B] true new-token counts (<= T)
+    start: jax.Array,  # scalar int32 — cached prefix length (cache rows
+    #                    [0, start) are already valid for these slots)
+    cache,
+    cfg: GPT2Config,
+):
+    """Prefill positions [start, start+T) on top of an existing cache
+    prefix — the prefix-caching fast path: a shared system prompt's KV is
+    copied into the slot once and only the suffix pays prefill FLOPs.
+
+    ``start`` is a *traced* scalar (no recompile per prefix length): each
+    new token attends over the full static cache row with a mask
+    ``col <= start + row`` — O(T * S_max) scores instead of O(T * (start+T)),
+    the static-shape trade this engine makes everywhere.
+    Returns (cache, last_logits) like :func:`prefill`.
+    """
+    if cfg.n_experts > 0:
+        raise NotImplementedError("decode path is dense-GPT2 only")
+    B, T = tokens.shape
+    S = cache["k"].shape[3]
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    pos = start + jnp.arange(T)
+    x = x + params["wpe"].astype(cfg.dtype)[pos][None]
+
+    cols = jnp.arange(S)
+    rows = jnp.arange(T)
+    # token row r (absolute position start+r) sees cache cols <= start+r
+    mask = cols[None, :] <= (start + rows)[:, None]  # [T, S]
+    scale = 1.0 / (cfg.head_dim**0.5)
+
+    def body(x, layer):
+        p, ck, cv = layer  # ck/cv: [B, H, S, Dh]
+        q, k, v = _qkv(x, p, cfg)  # [B, H, T, Dh]
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k, start, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v, start, axis=2)
+        s = jnp.einsum("bhtd,bhsd->bhts", q, ck).astype(jnp.float32) * scale
+        s = jnp.where(mask[None, None], s, -1e30)
+        pattn = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+        attn = jnp.einsum("bhts,bhsd->bhtd", pattn, cv)
+        return _finish_block(x, attn, p, cfg), (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(
+        lambda c, lyr: body(c, lyr),
+        x,
+        (params["blocks"], cache["k"], cache["v"]),
+    )
+    cache = {"k": ks, "v": vs}
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = (last @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
+    return cache, logits
+
+
 def decode_step(
     params: Params,
     last_tokens: jax.Array,  # [B] int32 — token generated at positions-1

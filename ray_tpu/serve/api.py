@@ -33,6 +33,11 @@ class DeploymentConfig:
     #  "downscale_delay_s"} — demand-driven replica count (reference:
     # serve autoscaling_config). None = fixed num_replicas.
     autoscaling_config: Optional[dict] = None
+    # "prompt_prefix": routers derive an affinity key from the request's
+    # prompt prefix and prefer replicas that recently served it — their
+    # engine's prefix-KV pool is warm (reference:
+    # serve/_private/request_router/prefix_aware/prefix_aware_router.py).
+    request_affinity: Optional[str] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -143,6 +143,7 @@ class ServeController:
             "version": dep["version"],
             "replicas": [r for r, _ in dep["replicas"]],
             "max_concurrent": dep["config"].get("max_concurrent_queries", 8),
+            "affinity": dep["config"].get("request_affinity"),
         }
 
     async def poll_routing(

@@ -47,6 +47,10 @@ class Router:
         # routers neither poll on a period nor serve stale membership
         # (reference: serve/_private/long_poll.py LongPollClient).
         self._listen_task: asyncio.Task | None = None
+        # Deployment-declared request affinity ("prompt_prefix"): requests
+        # with a shared prompt prefix stick to replicas whose prefix-KV
+        # pool is warm (reference: prefix_aware_router.py).
+        self._affinity: str | None = None
 
     def close(self) -> None:
         task = self._listen_task
@@ -105,9 +109,33 @@ class Router:
                 await asyncio.sleep(1.0)
         return False
 
+    def _affinity_key(self, args: tuple, kwargs: dict) -> str:
+        """Derive the routing-affinity key for prompt-prefix deployments:
+        a hash of the request's first 256 prompt characters. Rides the
+        same affinity table model-multiplexing uses."""
+        if self._affinity != "prompt_prefix":
+            return ""
+        req = args[0] if args else kwargs.get("request")
+        if not isinstance(req, dict):
+            return ""
+        body = req.get("body")
+        body = body if isinstance(body, dict) else req
+        prompt = body.get("prompt") or ""
+        if not prompt:
+            msgs = body.get("messages")
+            if isinstance(msgs, list) and msgs:
+                prompt = str((msgs[0] or {}).get("content", ""))
+        prefix = str(prompt)[:256]
+        if not prefix:
+            return ""
+        import hashlib
+
+        return "px:" + hashlib.sha1(prefix.encode()).hexdigest()[:16]
+
     def _apply(self, table: dict) -> None:
         if table.get("replicas") is None:
             return
+        self._affinity = table.get("affinity")
         import time
 
         now = time.monotonic()
@@ -188,10 +216,25 @@ class Router:
             else b
         )
 
+    # Affinity-table key budget: prefix keys ("px:...") are effectively
+    # per-distinct-prompt, so unlike multiplex model ids the key space is
+    # unbounded — LRU past this cap.
+    MAX_AFFINITY_KEYS = 512
+
     def _note_model(self, model_id: str, rid: str) -> None:
         if not model_id:
             return
-        reps = self._model_replicas.setdefault(model_id, [])
+        reps = self._model_replicas.get(model_id)
+        if reps is None:
+            reps = self._model_replicas[model_id] = []
+        else:
+            # Keep insertion order ~= recency so cap eviction drops the
+            # coldest keys (dict preserves insertion order).
+            self._model_replicas[model_id] = self._model_replicas.pop(
+                model_id
+            )
+        while len(self._model_replicas) > self.MAX_AFFINITY_KEYS:
+            self._model_replicas.pop(next(iter(self._model_replicas)))
         if rid in reps:
             return
         reps.append(rid)
@@ -210,13 +253,14 @@ class Router:
                 if not self._replicas:
                     await asyncio.sleep(0.2)
                     continue
-            replica = self._pick(model_id)
+            pick_key = model_id or self._affinity_key(args, kwargs)
+            replica = self._pick(pick_key)
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             try:
                 ref = replica.handle.remote(method, payload, model_id)
                 result = await core_api.get_async(ref)
-                self._note_model(model_id, rid)
+                self._note_model(pick_key, rid)
                 return result
             except (ActorDiedError, ActorUnavailableError) as e:
                 # Replica died mid-request: drop it locally, force-refresh
@@ -254,7 +298,8 @@ class Router:
                 if not self._replicas:
                     await asyncio.sleep(0.2)
                     continue
-            replica = self._pick(model_id)
+            pick_key = model_id or self._affinity_key(args, kwargs)
+            replica = self._pick(pick_key)
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             delivered = False
@@ -265,7 +310,7 @@ class Router:
                 async for ref in gen:
                     value = await core_api.get_async(ref)
                     if not delivered:
-                        self._note_model(model_id, rid)
+                        self._note_model(pick_key, rid)
                     delivered = True
                     yield value
                 return
