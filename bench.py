@@ -70,94 +70,122 @@ def run_bench() -> dict:
         make_train_step,
     )
 
+    import dataclasses
+
     smoke = bool(os.environ.get("RAY_TPU_BENCH_SMOKE"))
     devices = jax.devices()
     n_dev = len(devices)
     _log(f"bench devices: {n_dev} x {devices[0].device_kind}")
 
     if smoke:
-        cfg = gpt2.GPT2Config.tiny()
-        batch_candidates = [8]
-        seq = cfg.max_seq
+        base = gpt2.GPT2Config.tiny()
+        candidates = [(8, base)]
         warmup, iters = 1, 2
     else:
-        cfg = gpt2.GPT2Config.gpt2_125m()
-        # Descending so the OOM back-off never retries a larger batch;
-        # 24 first = measured-best on v5e (per-token cost grows past B=24:
-        # the step goes HBM-bound before it goes MXU-bound).
-        batch_candidates = [24, 16, 8]
-        seq = cfg.max_seq
+        base = gpt2.GPT2Config.gpt2_125m()
+        # (per-chip batch, config) in preference order. Round-4 sweep on
+        # v5e: B=8 with the chunked-loss scan DISABLED (loss_chunk=0) wins
+        # — the full [8, S, vocab] f32 logits fit HBM at B=8 and skipping
+        # the chunk scan's extra lm-head remat matmul is worth ~13%
+        # (78.9 ms vs 90.2 ms/step = 103.8k vs 90.8k tok/s/chip). Larger
+        # batches must keep chunking (logits would be 3-10 GB) and
+        # measured slower per token; they remain as OOM backoffs.
+        candidates = [
+            (8, dataclasses.replace(base, loss_chunk=0)),
+            (12, dataclasses.replace(base, loss_chunk=0)),
+            (24, base),
+            (8, base),
+        ]
         warmup, iters = 3, 10
 
-    mesh = make_mesh(MeshSpec(dp=n_dev), devices)
-    shardings = shardings_from_logical(
-        gpt2.param_logical_specs(cfg), DEFAULT_RULES, mesh
-    )
     opt = default_optimizer(total_steps=1000)
 
-    last_err = None
-    for per_chip_batch in batch_candidates:
+    def measure_one(per_chip_batch, cfg):
+        mesh = make_mesh(MeshSpec(dp=n_dev), devices)
+        shardings = shardings_from_logical(
+            gpt2.param_logical_specs(cfg), DEFAULT_RULES, mesh
+        )
+        seq = cfg.max_seq
         B = per_chip_batch * n_dev
+        state = make_train_state(
+            lambda k: gpt2.init_params(k, cfg),
+            opt,
+            jax.random.key(0),
+            param_shardings=shardings,
+        )
+        step = make_train_step(
+            lambda p, b: gpt2.loss_fn(p, b, cfg),
+            opt,
+            mesh=mesh,
+            batch_spec=P(("dp", "fsdp")),
+            param_shardings=shardings,
+        )
+        tokens = jax.random.randint(
+            jax.random.key(1), (B, seq), 0, cfg.vocab_size
+        )
+        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+        t0 = time.perf_counter()
+        for _ in range(warmup):
+            state, metrics = step(state, batch)
+        # float() forces a device->host transfer: the only reliable sync
+        # on tunneled backends (block_until_ready can return early).
+        loss_val = float(metrics["loss"])
+        _log(
+            f"warmup done (B={B}, chunk={cfg.loss_chunk}) in "
+            f"{time.perf_counter() - t0:.1f}s, loss={loss_val:.4f}"
+        )
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+        per_chip = B * seq * iters / dt / n_dev
+        _log(
+            f"B={B} seq={seq} chunk={cfg.loss_chunk}: "
+            f"{per_chip:,.0f} tok/s/chip ({dt / iters * 1e3:.1f} ms/step)"
+        )
+        return per_chip
+
+    # Measure the first TWO viable candidates and report the better one
+    # (the preference order is from the sweep, but tunnels/toolchain drift;
+    # one extra ~60 s measurement buys a verified choice). OOM backs off
+    # to the next candidate; other errors surface immediately.
+    best = 0.0
+    measured = 0
+    last_err = None
+    for per_chip_batch, cfg in candidates:
+        if measured >= 2:
+            break
         try:
-            state = make_train_state(
-                lambda k: gpt2.init_params(k, cfg),
-                opt,
-                jax.random.key(0),
-                param_shardings=shardings,
-            )
-            step = make_train_step(
-                lambda p, b: gpt2.loss_fn(p, b, cfg),
-                opt,
-                mesh=mesh,
-                batch_spec=P(("dp", "fsdp")),
-                param_shardings=shardings,
-            )
-            tokens = jax.random.randint(
-                jax.random.key(1), (B, seq), 0, cfg.vocab_size
-            )
-            batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
-            t0 = time.perf_counter()
-            for _ in range(warmup):
-                state, metrics = step(state, batch)
-            # float() forces a device->host transfer: the only reliable sync
-            # on tunneled backends (block_until_ready can return early).
-            loss_val = float(metrics["loss"])
-            _log(
-                f"warmup done (B={B}) in {time.perf_counter() - t0:.1f}s, "
-                f"loss={loss_val:.4f}"
-            )
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                state, metrics = step(state, batch)
-            float(metrics["loss"])
-            dt = time.perf_counter() - t0
-            tokens_per_sec = B * seq * iters / dt
-            per_chip = tokens_per_sec / n_dev
-            _log(
-                f"B={B} seq={seq}: {tokens_per_sec:,.0f} tok/s total, "
-                f"{per_chip:,.0f} tok/s/chip ({dt / iters * 1e3:.1f} ms/step)"
-            )
-            return {
-                "metric": METRIC,
-                "value": round(per_chip, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(
-                    per_chip / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4
-                ),
-            }
+            best = max(best, measure_one(per_chip_batch, cfg))
+            measured += 1
         except Exception as e:
-            # Back off only on OOM-shaped failures; anything else is a bug and
-            # must surface immediately rather than burn four compile cycles.
             msg = f"{type(e).__name__}: {e}"
             oom = any(
                 s in msg
                 for s in ("RESOURCE_EXHAUSTED", "Out of memory", "OOM", "hbm")
             )
             if not oom:
+                if best > 0.0:
+                    # Report what we have rather than forfeit the round,
+                    # but LOUDLY: a broken candidate is a real bug.
+                    _log(
+                        f"candidate B={per_chip_batch} "
+                        f"chunk={cfg.loss_chunk} failed NON-OOM "
+                        f"(reporting earlier result): {msg[:500]}"
+                    )
+                    break
                 raise
             last_err = e
-            _log(f"batch {B} OOM; backing off")
-    raise RuntimeError(f"all batch sizes failed; last error: {last_err}")
+            _log(f"candidate B={per_chip_batch} OOM; backing off")
+    if best == 0.0:
+        raise RuntimeError(f"all candidates failed; last error: {last_err}")
+    return {
+        "metric": METRIC,
+        "value": round(best, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(best / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4),
+    }
 
 
 def _probe_backend() -> str:

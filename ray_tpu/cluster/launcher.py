@@ -35,8 +35,16 @@ def _load_state(config: ClusterConfig, state_dir: str) -> dict:
     path = _state_path(config, state_dir)
     if os.path.exists(path):
         with open(path) as f:
-            return json.load(f)
-    return {"instances": {}, "head": None, "gcs_address": None}
+            state = json.load(f)
+        if state.get("schema", 1) < 2:
+            # Pre-"bootstrapped"-flag state: every tracked instance was
+            # only recorded after a successful bootstrap, so mark them —
+            # otherwise the cleanup pass would terminate healthy workers.
+            for inst in state.get("instances", {}).values():
+                inst.setdefault("bootstrapped", True)
+            state["schema"] = 2
+        return state
+    return {"schema": 2, "instances": {}, "head": None, "gcs_address": None}
 
 
 def _save_state(config: ClusterConfig, state_dir: str, state: dict) -> None:
@@ -254,6 +262,7 @@ def cluster_down(
                 terminate_error=f"{type(e).__name__}: {e}",
             )
     state = {
+        "schema": 2,
         "instances": failed,
         "head": head if head in failed else None,
         "gcs_address": state.get("gcs_address") if head in failed else None,

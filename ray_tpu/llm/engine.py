@@ -309,16 +309,27 @@ class LLMEngine:
             T = len(req.prompt)
             entry = self._find_prefix(req.prompt)
             if entry is not None:
+                # The suffix bucket must FIT behind the prefix: a padded
+                # write past max_seq would be start-clamped by XLA and
+                # silently shift the cache. No fitting bucket -> full
+                # prefill (correct, just unaided).
+                P = entry["len"]
+                rem = T - P
+                bucket = next(
+                    (
+                        b
+                        for b in self.config.prefill_buckets
+                        if b >= rem and P + b <= self.config.max_seq
+                    ),
+                    None,
+                )
+                if bucket is None:
+                    entry = None
+            if entry is not None:
                 # Prefix hit: copy the pooled KV into the slot, prefill
                 # only the suffix (the whole point: a shared system prompt
                 # pays prefill FLOPs once per pool lifetime, not per
                 # request).
-                P = entry["len"]
-                rem = T - P
-                bucket = next(
-                    (b for b in self.config.prefill_buckets if b >= rem),
-                    self.config.prefill_buckets[-1],
-                )
                 toks = np.zeros((1, bucket), np.int32)
                 toks[0, :rem] = req.prompt[P:]
                 self.cache = self._copy_prefix_in(

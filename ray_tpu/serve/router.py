@@ -123,8 +123,8 @@ class Router:
         prompt = body.get("prompt") or ""
         if not prompt:
             msgs = body.get("messages")
-            if isinstance(msgs, list) and msgs:
-                prompt = str((msgs[0] or {}).get("content", ""))
+            if isinstance(msgs, list) and msgs and isinstance(msgs[0], dict):
+                prompt = str(msgs[0].get("content", ""))
         prefix = str(prompt)[:256]
         if not prefix:
             return ""
@@ -233,8 +233,17 @@ class Router:
             self._model_replicas[model_id] = self._model_replicas.pop(
                 model_id
             )
-        while len(self._model_replicas) > self.MAX_AFFINITY_KEYS:
-            self._model_replicas.pop(next(iter(self._model_replicas)))
+        if len(self._model_replicas) > self.MAX_AFFINITY_KEYS:
+            # Evict only prefix keys ("px:"): their space is unbounded,
+            # while multiplex model ids are naturally few AND expensive to
+            # lose (a cold replica reloads the model weights).
+            for key in [
+                k for k in self._model_replicas if k.startswith("px:")
+            ]:
+                if len(self._model_replicas) <= self.MAX_AFFINITY_KEYS:
+                    break
+                if key != model_id:
+                    self._model_replicas.pop(key)
         if rid in reps:
             return
         reps.append(rid)

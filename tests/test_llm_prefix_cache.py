@@ -119,6 +119,28 @@ def test_prefix_pool_lru_eviction():
     assert eng.stats["prefix_hits"] == hits
 
 
+def test_prefix_hit_never_overflows_cache():
+    """When no suffix bucket fits behind the prefix (P + bucket would
+    exceed max_seq, which XLA would clamp into silent cache corruption),
+    admission falls back to full prefill — correct output, no hit."""
+    cfg = _tiny_config(
+        max_seq=64, prefill_buckets=(32, 64), prefix_chunk=16
+    )
+    on = LLMEngine(cfg)
+    off = LLMEngine(_tiny_config(
+        max_seq=64, prefill_buckets=(32, 64), prefix_chunk=16,
+        enable_prefix_caching=False,
+    ))
+    sampling = SamplingParams(max_tokens=3, temperature=0.0)
+    shared = list(range(2, 50))  # 48-token aligned prefix
+    p1 = shared + list(range(50, 62))  # 60 tokens: rem=12, bucket 32 -> 80>64
+    out_on = on.generate([p1], sampling)[0]["token_ids"]
+    out_on2 = on.generate([p1], sampling)[0]["token_ids"]
+    out_off = off.generate([p1], sampling)[0]["token_ids"]
+    assert out_on == out_off == out_on2
+    assert on.stats["prefix_hits"] == 0  # guard forced the full path
+
+
 def test_router_prefix_affinity():
     """Same-prefix requests route to the same replica (warm KV pool);
     different prefixes may spread."""
