@@ -37,9 +37,13 @@ def _load_state(config: ClusterConfig, state_dir: str) -> dict:
         with open(path) as f:
             state = json.load(f)
         if state.get("schema", 1) < 2:
-            # Pre-"bootstrapped"-flag state: every tracked instance was
-            # only recorded after a successful bootstrap, so mark them —
-            # otherwise the cleanup pass would terminate healthy workers.
+            # Pre-schema state files: almost all were written by versions
+            # that recorded instances only after a successful bootstrap,
+            # so marking them bootstrapped is right — terminating healthy
+            # workers on upgrade would be far worse. (A file written by
+            # the one intermediate version that persisted-before-bootstrap
+            # AND crashed mid-up can mark a zombie as healthy; it stays
+            # tracked and `raytpu down` still cleans it.)
             for inst in state.get("instances", {}).values():
                 inst.setdefault("bootstrapped", True)
             state["schema"] = 2

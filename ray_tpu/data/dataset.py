@@ -113,8 +113,17 @@ class Dataset:
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._with_op(RepartitionOp(num_blocks))
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        return self._with_op(RandomShuffleOp(seed))
+    def random_shuffle(
+        self,
+        *,
+        seed: Optional[int] = None,
+        num_blocks: Optional[int] = None,
+    ) -> "Dataset":
+        """Globally randomize row order (streaming all-to-all: inputs are
+        consumed incrementally, never materialized as a whole stage).
+        ``num_blocks`` fixes the output block count (default: the
+        executor's streaming window)."""
+        return self._with_op(RandomShuffleOp(seed, num_blocks))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
         return self._with_op(SortOp(key, descending))

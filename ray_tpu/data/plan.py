@@ -90,6 +90,10 @@ class RepartitionOp:  # barrier
 @dataclass
 class RandomShuffleOp:  # barrier
     seed: Optional[int] = None
+    # Output block count. None = bounded by the executor's streaming
+    # window (the shuffle consumes inputs incrementally; a fixed output
+    # count is what makes that possible without knowing the input count).
+    num_blocks: Optional[int] = None
 
 
 @dataclass
@@ -99,6 +103,91 @@ class SortOp:  # barrier
 
 
 BARRIER_OPS = (RepartitionOp, RandomShuffleOp, SortOp)
+
+
+# -- logical optimizer --------------------------------------------------------
+
+
+def optimize_ops(ops: list) -> list:
+    """Rule-based logical rewrites (reference:
+    python/ray/data/_internal/logical/optimizers.py). Conservative rules
+    only — every rewrite preserves row-level semantics:
+
+    1. Consecutive Repartition barriers collapse to the last one.
+    2. Consecutive RandomShuffle barriers collapse to the last one
+       (shuffling twice is one shuffle).
+    3. A RandomShuffle immediately before a Sort is dead (the sort
+       redefines the order) and is dropped.
+    4. Consecutive SelectColumns ops merge; consecutive DropColumns merge.
+    5. Column pruning (Select/Drop at the head of a post-barrier chain)
+       is pushed AHEAD of Repartition/RandomShuffle so dropped columns
+       never pay shuffle bytes; for Sort only when the sort key survives
+       the projection.
+    """
+    ops = list(ops)
+    changed = True
+    while changed:
+        changed = False
+        out: list = []
+        i = 0
+        while i < len(ops):
+            op = ops[i]
+            nxt = ops[i + 1] if i + 1 < len(ops) else None
+            # Rules 1+2: consecutive same-kind barriers.
+            if (
+                isinstance(op, (RepartitionOp, RandomShuffleOp))
+                and type(nxt) is type(op)
+            ):
+                i += 1  # drop `op`, keep the later one
+                changed = True
+                continue
+            # Rule 3: shuffle immediately before sort is dead.
+            if isinstance(op, RandomShuffleOp) and isinstance(nxt, SortOp):
+                i += 1
+                changed = True
+                continue
+            # Rule 4: merge column projections. Only when the second
+            # select's columns all survive the first — otherwise the
+            # unmerged chain raises at runtime (the user's bug must
+            # surface at the select, not silently project fewer columns).
+            if isinstance(op, SelectColumnsOp) and isinstance(
+                nxt, SelectColumnsOp
+            ):
+                if all(c in set(op.cols) for c in nxt.cols):
+                    out.append(SelectColumnsOp(list(nxt.cols)))
+                    i += 2
+                    changed = True
+                    continue
+            if isinstance(op, DropColumnsOp) and isinstance(
+                nxt, DropColumnsOp
+            ):
+                merged = list(op.cols) + [
+                    c for c in nxt.cols if c not in set(op.cols)
+                ]
+                out.append(DropColumnsOp(merged))
+                i += 2
+                changed = True
+                continue
+            # Rule 5: projection pushdown through a barrier.
+            if isinstance(op, BARRIER_OPS) and isinstance(
+                nxt, (SelectColumnsOp, DropColumnsOp)
+            ):
+                movable = True
+                if isinstance(op, SortOp):
+                    if isinstance(nxt, SelectColumnsOp):
+                        movable = op.key in nxt.cols
+                    else:
+                        movable = op.key not in nxt.cols
+                if movable:
+                    out.append(nxt)
+                    out.append(op)
+                    i += 2
+                    changed = True
+                    continue
+            out.append(op)
+            i += 1
+        ops = out
+    return ops
 CHAIN_OPS = (
     MapBatchesOp,
     MapRowsOp,
@@ -189,7 +278,7 @@ class DataPlan:
 
     def stages(self) -> list[Stage]:
         stages = [Stage(barrier=None, chain=[])]
-        for op in self.ops:
+        for op in optimize_ops(self.ops):
             if isinstance(op, BARRIER_OPS):
                 stages.append(Stage(barrier=op, chain=[]))
             elif isinstance(op, CHAIN_OPS):

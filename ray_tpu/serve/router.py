@@ -234,9 +234,11 @@ class Router:
                 model_id
             )
         if len(self._model_replicas) > self.MAX_AFFINITY_KEYS:
-            # Evict only prefix keys ("px:"): their space is unbounded,
-            # while multiplex model ids are naturally few AND expensive to
-            # lose (a cold replica reloads the model weights).
+            # Prefer evicting prefix keys ("px:"): their space is
+            # unbounded, while multiplex model ids are naturally few AND
+            # expensive to lose (a cold replica reloads the model). But
+            # the cap is HARD — if a caller floods distinct model ids,
+            # oldest ids evict too; bounded memory beats warm affinity.
             for key in [
                 k for k in self._model_replicas if k.startswith("px:")
             ]:
@@ -244,6 +246,11 @@ class Router:
                     break
                 if key != model_id:
                     self._model_replicas.pop(key)
+            while len(self._model_replicas) > self.MAX_AFFINITY_KEYS:
+                oldest = next(
+                    k for k in self._model_replicas if k != model_id
+                )
+                self._model_replicas.pop(oldest)
         if rid in reps:
             return
         reps.append(rid)
