@@ -121,8 +121,8 @@ class Dataset:
     ) -> "Dataset":
         """Globally randomize row order (streaming all-to-all: inputs are
         consumed incrementally, never materialized as a whole stage).
-        ``num_blocks`` fixes the output block count (default: the
-        executor's streaming window)."""
+        ``num_blocks`` fixes the output block count (default: the input
+        block count, so granularity survives the shuffle)."""
         return self._with_op(RandomShuffleOp(seed, num_blocks))
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":

@@ -237,9 +237,6 @@ class StreamingExecutor:
                     sources = self._apply_barrier(stage.barrier, sources)
                 pending_stream = None
                 is_read = False
-            elif pending_stream is not None:
-                sources = [ref for ref, _ in pending_stream]
-                pending_stream = None
             if final:
                 needs_reshard = self._shard is not None and (
                     # Fewer blocks than shards: a block-granular shard would
@@ -439,7 +436,7 @@ class StreamingExecutor:
         possible. Outputs are lazy concat tasks (they run as the next
         stage pulls them)."""
         rec = StageStats("RandomShuffleOp(streaming)", "barrier")
-        self.stats.stages.append(rec)
+        appended = False
         try:
             n_out = op.num_blocks or default_out
             split = ray_tpu.remote(_shuffle_split)
@@ -454,6 +451,12 @@ class StreamingExecutor:
                     ref, _rows = next(it)
                 except StopIteration:
                     break
+                if not appended:
+                    # First pull ran the upstream generator's prologue
+                    # (which appends ITS StageStats); appending ours now
+                    # keeps stats in execution order.
+                    self.stats.stages.append(rec)
+                    appended = True
                 t0 = time.perf_counter()
                 seed = None if op.seed is None else op.seed + i
                 out_refs = split.options(num_returns=n_out).remote(
@@ -469,6 +472,8 @@ class StreamingExecutor:
                 rec.wall_s += time.perf_counter() - t0
             if rec.blocks_in == 0:
                 rec.blocks_out = 0
+                if not appended:
+                    self.stats.stages.append(rec)
                 return []
             t0 = time.perf_counter()
             concat = ray_tpu.remote(_concat_blocks_only)

@@ -90,9 +90,9 @@ class RepartitionOp:  # barrier
 @dataclass
 class RandomShuffleOp:  # barrier
     seed: Optional[int] = None
-    # Output block count. None = bounded by the executor's streaming
-    # window (the shuffle consumes inputs incrementally; a fixed output
-    # count is what makes that possible without knowing the input count).
+    # Output block count. None = the upstream input block count (block
+    # granularity survives the shuffle; the count must be fixed before
+    # consumption starts — that is what makes streaming possible).
     num_blocks: Optional[int] = None
 
 
@@ -161,13 +161,14 @@ def optimize_ops(ops: list) -> list:
             if isinstance(op, DropColumnsOp) and isinstance(
                 nxt, DropColumnsOp
             ):
-                merged = list(op.cols) + [
-                    c for c in nxt.cols if c not in set(op.cols)
-                ]
-                out.append(DropColumnsOp(merged))
-                i += 2
-                changed = True
-                continue
+                # Merge only DISJOINT drops: re-dropping a column raises
+                # KeyError unoptimized, and that user bug must still
+                # surface (same contract as the Select merge above).
+                if not set(op.cols) & set(nxt.cols):
+                    out.append(DropColumnsOp(list(op.cols) + list(nxt.cols)))
+                    i += 2
+                    changed = True
+                    continue
             # Rule 5: projection pushdown through a barrier.
             if isinstance(op, BARRIER_OPS) and isinstance(
                 nxt, (SelectColumnsOp, DropColumnsOp)

@@ -205,6 +205,14 @@ class Algorithm:
         }
 
     # -- checkpointing (reference: rllib/utils/checkpoints.py Checkpointable)
+    def extra_state(self) -> dict:
+        """Algorithm-specific state beyond learner+counters (subclass
+        hook; e.g. IMPALA's weight-broadcast version)."""
+        return {}
+
+    def apply_extra_state(self, state: dict) -> None:
+        pass
+
     def save(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
         state = {
@@ -214,6 +222,7 @@ class Algorithm:
             "config": dataclasses.asdict(
                 dataclasses.replace(self.config, env=None)
             ),
+            "extra": self.extra_state(),
         }
         with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
             pickle.dump(state, f)
@@ -225,6 +234,7 @@ class Algorithm:
         self.learner_group.set_state(state["learner"])
         self.iteration = state["iteration"]
         self._total_env_steps = state["total_env_steps"]
+        self.apply_extra_state(state.get("extra") or {})
         self._sync_weights()
 
     def stop(self) -> None:

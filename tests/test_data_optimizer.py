@@ -50,8 +50,16 @@ def test_projections_merge():
         [SelectColumnsOp(["a", "b", "c"]), SelectColumnsOp(["c", "a"])]
     )
     assert len(ops) == 1 and ops[0].cols == ["c", "a"]
-    ops = optimize_ops([DropColumnsOp(["a"]), DropColumnsOp(["b", "a"])])
+    ops = optimize_ops([DropColumnsOp(["a"]), DropColumnsOp(["b"])])
     assert len(ops) == 1 and set(ops[0].cols) == {"a", "b"}
+    # Overlapping drops must NOT merge: re-dropping raises at runtime and
+    # that user bug must still surface.
+    ops = optimize_ops([DropColumnsOp(["a"]), DropColumnsOp(["b", "a"])])
+    assert len(ops) == 2
+    # A select that references a column the previous select removed must
+    # not merge either (it raises unoptimized).
+    ops = optimize_ops([SelectColumnsOp(["a"]), SelectColumnsOp(["a", "b"])])
+    assert len(ops) == 2
 
 
 def test_projection_pushes_through_shuffle_and_repartition():
@@ -119,9 +127,10 @@ def test_streaming_shuffle_fixed_output_blocks(cluster):
     ds = rdata.range(60, parallelism=6).random_shuffle(
         seed=3, num_blocks=3
     )
-    blocks = list(ds.iter_blocks()) if hasattr(ds, "iter_blocks") else None
     rows = ds.take_all()
     assert sorted(r["id"] for r in rows) == list(range(60))
+    # num_blocks took effect: the shuffle emitted exactly 3 blocks.
+    assert "6->3 blocks" in ds.stats()
 
 
 def test_shuffle_then_map_streams_end_to_end(cluster):
