@@ -89,7 +89,20 @@ class Config:
     # gcs_task_manager.h bounded store; log_monitor.py tail interval)
     task_event_flush_interval_s: float = 1.0
     task_events_max: int = 10000
+    # False disables task-event recording entirely (the ~0.1 ms/call
+    # observability tax on the submit path; timeline/state API lose task
+    # rows). RAY_TPU_TASK_EVENTS_ENABLED=0 to turn off.
+    task_events_enabled: bool = True
     metrics_report_interval_s: float = 2.0
+    # Task-push pipelining (reference: the submitter keeps the leased
+    # worker's queue non-empty instead of one in-flight task per lease):
+    # how many pushes may be in flight per lease. 1 = the old behavior.
+    push_pipeline_depth: int = 2
+    # Batched push RPCs: when a scheduling class's queue is at least
+    # push_batch_min_queue deep, up to push_batch_size tasks ride ONE
+    # worker.push_batch RPC (amortizing per-message pickling/framing).
+    push_batch_size: int = 4
+    push_batch_min_queue: int = 8
     log_monitor_interval_s: float = 0.3
     log_to_driver: bool = True
 

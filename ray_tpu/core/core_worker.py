@@ -136,6 +136,11 @@ class CoreWorker:
         self._interrupt_done: dict[str, threading.Event] = {}
         # executor side: task_id -> asyncio.Task for coroutine task fns
         self._running_async: dict[str, asyncio.Future] = {}
+        # One normal task executes at a time in this worker, even with
+        # pipelined pushes keeping more queued here: sync fns serialize on
+        # the 1-thread executor anyway; this lock extends the guarantee to
+        # coroutine fns and async generators (the lease is 1 slot).
+        self._normal_task_serial = asyncio.Lock()
 
         # executor side
         self._executor: concurrent.futures.ThreadPoolExecutor | None = None
@@ -238,6 +243,8 @@ class CoreWorker:
 
     def _task_event(self, task_id: str, state: str, **fields) -> None:
         """Record one lifecycle transition; flushed to the GCS in batches."""
+        if not GLOBAL_CONFIG.task_events_enabled:
+            return
         ev = {
             "task_id": task_id,
             "state": state,
@@ -931,11 +938,7 @@ class CoreWorker:
         lease_id = grant["lease_id"]
         qs.leases[lease_id] = grant
         try:
-            while qs.queue:
-                spec = qs.queue.pop(0)
-                ok = await self._push_to_worker(spec, grant)
-                if not ok:
-                    break  # worker died; lease dead. retry logic re-queued.
+            await self._drain_lease(qs, grant)
         finally:
             qs.leases.pop(lease_id, None)
             try:
@@ -947,6 +950,128 @@ class CoreWorker:
                 pass
             if qs.queue:
                 self._pump_queue(key, qs)
+
+    async def _drain_lease(self, qs: "_QueueState", grant: dict) -> None:
+        """Feed the leased worker until the class queue empties or the
+        worker dies. Two latency levers over the old one-at-a-time loop
+        (PERF.md round-3 list):
+
+        - PIPELINING: up to ``push_pipeline_depth`` pushes stay in flight,
+          so the next task is already at the worker when the current one
+          finishes (the worker's single executor thread still serializes
+          execution — the lease's one resource slot is never
+          oversubscribed).
+        - BATCHING: with a deep queue, up to ``push_batch_size`` tasks
+          ride one worker.push_batch RPC, amortizing per-message framing.
+
+        Completion is awaited oldest-first; a worker death stops new
+        pushes and lets each in-flight push run its own retry path."""
+        cfg = GLOBAL_CONFIG
+        depth = max(1, cfg.push_pipeline_depth)
+        pending: list = []  # [(future-of-ok)]  in submission order
+        alive = True
+        while True:
+            while alive and qs.queue and len(pending) < depth:
+                if (
+                    cfg.push_batch_size > 1
+                    and len(qs.queue) >= cfg.push_batch_min_queue
+                    # Only retryable tasks ride batches: a worker death
+                    # mid-batch charges a retry to EVERY member (one RPC
+                    # cannot tell who executed), and a max_retries=0 task
+                    # must never be permanently failed without having
+                    # started — those go one-per-push like before.
+                    and qs.queue[0].retries_left > 0
+                ):
+                    n = 1
+                    while (
+                        n < min(cfg.push_batch_size, len(qs.queue))
+                        and qs.queue[n].retries_left > 0
+                    ):
+                        n += 1
+                    specs = [qs.queue.pop(0) for _ in range(n)]
+                    pending.append(
+                        asyncio.ensure_future(
+                            self._push_batch_to_worker(specs, grant)
+                        )
+                    )
+                else:
+                    spec = qs.queue.pop(0)
+                    pending.append(
+                        asyncio.ensure_future(
+                            self._push_to_worker(spec, grant)
+                        )
+                    )
+            if not pending:
+                return
+            ok = await pending.pop(0)
+            if not ok:
+                alive = False  # drain remaining in-flight, push no more
+
+    async def _push_batch_to_worker(
+        self, specs: list, grant: dict
+    ) -> bool:
+        """Push several tasks as ONE RPC; the worker executes them in
+        order and replies with one result list. Connection loss routes
+        every spec through the per-task retry/fail path."""
+        live: list = []
+        for spec in specs:
+            if spec.cancelled:
+                await self._fail_task(
+                    spec,
+                    TaskCancelledError(f"task {spec.name} was cancelled"),
+                )
+            else:
+                live.append(spec)
+        if not live:
+            return True
+        payloads = [self._push_payload(spec) for spec in live]
+        for spec in live:
+            self._inflight_push[spec.task_id] = tuple(grant["worker_addr"])
+            self._task_event(
+                spec.task_id,
+                "RUNNING",
+                node_id=grant.get("node_id"),
+                worker_id=grant.get("worker_id"),
+            )
+        try:
+            replies = await self.endpoint.acall(
+                tuple(grant["worker_addr"]),
+                "worker.push_batch",
+                {"tasks": payloads},
+            )
+        except (ConnectionLost, ConnectionError, OSError):
+            # ONE reap for the one dead worker, then per-spec retry/fail.
+            await self._reap_worker(grant)
+            for spec in live:
+                await self._retry_or_fail_after_conn_loss(spec)
+            return False
+        except Exception as e:  # noqa: BLE001
+            # Whole-RPC failure with the connection alive (reply encoding
+            # etc.): like the single-push path, the owner cannot know who
+            # ran — fail every member so their refs resolve.
+            for spec in live:
+                await self._fail_task(spec, e)
+            return True
+        finally:
+            for spec in live:
+                self._inflight_push.pop(spec.task_id, None)
+        for spec, reply in zip(live, replies):
+            self._apply_task_reply(spec, reply)
+        return True
+
+    def _push_payload(self, spec: TaskSpec) -> dict:
+        return {
+            "task_id": spec.task_id,
+            "name": spec.name,
+            "func": spec.func_payload,
+            "args": spec.args,
+            "kwargs": spec.kwargs,
+            "return_ids": spec.return_ids,
+            "owner_addr": tuple(self.endpoint.address),
+            "pg": spec.pg,
+            "trace_ctx": spec.trace_ctx,
+            "streaming": spec.streaming,
+        }
 
     async def _request_lease(self, spec: TaskSpec) -> dict | None:
         payload = {
@@ -989,18 +1114,7 @@ class CoreWorker:
                 TaskCancelledError(f"task {spec.name} was cancelled"),
             )
             return True  # lease is fine; continue with the next queued task
-        payload = {
-            "task_id": spec.task_id,
-            "name": spec.name,
-            "func": spec.func_payload,
-            "args": spec.args,
-            "kwargs": spec.kwargs,
-            "return_ids": spec.return_ids,
-            "owner_addr": tuple(self.endpoint.address),
-            "pg": spec.pg,
-            "trace_ctx": spec.trace_ctx,
-            "streaming": spec.streaming,
-        }
+        payload = self._push_payload(spec)
         self._inflight_push[spec.task_id] = tuple(grant["worker_addr"])
         self._task_event(
             spec.task_id,
@@ -1030,8 +1144,13 @@ class CoreWorker:
     ) -> bool:
         """The leased worker's connection died mid-push: reap it, then
         retry or fail the task. Returns False (lease's worker is gone)."""
-        # Let the node reap the dead worker NOW so a retry doesn't get
-        # handed the same corpse from the idle pool.
+        await self._reap_worker(grant)
+        await self._retry_or_fail_after_conn_loss(spec)
+        return False
+
+    async def _reap_worker(self, grant: dict) -> None:
+        """Let the node reap the dead worker NOW so a retry doesn't get
+        handed the same corpse from the idle pool."""
         try:
             await self.endpoint.acall(
                 tuple(grant["node_addr"]),
@@ -1040,6 +1159,8 @@ class CoreWorker:
             )
         except Exception:
             pass
+
+    async def _retry_or_fail_after_conn_loss(self, spec: TaskSpec) -> None:
         if spec.cancelled:
             # force-cancel kills the worker; report cancellation, not a
             # crash, and never retry a cancelled task.
@@ -1058,7 +1179,6 @@ class CoreWorker:
                     f"(task {spec.task_id[:8]})"
                 ),
             )
-        return False
 
     async def _enqueue_task_respec(self, spec: TaskSpec) -> None:
         key = self._sched_key_of(spec)
@@ -1516,6 +1636,15 @@ class CoreWorker:
             return await self._execute_actor_task(p)
         return await self._execute_task(p)
 
+    async def _h_worker_push_batch(self, conn, p):
+        """Batched push: execute the tasks in order, reply with one result
+        list (see _push_batch_to_worker; reference: the submitter-side
+        batching lever in PERF.md)."""
+        return [
+            await self._h_worker_push_task(conn, task)
+            for task in p["tasks"]
+        ]
+
     # -- device objects (reference: gpu_object_manager __ray_send__) ---------
 
     async def _h_worker_rdt_fetch(self, conn, p):
@@ -1676,29 +1805,31 @@ class CoreWorker:
 
         try:
             if p.get("streaming"):
-                results = await self._execute_streaming(
-                    p, func, args, kwargs, pginfo, self._executor
-                )
+                async with self._normal_task_serial:
+                    results = await self._execute_streaming(
+                        p, func, args, kwargs, pginfo, self._executor
+                    )
                 return {"results": results, "exec": self._exec_span(t_exec0)}
             if asyncio.iscoroutinefunction(func):
-                with self._cancel_lock:
-                    if task_id in self._cancelled_tasks:
+                async with self._normal_task_serial:
+                    with self._cancel_lock:
+                        if task_id in self._cancelled_tasks:
+                            raise TaskCancelledError(
+                                f"task {p['name']} cancelled"
+                            )
+                        with _bind_ambient_pg(pginfo):
+                            coro_task = asyncio.ensure_future(
+                                func(*args, **kwargs)
+                            )
+                        self._running_async[task_id] = coro_task
+                    try:
+                        result = await coro_task
+                    except asyncio.CancelledError:
                         raise TaskCancelledError(
                             f"task {p['name']} cancelled"
-                        )
-                    with _bind_ambient_pg(pginfo):
-                        coro_task = asyncio.ensure_future(
-                            func(*args, **kwargs)
-                        )
-                    self._running_async[task_id] = coro_task
-                try:
-                    result = await coro_task
-                except asyncio.CancelledError:
-                    raise TaskCancelledError(
-                        f"task {p['name']} cancelled"
-                    ) from None
-                finally:
-                    self._running_async.pop(task_id, None)
+                        ) from None
+                    finally:
+                        self._running_async.pop(task_id, None)
             else:
                 result = await loop.run_in_executor(self._executor, run)
             results = self._encode_results(p, result)
