@@ -972,6 +972,13 @@ class CoreWorker:
         alive = True
         while True:
             while alive and qs.queue and len(pending) < depth:
+                head = qs.queue[0]
+                if pending and head.retries_left <= 0:
+                    # A max_retries=0 task must never be in flight BEHIND
+                    # another task: worker death would permanently fail it
+                    # without it ever starting. It rides alone (depth-1
+                    # behavior) once the pipeline drains.
+                    break
                 if (
                     cfg.push_batch_size > 1
                     and len(qs.queue) >= cfg.push_batch_min_queue
@@ -980,15 +987,25 @@ class CoreWorker:
                     # cannot tell who executed), and a max_retries=0 task
                     # must never be permanently failed without having
                     # started — those go one-per-push like before.
-                    and qs.queue[0].retries_left > 0
+                    and head.retries_left > 0
                 ):
-                    n = 1
-                    while (
-                        n < min(cfg.push_batch_size, len(qs.queue))
-                        and qs.queue[n].retries_left > 0
-                    ):
+                    # A batch member must not CONSUME an earlier member's
+                    # output: the producer's result only ships on the
+                    # combined reply, so the consumer's arg fetch would
+                    # deadlock the whole batch.
+                    batch_returns: set = set()
+                    n = 0
+                    while n < min(cfg.push_batch_size, len(qs.queue)):
+                        cand = qs.queue[n]
+                        if cand.retries_left <= 0 or (
+                            batch_returns
+                            and batch_returns
+                            & self._spec_arg_ref_ids(cand)
+                        ):
+                            break
+                        batch_returns.update(cand.return_ids)
                         n += 1
-                    specs = [qs.queue.pop(0) for _ in range(n)]
+                    specs = [qs.queue.pop(0) for _ in range(max(n, 1))]
                     pending.append(
                         asyncio.ensure_future(
                             self._push_batch_to_worker(specs, grant)
@@ -1006,6 +1023,15 @@ class CoreWorker:
             ok = await pending.pop(0)
             if not ok:
                 alive = False  # drain remaining in-flight, push no more
+
+    @staticmethod
+    def _spec_arg_ref_ids(spec: TaskSpec) -> set:
+        """Object ids this task's args/kwargs reference."""
+        out = set()
+        for kind, v in list(spec.args) + list(spec.kwargs.values()):
+            if kind == "r":
+                out.add(v.hex() if hasattr(v, "hex") else str(v))
+        return out
 
     async def _push_batch_to_worker(
         self, specs: list, grant: dict
@@ -1106,47 +1132,12 @@ class CoreWorker:
             raise RuntimeError(f"bad lease reply: {reply}")
 
     async def _push_to_worker(self, spec: TaskSpec, grant: dict) -> bool:
-        """Push one task; on worker death retry or fail. Returns False if the
-        lease's worker is gone."""
-        if spec.cancelled:
-            await self._fail_task(
-                spec,
-                TaskCancelledError(f"task {spec.name} was cancelled"),
-            )
-            return True  # lease is fine; continue with the next queued task
-        payload = self._push_payload(spec)
-        self._inflight_push[spec.task_id] = tuple(grant["worker_addr"])
-        self._task_event(
-            spec.task_id,
-            "RUNNING",
-            node_id=grant.get("node_id"),
-            worker_id=grant.get("worker_id"),
-        )
-        try:
-            reply = await self.endpoint.acall(
-                tuple(grant["worker_addr"]), "worker.push_task", payload
-            )
-        except (ConnectionLost, ConnectionError, OSError) as conn_err:
-            return await self._push_connection_lost(spec, grant, conn_err)
-        except Exception as e:  # noqa: BLE001
-            # Application-level error from the execution RPC (executor bug
-            # or unserializable reply): fail the task so its return refs
-            # resolve instead of pending forever.
-            await self._fail_task(spec, e)
-            return True
-        finally:
-            self._inflight_push.pop(spec.task_id, None)
-        self._apply_task_reply(spec, reply)
-        return True
-
-    async def _push_connection_lost(
-        self, spec: TaskSpec, grant: dict, conn_err
-    ) -> bool:
-        """The leased worker's connection died mid-push: reap it, then
-        retry or fail the task. Returns False (lease's worker is gone)."""
-        await self._reap_worker(grant)
-        await self._retry_or_fail_after_conn_loss(spec)
-        return False
+        """Push one task; on worker death retry or fail. Returns False if
+        the lease's worker is gone. A batch of one: the batch path already
+        implements the full push bracket (cancel check, inflight/event
+        bookkeeping, conn-loss reap+retry, whole-RPC failure, reply
+        apply) — one copy of that state machine, not two."""
+        return await self._push_batch_to_worker([spec], grant)
 
     async def _reap_worker(self, grant: dict) -> None:
         """Let the node reap the dead worker NOW so a retry doesn't get
@@ -1831,7 +1822,8 @@ class CoreWorker:
                     finally:
                         self._running_async.pop(task_id, None)
             else:
-                result = await loop.run_in_executor(self._executor, run)
+                async with self._normal_task_serial:
+                    result = await loop.run_in_executor(self._executor, run)
             results = self._encode_results(p, result)
             await self._flush_created(results)
             return {"results": results, "exec": self._exec_span(t_exec0)}

@@ -65,6 +65,24 @@ def test_batched_pushes_propagate_per_task_errors(batchy_cluster):
             assert ray_tpu.get(r) == i
 
 
+def test_chained_refs_within_batch_window_no_deadlock(batchy_cluster):
+    """A burst where later tasks CONSUME earlier tasks' outputs must not
+    deadlock: a consumer batched with its producer would wait forever on
+    the combined reply (the producer's result only ships when the whole
+    batch finishes). The batch builder cuts batches at such edges."""
+    a = double.remote(1)
+
+    @ray_tpu.remote
+    def plus(x, y):
+        return x + y
+
+    # Chain depth 3 submitted in one burst — producers and consumers land
+    # in the same scheduling class's queue together.
+    b = [plus.remote(a, i) for i in range(6)]
+    c = [plus.remote(b[i], 100) for i in range(6)]
+    assert ray_tpu.get(c, timeout=60) == [2 + i + 100 for i in range(6)]
+
+
 def test_batched_pushes_with_object_args(batchy_cluster):
     """Batched tasks whose args are object refs resolve normally."""
     base = ray_tpu.put(10)
