@@ -74,6 +74,10 @@ class TaskSpec:
     completed: bool = False  # finished at least once (spec kept for lineage)
     lineage_attempts: int = 0  # reconstruction resubmissions so far
     streaming: bool = False  # num_returns="streaming": yields stream items
+    # Every object id the args/kwargs reference, INCLUDING refs nested in
+    # containers (collected at encode time; the batch builder cuts batches
+    # at producer->consumer edges using this).
+    arg_ref_ids: frozenset = frozenset()
     # actor fields
     actor_id: str | None = None
     method: str | None = None
@@ -830,12 +834,16 @@ class CoreWorker:
         return_ids = [ObjectID.random().hex() for _ in range(n_returns)]
         if func_payload is None:
             func_payload = cloudpickle.dumps(func)
+        ref_bag: set = set()
         spec = TaskSpec(
             task_id=task_id,
             name=name,
             func_payload=func_payload,
-            args=[self._encode_arg(a) for a in args],
-            kwargs={k: self._encode_arg(v) for k, v in kwargs.items()},
+            args=[self._encode_arg(a, ref_bag) for a in args],
+            kwargs={
+                k: self._encode_arg(v, ref_bag) for k, v in kwargs.items()
+            },
+            arg_ref_ids=frozenset(ref_bag),
             return_ids=return_ids,
             resources=resources,
             retries_left=max_retries,
@@ -878,10 +886,18 @@ class CoreWorker:
         else:
             self.endpoint.submit(coro).result(timeout=30)
 
-    def _encode_arg(self, value: Any):
+    def _encode_arg(self, value: Any, ref_bag: "set | None" = None):
         if isinstance(value, ObjectRef):
+            if ref_bag is not None:
+                ref_bag.add(value.hex())
             return ("r", value)
-        payload, _refs = serialization.dumps(value)
+        payload, refs = serialization.dumps(value)
+        if ref_bag is not None:
+            # Refs NESTED in containers count too: a batch member that
+            # consumes such a ref from an earlier member would deadlock
+            # the combined reply (see _drain_lease's batch cut).
+            for r in refs:
+                ref_bag.add(r.hex() if hasattr(r, "hex") else str(r))
         return ("v", payload)
 
     @staticmethod
@@ -968,16 +984,28 @@ class CoreWorker:
         pushes and lets each in-flight push run its own retry path."""
         cfg = GLOBAL_CONFIG
         depth = max(1, cfg.push_pipeline_depth)
-        pending: list = []  # [(future-of-ok)]  in submission order
+        # [(future-of-ok, has_nonretryable)] in submission order.
+        pending: list = []
         alive = True
         while True:
             while alive and qs.queue and len(pending) < depth:
                 head = qs.queue[0]
-                if pending and head.retries_left <= 0:
-                    # A max_retries=0 task must never be in flight BEHIND
-                    # another task: worker death would permanently fail it
-                    # without it ever starting. It rides alone (depth-1
-                    # behavior) once the pipeline drains.
+                if pending and (
+                    head.retries_left <= 0
+                    or any(nr for _, nr in pending)
+                ):
+                    # A max_retries=0 task must never SHARE the pipeline
+                    # with any other task, in either direction: worker
+                    # death while two tasks are in flight can permanently
+                    # fail the one that never started (execution order at
+                    # the worker is not submission order — arg resolution
+                    # happens before the serial lock). It rides alone.
+                    break
+                if pending and len(qs.queue) <= qs.inflight:
+                    # Pipelining must not STARVE parallelism: other lease
+                    # requests are in flight for this class, and each
+                    # queued task left here becomes a parallel execution
+                    # there. Only pipeline the surplus beyond them.
                     break
                 if (
                     cfg.push_batch_size > 1
@@ -1007,27 +1035,35 @@ class CoreWorker:
                         n += 1
                     specs = [qs.queue.pop(0) for _ in range(max(n, 1))]
                     pending.append(
-                        asyncio.ensure_future(
-                            self._push_batch_to_worker(specs, grant)
+                        (
+                            asyncio.ensure_future(
+                                self._push_batch_to_worker(specs, grant)
+                            ),
+                            any(s.retries_left <= 0 for s in specs),
                         )
                     )
                 else:
                     spec = qs.queue.pop(0)
                     pending.append(
-                        asyncio.ensure_future(
-                            self._push_to_worker(spec, grant)
+                        (
+                            asyncio.ensure_future(
+                                self._push_to_worker(spec, grant)
+                            ),
+                            spec.retries_left <= 0,
                         )
                     )
             if not pending:
                 return
-            ok = await pending.pop(0)
+            fut, _nr = pending.pop(0)
+            ok = await fut
             if not ok:
                 alive = False  # drain remaining in-flight, push no more
 
     @staticmethod
     def _spec_arg_ref_ids(spec: TaskSpec) -> set:
-        """Object ids this task's args/kwargs reference."""
-        out = set()
+        """Object ids this task's args/kwargs reference (top-level AND
+        nested; nested ids were bagged at encode time)."""
+        out = set(spec.arg_ref_ids)
         for kind, v in list(spec.args) + list(spec.kwargs.values()):
             if kind == "r":
                 out.add(v.hex() if hasattr(v, "hex") else str(v))

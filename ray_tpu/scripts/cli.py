@@ -332,6 +332,46 @@ def cmd_cluster_status(args) -> int:
     return 0
 
 
+def cmd_serve_deploy(args) -> int:
+    """`raytpu serve deploy app.yaml --address ...` (reference:
+    `serve deploy`, python/ray/serve/scripts.py)."""
+    import ray_tpu
+    from ray_tpu.serve.schema import deploy_from_file, serve_status
+
+    ray_tpu.init(address=args.address)
+    try:
+        deploy_from_file(args.config)
+        print(json.dumps(serve_status(), indent=2))
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_serve_status(args) -> int:
+    import ray_tpu
+    from ray_tpu.serve.schema import serve_status
+
+    ray_tpu.init(address=args.address)
+    try:
+        print(json.dumps(serve_status(), indent=2))
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def cmd_serve_shutdown(args) -> int:
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(address=args.address)
+    try:
+        serve.shutdown()
+        print(json.dumps({"ok": True}))
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="raytpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -419,6 +459,25 @@ def main(argv: list[str] | None = None) -> int:
     p_cstat.add_argument("config", help="cluster YAML path")
     p_cstat.add_argument("--state-dir", default=None)
     p_cstat.set_defaults(fn=cmd_cluster_status)
+
+    p_serve = sub.add_parser(
+        "serve", help="declarative Serve: deploy/status/shutdown"
+    )
+    serve_sub = p_serve.add_subparsers(dest="serve_cmd", required=True)
+    ps_deploy = serve_sub.add_parser(
+        "deploy", help="deploy applications from a serve YAML"
+    )
+    ps_deploy.add_argument("config", help="serve YAML path")
+    ps_deploy.add_argument("--address", required=True)
+    ps_deploy.set_defaults(fn=cmd_serve_deploy)
+    ps_status = serve_sub.add_parser("status", help="deployment table")
+    ps_status.add_argument("--address", required=True)
+    ps_status.set_defaults(fn=cmd_serve_status)
+    ps_down = serve_sub.add_parser(
+        "shutdown", help="tear down every deployment + the proxy"
+    )
+    ps_down.add_argument("--address", required=True)
+    ps_down.set_defaults(fn=cmd_serve_shutdown)
 
     args = parser.parse_args(argv)
     return args.fn(args)

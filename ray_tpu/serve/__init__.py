@@ -22,6 +22,11 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.schema import (
+    deploy_from_config,
+    deploy_from_file,
+    load_serve_config,
+)
 
 __all__ = [
     "Application",
@@ -29,8 +34,11 @@ __all__ = [
     "DeploymentHandle",
     "batch",
     "delete",
+    "deploy_from_config",
+    "deploy_from_file",
     "deployment",
     "get_handle",
+    "load_serve_config",
     "get_multiplexed_model_id",
     "multiplexed",
     "run",

@@ -11,6 +11,8 @@ from ray_tpu.train.config import RunConfig
 from ray_tpu.tune.result_grid import ResultGrid, TrialResult
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     FIFOScheduler,
     PopulationBasedTraining,
 )
@@ -23,6 +25,7 @@ from ray_tpu.tune.search import (
 )
 from ray_tpu.tune.search_alg import (
     FunctionSearcher,
+    GridSearcher,
     RandomSearcher,
     Searcher,
 )
@@ -36,8 +39,11 @@ from ray_tpu.tune.tuner import (
 
 __all__ = [
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
     "FIFOScheduler",
     "FunctionSearcher",
+    "GridSearcher",
     "RandomSearcher",
     "Searcher",
     "PopulationBasedTraining",

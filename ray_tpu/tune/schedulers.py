@@ -74,6 +74,132 @@ class ASHAScheduler:
         return decision
 
 
+class MedianStoppingRule:
+    """Stop a trial whose best result so far is worse than the median of
+    the other trials' RUNNING MEANS at comparable time (reference:
+    python/ray/tune/schedulers/median_stopping_rule.py — the Vizier rule).
+    Conservative by construction: trials inside the grace period are never
+    stopped, and fewer than ``min_samples_required`` peers means no
+    decision."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "min",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+        time_attr: str = "training_iteration",
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        # trial_id -> [(t, value), ...] full timed history: the median is
+        # computed over peers' running means AT COMPARABLE TIME (results
+        # with t' <= t), so a young trial is never judged against where
+        # long-running peers got to later.
+        self._history: dict[str, list] = {}
+
+    def _running_mean_at(self, tid: str, t) -> "float | None":
+        vals = [v for tv, v in self._history[tid] if tv <= t]
+        return sum(vals) / len(vals) if vals else None
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        hist = self._history.setdefault(trial_id, [])
+        hist.append((t, value))
+        if t < self.grace:
+            return CONTINUE
+        peer_means = [
+            m
+            for tid in self._history
+            if tid != trial_id
+            for m in [self._running_mean_at(tid, t)]
+            if m is not None
+        ]
+        if len(peer_means) < self.min_samples:
+            return CONTINUE
+        import statistics
+
+        median = statistics.median(peer_means)
+        if self.mode == "max":
+            best = max(v for _, v in hist)
+            worse = best < median
+        else:
+            best = min(v for _, v in hist)
+            worse = best > median
+        return STOP if worse else CONTINUE
+
+
+class HyperBandScheduler:
+    """Bracketed successive halving (reference:
+    python/ray/tune/schedulers/hyperband.py). Trials are assigned
+    round-robin to brackets whose grace periods span the HyperBand
+    (r, n) trade-off — one bracket explores many configs briefly, another
+    runs few configs long. Within a bracket the rung rule is applied
+    asynchronously (the ASHA decision), which is how this runtime's
+    streaming result loop can drive it without a global pause barrier;
+    bracket diversity is what plain ASHA lacks."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "min",
+        max_t: int = 81,
+        reduction_factor: int = 3,
+        time_attr: str = "training_iteration",
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # s_max+1 brackets: bracket s starts trials at r = max_t / rf^s.
+        # Integer loop, not int(log(...)): float rounding at exact powers
+        # (e.g. log(243, 3) = 4.9999...) would drop the most-explorative
+        # bracket.
+        s_max = 0
+        t = max_t
+        while t >= reduction_factor:
+            t //= reduction_factor
+            s_max += 1
+        self._brackets = []
+        for s in range(s_max, -1, -1):
+            grace = max(1, int(max_t / (reduction_factor**s)))
+            self._brackets.append(
+                ASHAScheduler(
+                    metric,
+                    mode=mode,
+                    max_t=max_t,
+                    grace_period=grace,
+                    reduction_factor=reduction_factor,
+                    time_attr=time_attr,
+                )
+            )
+        self._assignment: dict[str, int] = {}
+        self._next = 0
+
+    def bracket_of(self, trial_id: str) -> int:
+        b = self._assignment.get(trial_id)
+        if b is None:
+            b = self._assignment[trial_id] = self._next
+            self._next = (self._next + 1) % len(self._brackets)
+        return b
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return self._brackets[self.bracket_of(trial_id)].on_result(
+            trial_id, result
+        )
+
+
 class PopulationBasedTraining:
     """PBT (reference: python/ray/tune/schedulers/pbt.py:27). Every
     ``perturbation_interval`` iterations a trial's latest metric is ranked

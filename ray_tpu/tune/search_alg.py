@@ -60,6 +60,43 @@ class RandomSearcher(Searcher):
             self.history[trial_id] = dict(result)
 
 
+class GridSearcher(Searcher):
+    """Deterministic cross-product of the space's grid axes (sampler/
+    literal keys drawn once per variant); exhausts after the product —
+    suggest() then returns None (reference: basic_variant.py's grid side,
+    as an incremental Searcher instead of an up-front variant list).
+
+    NOTE: TuneConfig.num_samples is the Tuner's total trial budget for
+    ANY searcher — set it to at least the grid product (len(variants))
+    or the tail of the grid is never requested."""
+
+    def __init__(
+        self,
+        param_space: dict,
+        num_samples: int = 1,
+        seed: Optional[int] = None,
+    ):
+        from ray_tpu.tune.search import generate_variants
+
+        self.param_space = dict(param_space)
+        self._variants = generate_variants(
+            param_space, num_samples=num_samples, seed=seed
+        )
+        self._next = 0
+        self.history: dict[str, dict] = {}
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._next >= len(self._variants):
+            return None
+        cfg = self._variants[self._next]
+        self._next += 1
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None) -> None:
+        if result is not None:
+            self.history[trial_id] = dict(result)
+
+
 class FunctionSearcher(Searcher):
     """Wrap a plain function as a searcher:
     ``fn(trial_id, history: {tid: final_metrics}) -> config | None``."""

@@ -1,0 +1,179 @@
+"""HyperBand + median-stopping schedulers and the grid searcher.
+
+Reference parity: python/ray/tune/schedulers/hyperband.py,
+median_stopping_rule.py, search/basic_variant.py — round-3 verdict
+missing #8 (scheduler/searcher breadth on the existing seams).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import (
+    COMPLETE,
+    CONTINUE,
+    STOP,
+    HyperBandScheduler,
+    MedianStoppingRule,
+)
+
+
+# -- unit: median stopping ----------------------------------------------------
+
+
+def test_median_stopping_stops_clear_loser():
+    rule = MedianStoppingRule(
+        "loss", mode="min", grace_period=2, min_samples_required=2
+    )
+    # Three good trials build history.
+    for t in range(1, 4):
+        for tid in ("a", "b", "c"):
+            assert rule.on_result(
+                tid, {"training_iteration": t, "loss": 0.1 * t}
+            ) in (CONTINUE,)
+    # A trial far above the median of running means is stopped once past
+    # grace.
+    assert rule.on_result(
+        "loser", {"training_iteration": 3, "loss": 100.0}
+    ) == STOP
+
+
+def test_median_stopping_respects_grace_and_min_samples():
+    rule = MedianStoppingRule(
+        "loss", mode="min", grace_period=5, min_samples_required=3
+    )
+    # Within grace: never stopped, no matter how bad.
+    assert rule.on_result(
+        "x", {"training_iteration": 1, "loss": 1e9}
+    ) == CONTINUE
+    # Past grace but only one peer: still no decision.
+    rule.on_result("p1", {"training_iteration": 6, "loss": 0.1})
+    assert rule.on_result(
+        "x", {"training_iteration": 6, "loss": 1e9}
+    ) == CONTINUE
+
+
+def test_median_stopping_max_mode():
+    rule = MedianStoppingRule(
+        "acc", mode="max", grace_period=1, min_samples_required=2
+    )
+    for tid in ("a", "b", "c"):
+        rule.on_result(tid, {"training_iteration": 2, "acc": 0.9})
+    assert rule.on_result(
+        "bad", {"training_iteration": 2, "acc": 0.05}
+    ) == STOP
+    assert rule.on_result(
+        "good", {"training_iteration": 2, "acc": 0.95}
+    ) == CONTINUE
+
+
+# -- unit: hyperband ----------------------------------------------------------
+
+
+def test_hyperband_brackets_span_grace_periods():
+    hb = HyperBandScheduler("loss", mode="min", max_t=27, reduction_factor=3)
+    graces = sorted(b.grace for b in hb._brackets)
+    assert graces == [1, 3, 9, 27]  # the (r, n) trade-off ladder
+
+
+def test_hyperband_round_robin_assignment_and_decisions():
+    hb = HyperBandScheduler("loss", mode="min", max_t=9, reduction_factor=3)
+    n_brackets = len(hb._brackets)
+    tids = [f"t{i}" for i in range(2 * n_brackets)]
+    for tid in tids:
+        hb.bracket_of(tid)
+    # Round-robin: each bracket holds exactly 2 of the trials.
+    from collections import Counter
+
+    counts = Counter(hb._assignment.values())
+    assert all(c == 2 for c in counts.values())
+    # Budget exhaustion completes a trial regardless of bracket.
+    assert hb.on_result(
+        "t0", {"training_iteration": 9, "loss": 1.0}
+    ) == COMPLETE
+
+
+def test_hyperband_aggressive_bracket_stops_losers():
+    hb = HyperBandScheduler("loss", mode="min", max_t=9, reduction_factor=3)
+    # Pin 4 trials into the MOST aggressive bracket (grace=1).
+    aggressive = min(
+        range(len(hb._brackets)), key=lambda i: hb._brackets[i].grace
+    )
+    for i in range(4):
+        hb._assignment[f"t{i}"] = aggressive
+    decisions = [
+        hb.on_result(f"t{i}", {"training_iteration": 1, "loss": float(i)})
+        for i in range(4)
+    ]
+    assert STOP in decisions  # worst trials cut at the first rung
+    assert decisions[0] == CONTINUE  # best survives
+
+
+# -- e2e: tuner runs with the new pieces -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_tuner_with_grid_searcher_and_median_stopping(cluster, tmp_path):
+    # Closure, not module-level: cloudpickle must serialize by VALUE (the
+    # worker processes cannot import the tests package).
+    def trainable(config):
+        for t in range(1, 6):
+            tune.report(loss=config["width"] * 0.1 + t * 0.01)
+
+    space = {"width": tune.grid_search([1, 2, 3, 4])}
+    searcher = tune.GridSearcher(space)
+    tuner = tune.Tuner(
+        trainable,
+        param_space=space,
+        tune_config=tune.TuneConfig(
+            metric="loss",
+            mode="min",
+            num_samples=4,  # searcher budget: must cover the grid product
+            search_alg=searcher,
+            scheduler=tune.MedianStoppingRule(
+                "loss", mode="min", grace_period=2
+            ),
+            max_concurrent_trials=2,
+        ),
+        run_config=tune.RunConfig(
+            name="grid_median", storage_path=str(tmp_path)
+        ),
+    )
+    grid = tuner.fit()
+    # The grid exhausted: exactly 4 trials, each with a distinct width.
+    assert len(grid) == 4
+    widths = sorted(r.config["width"] for r in grid)
+    assert widths == [1, 2, 3, 4]
+    best = grid.get_best_result()
+    assert best.config["width"] == 1
+
+
+def test_tuner_with_hyperband(cluster, tmp_path):
+    def trainable(config):
+        for t in range(1, 6):
+            tune.report(loss=config["width"] * 0.1 + t * 0.01)
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"width": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(
+            metric="loss",
+            mode="min",
+            scheduler=tune.HyperBandScheduler(
+                "loss", mode="min", max_t=5, reduction_factor=2
+            ),
+            max_concurrent_trials=2,
+        ),
+        run_config=tune.RunConfig(
+            name="hyperband", storage_path=str(tmp_path)
+        ),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert grid.get_best_result().config["width"] == 1
