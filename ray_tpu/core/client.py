@@ -63,6 +63,11 @@ class _Session:
     def __init__(self):
         self.session_id = uuid.uuid4().hex[:12]
         self.claims: dict[str, int] = {}  # oid -> count
+        # task_id -> the proxy-side ObjectRefGenerator. Holding the OBJECT
+        # (not just the id) is load-bearing: its destructor drops the
+        # stream, so letting it GC after the submit handler would tear the
+        # stream down before the client's first pull.
+        self.streams: dict[str, Any] = {}
 
 
 class ClientServer:
@@ -98,6 +103,8 @@ class ClientServer:
             "gcs_call",
             "ref_new",
             "ref_del",
+            "stream_next",
+            "stream_drop",
         ):
             self.endpoint.register(
                 f"client.{name}", getattr(self, f"_h_{name}")
@@ -123,9 +130,17 @@ class ClientServer:
         if session is None or self._worker is None:
             return
         worker, claims = self._worker, dict(session.claims)
+        streams = dict(session.streams)
         session.claims.clear()
+        session.streams.clear()
 
         async def release_all():
+            for task_id in streams:
+                try:
+                    worker.drop_stream(task_id)
+                except Exception:
+                    pass
+            streams.clear()  # release the generator objects
             for oid, count in claims.items():
                 for _ in range(count):
                     await worker._release_local_ref(oid)
@@ -234,8 +249,50 @@ class ClientServer:
             pg=p.get("pg"),
             runtime_env=p.get("runtime_env"),
         )
+        if p["num_returns"] == "streaming":
+            return await self._register_stream(session, refs[0])
         await self._claim_refs(session, refs)
         return serialization.dumps(refs)[0]
+
+    async def _register_stream(self, session: _Session, gen):
+        """A streaming submit returned an (owner-bound) ObjectRefGenerator:
+        the PROXY worker iterates it; the client pulls item refs through
+        stream_next. The sentinel ref is claimed by the session so lineage
+        stays alive until the client releases it."""
+        sentinel = gen.completed()
+        await self._claim_refs(session, [sentinel])
+        session.streams[gen.task_id] = gen
+        return serialization.dumps(
+            {"task_id": gen.task_id, "sentinel": sentinel}
+        )[0]
+
+    async def _h_stream_next(self, conn, p):
+        """Next item ref of a session's stream (blocks until the item
+        lands or the stream ends); {"end": True} after the final item."""
+        session = self._session(conn)
+        worker = self.worker
+        task_id = p["task_id"]
+        if task_id not in session.streams:
+            raise RayTpuError(
+                f"stream {task_id[:8]} is not owned by this session"
+            )
+        ref = await self._on_worker(
+            worker, worker.stream_next_async(task_id, p["cursor"])
+        )
+        if ref is None:
+            return serialization.dumps({"end": True})[0]
+        await self._claim_refs(session, [ref])
+        return serialization.dumps({"ref": ref})[0]
+
+    async def _h_stream_drop(self, conn, p):
+        session = self._session(conn)
+        worker = self.worker
+        session.streams.pop(p["task_id"], None)
+        try:
+            worker.drop_stream(p["task_id"])
+        except Exception:
+            pass
+        return True
 
     async def _h_create_actor(self, conn, p):
         self._session(conn)
@@ -273,6 +330,8 @@ class ClientServer:
             name=p.get("name", ""),
             max_task_retries=p.get("max_task_retries", 0),
         )
+        if p["num_returns"] == "streaming":
+            return await self._register_stream(session, refs[0])
         await self._claim_refs(session, refs)
         return serialization.dumps(refs)[0]
 
@@ -481,11 +540,6 @@ class ClientWorker:
         pg=None,
         runtime_env=None,
     ) -> list:
-        if num_returns == "streaming":
-            raise NotImplementedError(
-                "streaming generators are not supported over the client "
-                "boundary yet (the generator is owner-bound)"
-            )
         if func_payload is None:
             func_payload = cloudpickle.dumps(func)
         reply = self._call(
@@ -504,7 +558,15 @@ class ClientWorker:
                 "runtime_env": runtime_env,
             },
         )
-        return self._load_reply(reply)
+        out = self._load_reply(reply)
+        if num_returns == "streaming":
+            return [self._make_client_stream(out)]
+        return out
+
+    def _make_client_stream(self, desc: dict) -> "ClientStreamGenerator":
+        return ClientStreamGenerator(
+            self, desc["task_id"], desc["sentinel"]
+        )
 
     def create_actor(self, cls, args, kwargs, **opts) -> dict:
         return self._call(
@@ -527,11 +589,6 @@ class ClientWorker:
         name: str = "",
         max_task_retries: int = 0,
     ) -> list:
-        if num_returns == "streaming":
-            raise NotImplementedError(
-                "streaming generators are not supported over the client "
-                "boundary yet (the generator is owner-bound)"
-            )
         reply = self._call(
             "client.submit_actor_task",
             {
@@ -543,7 +600,30 @@ class ClientWorker:
                 "max_task_retries": max_task_retries,
             },
         )
-        return self._load_reply(reply)
+        out = self._load_reply(reply)
+        if num_returns == "streaming":
+            return [self._make_client_stream(out)]
+        return out
+
+    def stream_next(self, task_id: str, cursor: int):
+        """Next item ref of a remote stream; None at end-of-stream.
+        Blocks server-side until the item lands (the proxy worker's
+        generator wait), so the RPC timeout is generous."""
+        reply = self._call(
+            "client.stream_next",
+            {"task_id": task_id, "cursor": cursor},
+            timeout=3600,
+        )
+        out = self._load_reply(reply)
+        if out.get("end"):
+            return None
+        return out["ref"]
+
+    def drop_stream(self, task_id: str) -> None:
+        try:
+            self._call("client.stream_drop", {"task_id": task_id}, timeout=30)
+        except Exception:
+            pass  # disconnect teardown drops it server-side anyway
 
     def get(self, refs: list, timeout: float | None = None):
         reply = self._call(
@@ -583,3 +663,64 @@ class ClientWorker:
             "client.cancel",
             {"ref": serialization.dumps(ref)[0], "force": force},
         )
+
+
+class ClientStreamGenerator:
+    """Client-side twin of :class:`ray_tpu.core.streaming.ObjectRefGenerator`
+    for remote drivers: each __next__ pulls the next item ref through the
+    client server (which iterates the owner-bound generator on the proxy
+    worker). Yields ObjectRefs; resolve them with ray_tpu.get as usual.
+    Not serializable — it belongs to this client session."""
+
+    def __init__(self, client: "ClientWorker", task_id: str, sentinel_ref):
+        self._client = client
+        self._task_id = task_id
+        self._sentinel_ref = sentinel_ref
+        self._cursor = 0
+
+    @property
+    def task_id(self) -> str:
+        return self._task_id
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ref = self._client.stream_next(self._task_id, self._cursor)
+        if ref is None:
+            raise StopIteration
+        self._cursor += 1
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        # The pull is a blocking round-trip; keep the client loop free.
+        import asyncio
+
+        try:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self.__next__
+            )
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+    def completed(self):
+        """Sentinel ref: resolves when the stream finished (raises the
+        task's error on failure); also what cancel() targets."""
+        return self._sentinel_ref
+
+    def __reduce__(self):
+        raise TypeError(
+            "ClientStreamGenerator is not serializable: it belongs to the "
+            "client session that created it"
+        )
+
+    def __del__(self):
+        client, task_id = self._client, self._task_id
+        if client is not None:
+            try:
+                client.drop_stream(task_id)
+            except Exception:
+                pass

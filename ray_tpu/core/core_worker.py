@@ -36,6 +36,7 @@ from ray_tpu.core.errors import (
     ActorDiedError,
     GetTimeoutError,
     ObjectLostError,
+    RayTpuError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,

@@ -96,6 +96,51 @@ __all__ = [
     "range",
     "read_csv",
     "read_datasource",
+    "range_tensor",
+    "read_binary_files",
+    "read_images",
     "read_json",
+    "read_tfrecords",
     "read_parquet",
 ]
+
+
+def read_binary_files(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """Whole files as {"bytes", "path"} rows (reference:
+    ray.data.read_binary_files)."""
+    from ray_tpu.data.datasource import BinaryDatasource
+
+    return _from_source(BinaryDatasource(paths, **kwargs), parallelism)
+
+
+def read_images(
+    paths, *, size=None, mode="RGB", parallelism: int = -1, **kwargs
+) -> Dataset:
+    """Decoded images as {"image": [H, W, C], "path"} rows (reference:
+    ray.data.read_images)."""
+    from ray_tpu.data.datasource import ImageDatasource
+
+    return _from_source(
+        ImageDatasource(paths, size=size, mode=mode, **kwargs), parallelism
+    )
+
+
+def read_tfrecords(
+    paths, *, verify_crc: bool = False, parallelism: int = -1, **kwargs
+) -> Dataset:
+    """TFRecord files as raw-bytes {"data"} rows; decode with map_batches
+    (reference: ray.data.read_tfrecords)."""
+    from ray_tpu.data.datasource import TFRecordDatasource
+
+    return _from_source(
+        TFRecordDatasource(paths, verify_crc=verify_crc, **kwargs),
+        parallelism,
+    )
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    """{"data": ndarray(shape)} rows valued by row id (reference:
+    ray.data.range_tensor)."""
+    from ray_tpu.data.datasource import RangeTensorDatasource
+
+    return _from_source(RangeTensorDatasource(n, shape), parallelism)

@@ -87,11 +87,42 @@ class BlockAccessor:
         )
 
     def iter_rows(self) -> Iterator[dict]:
+        # Tensor columns (FixedSizeList + tensor_shape metadata) must come
+        # back as shaped ndarrays per row, not nested python lists — the
+        # reference's tensor extension behaves the same in iter_rows.
+        tensor_shapes = {}
+        for i, name in enumerate(self._block.column_names):
+            meta = self._block.schema.field(i).metadata or {}
+            shape_repr = meta.get(b"tensor_shape")
+            if shape_repr is not None:
+                import ast
+
+                tensor_shapes[name] = ast.literal_eval(shape_repr.decode())
         for batch in self._block.to_batches():
-            cols = batch.to_pydict()
-            names = list(cols)
+            names = list(batch.column_names)
+            # Only NON-tensor columns go through python lists; tensor
+            # columns stay ndarrays end-to-end (to_pydict would box every
+            # pixel into a python int just to throw it away).
+            cols = {
+                n: batch.column(n).to_pylist()
+                for n in names
+                if n not in tensor_shapes
+            }
+            tensor_cols = {
+                n: _arrow_to_numpy(batch.column(n)).reshape(
+                    (batch.num_rows,) + tuple(tensor_shapes[n])
+                )
+                for n in names
+                if n in tensor_shapes
+            }
             for i in range(batch.num_rows):
-                yield {n: cols[n][i] for n in names}
+                row = {}
+                for n in names:
+                    if n in tensor_cols:
+                        row[n] = tensor_cols[n][i]
+                    else:
+                        row[n] = cols[n][i]
+                yield row
 
     def to_pandas(self):
         return self._block.to_pandas()

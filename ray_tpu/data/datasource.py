@@ -193,3 +193,189 @@ class NumpyDatasource(Datasource):
             )
             for a in self._arrays
         ]
+
+
+class BinaryDatasource(FileDatasource):
+    """Whole files as rows: {"bytes": ..., "path": ...} (reference:
+    ray.data.read_binary_files)."""
+
+    suffixes = ("",)
+
+    def __init__(self, paths, **kw):
+        super().__init__(paths, **kw)
+        # The empty suffix globs '*' in directories, which matches
+        # subdirectories too — only regular files are readable rows.
+        self._files = [p for p in self._files if os.path.isfile(p)]
+        if not self._files:
+            raise FileNotFoundError(f"no regular files found for {paths}")
+
+    def read_file(self, path: str) -> Block:
+        from ray_tpu.data.block import BlockAccessor
+
+        with open(path, "rb") as f:
+            data = f.read()
+        return BlockAccessor.batch_to_block(
+            {"bytes": [data], "path": [path]}
+        )
+
+
+class ImageDatasource(FileDatasource):
+    """Images decoded to ndarray rows: {"image": [H, W, C] uint8, "path"}
+    (reference: ray.data.read_images). ``size=(H, W)`` resizes; ``mode``
+    converts (e.g. "RGB", "L")."""
+
+    suffixes = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+    def __init__(self, paths, size=None, mode="RGB", **kw):
+        super().__init__(paths, **kw)
+        self._size = tuple(size) if size else None
+        self._mode = mode
+
+    def read_file(self, path: str) -> Block:
+        import numpy as _np
+        from PIL import Image
+
+        from ray_tpu.data.block import BlockAccessor
+
+        with Image.open(path) as im:
+            if self._mode:
+                im = im.convert(self._mode)
+            if self._size:
+                # PIL takes (W, H); the API takes (H, W) like the reference.
+                im = im.resize((self._size[1], self._size[0]))
+            arr = _np.asarray(im)
+        return BlockAccessor.batch_to_block(
+            {"image": arr[None], "path": [path]}
+        )
+
+
+# -- TFRecord -----------------------------------------------------------------
+# Wire format (TensorFlow's record IO): per record
+#   uint64 length | uint32 masked_crc32c(length) | bytes data |
+#   uint32 masked_crc32c(data)
+# CRC32C in pure python (small table; the files here are test/ingest scale —
+# a native crc is an optimization, not a dependency worth adding).
+
+_CRC32C_POLY = 0x82F63B78
+_CRC32C_TABLE = []
+for _n in range(256):
+    _c = _n
+    for _ in range(8):
+        _c = (_c >> 1) ^ _CRC32C_POLY if _c & 1 else _c >> 1
+    _CRC32C_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def write_tfrecords(records, path: str) -> int:
+    """Write an iterable of bytes records as one TFRecord file; returns the
+    record count. (Counterpart of TFRecordDatasource; interoperable with
+    TensorFlow readers — masked crc32c included.)"""
+    import struct
+
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            if not isinstance(rec, (bytes, bytearray)):
+                raise TypeError(
+                    f"tfrecord records must be bytes, got {type(rec)}"
+                )
+            length = struct.pack("<Q", len(rec))
+            f.write(length)
+            f.write(struct.pack("<I", _masked_crc(length)))
+            f.write(rec)
+            f.write(struct.pack("<I", _masked_crc(bytes(rec))))
+            n += 1
+    return n
+
+
+class TFRecordDatasource(FileDatasource):
+    """TFRecord files as raw-bytes rows {"data": ...} (reference:
+    ray.data.read_tfrecords; that parses tf.train.Example — here records
+    stay opaque bytes and ``map_batches`` applies the user's decoder,
+    which is the TPU-native shape anyway: decode on the host CPU workers,
+    feed arrays to the chips). ``verify_crc=True`` checks record CRCs."""
+
+    suffixes = (".tfrecord", ".tfrecords")
+
+    def __init__(self, paths, verify_crc: bool = False, **kw):
+        super().__init__(paths, **kw)
+        self._verify = verify_crc
+
+    def read_file(self, path: str) -> Block:
+        import struct
+
+        from ray_tpu.data.block import BlockAccessor
+
+        records = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if not header:
+                    break
+                if len(header) < 8:
+                    raise ValueError(f"{path}: truncated record length")
+                (length,) = struct.unpack("<Q", header)
+                len_crc_raw = f.read(4)
+                if len(len_crc_raw) < 4:
+                    raise ValueError(f"{path}: truncated length crc")
+                (len_crc,) = struct.unpack("<I", len_crc_raw)
+                data = f.read(length)
+                if len(data) < length:
+                    raise ValueError(f"{path}: truncated record body")
+                data_crc_raw = f.read(4)
+                if len(data_crc_raw) < 4:
+                    raise ValueError(f"{path}: truncated data crc")
+                (data_crc,) = struct.unpack("<I", data_crc_raw)
+                if self._verify:
+                    if _masked_crc(header) != len_crc:
+                        raise ValueError(f"{path}: length crc mismatch")
+                    if _masked_crc(data) != data_crc:
+                        raise ValueError(f"{path}: data crc mismatch")
+                records.append(data)
+        return BlockAccessor.batch_to_block({"data": records})
+
+
+class RangeTensorDatasource(Datasource):
+    """{"data": ndarray of ``shape``} rows, id-valued — the quick way to
+    synthesize tensor datasets at any scale (reference:
+    ray.data.range_tensor)."""
+
+    def __init__(self, n: int, shape: tuple = (1,)):
+        self._n = int(n)
+        self._shape = tuple(shape)
+
+    def estimated_num_rows(self):
+        return self._n
+
+    def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
+        from ray_tpu.data.block import BlockAccessor
+
+        if self._n <= 0:
+            return []
+        parallelism = max(1, min(parallelism, self._n))
+        step = -(-self._n // parallelism)
+        tasks = []
+        for start in range(0, self._n, step):
+            end = min(start + step, self._n)
+
+            def make(start=start, end=end, shape=self._shape):
+                ids = np.arange(start, end, dtype=np.int64)
+                block = np.broadcast_to(
+                    ids.reshape((-1,) + (1,) * len(shape)),
+                    (end - start,) + shape,
+                ).copy()
+                return BlockAccessor.batch_to_block({"data": block})
+
+            tasks.append(ReadTask(make, num_rows=end - start))
+        return tasks
