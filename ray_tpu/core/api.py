@@ -699,7 +699,11 @@ def cancel(ref, *, force: bool = False) -> None:
     already-finished task is a no-op; actor tasks are not cancellable (kill
     the actor instead). An ``ObjectRefGenerator`` may be passed to cancel
     its streaming task mid-stream."""
-    if isinstance(ref, ObjectRefGenerator):
+    if isinstance(ref, ObjectRefGenerator) or (
+        # Client-mode streams are a different class (ClientStreamGenerator)
+        # but carry the same contract: completed() is the cancel target.
+        not isinstance(ref, ObjectRef) and hasattr(ref, "completed")
+    ):
         ref = ref.completed()
     _require_worker().cancel(ref, force=force)
 

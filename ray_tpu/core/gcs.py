@@ -72,6 +72,12 @@ class ActorRecord:
 class GcsServer:
     def __init__(self, session_id: str, storage_path: str | None = None):
         from ray_tpu.core.gcs_store import make_store
+        from ray_tpu.util.events import EventRecorder
+
+        # Structured definition/lifecycle events (reference:
+        # ray_event_recorder.h + dashboard aggregator); export path via
+        # RAY_TPU_EVENT_EXPORT_PATH.
+        self.events = EventRecorder(source="gcs")
 
         # Durable metadata storage (reference: gcs_table_storage.h over
         # store_client/; RedisStoreClient:126 is the FT path). With a
@@ -283,6 +289,12 @@ class GcsServer:
         }
         self.node_last_seen[p["node_id"]] = time.monotonic()
         self._bump_node_version(p["node_id"])
+        self.events.record(
+            "NODE", "DEFINITION", p["node_id"],
+            {"labels": dict(p.get("labels", {})),
+             "resources": dict(p["resources"])},
+        )
+        self.events.record("NODE", "LIFECYCLE", p["node_id"], {"state": ALIVE})
         await self._publish("nodes", {"node_id": p["node_id"], "state": ALIVE})
         await self._retry_pending_actors()
         await self._retry_pending_pgs()
@@ -389,7 +401,10 @@ class GcsServer:
     async def _mark_node_dead(self, node_id: str, reason: str):
         view = self.nodes.get(node_id)
         if view is None or not view.alive:
-            return
+            return  # unknown/already-dead: no duplicate DEAD event either
+        self.events.record(
+            "NODE", "LIFECYCLE", node_id, {"state": DEAD, "reason": reason}
+        )
         view.alive = False
         view.available = {}
         self.node_metrics.pop(node_id, None)
@@ -425,6 +440,11 @@ class GcsServer:
             self.named_actors[rec.name] = rec.actor_id
         self.actors[rec.actor_id] = rec
         self._save_actor(rec)
+        self.events.record(
+            "ACTOR", "DEFINITION", rec.actor_id,
+            {"name": rec.name or "",
+             "class": str(spec.get("class_name", ""))},
+        )
         await self._schedule_actor(rec)
         return self._actor_info(rec)
 
@@ -469,6 +489,10 @@ class GcsServer:
         rec.addr = tuple(reply["worker_addr"])
         rec.worker_id = reply["worker_id"]
         rec.state = ALIVE
+        self.events.record(
+            "ACTOR", "LIFECYCLE", rec.actor_id,
+            {"state": ALIVE, "node_id": rec.node_id},
+        )
         self._wake(rec)
         await self._publish("actors", self._actor_info(rec))
 
@@ -497,12 +521,21 @@ class GcsServer:
         ):
             rec.restarts += 1
             rec.state = RESTARTING
+            self.events.record(
+                "ACTOR", "LIFECYCLE", rec.actor_id,
+                {"state": RESTARTING, "restarts": rec.restarts,
+                 "reason": reason},
+            )
             rec.addr = None
             await self._publish("actors", self._actor_info(rec))
             await self._schedule_actor(rec)
         else:
             rec.state = DEAD
             rec.error = reason
+            self.events.record(
+                "ACTOR", "LIFECYCLE", rec.actor_id,
+                {"state": DEAD, "reason": reason},
+            )
             rec.addr = None
             self._wake(rec)
             await self._publish("actors", self._actor_info(rec))
@@ -561,6 +594,10 @@ class GcsServer:
         if rec.killed:
             rec.state = DEAD
             rec.error = "killed via ray_tpu.kill"
+            self.events.record(
+                "ACTOR", "LIFECYCLE", rec.actor_id,
+                {"state": DEAD, "reason": "killed"},
+            )
             if rec.name:
                 self.named_actors.pop(rec.name, None)
             self._wake(rec)
@@ -655,6 +692,26 @@ class GcsServer:
         snaps = [s for lst in self.node_metrics.values() for s in lst]
         return snaps
 
+    # -- structured events (reference: ray_event_recorder.h + aggregator) ----
+
+    async def _h_record_event(self, conn, p):
+        """External components (job manager, serve) record through this."""
+        self.events.record(
+            p["entity_kind"], p["event_type"], p["entity_id"],
+            p.get("attrs"),
+        )
+        return True
+
+    async def _h_list_events(self, conn, p):
+        return self.events.list_events(
+            kind=p.get("kind"),
+            entity_id=p.get("entity_id"),
+            limit=int(p.get("limit", 1000)),
+        )
+
+    async def _h_event_stats(self, conn, p):
+        return self.events.stats()
+
     def _resolve_actor(self, p) -> Optional[ActorRecord]:
         if p.get("actor_id"):
             return self.actors.get(p["actor_id"])
@@ -681,6 +738,11 @@ class GcsServer:
             if rec.name in self.named_pgs:
                 raise ValueError(f"placement group name {rec.name!r} taken")
             self.named_pgs[rec.name] = rec.pg_id
+        self.events.record(
+            "PLACEMENT_GROUP", "DEFINITION", rec.pg_id,
+            {"name": rec.name or "", "strategy": rec.strategy,
+             "bundles": len(rec.bundles)},
+        )
         self.pgs[rec.pg_id] = rec
         await self._schedule_pg(rec)
         return self._pg_info(rec)
@@ -784,6 +846,10 @@ class GcsServer:
         idxs = [i for i, n in enumerate(rec.bundle_nodes) if n is None]
         if not idxs:
             rec.state = PG_CREATED
+            self.events.record(
+                "PLACEMENT_GROUP", "LIFECYCLE", rec.pg_id,
+                {"state": PG_CREATED},
+            )
             self._wake(rec)
             return
         placement = self._place_bundles(rec, idxs)
@@ -876,6 +942,10 @@ class GcsServer:
             return
         if all(n is not None for n in rec.bundle_nodes):
             rec.state = PG_CREATED
+            self.events.record(
+                "PLACEMENT_GROUP", "LIFECYCLE", rec.pg_id,
+                {"state": PG_CREATED},
+            )
             self._wake(rec)
         elif rec.pg_id not in self.pending_pgs:
             self.pending_pgs.append(rec.pg_id)
@@ -918,6 +988,9 @@ class GcsServer:
         if rec is None or rec.state == PG_REMOVED:
             return False
         rec.state = PG_REMOVED
+        self.events.record(
+            "PLACEMENT_GROUP", "LIFECYCLE", rec.pg_id, {"state": PG_REMOVED}
+        )
         if rec.name:
             self.named_pgs.pop(rec.name, None)
         if rec.pg_id in self.pending_pgs:

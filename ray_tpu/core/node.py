@@ -1343,6 +1343,32 @@ class NodeManager:
                 )
         return out
 
+    async def _h_read_worker_log(self, conn, p):
+        """Tail of one worker's captured stdout/stderr file (dashboard log
+        viewing; reference: dashboard log module serving session-dir
+        files). Returns None when logs are inherited or the worker never
+        wrote."""
+        stream = p.get("stream", "out")
+        if stream not in ("out", "err"):
+            raise ValueError(f"stream must be 'out' or 'err', got {stream!r}")
+        if self.log_dir is None:
+            return None
+        path = os.path.join(
+            self.log_dir, f"worker-{p['worker_id'][:12]}.{stream}"
+        )
+        if not os.path.exists(path):
+            return None
+        tail = min(int(p.get("tail_bytes", 65536)), 4 * 1024 * 1024)
+
+        def read():
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail))
+                return f.read().decode("utf-8", errors="replace")
+
+        return await asyncio.get_running_loop().run_in_executor(None, read)
+
     async def _h_get_info(self, conn, p):
         return {
             "node_id": self.node_id,

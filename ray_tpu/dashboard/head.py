@@ -182,6 +182,62 @@ class DashboardHead:
             }
             if path in simple:
                 return _jsonable(simple[path]())
+            if path == "/api/logs":
+                # Tail one worker's captured stdout/stderr from its node
+                # (reference: dashboard log module).
+                from ray_tpu.core import api as core_api
+                from ray_tpu.util.state import api as state_api
+
+                worker_id = query.get("worker_id", "")
+                if not worker_id:
+                    # '' would prefix-match the first listed worker and
+                    # serve an arbitrary log with a 200.
+                    return {"error": "worker_id query param required"}
+                target_node = None
+                for w in state_api.list_workers():
+                    if w.get("worker_id", "").startswith(worker_id):
+                        target_node = w["node_id"]
+                        worker_id = w["worker_id"]
+                        break
+                if target_node is None:
+                    return {"error": f"unknown worker {worker_id!r}"}
+                worker = core_api._require_worker()
+                for n in state_api.list_nodes():
+                    if n["NodeID"] == target_node:
+                        text = worker.endpoint.call(
+                            tuple(n["Address"]),
+                            "node.read_worker_log",
+                            {
+                                "worker_id": worker_id,
+                                "stream": query.get("stream", "out"),
+                                "tail_bytes": int(
+                                    query.get("tail", 65536)
+                                ),
+                            },
+                            timeout=30,
+                        )
+                        return {
+                            "worker_id": worker_id,
+                            "stream": query.get("stream", "out"),
+                            "text": text or "",
+                        }
+                return {"error": f"node {target_node!r} not found"}
+            if path == "/api/events":
+                # Structured definition/lifecycle events (the aggregator
+                # role; reference: dashboard modules/aggregator).
+                from ray_tpu.core import api as core_api
+
+                worker = core_api._require_worker()
+                return _jsonable(
+                    worker.gcs.call(
+                        "list_events",
+                        {
+                            "kind": query.get("kind"),
+                            "entity_id": query.get("entity_id"),
+                            "limit": int(query.get("limit", 1000)),
+                        },
+                    )
+                )
         if path in (
             "/api/profile",
             "/api/profile/dump",

@@ -5,8 +5,9 @@ frontend (python/ray/dashboard/client); this framework serves ONE
 dependency-free page (inline CSS/JS, fetch() against /api/*) — a cluster
 overview that needs no build toolchain, no node_modules, and works from
 curl'd-up clusters. Panels: nodes (resources/liveness), actors, task
-summary, jobs, placement groups, workers (with one-click profile links),
-auto-refreshing.
+summary WITH drill-down to per-task rows, jobs, placement groups,
+workers (one-click profile + log links with an inline viewer), recent
+lifecycle events, auto-refreshing.
 """
 
 PAGE = """<!DOCTYPE html>
@@ -32,11 +33,21 @@ PAGE = """<!DOCTYPE html>
     <span id="refreshed" class="muted"></span></h1>
 <h2>Resources</h2><div id="resources"></div>
 <h2>Nodes</h2><table id="nodes"></table>
-<h2>Task summary</h2><table id="tasks"></table>
+<h2>Task summary
+  <a href="#" id="tasktoggle" class="muted">[show tasks]</a></h2>
+<table id="tasks"></table>
+<table id="taskrows" style="display:none"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Workers</h2><table id="workers"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
+<h2>Recent events</h2><table id="events"></table>
+<div id="logview" style="display:none">
+  <h2>Log: <span id="logtitle"></span>
+    <a href="#" id="logclose" class="muted">[close]</a></h2>
+  <pre id="logtext" style="background:#161b22;padding:.6rem;
+       max-height:28rem;overflow:auto;white-space:pre-wrap"></pre>
+</div>
 <script>
 async function j(path) {
   // One failing endpoint must not abort the whole refresh tick.
@@ -91,13 +102,23 @@ async function refresh() {
       esc(a.state || ""), esc((a.node_id || "").slice(0, 12)),
       esc(a.restarts ?? 0)])).join("");
   const wt = document.getElementById("workers");
-  wt.innerHTML = row(["worker", "node", "state", "pid", "profile"], "th") +
+  wt.innerHTML = row(
+      ["worker", "node", "state", "pid", "profile", "logs"], "th") +
     (workers || []).filter(w => w.worker_id).map(w => row([
       esc(w.worker_id.slice(0, 12)), esc((w.node_id || "").slice(0, 12)),
       esc(w.state || ""), esc(w.pid ?? ""),
       `<a href="/api/profile?worker_id=${encodeURIComponent(w.worker_id)}&duration=2">cpu</a> ` +
-      `<a href="/api/profile/dump?worker_id=${encodeURIComponent(w.worker_id)}">stacks</a>`
+      `<a href="/api/profile/dump?worker_id=${encodeURIComponent(w.worker_id)}">stacks</a>`,
+      `<a href="#" onclick="return showLog('${esc(w.worker_id)}','out')">out</a> ` +
+      `<a href="#" onclick="return showLog('${esc(w.worker_id)}','err')">err</a>`
       ])).join("");
+  const et = document.getElementById("events");
+  const evs = await j("/api/events?limit=30");
+  et.innerHTML = row(["time", "kind", "entity", "attrs"], "th") +
+    (evs || []).slice().reverse().map(e => row([
+      esc(new Date(e.timestamp * 1000).toLocaleTimeString()),
+      esc(e.kind), esc((e.entity_id || "").slice(0, 12)),
+      esc(JSON.stringify(e.attrs || {}))])).join("");
   const jt = document.getElementById("jobs");
   jt.innerHTML = row(["job", "status", "entrypoint"], "th") +
     (jobs || []).map(x => row([
@@ -109,6 +130,37 @@ async function refresh() {
       esc((p.pg_id || "").slice(0, 12)), esc(p.state || ""),
       esc(JSON.stringify(p.bundles || []))])).join("");
 }
+async function showLog(workerId, stream) {
+  const out = await j(`/api/logs?worker_id=${encodeURIComponent(workerId)}` +
+                      `&stream=${stream}&tail=65536`);
+  document.getElementById("logview").style.display = "";
+  document.getElementById("logtitle").textContent =
+    `${workerId.slice(0, 12)} (${stream})`;
+  document.getElementById("logtext").textContent =
+    out && out.text ? out.text : (out && out.error) || "(empty)";
+  document.getElementById("logview").scrollIntoView();
+  return false;
+}
+document.getElementById("logclose").onclick = () => {
+  document.getElementById("logview").style.display = "none"; return false;
+};
+let showTasks = false;
+document.getElementById("tasktoggle").onclick = async () => {
+  showTasks = !showTasks;
+  const tr = document.getElementById("taskrows");
+  document.getElementById("tasktoggle").textContent =
+    showTasks ? "[hide tasks]" : "[show tasks]";
+  tr.style.display = showTasks ? "" : "none";
+  if (showTasks) {
+    const rows = await j("/api/tasks");
+    tr.innerHTML = row(["task", "name", "state", "node", "worker"], "th") +
+      (rows || []).slice(-200).reverse().map(t => row([
+        esc((t.task_id || "").slice(0, 12)), esc(t.name || ""),
+        esc(t.state || ""), esc((t.node_id || "").slice(0, 12)),
+        esc((t.worker_id || "").slice(0, 12))])).join("");
+  }
+  return false;
+};
 refresh();
 setInterval(refresh, 5000);
 </script>

@@ -179,6 +179,7 @@ class JobManager:
             start_time=time.time(),
         )
         self._worker.gcs.kv_put(job_id, info.to_json(), ns=_KV_NS)
+        self._record_event(job_id, "DEFINITION", {"entrypoint": entrypoint})
         sup = (
             self._ray.remote(JobSupervisor)
             .options(name=_supervisor_name(job_id), num_cpus=0)
@@ -191,7 +192,24 @@ class JobManager:
         )
         info.status = JobStatus.RUNNING if ok else JobStatus.FAILED
         self._worker.gcs.kv_put(job_id, info.to_json(), ns=_KV_NS)
+        self._record_event(job_id, "LIFECYCLE", {"state": info.status})
         return job_id
+
+    def _record_event(self, job_id: str, event_type: str, attrs: dict):
+        """Structured job events into the GCS recorder (reference:
+        job definition/lifecycle events in ray_event_recorder.h)."""
+        try:
+            self._worker.gcs.call(
+                "record_event",
+                {
+                    "entity_kind": "JOB",
+                    "event_type": event_type,
+                    "entity_id": job_id,
+                    "attrs": attrs,
+                },
+            )
+        except Exception:
+            pass  # events are best-effort observability
 
     # -- queries -------------------------------------------------------------
     def _refresh(self, info: JobInfo) -> JobInfo:
@@ -205,10 +223,15 @@ class JobManager:
             info.message = "supervisor actor died"
             self._worker.gcs.kv_put(info.job_id, info.to_json(), ns=_KV_NS)
             return info
+        prev = info.status
         info.status = st["status"]
         info.message = st["message"]
         info.end_time = st["end_time"]
         self._worker.gcs.kv_put(info.job_id, info.to_json(), ns=_KV_NS)
+        if info.status != prev:
+            self._record_event(
+                info.job_id, "LIFECYCLE", {"state": info.status}
+            )
         return info
 
     def get_job_info(self, job_id: str) -> JobInfo:

@@ -1,0 +1,130 @@
+"""Structured cluster events: definition + lifecycle records with export.
+
+Reference parity: src/ray/observability/ray_event_recorder.h (typed
+definition/lifecycle events for actors/jobs/nodes/tasks) + the dashboard
+aggregator module (python/ray/dashboard/modules/aggregator/) that ships
+them to an external pipeline. Redesign: one in-process recorder owned by
+the GCS; every record carries
+
+    {event_id, timestamp, source, kind, entity_id, attrs}
+
+with kind in {NODE, ACTOR, JOB, PLACEMENT_GROUP} x {DEFINITION, LIFECYCLE}.
+Sinks: a bounded in-memory ring (the dashboard /api/events route reads it)
+and an optional JSON-lines file (`RAY_TPU_EVENT_EXPORT_PATH`) an external
+collector can tail — the aggregator-pipeline role without inventing a
+wire protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+DEFINITION = "DEFINITION"
+LIFECYCLE = "LIFECYCLE"
+
+
+class EventRecorder:
+    """Bounded recorder + optional file export. Thread-safe (the GCS loop
+    records; dashboard reads may come from any thread)."""
+
+    def __init__(
+        self,
+        source: str = "gcs",
+        capacity: int = 10_000,
+        export_path: Optional[str] = None,
+    ):
+        self._source = source
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._export_path = export_path or os.environ.get(
+            "RAY_TPU_EVENT_EXPORT_PATH"
+        )
+        self._export_file = None
+        self._dropped = 0
+
+    def record(
+        self,
+        entity_kind: str,  # NODE | ACTOR | JOB | PLACEMENT_GROUP
+        event_type: str,  # DEFINITION | LIFECYCLE
+        entity_id: str,
+        attrs: dict | None = None,
+    ) -> dict:
+        ev = {
+            "event_id": uuid.uuid4().hex[:16],
+            "timestamp": time.time(),
+            "source": self._source,
+            "kind": f"{entity_kind}_{event_type}",
+            "entity_id": entity_id,
+            "attrs": dict(attrs or {}),
+        }
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
+        # File export OUTSIDE the ring lock (a slow filesystem must not
+        # block readers) and under its own lock for line atomicity. The
+        # recorder's callers run on the GCS loop; the write is small and
+        # line-buffered, but a genuinely slow sink should point
+        # RAY_TPU_EVENT_EXPORT_PATH at local disk and tail from there.
+        with self._io_lock:
+            self._export(ev)
+        return ev
+
+    def _export(self, ev: dict) -> None:
+        if not self._export_path:
+            return
+        try:
+            if self._export_file is None:
+                self._export_file = open(self._export_path, "a")
+            json.dump(ev, self._export_file, default=str)
+            self._export_file.write("\n")
+            self._export_file.flush()
+        except Exception:
+            # Export is best-effort; the ring buffer is the source of
+            # truth for the dashboard. Drop the file handle so a later
+            # event retries the open (rotated/remounted path).
+            try:
+                if self._export_file is not None:
+                    self._export_file.close()
+            except Exception:
+                pass
+            self._export_file = None
+
+    def list_events(
+        self,
+        *,
+        kind: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        limit: int = 1000,
+    ) -> list[dict]:
+        with self._lock:
+            out = list(self._events)
+        if kind:
+            out = [e for e in out if e["kind"].startswith(kind)]
+        if entity_id:
+            out = [e for e in out if e["entity_id"] == entity_id]
+        return out[-limit:]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "buffered": len(self._events),
+                "dropped": self._dropped,
+                "export_path": self._export_path,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._export_file is not None:
+                try:
+                    self._export_file.close()
+                except Exception:
+                    pass
+                self._export_file = None
