@@ -490,12 +490,19 @@ class NodeManager:
             return subprocess.DEVNULL if stream == "out" and os.environ.get(
                 "RAY_TPU_SILENCE_WORKERS"
             ) else None
+        path = self._worker_log_path(worker_id, stream)
+        if path is None:
+            return None
+        return open(path, "ab", buffering=0)
+
+    def _worker_log_path(self, worker_id: str, stream: str) -> "str | None":
+        """THE naming convention for captured worker streams — shared by
+        the write side (_worker_log_file) and the dashboard read RPC."""
         if self.log_dir is None:
             return None
-        path = os.path.join(
+        return os.path.join(
             self.log_dir, f"worker-{worker_id[:12]}.{stream}"
         )
-        return open(path, "ab", buffering=0)
 
     def _worker_cap(self) -> int:
         cap = GLOBAL_CONFIG.max_worker_processes
@@ -1351,12 +1358,8 @@ class NodeManager:
         stream = p.get("stream", "out")
         if stream not in ("out", "err"):
             raise ValueError(f"stream must be 'out' or 'err', got {stream!r}")
-        if self.log_dir is None:
-            return None
-        path = os.path.join(
-            self.log_dir, f"worker-{p['worker_id'][:12]}.{stream}"
-        )
-        if not os.path.exists(path):
+        path = self._worker_log_path(p["worker_id"], stream)
+        if path is None or not os.path.exists(path):
             return None
         tail = min(int(p.get("tail_bytes", 65536)), 4 * 1024 * 1024)
 

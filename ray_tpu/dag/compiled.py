@@ -95,7 +95,13 @@ class DAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, *, buffer_size: int = 1 << 20):
+    def __init__(
+        self,
+        root: DAGNode,
+        *,
+        buffer_size: int = 1 << 20,
+        device_transfers: bool = False,
+    ):
         import ray_tpu
         from ray_tpu.core import api as core_api
         from ray_tpu.dag.channel import RpcChannel, open_channel
@@ -166,6 +172,12 @@ class CompiledDAG:
                 spec = RpcChannel.make_spec(
                     consumer_loc[1], capacity=self.buffer_size
                 )
+            if device_transfers:
+                # Device-tensor edges: jax.Arrays move device-to-device
+                # over the transfer fabric; the spec above becomes the
+                # control channel carrying tiny descriptors (reference:
+                # torch_tensor_accelerator_channel.py:49).
+                spec = {"kind": "device", "ctrl": spec}
             self._chans[key] = spec
             return spec
 

@@ -109,8 +109,8 @@ async function refresh() {
       esc(w.state || ""), esc(w.pid ?? ""),
       `<a href="/api/profile?worker_id=${encodeURIComponent(w.worker_id)}&duration=2">cpu</a> ` +
       `<a href="/api/profile/dump?worker_id=${encodeURIComponent(w.worker_id)}">stacks</a>`,
-      `<a href="#" onclick="return showLog('${esc(w.worker_id)}','out')">out</a> ` +
-      `<a href="#" onclick="return showLog('${esc(w.worker_id)}','err')">err</a>`
+      `<a href="#" onclick="showLog('${esc(w.worker_id)}','out');return false">out</a> ` +
+      `<a href="#" onclick="showLog('${esc(w.worker_id)}','err');return false">err</a>`
       ])).join("");
   const et = document.getElementById("events");
   const evs = await j("/api/events?limit=30");
@@ -145,7 +145,12 @@ document.getElementById("logclose").onclick = () => {
   document.getElementById("logview").style.display = "none"; return false;
 };
 let showTasks = false;
-document.getElementById("tasktoggle").onclick = async () => {
+document.getElementById("tasktoggle").onclick = (ev) => {
+  ev.preventDefault();
+  toggleTasks();
+  return false;
+};
+async function toggleTasks() {
   showTasks = !showTasks;
   const tr = document.getElementById("taskrows");
   document.getElementById("tasktoggle").textContent =

@@ -155,12 +155,14 @@ class _Fabric:
             self._stats["arms"] += 1
         # A TTL-evicted entry's fetch budget was consumed at arm time and
         # its pull can no longer land; refund it so the object is not lost
-        # (every other failure path refunds the same way).
+        # (every other failure path refunds the same way). oid None =
+        # channel-owned arm (DeviceChannel): no store entry to refund.
         if evicted:
             from ray_tpu.experimental.device_objects import store
 
             for ev_oid, ev_staged, _t in evicted:
-                store().restore_arm(ev_oid, ev_staged)
+                if ev_oid is not None:
+                    store().restore_arm(ev_oid, ev_staged)
         return {
             "uuid": uid,
             "address": self.address(),
