@@ -44,6 +44,11 @@ class AlgorithmConfig:
     learner_resources: dict | None = None
     env_runner_resources: dict | None = None
     collective_backend: str = "cpu"
+    # Connector pipeline FACTORIES (zero-arg callables returning lists of
+    # ray_tpu.rllib.connectors.Connector): factories because each runner
+    # must own its own stateful instances (reference: rllib/connectors/).
+    env_to_module: Callable | None = None
+    module_to_env: Callable | None = None
 
     # -- fluent helpers -----------------------------------------------------
     def environment(self, env) -> "AlgorithmConfig":
@@ -139,6 +144,8 @@ class Algorithm:
             lambda_=config.lambda_,
             seed=config.seed,
             worker_index=i,
+            env_to_module=config.env_to_module,
+            module_to_env=config.module_to_env,
         )
 
     # -- overridables -------------------------------------------------------
@@ -213,14 +220,33 @@ class Algorithm:
     def apply_extra_state(self, state: dict) -> None:
         pass
 
+    def _connector_state(self) -> "dict | None":
+        """Runner 0's connector state (stateful connectors like obs
+        normalizers; stats differ slightly per runner — rank 0's are the
+        canonical checkpoint copy, as with every other replicated stat)."""
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(
+                self.env_runners[0].get_connector_state.remote(), timeout=30
+            )
+        except Exception:
+            return None
+
     def save(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
         state = {
+            "connectors": self._connector_state(),
             "learner": self.learner_group.get_state(),
             "iteration": self.iteration,
             "total_env_steps": self._total_env_steps,
             "config": dataclasses.asdict(
-                dataclasses.replace(self.config, env=None)
+                dataclasses.replace(
+                    self.config,
+                    env=None,
+                    env_to_module=None,
+                    module_to_env=None,
+                )
             ),
             "extra": self.extra_state(),
         }
@@ -235,6 +261,16 @@ class Algorithm:
         self.iteration = state["iteration"]
         self._total_env_steps = state["total_env_steps"]
         self.apply_extra_state(state.get("extra") or {})
+        connectors = state.get("connectors")
+        if connectors:
+            import ray_tpu
+
+            ray_tpu.get(
+                [
+                    r.set_connector_state.remote(connectors)
+                    for r in self.env_runners
+                ]
+            )
         self._sync_weights()
 
     def stop(self) -> None:

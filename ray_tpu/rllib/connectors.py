@@ -1,0 +1,156 @@
+"""Connector pipelines: env <-> module transformations.
+
+Reference parity: rllib/connectors/ (env-to-module and module-to-env
+connector pipelines — the reference's abstraction between raw environment
+arrays and RLModule tensors). Redesign for this runtime: a connector is a
+small stateful callable over numpy batches; EnvRunners apply the
+env-to-module pipeline to observations before the jitted policy step and
+the module-to-env pipeline to actions before env.step. Stateful
+connectors (e.g. observation normalizers) expose get_state/set_state so
+their statistics ride weight broadcasts and checkpoints.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Connector:
+    """One transformation stage. ``__call__(data) -> data`` where data is
+    a numpy array batch ([N, ...] observations or actions)."""
+
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # Stateful connectors override; stateless ones inherit the no-ops.
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+
+class ConnectorPipeline:
+    """Ordered connectors applied left to right."""
+
+    def __init__(self, connectors: "list[Connector] | None" = None):
+        self.connectors = list(connectors or [])
+
+    def __call__(self, data, update: bool = True):
+        """update=False applies stateful connectors FROZEN (no statistics
+        update) — bootstrap-value transforms must not double-count the
+        fragment-boundary observation."""
+        for c in self.connectors:
+            if not update and hasattr(c, "frozen"):
+                prev = c.frozen
+                c.frozen = True
+                try:
+                    data = c(data)
+                finally:
+                    c.frozen = prev
+            else:
+                data = c(data)
+        return data
+
+    def __len__(self):
+        return len(self.connectors)
+
+    def get_state(self) -> list:
+        return [c.get_state() for c in self.connectors]
+
+    def set_state(self, states: list) -> None:
+        if len(states) != len(self.connectors):
+            raise ValueError(
+                f"connector state length {len(states)} != pipeline length "
+                f"{len(self.connectors)} — checkpoint from a different "
+                f"pipeline shape"
+            )
+        for c, st in zip(self.connectors, states):
+            c.set_state(st)
+
+
+class FlattenObs(Connector):
+    """[N, *dims] -> [N, prod(dims)] (image/matrix observations into the
+    MLP module's flat input; reference: connectors/env_to_module/flatten_
+    observations.py)."""
+
+    def __call__(self, data):
+        data = np.asarray(data)
+        return data.reshape(data.shape[0], -1)
+
+
+class NormalizeObs(Connector):
+    """Running mean/std observation normalization (reference:
+    connectors' MeanStdFilter). Statistics update on every batch during
+    sampling; ``frozen=True`` applies without updating (evaluation)."""
+
+    def __init__(self, epsilon: float = 1e-8, clip: float = 10.0):
+        self.eps = epsilon
+        self.clip = clip
+        self._count = 0.0
+        self._mean: np.ndarray | None = None
+        self._m2: np.ndarray | None = None
+        self.frozen = False
+
+    def _update(self, batch: np.ndarray) -> None:
+        # Chan et al. parallel variance merge of (batch) into (running).
+        bcount = batch.shape[0]
+        bmean = batch.mean(axis=0)
+        bvar = batch.var(axis=0) * bcount
+        if self._mean is None:
+            self._count = float(bcount)
+            self._mean = bmean.astype(np.float64)
+            self._m2 = bvar.astype(np.float64)
+            return
+        delta = bmean - self._mean
+        total = self._count + bcount
+        self._mean = self._mean + delta * (bcount / total)
+        self._m2 = self._m2 + bvar + delta**2 * self._count * bcount / total
+        self._count = total
+
+    def __call__(self, data):
+        data = np.asarray(data, np.float64)
+        if not self.frozen:
+            self._update(data)
+        if self._mean is None or self._count < 2:
+            return data.astype(np.float32)
+        std = np.sqrt(self._m2 / self._count + self.eps)
+        out = (data - self._mean) / std
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def get_state(self) -> dict:
+        return {
+            "count": self._count,
+            "mean": None if self._mean is None else self._mean.tolist(),
+            "m2": None if self._m2 is None else self._m2.tolist(),
+        }
+
+    def set_state(self, state: dict) -> None:
+        self._count = state.get("count", 0.0)
+        mean = state.get("mean")
+        m2 = state.get("m2")
+        self._mean = None if mean is None else np.asarray(mean, np.float64)
+        self._m2 = None if m2 is None else np.asarray(m2, np.float64)
+
+
+class ClipActions(Connector):
+    """Clip continuous actions into [low, high] before env.step
+    (reference: module-to-env clip_actions connector)."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low)
+        self.high = np.asarray(high)
+
+    def __call__(self, data):
+        return np.clip(np.asarray(data), self.low, self.high)
+
+
+class ScaleObs(Connector):
+    """Fixed affine rescale (e.g. uint8 images / 255)."""
+
+    def __init__(self, scale: float, offset: float = 0.0):
+        self.scale = float(scale)
+        self.offset = float(offset)
+
+    def __call__(self, data):
+        return (np.asarray(data, np.float32) + self.offset) * self.scale

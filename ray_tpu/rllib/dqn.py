@@ -83,6 +83,8 @@ class DQNEnvRunner(RolloutBase):
         rollout_fragment_length: int = 64,
         seed: int = 0,
         worker_index: int = 0,
+        env_to_module=None,
+        module_to_env=None,
     ):
         super().__init__(
             env_maker,
@@ -91,6 +93,8 @@ class DQNEnvRunner(RolloutBase):
             rollout_fragment_length=rollout_fragment_length,
             seed=seed,
             worker_index=worker_index,
+            env_to_module=env_to_module,
+            module_to_env=module_to_env,
         )
         self._rng = np.random.default_rng(seed * 99991 + worker_index)
         self._epsilon = 1.0
@@ -115,21 +119,34 @@ class DQNEnvRunner(RolloutBase):
         obs_rows, act_rows, rew_rows = [], [], []
         next_rows, term_rows = [], []
         for _ in range(T):
-            greedy = np.asarray(self._greedy(self._params, self._obs))
+            obs_in = np.asarray(
+                self._env_to_module(self._obs), np.float32
+            )
+            greedy = np.asarray(self._greedy(self._params, obs_in))
             explore = self._rng.random(N) < self._epsilon
             actions = np.where(
                 explore, self._rng.integers(0, n_act, size=N), greedy
             ).astype(greedy.dtype)
             live = ~self._autoreset
-            next_obs, rew, term, trunc, _ = self._envs.step(actions)
+            env_actions = (
+                np.asarray(self._module_to_env(actions))
+                if len(self._module_to_env)
+                else actions
+            )
+            next_obs, rew, term, trunc, _ = self._envs.step(env_actions)
             # next_obs on a done step is the episode's FINAL observation
             # (gymnasium NEXT_STEP autoreset resets one step later); the
             # terminal flag gates bootstrapping in the TD target, and the
-            # following dummy reset row is dropped via `live`.
-            obs_rows.append(self._obs[live])
+            # following dummy reset row is dropped via `live`. Replay
+            # stores CONNECTED observations (frozen for next_obs: that
+            # same obs updates stats when it leads the next step).
+            next_in = np.asarray(
+                self._env_to_module(next_obs, update=False), np.float32
+            )
+            obs_rows.append(obs_in[live])
             act_rows.append(actions[live])
             rew_rows.append(rew[live])
-            next_rows.append(next_obs[live])
+            next_rows.append(next_in[live])
             term_rows.append(term[live])
             self._record_episode_step(rew, live, term, trunc)
             self._obs = next_obs
@@ -332,6 +349,8 @@ class DQN(Algorithm):
             rollout_fragment_length=config.rollout_fragment_length,
             seed=config.seed,
             worker_index=i,
+            env_to_module=config.env_to_module,
+            module_to_env=config.module_to_env,
         )
 
     def _epsilon(self) -> float:

@@ -67,9 +67,21 @@ class RolloutBase:
         rollout_fragment_length: int = 200,
         seed: int = 0,
         worker_index: int = 0,
+        env_to_module: Callable | None = None,
+        module_to_env: Callable | None = None,
     ):
         import gymnasium as gym
 
+        from ray_tpu.rllib.connectors import ConnectorPipeline
+
+        # Connector pipelines (reference: rllib/connectors/): factories so
+        # every runner owns its OWN stateful instances (normalizer stats).
+        self._env_to_module = ConnectorPipeline(
+            env_to_module() if env_to_module else []
+        )
+        self._module_to_env = ConnectorPipeline(
+            module_to_env() if module_to_env else []
+        )
         self.module = module
         self.num_envs = num_envs
         self.fragment_len = rollout_fragment_length
@@ -111,6 +123,17 @@ class RolloutBase:
         return True
 
     def ping(self) -> bool:
+        return True
+
+    def get_connector_state(self) -> dict:
+        return {
+            "env_to_module": self._env_to_module.get_state(),
+            "module_to_env": self._module_to_env.get_state(),
+        }
+
+    def set_connector_state(self, state: dict) -> bool:
+        self._env_to_module.set_state(state.get("env_to_module", []))
+        self._module_to_env.set_state(state.get("module_to_env", []))
         return True
 
     def _record_episode_step(self, rew, live, term, trunc) -> np.ndarray:
@@ -165,6 +188,8 @@ class EnvRunner(RolloutBase):
         lambda_: float = 0.95,
         seed: int = 0,
         worker_index: int = 0,
+        env_to_module: Callable | None = None,
+        module_to_env: Callable | None = None,
     ):
         super().__init__(
             env_maker,
@@ -173,6 +198,8 @@ class EnvRunner(RolloutBase):
             rollout_fragment_length=rollout_fragment_length,
             seed=seed,
             worker_index=worker_index,
+            env_to_module=env_to_module,
+            module_to_env=module_to_env,
         )
         self.gamma = gamma
         self.lam = lambda_
@@ -197,7 +224,7 @@ class EnvRunner(RolloutBase):
         if self._params is None:
             raise RuntimeError("set_weights() before sample()")
         T, N = self.fragment_len, self.num_envs
-        obs_buf = np.empty((T, N) + self._obs.shape[1:], np.float32)
+        obs_buf = None  # allocated from the CONNECTED obs shape
         act_list, logp_buf = [], np.empty((T, N), np.float32)
         vf_buf = np.empty((T, N), np.float32)
         rew_buf = np.empty((T, N), np.float32)
@@ -207,9 +234,16 @@ class EnvRunner(RolloutBase):
 
         for t in range(T):
             self._key, k = jax.random.split(self._key)
-            actions, logp, vf = self._policy_step(self._params, self._obs, k)
+            # env-to-module connectors transform raw observations into the
+            # module's input space; the TRANSFORMED obs is what trains.
+            obs_in = np.asarray(
+                self._env_to_module(self._obs), np.float32
+            )
+            if obs_buf is None:
+                obs_buf = np.empty((T,) + obs_in.shape, np.float32)
+            actions, logp, vf = self._policy_step(self._params, obs_in, k)
             actions_np = np.asarray(actions)
-            obs_buf[t] = self._obs
+            obs_buf[t] = obs_in
             act_list.append(actions_np)
             logp_buf[t] = np.asarray(logp)
             vf_buf[t] = np.asarray(vf)
@@ -218,7 +252,12 @@ class EnvRunner(RolloutBase):
             # masked out of the loss and the episode accounting.
             live = ~self._autoreset
             mask_buf[t] = live
-            next_obs, rew, term, trunc, _ = self._envs.step(actions_np)
+            env_actions = (
+                np.asarray(self._module_to_env(actions_np))
+                if len(self._module_to_env)
+                else actions_np
+            )
+            next_obs, rew, term, trunc, _ = self._envs.step(env_actions)
             rew_buf[t] = rew
             term_buf[t] = term
             trunc_buf[t] = trunc
@@ -226,7 +265,18 @@ class EnvRunner(RolloutBase):
             self._obs = next_obs
         self._total_steps += int(mask_buf.sum())
 
-        last_vf = np.asarray(self._vf(self._params, self._obs))
+        last_vf = np.asarray(
+            self._vf(
+                self._params,
+                np.asarray(
+                    # frozen: this same obs transforms AGAIN at the next
+                    # fragment's first step — updating twice would bias
+                    # stats toward fragment-boundary states.
+                    self._env_to_module(self._obs, update=False),
+                    np.float32,
+                ),
+            )
+        )
         adv, targets = compute_gae(
             rew_buf, vf_buf, last_vf, term_buf, trunc_buf, self.gamma, self.lam
         )

@@ -186,6 +186,8 @@ class ImpalaEnvRunner(RolloutBase):
         rollout_fragment_length: int = 64,
         seed: int = 0,
         worker_index: int = 0,
+        env_to_module=None,
+        module_to_env=None,
     ):
         super().__init__(
             env_maker,
@@ -194,6 +196,8 @@ class ImpalaEnvRunner(RolloutBase):
             rollout_fragment_length=rollout_fragment_length,
             seed=seed,
             worker_index=worker_index,
+            env_to_module=env_to_module,
+            module_to_env=module_to_env,
         )
         self._key = jax.random.key(seed * 100003 + worker_index)
         self._weights_version = 0
@@ -228,21 +232,34 @@ class ImpalaEnvRunner(RolloutBase):
         mask_buf = np.empty((T, N), np.float32)
         for t in range(T):
             self._key, k = jax.random.split(self._key)
-            actions, logp, _vf = self._policy_step(self._params, self._obs, k)
+            obs_in = np.asarray(self._env_to_module(self._obs), np.float32)
+            actions, logp, _vf = self._policy_step(self._params, obs_in, k)
             actions_np = np.asarray(actions)
-            obs_buf[t] = self._obs
+            obs_buf[t] = obs_in
             act_list.append(actions_np)
             logp_buf[t] = np.asarray(logp)
             live = ~self._autoreset
             mask_buf[t] = live
-            next_obs, rew, term, trunc, _ = self._envs.step(actions_np)
+            env_actions = (
+                np.asarray(self._module_to_env(actions_np))
+                if len(self._module_to_env)
+                else actions_np
+            )
+            next_obs, rew, term, trunc, _ = self._envs.step(env_actions)
             rew_buf[t] = rew
             term_buf[t] = term
             trunc_buf[t] = trunc
             self._record_episode_step(rew, live, term, trunc)
             self._obs = next_obs
         self._total_steps += int(mask_buf.sum())
-        bootstrap = np.asarray(self._vf(self._params, self._obs))
+        bootstrap = np.asarray(
+            self._vf(
+                self._params,
+                np.asarray(
+                    self._env_to_module(self._obs, update=False), np.float32
+                ),
+            )
+        )
         # Plain dict, NOT SampleBatch: time-major [T, N] columns plus the
         # [N] bootstrap row are deliberately ragged in the leading dim.
         return {
@@ -318,6 +335,8 @@ class Impala(Algorithm):
             rollout_fragment_length=config.rollout_fragment_length,
             seed=config.seed,
             worker_index=i,
+            env_to_module=config.env_to_module,
+            module_to_env=config.module_to_env,
         )
 
     def learner_loss_args(self) -> tuple:

@@ -1,0 +1,308 @@
+"""Multi-agent environments with shared-policy training.
+
+Reference parity: rllib/env/multi_agent_env.py (dict-keyed observations /
+actions / rewards per agent id, "__all__" termination) and the
+parameter-sharing configuration of rllib algorithms. Redesign for this
+runtime: agents ARE the batch axis — a MultiAgentEnvRunner stacks the
+agent dict into one [n_agents, obs] policy step (one jitted call for the
+whole team), GAE runs time-major with agents as columns, and the standard
+Learner trains the shared module on the flattened [T * n_agents] batch.
+Per-policy (non-shared) setups decompose into one Algorithm per policy
+over env wrappers; the shared-policy path is the one built in.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable
+
+import jax
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.env_runner import compute_gae
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.rl_module import RLModule, to_numpy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class MultiAgentEnv:
+    """ABC (reference: rllib/env/multi_agent_env.py). Dict-keyed API:
+
+    - ``agents``: fixed, ordered list of agent ids.
+    - ``reset(seed) -> (obs_dict, info)``
+    - ``step(action_dict) -> (obs, rew, terminated, truncated, info)``,
+      each a per-agent dict; ``terminated["__all__"]`` /
+      ``truncated["__all__"]`` end the episode for everyone.
+
+    This runtime's runner steps every agent every step (the common
+    simultaneous-move case); turn-based games model "not my turn" as a
+    no-op action.
+    """
+
+    agents: list
+
+    def reset(self, *, seed=None):
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+    @property
+    def observation_space(self):
+        raise NotImplementedError  # per-agent space (shared policy)
+
+    @property
+    def action_space(self):
+        raise NotImplementedError
+
+
+class MultiAgentEnvRunner:
+    """Shared-policy rollout actor: one jitted policy step serves the
+    whole team ([n_agents, obs] stacked batch); fragments flatten to
+    [T * n_agents] rows for the standard Learner."""
+
+    def __init__(
+        self,
+        env_maker: Callable,
+        module: RLModule,
+        *,
+        rollout_fragment_length: int = 128,
+        gamma: float = 0.99,
+        lambda_: float = 0.95,
+        seed: int = 0,
+        worker_index: int = 0,
+        num_envs: int = 1,  # accepted for config parity; one env per runner
+        env_to_module: Callable | None = None,
+        module_to_env: Callable | None = None,
+    ):
+        from ray_tpu.rllib.connectors import ConnectorPipeline
+
+        self._env_to_module = ConnectorPipeline(
+            env_to_module() if env_to_module else []
+        )
+        self._module_to_env = ConnectorPipeline(
+            module_to_env() if module_to_env else []
+        )
+        self._env: MultiAgentEnv = env_maker()
+        self.agents = list(self._env.agents)
+        self.module = module
+        self.fragment_len = rollout_fragment_length
+        self.gamma = gamma
+        self.lam = lambda_
+        self._key = jax.random.key(seed * 100003 + worker_index)
+        obs, _ = self._env.reset(seed=seed * 7919 + worker_index)
+        self._obs = self._stack(obs)
+        try:
+            self._cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # pragma: no cover
+            self._cpu = None
+        self._params = None
+        self._ep_return = 0.0  # team return of the running episode
+        self._ep_len = 0
+        self._episode_returns: collections.deque = collections.deque(
+            maxlen=100
+        )
+        self._episode_lengths: collections.deque = collections.deque(
+            maxlen=100
+        )
+        self._total_steps = 0
+
+        @jax.jit
+        def _policy_step(params, obs, key):
+            out = self.module.forward(params, obs)
+            actions = self.module.dist_sample(out, key)
+            logp = self.module.dist_logp(out, actions)
+            return actions, logp, out["vf"]
+
+        self._policy_step = _policy_step
+        self._vf = jax.jit(
+            lambda params, obs: self.module.forward(params, obs)["vf"]
+        )
+
+    def _stack(self, obs_dict: dict) -> np.ndarray:
+        return np.stack(
+            [np.asarray(obs_dict[a], np.float32) for a in self.agents]
+        )
+
+    def set_weights(self, params, version: int = 0) -> bool:
+        params = to_numpy(params)
+        if self._cpu is not None:
+            params = jax.device_put(params, self._cpu)
+        self._params = params
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+    def get_connector_state(self) -> dict:
+        return {
+            "env_to_module": self._env_to_module.get_state(),
+            "module_to_env": self._module_to_env.get_state(),
+        }
+
+    def set_connector_state(self, state: dict) -> bool:
+        self._env_to_module.set_state(state.get("env_to_module", []))
+        self._module_to_env.set_state(state.get("module_to_env", []))
+        return True
+
+    def sample(self) -> SampleBatch:
+        if self._params is None:
+            raise RuntimeError("set_weights() before sample()")
+        T, N = self.fragment_len, len(self.agents)
+        obs_buf = None  # allocated from the CONNECTED obs shape
+        act_list, logp_buf = [], np.empty((T, N), np.float32)
+        vf_buf = np.empty((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        term_buf = np.zeros((T, N), np.float32)
+        trunc_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            self._key, k = jax.random.split(self._key)
+            obs_in = np.asarray(self._env_to_module(self._obs), np.float32)
+            if obs_buf is None:
+                obs_buf = np.empty((T,) + obs_in.shape, np.float32)
+            actions, logp, vf = self._policy_step(self._params, obs_in, k)
+            actions_np = np.asarray(actions)
+            obs_buf[t] = obs_in
+            act_list.append(actions_np)
+            logp_buf[t] = np.asarray(logp)
+            vf_buf[t] = np.asarray(vf)
+            env_actions = (
+                np.asarray(self._module_to_env(actions_np))
+                if len(self._module_to_env)
+                else actions_np
+            )
+            action_dict = {
+                a: env_actions[i] for i, a in enumerate(self.agents)
+            }
+            obs, rew, term, trunc, _ = self._env.step(action_dict)
+            for i, a in enumerate(self.agents):
+                rew_buf[t, i] = rew.get(a, 0.0)
+                term_buf[t, i] = float(term.get(a, False))
+                trunc_buf[t, i] = float(trunc.get(a, False))
+            self._ep_return += float(sum(rew.values()))
+            self._ep_len += 1
+            done_all = term.get("__all__", False) or trunc.get(
+                "__all__", False
+            )
+            if done_all:
+                self._episode_returns.append(self._ep_return)
+                self._episode_lengths.append(self._ep_len)
+                self._ep_return = 0.0
+                self._ep_len = 0
+                if trunc.get("__all__", False):
+                    # Truncation bootstraps from the FINAL observation —
+                    # folding gamma*V(final) into the reward with term=1
+                    # yields identical targets while keeping self._obs as
+                    # the NEXT episode's start (GAE must not read the new
+                    # episode's value for the old one's last step).
+                    final_in = np.asarray(
+                        self._env_to_module(
+                            self._stack(obs), update=False
+                        ),
+                        np.float32,
+                    )
+                    final_vf = np.asarray(
+                        self._vf(self._params, final_in)
+                    )
+                    rew_buf[t] += self.gamma * final_vf
+                term_buf[t] = 1.0
+                trunc_buf[t] = 0.0
+                obs, _ = self._env.reset()
+            self._obs = self._stack(obs)
+        self._total_steps += T * N
+
+        last_vf = np.asarray(
+            self._vf(
+                self._params,
+                np.asarray(
+                    self._env_to_module(self._obs, update=False), np.float32
+                ),
+            )
+        )
+        adv, targets = compute_gae(
+            rew_buf, vf_buf, last_vf, term_buf, trunc_buf,
+            self.gamma, self.lam,
+        )
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        return SampleBatch(
+            {
+                sb.OBS: flat(obs_buf),
+                sb.ACTIONS: flat(np.stack(act_list)),
+                sb.LOGP: flat(logp_buf),
+                sb.VF_PREDS: flat(vf_buf),
+                sb.REWARDS: flat(rew_buf),
+                sb.TERMINATEDS: flat(term_buf),
+                sb.TRUNCATEDS: flat(trunc_buf),
+                sb.ADVANTAGES: flat(adv),
+                sb.VALUE_TARGETS: flat(targets),
+                sb.LOSS_MASK: np.ones((T * N,), np.float32),
+            }
+        )
+
+    def metrics(self) -> dict:
+        rets = list(self._episode_returns)
+        return {
+            "num_env_steps_sampled": self._total_steps,
+            "num_episodes": len(rets),
+            "episode_return_mean": (
+                float(np.mean(rets)) if rets else np.nan
+            ),
+            "episode_return_max": float(np.max(rets)) if rets else np.nan,
+            "episode_len_mean": (
+                float(np.mean(self._episode_lengths))
+                if self._episode_lengths
+                else np.nan
+            ),
+        }
+
+    def stop(self) -> None:
+        self._env.close()
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    @property
+    def algo_class(self) -> type:
+        return MultiAgentPPO
+
+
+class MultiAgentPPO(PPO):
+    """Parameter-sharing multi-agent PPO: one module, agents batched."""
+
+    env_runner_cls = MultiAgentEnvRunner
+
+    def default_module(self, maker, config: AlgorithmConfig) -> RLModule:
+        from ray_tpu.rllib.rl_module import MLPModule
+
+        env = maker()
+        try:
+            obs_dim = int(np.prod(env.observation_space.shape))
+            space = env.action_space
+            discrete = hasattr(space, "n")
+            num_out = (
+                int(space.n) if discrete else int(np.prod(space.shape))
+            )
+        finally:
+            env.close()
+        return MLPModule(
+            obs_dim=obs_dim,
+            num_outputs=num_out,
+            hidden=tuple(config.hidden),
+            discrete=discrete,
+        )
+
+    def env_runner_kwargs(self, config: AlgorithmConfig, i: int) -> dict:
+        return dict(
+            rollout_fragment_length=config.rollout_fragment_length,
+            gamma=config.gamma,
+            lambda_=config.lambda_,
+            seed=config.seed,
+            worker_index=i,
+            env_to_module=config.env_to_module,
+            module_to_env=config.module_to_env,
+        )
