@@ -32,12 +32,21 @@ import numpy as np
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models import gpt2
-from ray_tpu.models.gpt2_decode import (
-    decode_step,
-    init_kv_cache,
-    prefill,
-    prefill_continue,
-)
+
+
+def _model_ops(cfg):
+    """(model_module, decode_module) for a model-family config — the ONE
+    dispatch point; everything else in the engine is family-agnostic
+    (the cache pytree layouts agree: [L, B, heads, S, Dh])."""
+    from ray_tpu.models.llama import LlamaConfig
+
+    if isinstance(cfg, LlamaConfig):
+        from ray_tpu.models import llama, llama_decode
+
+        return llama, llama_decode
+    from ray_tpu.models import gpt2_decode
+
+    return gpt2, gpt2_decode
 
 
 @dataclasses.dataclass
@@ -70,6 +79,7 @@ class LLMEngine:
         if cfg.vocab_size < self.tokenizer.vocab_size:
             raise ValueError("model vocab smaller than tokenizer vocab")
         self.model_config = cfg
+        self._model, self._decode_mod = _model_ops(cfg)
         devices = jax.devices()
         tp = config.tensor_parallelism
         if tp > 1:
@@ -84,7 +94,9 @@ class LLMEngine:
 
             self.mesh = make_mesh(MeshSpec(tp=tp), devices[:tp])
             shardings = shardings_from_logical(
-                gpt2.param_logical_specs(cfg), DEFAULT_RULES, self.mesh
+                self._model.param_logical_specs(cfg),
+                DEFAULT_RULES,
+                self.mesh
             )
             self._replicated = NamedSharding(self.mesh, P())
         else:
@@ -95,17 +107,21 @@ class LLMEngine:
             with open(config.weights_path, "rb") as f:
                 params = jax.tree.map(jnp.asarray, pickle.load(f))
         else:
-            params = gpt2.init_params(jax.random.key(config.seed), cfg)
+            params = self._model.init_params(
+                jax.random.key(config.seed), cfg
+            )
         if shardings is not None:
             params = jax.device_put(params, shardings)
         self.params = params
 
         B, S = config.max_slots, config.max_seq
-        self.cache = init_kv_cache(cfg, B, S)
+        self.cache = self._decode_mod.init_kv_cache(cfg, B, S)
         # cfg binds as a jit-static closure constant; one compile per
         # prefill bucket + one for decode.
         self._prefill = jax.jit(functools.partial(self._prefill_impl, cfg=cfg))
-        self._decode = jax.jit(functools.partial(self._decode_impl, cfg=cfg))
+        self._decode = jax.jit(
+            functools.partial(self._decode_mod.decode_step, cfg=cfg)
+        )
         self._prefill_cont = jax.jit(
             functools.partial(self._prefill_cont_impl, cfg=cfg)
         )
@@ -134,14 +150,15 @@ class LLMEngine:
         self._steps = 0
 
     # -- jitted bodies (slot-batched cache update) ---------------------------
-    @staticmethod
-    def _prefill_impl(params, tokens, length, cache, slot, cfg):
+    def _prefill_impl(self, params, tokens, length, cache, slot, cfg):
         """Prefill ONE slot: tokens [1, T]; merge that slot's cache rows."""
         sub = {
             "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
             "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
         }
-        sub, logits = prefill(params, tokens, length[None], sub, cfg)
+        sub, logits = self._decode_mod.prefill(
+            params, tokens, length[None], sub, cfg
+        )
         cache = {
             "k": jax.lax.dynamic_update_slice_in_dim(
                 cache["k"], sub["k"], slot, axis=1
@@ -152,19 +169,14 @@ class LLMEngine:
         }
         return cache, logits[0]
 
-    @staticmethod
-    def _decode_impl(params, last_tokens, positions, cache, cfg):
-        return decode_step(params, last_tokens, positions, cache, cfg)
-
-    @staticmethod
-    def _prefill_cont_impl(params, tokens, length, start, cache, slot, cfg):
+    def _prefill_cont_impl(self, params, tokens, length, start, cache, slot, cfg):
         """Prefill ONE slot's suffix on top of a cached prefix already
         copied into that slot's rows [0, start)."""
         sub = {
             "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
             "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
         }
-        sub, logits = prefill_continue(
+        sub, logits = self._decode_mod.prefill_continue(
             params, tokens, length[None], start, sub, cfg
         )
         cache = {

@@ -182,3 +182,46 @@ def test_openai_serving_e2e(cluster):
         assert one["usage"]["completion_tokens"] == 1
     finally:
         serve.shutdown()
+
+
+def test_llama_family_engine_generates_and_prefix_caches():
+    """The engine serves the Llama family through the same slot machinery:
+    GQA cache ([L, B, KV_HEADS, S, Dh] — smaller than MHA), RoPE-aware
+    prefill/continue/decode, prefix caching included."""
+    from ray_tpu.llm.config import LLMConfig, SamplingParams
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.models.llama import LlamaConfig
+
+    model = LlamaConfig.tiny(
+        n_layer=2, d_model=64, n_head=4, n_kv_head=2, max_seq=128
+    )
+    eng = LLMEngine(
+        LLMConfig(
+            model_config=model,
+            max_slots=4,
+            max_seq=128,
+            prefill_buckets=(16, 32, 64),
+            prefix_chunk=16,
+        )
+    )
+    # GQA cache stores KV heads unexpanded.
+    assert eng.cache["k"].shape == (2, 4, 2, 128, 16)
+    sampling = SamplingParams(max_tokens=4, temperature=0.0)
+    shared = list(range(3, 35))  # 32-token aligned prefix
+    out1 = eng.generate([shared + [40]], sampling)[0]
+    out2 = eng.generate([shared + [41]], sampling)[0]
+    assert len(out1["token_ids"]) == 4 and len(out2["token_ids"]) == 4
+    assert eng.stats["prefix_hits"] == 1  # second prompt reused the prefix
+
+    # Prefix reuse must not change outputs: same prompt, cache off.
+    eng_off = LLMEngine(
+        LLMConfig(
+            model_config=model,
+            max_slots=4,
+            max_seq=128,
+            prefill_buckets=(16, 32, 64),
+            enable_prefix_caching=False,
+        )
+    )
+    ref2 = eng_off.generate([shared + [41]], sampling)[0]
+    assert out2["token_ids"] == ref2["token_ids"]
