@@ -15,6 +15,8 @@ import os
 import subprocess
 import time
 import uuid
+
+from ray_tpu.core.errors import ActorDiedError
 from typing import Optional
 
 _KV_NS = "jobs"
@@ -158,6 +160,9 @@ class JobManager:
 
         self._ray = ray_tpu
         self._worker = core_api._require_worker()
+        # job_id -> consecutive transient status-poll failures (escalates
+        # to FAILED past a threshold; see _refresh).
+        self._poll_failures: dict[str, int] = {}
 
     # -- submission ----------------------------------------------------------
     def submit_job(
@@ -218,11 +223,51 @@ class JobManager:
         try:
             sup = self._ray.get_actor(_supervisor_name(info.job_id))
             st = self._ray.get(sup.status.remote())
-        except Exception:
+        except (ActorDiedError, ValueError) as e:
+            # ValueError = no named actor in the GCS. During the submit
+            # window the job record exists BEFORE the supervisor actor
+            # registers — a PENDING job inside the grace period is
+            # starting, not dead (a concurrent dashboard refresh must not
+            # fail it).
+            if (
+                isinstance(e, ValueError)
+                and info.status == JobStatus.PENDING
+                and time.time() - info.start_time < 30.0
+            ):
+                return info
             info.status = JobStatus.FAILED
             info.message = "supervisor actor died"
             self._worker.gcs.kv_put(info.job_id, info.to_json(), ns=_KV_NS)
+            self._record_event(
+                info.job_id, "LIFECYCLE", {"state": info.status}
+            )
             return info
+        except Exception as e:
+            # Transient poll error (slow box, RPC timeout): keep the last
+            # known status — but BOUNDED: a supervisor that never answers
+            # again is dead in every way that matters, and a job must not
+            # show RUNNING forever (the pre-round-4 behavior failed jobs
+            # on the FIRST transient error; this fails on the 6th
+            # consecutive one).
+            fails = self._poll_failures.get(info.job_id, 0) + 1
+            self._poll_failures[info.job_id] = fails
+            if fails >= 6:
+                self._poll_failures.pop(info.job_id, None)
+                info.status = JobStatus.FAILED
+                info.message = (
+                    f"supervisor unreachable after {fails} consecutive "
+                    f"status polls: {e}"
+                )
+                self._worker.gcs.kv_put(
+                    info.job_id, info.to_json(), ns=_KV_NS
+                )
+                self._record_event(
+                    info.job_id, "LIFECYCLE", {"state": info.status}
+                )
+                return info
+            info.message = f"status poll failed (transient): {e}"
+            return info
+        self._poll_failures.pop(info.job_id, None)
         prev = info.status
         info.status = st["status"]
         info.message = st["message"]

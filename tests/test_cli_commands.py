@@ -83,11 +83,19 @@ ray_tpu.shutdown()
     assert gen.returncode == 0, gen.stderr[-800:]
 
     tl_path = str(tmp_path / "tl.json")
-    out = _cli(
-        "timeline", "--address", daemon["gcs_address"], "-o", tl_path
-    )
-    assert out.returncode == 0, out.stderr[-800:]
-    events = json.load(open(tl_path))
+    # Event flush is interval-driven; under load 2.5s may not cover it —
+    # retry the dump until events land (bounded).
+    deadline = time.monotonic() + 45
+    events = []
+    while time.monotonic() < deadline:
+        out = _cli(
+            "timeline", "--address", daemon["gcs_address"], "-o", tl_path
+        )
+        assert out.returncode == 0, out.stderr[-800:]
+        events = json.load(open(tl_path))
+        if isinstance(events, list) and len(events) >= 1:
+            break
+        time.sleep(1.0)
     assert isinstance(events, list) and len(events) >= 1
 
     out = _cli("memory", "--address", daemon["gcs_address"])
