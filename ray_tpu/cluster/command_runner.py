@@ -48,15 +48,20 @@ class LocalCommandRunner(CommandRunner):
             full_env.update({k: str(v) for k, v in env.items()})
         if detach:
             log = open(os.path.join(self.workdir, "daemon.log"), "ab")
-            proc = subprocess.Popen(
-                cmd,
-                shell=True,
-                cwd=self.workdir,
-                env=full_env,
-                stdout=log,
-                stderr=subprocess.STDOUT,
-                start_new_session=True,  # survives the launcher exiting
-            )
+            try:
+                proc = subprocess.Popen(
+                    cmd,
+                    shell=True,
+                    cwd=self.workdir,
+                    env=full_env,
+                    stdout=log,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True,  # survives the launcher exiting
+                )
+            finally:
+                # The child holds its own duplicate of the fd; keeping the
+                # parent's copy open would leak one fd per daemon launch.
+                log.close()
             self._procs.append(proc)
             return proc
         r = subprocess.run(

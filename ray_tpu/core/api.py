@@ -699,12 +699,21 @@ def cancel(ref, *, force: bool = False) -> None:
     already-finished task is a no-op; actor tasks are not cancellable (kill
     the actor instead). An ``ObjectRefGenerator`` may be passed to cancel
     its streaming task mid-stream."""
-    if isinstance(ref, ObjectRefGenerator) or (
+    if isinstance(ref, ObjectRefGenerator):
+        ref = ref.completed()
+    elif not isinstance(ref, ObjectRef):
         # Client-mode streams are a different class (ClientStreamGenerator)
         # but carry the same contract: completed() is the cancel target.
-        not isinstance(ref, ObjectRef) and hasattr(ref, "completed")
-    ):
-        ref = ref.completed()
+        # Lazy import: core.client imports this module.
+        from ray_tpu.core.client import ClientStreamGenerator
+
+        if isinstance(ref, ClientStreamGenerator):
+            ref = ref.completed()
+        else:
+            raise TypeError(
+                "cancel() expects an ObjectRef or a streaming generator, "
+                f"got {type(ref).__name__}"
+            )
     _require_worker().cancel(ref, force=force)
 
 

@@ -202,6 +202,12 @@ def device_get(ref: DeviceRef, *, to_device: bool = True, sharding=None):
                 f"device object {ref.oid} is gone from its owner (freed or "
                 f"fetch budget exhausted)"
             )
+        if desc is None or desc.get("unsupported"):
+            # Arm RPC failed or the owner can't serve this object over the
+            # fabric: the host fetch below is a fallback and must count as
+            # one — tests use transfer_stats()['fallbacks'] == 0 as proof
+            # the fabric carried the data.
+            _xfer.fabric().count_fallback()
         if desc is not None and not desc.get("unsupported"):
             try:
                 out = _xfer.fabric().pull(desc, target_sharding=sharding)

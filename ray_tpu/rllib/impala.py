@@ -55,18 +55,20 @@ def vtrace(
     """V-trace targets and policy-gradient advantages (time-major).
 
     Returns (vs, pg_advantages, mean_rho) — vs/pg_adv are stop-gradiented.
-    Terminated steps bootstrap 0; truncated steps end the recursion too
-    (same simplification as the GAE path: the post-reset observation's
-    value must not leak across the boundary).
+    Terminated steps bootstrap 0. Truncated steps DO bootstrap — with
+    next-step autoreset, values[t+1] at a truncation is V(final_obs), the
+    correct continuation value — mirroring compute_gae; truncation only
+    cuts the scan recursion so corrections never leak across episodes.
     """
     rho = jnp.exp(target_logp - behavior_logp)
     rho_c = jnp.minimum(rho, rho_bar)
     c = jnp.minimum(rho, c_bar)
-    not_done = (1.0 - terminateds) * (1.0 - truncateds)
+    not_term = 1.0 - terminateds
+    not_done = not_term * (1.0 - truncateds)
     next_values = jnp.concatenate(
         [values[1:], bootstrap_value[None]], axis=0
     )
-    delta = rho_c * (rewards + gamma * next_values * not_done - values)
+    delta = rho_c * (rewards + gamma * next_values * not_term - values)
 
     def scan_fn(carry, x):
         d_t, c_t, nd_t = x
@@ -81,7 +83,11 @@ def vtrace(
     )
     vs = vs_minus_v + values
     vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
-    pg_adv = rho_c * (rewards + gamma * vs_next * not_done - values)
+    # At a truncation the target bootstraps the raw critic value (the
+    # corrected vs[t+1] belongs to the post-reset episode); elsewhere the
+    # corrected vs_next is the proper V-trace target.
+    boot = jnp.where(truncateds > 0, next_values, vs_next)
+    pg_adv = rho_c * (rewards + gamma * boot * not_term - values)
     return (
         jax.lax.stop_gradient(vs),
         jax.lax.stop_gradient(pg_adv),

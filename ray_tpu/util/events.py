@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import threading
 import time
 import uuid
@@ -48,6 +49,13 @@ class EventRecorder:
         )
         self._export_file = None
         self._dropped = 0
+        # Export runs on a background writer thread: record() is called on
+        # the GCS event loop, and a hung export sink (NFS, full disk) must
+        # never block the control plane. Bounded queue, drop on overflow.
+        self._export_q: queue.Queue = queue.Queue(maxsize=4096)
+        self._export_dropped = 0
+        self._export_thread: Optional[threading.Thread] = None
+        self._closed = False
 
     def record(
         self,
@@ -68,18 +76,35 @@ class EventRecorder:
             if len(self._events) == self._events.maxlen:
                 self._dropped += 1
             self._events.append(ev)
-        # File export OUTSIDE the ring lock (a slow filesystem must not
-        # block readers) and under its own lock for line atomicity. The
-        # recorder's callers run on the GCS loop; the write is small and
-        # line-buffered, but a genuinely slow sink should point
-        # RAY_TPU_EVENT_EXPORT_PATH at local disk and tail from there.
-        with self._io_lock:
-            self._export(ev)
+        if self._export_path and not self._closed:
+            self._ensure_export_thread()
+            try:
+                self._export_q.put_nowait(ev)
+            except queue.Full:
+                self._export_dropped += 1
         return ev
 
-    def _export(self, ev: dict) -> None:
-        if not self._export_path:
+    def _ensure_export_thread(self) -> None:
+        if self._export_thread is not None:
             return
+        with self._io_lock:
+            if self._export_thread is None:
+                t = threading.Thread(
+                    target=self._export_loop,
+                    name="event-export",
+                    daemon=True,
+                )
+                self._export_thread = t
+                t.start()
+
+    def _export_loop(self) -> None:
+        while True:
+            ev = self._export_q.get()
+            if ev is None:  # close() sentinel
+                return
+            self._export(ev)
+
+    def _export(self, ev: dict) -> None:
         try:
             if self._export_file is None:
                 self._export_file = open(self._export_path, "a")
@@ -117,10 +142,20 @@ class EventRecorder:
             return {
                 "buffered": len(self._events),
                 "dropped": self._dropped,
+                "export_dropped": self._export_dropped,
                 "export_path": self._export_path,
             }
 
     def close(self) -> None:
+        """Drain queued export lines (bounded wait), then close the file."""
+        self._closed = True
+        t = self._export_thread
+        if t is not None:
+            try:
+                self._export_q.put_nowait(None)
+            except queue.Full:
+                pass
+            t.join(timeout=5.0)
         with self._lock:
             if self._export_file is not None:
                 try:

@@ -59,6 +59,32 @@ def test_vtrace_termination_blocks_bootstrap():
     assert vs[0, 0] == pytest.approx(1.0 + 0.9 * 1.0)
 
 
+def test_vtrace_truncation_bootstraps_next_value():
+    """A truncated step bootstraps V(final_obs) = values[t+1] (next-step
+    autoreset stores the final observation's value there), matching
+    compute_gae; only the correction recursion is cut at the boundary."""
+    T, N = 3, 1
+    rew = np.ones((T, N), np.float32)
+    vals = np.zeros((T, N), np.float32)
+    vals[2, 0] = 5.0  # V(final_obs) recorded at t+1 by autoreset
+    logp = np.zeros((T, N), np.float32)
+    trunc = np.zeros((T, N), np.float32)
+    trunc[1, 0] = 1.0  # TimeLimit at t=1
+    boot = np.array([100.0], np.float32)
+    vs, pg_adv, _ = vtrace(
+        logp, logp, rew, vals, boot, np.zeros_like(trunc), trunc, gamma=0.9
+    )
+    vs = np.asarray(vs)
+    pg_adv = np.asarray(pg_adv)
+    # Truncated step: target = r + gamma * V(final_obs), NOT r alone
+    # (that would bias targets toward 0 at TimeLimit boundaries).
+    assert vs[1, 0] == pytest.approx(1.0 + 0.9 * 5.0)
+    # ...but the recursion is cut: t=0 sees vs[1]'s delta, nothing later.
+    assert vs[0, 0] == pytest.approx(1.0 + 0.9 * vs[1, 0])
+    # pg_adv at the truncation bootstraps the raw critic value too.
+    assert pg_adv[1, 0] == pytest.approx(1.0 + 0.9 * 5.0 - 0.0)
+
+
 def test_vtrace_clips_large_ratios():
     T, N = 2, 1
     rew = np.ones((T, N), np.float32)
