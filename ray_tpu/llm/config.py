@@ -26,9 +26,18 @@ class LLMConfig:
     # None -> GPT2Config.gpt2_125m(); tests pass a tiny config.
     model_config: Any = None
     # Serving shape
-    max_slots: int = 8  # concurrent sequences (continuous-batching slots)
-    max_seq: int = 256  # cache length (prompt + generation)
-    prefill_buckets: tuple = (32, 64, 128, 256)  # prompt pad buckets
+    max_slots: int = 16  # concurrent sequences (continuous-batching slots)
+    max_seq: int = 2048  # cache length (prompt + generation)
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024, 2048)
+    # Paged KV cache (reference: the block/gpu-memory knobs vLLM exposes,
+    # vllm_models.py:89). kv_block_size > 0 -> requests hold block tables
+    # over a shared HBM pool sized num_kv_blocks; admission reserves
+    # ceil(min(prompt+max_tokens, max_seq)/block) blocks, so short
+    # requests stop paying max_seq-sized slot rows. 0 -> legacy dense
+    # per-slot cache. num_kv_blocks None -> half the dense-equivalent
+    # (2x oversubscription), floored at one max-length request + 1.
+    kv_block_size: int = 16
+    num_kv_blocks: Optional[int] = None
     # Parallelism: tensor-parallel degree (mesh `tp` axis over local devices)
     tensor_parallelism: int = 1
     # Placement: resources each replica actor demands

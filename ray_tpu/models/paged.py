@@ -1,0 +1,264 @@
+"""Paged (block-table) KV cache: serving memory management, TPU-native.
+
+Reference parity: the capability vLLM supplies under ray.llm — paged
+attention over a shared block pool (engine knobs at
+python/ray/llm/_internal/serve/engines/vllm/vllm_models.py:89). Redesigned
+for XLA's static-shape compilation model instead of CUDA paged-attention
+kernels:
+
+- **The pool** is a pytree ``{"k","v": [L, N_blocks, KH, block, Dh]}``.
+  A request owns a *block table* — ``[W]`` int32 physical block ids with
+  ``W = max_seq // block`` — so HBM is allocated per ~block tokens
+  actually used, not per ``max_seq`` slot row. Block 0 is a reserved
+  scratch block: padded/garbage writes land there and are never read.
+- **Scatter-then-gather attention.** New K/V are scattered straight into
+  their (block, offset) homes; the attending pass gathers the request's
+  blocks back into a dense ``[KH, S, Dh]`` row (a *transient* — XLA frees
+  it after the layer) and runs the same masked grouped-head einsums as
+  the dense cache path. Identical math ⇒ exact-logit parity with
+  :mod:`gpt2_decode` / :mod:`llama_decode`, which the tests assert.
+- **Static shapes everywhere**: W, block, and the prefill bucket are
+  compile-time constants; positions/tables are traced operands. Two
+  compiled programs (prefill-per-bucket + decode), like the dense path.
+- **Prefix sharing is free**: a pooled prefix is a list of block ids; a
+  hit points the new request's first P/block table entries at the shared
+  blocks (host-side refcount) — no device copy at all, where the dense
+  engine had to copy pooled KV into the slot row.
+
+Family dispatch (GPT-2 learned-position MHA vs Llama RoPE GQA) is a small
+hook table; everything else — scatter, gather, masking, grouped
+attention — is family-agnostic because GQA with group=1 *is* MHA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Params = dict
+
+
+def init_block_pool(cfg, num_blocks: int, block_size: int):
+    """Zeroed pool pytree {"k","v"}: [L, N, KH, block, Dh] in activation
+    dtype. KH is the KV-head count (unexpanded GQA for Llama)."""
+    kh = getattr(cfg, "n_kv_head", None) or cfg.n_head
+    shape = (cfg.n_layer, num_blocks, kh, block_size, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Family hooks
+
+
+def _is_llama(cfg) -> bool:
+    from ray_tpu.models.llama import LlamaConfig
+
+    return isinstance(cfg, LlamaConfig)
+
+
+def _family(cfg, S: int):
+    """Hook table: embed / qkv (position-aware) / finish / final.
+
+    ``pos2d`` is always [B, T] absolute positions — prefill passes
+    ``start + arange(T)`` broadcast over one row, decode passes per-slot
+    ``positions[:, None]``; the same hooks serve both.
+    """
+    if _is_llama(cfg):
+        from ray_tpu.models.llama import (
+            _mlp_sublayer,
+            _rms_norm,
+            rope_tables,
+        )
+
+        H, KH, Dh = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+        cos_full, sin_full = rope_tables(cfg, S)
+
+        def embed(params, tokens, pos2d):
+            return params["wte"].astype(cfg.dtype)[tokens]
+
+        def qkv(x, p, pos2d):
+            B, T, _ = x.shape
+            h = _rms_norm(x, p["attn_norm"], cfg.rms_eps)
+            q = (h @ p["wq"].astype(cfg.dtype)).reshape(B, T, H, Dh)
+            k = (h @ p["wk"].astype(cfg.dtype)).reshape(B, T, KH, Dh)
+            v = (h @ p["wv"].astype(cfg.dtype)).reshape(B, T, KH, Dh)
+            cos = cos_full[pos2d][:, :, None, :]  # [B, T, 1, half]
+            sin = sin_full[pos2d][:, :, None, :]
+
+            def rope(t):
+                t1, t2 = jnp.split(t, 2, axis=-1)
+                c = cos.astype(t.dtype)
+                s = sin.astype(t.dtype)
+                return jnp.concatenate(
+                    [t1 * c - t2 * s, t1 * s + t2 * c], axis=-1
+                )
+
+            heads = lambda t: t.transpose(0, 2, 1, 3)
+            return heads(rope(q)), heads(rope(k)), heads(v)
+
+        def finish(x, attn, p):  # attn [B, H, T, Dh]
+            B, Hh, T, _ = attn.shape
+            a = attn.transpose(0, 2, 1, 3).reshape(B, T, cfg.d_model)
+            x = x + a @ p["wo"].astype(cfg.dtype)
+            return _mlp_sublayer(x, p, cfg)
+
+        def final(params, last):  # last [B, D] -> [B, vocab] f32
+            h = _rms_norm(last, params["final_norm"], cfg.rms_eps)
+            return (h @ params["lm_head"].astype(cfg.dtype)).astype(
+                jnp.float32
+            )
+
+    else:
+        from ray_tpu.models.gpt2 import _layer_norm
+        from ray_tpu.models.gpt2_decode import _finish_block, _qkv
+
+        H, KH, Dh = cfg.n_head, cfg.n_head, cfg.head_dim
+
+        def embed(params, tokens, pos2d):
+            return (
+                params["wte"].astype(cfg.dtype)[tokens]
+                + params["wpe"].astype(cfg.dtype)[pos2d]
+            )
+
+        def qkv(x, p, pos2d):
+            return _qkv(x, p, cfg)
+
+        def finish(x, attn, p):
+            return _finish_block(x, attn, p, cfg)
+
+        def final(params, last):
+            h = _layer_norm(last, params["lnf_scale"], params["lnf_bias"])
+            return (h @ params["wte"].astype(cfg.dtype).T).astype(
+                jnp.float32
+            )
+
+    return embed, qkv, finish, final, H, KH, Dh
+
+
+# ---------------------------------------------------------------------------
+# Paged ops
+
+
+def paged_prefill(
+    params: Params,
+    tokens: jax.Array,  # [1, T] int32 — suffix tokens (whole prompt if
+    #                      start == 0), left-aligned in a static bucket
+    length: jax.Array,  # scalar int32 — true suffix token count (<= T)
+    start: jax.Array,  # scalar int32 — cached-prefix length (block-aligned;
+    #                     0 for a fresh prompt). Traced: no recompile per
+    #                     prefix length.
+    table: jax.Array,  # [W] int32 block table for this request
+    pool,
+    cfg,
+    *,
+    block_size: int,
+):
+    """Prefill positions [start, start+T) into the pool; return
+    (pool, last_logits [vocab] f32).
+
+    The one prefill program serves both the fresh path (start=0) and the
+    prefix-continue path — attention always spans the full gathered row
+    under the mask ``col <= start + row`` (the static-shape trade)."""
+    B, T = tokens.shape
+    W = table.shape[0]
+    S = W * block_size
+    embed, qkv, finish, final, H, KH, Dh = _family(cfg, S)
+    group = H // KH
+
+    pos = start + jnp.arange(T, dtype=jnp.int32)  # [T]
+    x = embed(params, tokens, pos[None])
+    bids = table[pos // block_size]  # [T] physical blocks to write
+    offs = pos % block_size
+    khi = jnp.arange(KH)
+    cols = jnp.arange(S)
+    mask = cols[None, :] <= pos[:, None]  # [T, S]
+    scale = 1.0 / (Dh**0.5)
+
+    def body(x, layer):
+        p, pk, pv = layer  # pk/pv: [N, KH, block, Dh]
+        q, k, v = qkv(x, p, pos[None])  # q [1,H,T,Dh], k/v [1,KH,T,Dh]
+        kt = k[0].transpose(1, 0, 2)  # [T, KH, Dh]
+        vt = v[0].transpose(1, 0, 2)
+        pk = pk.at[bids[:, None], khi[None, :], offs[:, None]].set(kt)
+        pv = pv.at[bids[:, None], khi[None, :], offs[:, None]].set(vt)
+        # Gather this request's row (transient): [W,KH,block,Dh]->[KH,S,Dh]
+        kd = pk[table].transpose(1, 0, 2, 3).reshape(KH, S, Dh)
+        vd = pv[table].transpose(1, 0, 2, 3).reshape(KH, S, Dh)
+        qg = q[0].reshape(KH, group, T, Dh)
+        s = jnp.einsum("kgtd,ksd->kgts", qg, kd).astype(jnp.float32) * scale
+        s = jnp.where(mask[None, None], s, -1e30)
+        pa = jax.nn.softmax(s, axis=-1).astype(vd.dtype)
+        attn = jnp.einsum("kgts,ksd->kgtd", pa, vd).reshape(1, H, T, Dh)
+        return finish(x, attn, p), (pk, pv)
+
+    x, (ks, vs) = jax.lax.scan(
+        lambda c, lyr: body(c, lyr),
+        x,
+        (params["blocks"], pool["k"], pool["v"]),
+    )
+    pool = {"k": ks, "v": vs}
+    last = jax.lax.dynamic_index_in_dim(
+        x[0], (length - 1).astype(jnp.int32), axis=0, keepdims=False
+    )
+    logits = final(params, last[None])[0]
+    return pool, logits
+
+
+def paged_decode(
+    params: Params,
+    last_tokens: jax.Array,  # [B] int32
+    positions: jax.Array,  # [B] int32 — write position per slot
+    tables: jax.Array,  # [B, W] int32 — per-slot block tables
+    pool,
+    cfg,
+    *,
+    block_size: int,
+):
+    """One token per slot against the shared pool; returns
+    (pool, logits [B, vocab] f32). Free slots must point their table at
+    the scratch block (id 0) so their garbage writes never land in a
+    block another request owns."""
+    B = last_tokens.shape[0]
+    W = tables.shape[1]
+    S = W * block_size
+    embed, qkv, finish, final, H, KH, Dh = _family(cfg, S)
+    group = H // KH
+
+    x = embed(params, last_tokens[:, None], positions[:, None])  # [B,1,D]
+    rows = jnp.arange(B)
+    bids = tables[rows, positions // block_size]  # [B]
+    offs = positions % block_size
+    khi = jnp.arange(KH)
+    cols = jnp.arange(S)
+    mask = cols[None, :] <= positions[:, None]  # [B, S]
+    scale = 1.0 / (Dh**0.5)
+
+    def body(x, layer):
+        p, pk, pv = layer  # [N, KH, block, Dh]
+        q, k, v = qkv(x, p, positions[:, None])  # [B,{H,KH},1,Dh]
+        pk = pk.at[bids[:, None], khi[None, :], offs[:, None]].set(
+            k[:, :, 0, :]
+        )
+        pv = pv.at[bids[:, None], khi[None, :], offs[:, None]].set(
+            v[:, :, 0, :]
+        )
+        kd = pk[tables].transpose(0, 2, 1, 3, 4).reshape(B, KH, S, Dh)
+        vd = pv[tables].transpose(0, 2, 1, 3, 4).reshape(B, KH, S, Dh)
+        qg = q[:, :, 0, :].reshape(B, KH, group, Dh)
+        s = jnp.einsum("bkgd,bksd->bkgs", qg, kd).astype(jnp.float32) * scale
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        pa = jax.nn.softmax(s, axis=-1).astype(vd.dtype)
+        attn = jnp.einsum("bkgs,bksd->bkgd", pa, vd).reshape(B, H, 1, Dh)
+        return finish(x, attn, p), (pk, pv)
+
+    x, (ks, vs) = jax.lax.scan(
+        lambda c, lyr: body(c, lyr),
+        x,
+        (params["blocks"], pool["k"], pool["v"]),
+    )
+    pool = {"k": ks, "v": vs}
+    logits = final(params, x[:, 0, :])
+    return pool, logits

@@ -204,8 +204,11 @@ def test_llama_family_engine_generates_and_prefix_caches():
             prefix_chunk=16,
         )
     )
-    # GQA cache stores KV heads unexpanded.
-    assert eng.cache["k"].shape == (2, 4, 2, 128, 16)
+    # GQA block pool stores KV heads unexpanded: [L, N, KH, block, Dh].
+    assert eng.paged
+    assert eng.pool["k"].shape[0] == 2  # layers
+    assert eng.pool["k"].shape[2] == 2  # n_kv_head, NOT n_head=4
+    assert eng.pool["k"].shape[4] == 16  # head_dim
     sampling = SamplingParams(max_tokens=4, temperature=0.0)
     shared = list(range(3, 35))  # 32-token aligned prefix
     out1 = eng.generate([shared + [40]], sampling)[0]
