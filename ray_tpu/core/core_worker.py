@@ -1781,7 +1781,9 @@ class CoreWorker:
         await self._actor_ready.wait()
         if self._actor_init_error is not None:
             raise self._actor_init_error
-        loop = DagLoop(self._actor_instance, p["tasks"])
+        loop = DagLoop(
+            self._actor_instance, p["tasks"], overlap=p.get("overlap", True)
+        )
         self._dag_loops[p["dag_id"]] = loop
         loop.start()
         return True

@@ -8,9 +8,11 @@ interpreted with ``.execute(x)``, or compile with
 """
 
 from ray_tpu.dag.channel import ChannelTimeout, ShmChannel
+from ray_tpu.dag.collective import allgather, allreduce
 from ray_tpu.dag.compiled import CompiledDAG, DAGRef
 from ray_tpu.dag.nodes import (
     ClassMethodNode,
+    CollectiveNode,
     DAGNode,
     InputNode,
     MultiOutputNode,
@@ -19,10 +21,13 @@ from ray_tpu.dag.nodes import (
 __all__ = [
     "ChannelTimeout",
     "ClassMethodNode",
+    "CollectiveNode",
     "CompiledDAG",
     "DAGNode",
     "DAGRef",
     "InputNode",
     "MultiOutputNode",
     "ShmChannel",
+    "allgather",
+    "allreduce",
 ]

@@ -34,6 +34,8 @@ _HDR = struct.Struct("<QQQ")  # seq, ack, len
 _U64 = struct.Struct("<Q")
 _OFF_SEQ, _OFF_ACK, _OFF_LEN = 0, 8, 16
 _SPIN_S = 0.0002
+# Chaos knob for scheduling tests: per-read simulated transfer latency.
+_READ_DELAY_S = float(os.environ.get("RAY_TPU_DAG_READ_DELAY_MS", "0")) / 1e3
 
 
 class ChannelTimeout(Exception):
@@ -133,6 +135,11 @@ class ShmChannel:
             time.sleep(_SPIN_S)
         value = pickle.loads(self._mm[_HDR.size : _HDR.size + ln])
         _U64.pack_into(self._mm, _OFF_ACK, seq)  # reader owns ack only
+        if _READ_DELAY_S > 0.0:
+            # Chaos knob — no-op in production (env unset): simulated
+            # transfer latency, so scheduling tests can prove the overlap
+            # pass hides read cost without multi-GB payloads.
+            time.sleep(_READ_DELAY_S)
         return value
 
     def close(self, unlink: bool = False) -> None:

@@ -1,0 +1,76 @@
+"""In-DAG collective operations: ``allreduce.bind([...])``.
+
+Reference parity: python/ray/experimental/collective/operations.py:151
+(AllReduceWrapper.bind creating per-rank collective nodes inside a
+compiled graph — the reference lowers them to NCCL; here each rank's
+DagLoop calls :mod:`ray_tpu.util.collective`, whose CPU backend
+rendezvouses via the GCS coordinator and whose XLA backend runs a
+multi-controller psum over ICI).
+
+Usage::
+
+    with InputNode() as inp:
+        g1 = w1.grads.bind(inp)
+        g2 = w2.grads.bind(inp)
+        r1, r2 = allreduce.bind([g1, g2])
+        dag = MultiOutputNode([w1.apply.bind(r1), w2.apply.bind(r2)])
+    compiled = dag.experimental_compile()
+
+The compile step declares one collective group per bind over the
+participating actors (create_collective_group — actors auto-join on
+their first collective call) and tears it down with the DAG.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from ray_tpu.dag.nodes import ClassMethodNode, CollectiveNode
+
+_group_ids = itertools.count()
+
+
+class _CollectiveWrapper:
+    def __init__(self, kind: str):
+        self._kind = kind
+
+    def bind(
+        self,
+        nodes: list,
+        *,
+        op: str = "sum",
+        backend: str = "cpu",
+        group_name: str | None = None,
+    ) -> list:
+        """One upstream node per rank (each on a distinct actor); returns
+        the per-rank reduced nodes in the same order."""
+        if len(nodes) < 2:
+            raise ValueError("a collective needs at least 2 participants")
+        for n in nodes:
+            if not isinstance(n, ClassMethodNode):
+                raise TypeError(
+                    f"collective inputs must be actor method nodes, got {n!r}"
+                )
+        actors = [n.actor._actor_id for n in nodes]
+        if len(set(actors)) != len(actors):
+            raise ValueError(
+                "collective participants must be distinct actors (one rank "
+                "per process)"
+            )
+        name = group_name or f"dag-coll-{next(_group_ids)}"
+        return [
+            CollectiveNode(
+                n,
+                group_name=name,
+                rank=i,
+                world_size=len(nodes),
+                op=op,
+                backend=backend,
+                collective=self._kind,
+            )
+            for i, n in enumerate(nodes)
+        ]
+
+
+allreduce = _CollectiveWrapper("allreduce")
+allgather = _CollectiveWrapper("allgather")

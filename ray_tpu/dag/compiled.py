@@ -62,12 +62,20 @@ def interpret(root: DAGNode, args: tuple, kwargs: dict) -> Any:
     def resolve(v):
         return values[v.node_id] if isinstance(v, DAGNode) else v
 
+    from ray_tpu.dag.nodes import CollectiveNode
+
     result = None
     for node in _toposort(root):
         if isinstance(node, InputNode):
             if kwargs or len(args) != 1:
                 raise ValueError("DAG execute takes exactly one positional arg")
             values[node.node_id] = args[0]
+        elif isinstance(node, CollectiveNode):
+            raise NotImplementedError(
+                "collective nodes require experimental_compile(): the "
+                "uncompiled interpreter runs nodes one at a time, so a "
+                "gang rendezvous would deadlock"
+            )
         elif isinstance(node, ClassMethodNode):
             a = [resolve(v) for v in node.args]
             kw = {k: resolve(v) for k, v in node.kwargs.items()}
@@ -101,16 +109,50 @@ class CompiledDAG:
         *,
         buffer_size: int = 1 << 20,
         device_transfers: bool = False,
+        overlap: bool = True,
     ):
         import ray_tpu
         from ray_tpu.core import api as core_api
         from ray_tpu.dag.channel import RpcChannel, open_channel
+        from ray_tpu.dag.nodes import CollectiveNode
 
         self._worker = core_api._require_worker()
         self.dag_id = f"dag-{next(_dag_ids)}"
         self.buffer_size = buffer_size
+        self.overlap = overlap
         nodes = _toposort(root)
         self.root = root
+
+        # -- declare in-DAG collective groups --------------------------------
+        # One group per allreduce.bind(); actors auto-join on their first
+        # collective call (reference: operations.py:151 init path).
+        groups: dict[str, list] = {}
+        for n in nodes:
+            if isinstance(n, CollectiveNode):
+                groups.setdefault(n.collective["group_name"], []).append(n)
+        self._collective_groups: list[str] = []
+        if groups:
+            from ray_tpu.util.collective import collective as _coll
+
+            for gname, members in groups.items():
+                members = sorted(members, key=lambda m: m.collective["rank"])
+                ws = members[0].collective["world_size"]
+                if len(members) != ws:
+                    raise ValueError(
+                        f"collective group {gname!r}: {len(members)} nodes "
+                        f"in the DAG but world_size={ws}"
+                    )
+                try:
+                    _coll.create_collective_group(
+                        [m.actor for m in members],
+                        ws,
+                        [m.collective["rank"] for m in members],
+                        backend=members[0].collective["backend"],
+                        group_name=gname,
+                    )
+                except ValueError:
+                    pass  # pre-declared by the user: fine
+                self._collective_groups.append(gname)
 
         inputs = [n for n in nodes if isinstance(n, InputNode)]
         if len(inputs) != 1:
@@ -246,14 +288,15 @@ class CompiledDAG:
                     out_chans_by_pos[li] = open_channel(spec, mode="read")
                     out_specs.append(spec)
             aid = n.actor._actor_id
-            per_actor.setdefault(aid, []).append(
-                {
-                    "method": n.method_name,
-                    "args": arg_specs,
-                    "kwargs": kwarg_specs,
-                    "outputs": out_specs,
-                }
-            )
+            task = {
+                "method": n.method_name,
+                "args": arg_specs,
+                "kwargs": kwarg_specs,
+                "outputs": out_specs,
+            }
+            if isinstance(n, CollectiveNode):
+                task["collective"] = dict(n.collective)
+            per_actor.setdefault(aid, []).append(task)
 
         self._output_chans = [
             out_chans_by_pos[li] for li in range(len(out_leaves))
@@ -262,7 +305,7 @@ class CompiledDAG:
             self._worker.endpoint.call(
                 self._actor_addrs[aid],
                 "worker.start_dag_loop",
-                {"dag_id": self.dag_id, "tasks": tasks},
+                {"dag_id": self.dag_id, "tasks": tasks, "overlap": overlap},
                 timeout=30,
             )
         self._submitted = 0
@@ -331,6 +374,16 @@ class CompiledDAG:
                 try:
                     _os.unlink(spec["path"])
                 except OSError:
+                    pass
+        # Auto-declared collective groups die with the DAG (the driver is
+        # a non-member, so destroy tears down coordinator + declaration).
+        if self._collective_groups:
+            from ray_tpu.util.collective import collective as _coll
+
+            for g in self._collective_groups:
+                try:
+                    _coll.destroy_collective_group(g)
+                except Exception:
                     pass
 
     def __del__(self):

@@ -1,15 +1,29 @@
 """Actor-side compiled-DAG executor loop.
 
 Reference parity: the ExecutableTask loop compiled_dag_node.py schedules
-onto each actor. One daemon thread per (actor, DAG): read operand channels
-(in task order), invoke the bound methods on the actor instance, write
-result channels. Errors travel the channels as ``_DagTaskError`` markers so
-the driver re-raises and downstream nodes skip execution for that index
-instead of deadlocking.
+onto each actor, including its two round-4-missing capabilities:
+
+- **Compute/comm overlap** (reference: the overlapped NCCL-stream
+  scheduling in compiled_dag_node.py): with ``overlap=True`` each task
+  gets a prefetcher thread that reads the NEXT tick's operands — pulling
+  shm/rpc/device-channel transfers — while the main loop is still
+  computing the current tick. Transfer latency hides behind compute; the
+  main loop stays strictly serial (one compute at a time per actor), so
+  execution order and results are unchanged.
+- **In-DAG collectives** (reference: experimental/collective/
+  operations.py:151): a task carrying a ``collective`` spec calls
+  :mod:`ray_tpu.util.collective` instead of an instance method; the
+  gang's loops rendezvous across actors (auto-joining the group the
+  driver declared at compile time).
+
+Errors travel the channels as ``_DagTaskError`` markers so the driver
+re-raises and downstream nodes skip execution for that index instead of
+deadlocking.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 
 from ray_tpu.dag.channel import ChannelTimeout, open_channel
@@ -24,14 +38,83 @@ class _DagTaskError:
         self.exc = exc
 
 
+class _StopLoop(Exception):
+    pass
+
+
+class _ChannelDied:
+    """Prefetcher -> main loop marker: operand transport is gone."""
+
+
+class _Prefetcher:
+    """Reads one task's operand channels ahead of the compute loop.
+
+    A bounded queue (depth 1) means at most one tick is prefetched — the
+    next tick's transfers overlap the current tick's compute, and channel
+    backpressure still bounds the pipeline."""
+
+    def __init__(self, task: dict, stop: threading.Event):
+        self._task = task
+        self._stop = stop
+        self.q: queue.Queue = queue.Queue(maxsize=1)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dag-prefetch"
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def join(self) -> None:
+        self._thread.join(timeout=5)
+
+    def _read(self, ch):
+        while not self._stop.is_set():
+            try:
+                return ch.read(timeout=_POLL_S)
+            except ChannelTimeout:
+                continue
+            except Exception:
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "compiled-DAG prefetch stopping: operand channel died"
+                )
+                raise _StopLoop
+        raise _StopLoop
+
+    def _run(self) -> None:
+        t = self._task
+        try:
+            while not self._stop.is_set():
+                operands = []
+                for k, v in t["args"]:
+                    operands.append(self._read(v) if k == "chan" else v)
+                kw = {}
+                for name, (k, v) in t["kwargs"].items():
+                    kw[name] = self._read(v) if k == "chan" else v
+                while not self._stop.is_set():
+                    try:
+                        self.q.put((operands, kw), timeout=_POLL_S)
+                        break
+                    except queue.Full:
+                        continue
+        except _StopLoop:
+            try:
+                self.q.put_nowait(_ChannelDied)
+            except queue.Full:
+                pass
+
+
 class DagLoop:
-    def __init__(self, instance, tasks: list[dict]):
+    def __init__(self, instance, tasks: list[dict], overlap: bool = True):
         self.instance = instance
+        self.overlap = overlap
         self.tasks = []
         for t in tasks:
             self.tasks.append(
                 {
                     "method": t["method"],
+                    "collective": t.get("collective"),
                     # Operand channels are READ here; result channels are
                     # WRITTEN (rpc channels are mailbox-reader vs
                     # push-writer — the role matters).
@@ -52,16 +135,32 @@ class DagLoop:
                 }
             )
         self._stop = threading.Event()
+        self._prefetchers: list[_Prefetcher] = []
+        if overlap:
+            for t in self.tasks:
+                has_chan = any(k == "chan" for k, _ in t["args"]) or any(
+                    k == "chan" for k, _ in t["kwargs"].values()
+                )
+                t["prefetch"] = _Prefetcher(t, self._stop) if has_chan else None
+                if t["prefetch"] is not None:
+                    self._prefetchers.append(t["prefetch"])
+        else:
+            for t in self.tasks:
+                t["prefetch"] = None
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="dag-loop"
         )
 
     def start(self) -> None:
+        for p in self._prefetchers:
+            p.start()
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+        for p in self._prefetchers:
+            p.join()
         for t in self.tasks:
             # unlink=True: actor-to-actor shm files live on THIS host and
             # nobody else can clean them; double-unlink is a swallowed
@@ -94,28 +193,59 @@ class DagLoop:
                 raise _StopLoop
         raise _StopLoop
 
+    def _operands(self, t: dict):
+        """(operands, kwargs) for one tick — prefetched or read inline."""
+        pf = t.get("prefetch")
+        if pf is not None:
+            while not self._stop.is_set():
+                try:
+                    got = pf.q.get(timeout=_POLL_S)
+                except queue.Empty:
+                    continue
+                if got is _ChannelDied:
+                    raise _StopLoop
+                return got
+            raise _StopLoop
+        operands = [
+            self._read(v) if k == "chan" else v for k, v in t["args"]
+        ]
+        kw = {
+            name: (self._read(v) if k == "chan" else v)
+            for name, (k, v) in t["kwargs"].items()
+        }
+        return operands, kw
+
+    def _invoke(self, t: dict, operands: list, kw: dict):
+        if t["collective"] is not None:
+            from ray_tpu.util.collective import collective as coll
+            from ray_tpu.util.collective.types import ReduceOp
+
+            c = t["collective"]
+            if c["kind"] == "allreduce":
+                return coll.allreduce(
+                    operands[0], c["group_name"], ReduceOp(c["op"])
+                )
+            if c["kind"] == "allgather":
+                return coll.allgather(operands[0], c["group_name"])
+            raise ValueError(f"unknown collective {c['kind']!r}")
+        return getattr(self.instance, t["method"])(*operands, **kw)
+
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
                 for t in self.tasks:
-                    operands = []
-                    err = None
-                    for k, v in t["args"]:
-                        val = self._read(v) if k == "chan" else v
-                        if isinstance(val, _DagTaskError):
-                            err = val
-                        operands.append(val)
-                    kw = {}
-                    for name, (k, v) in t["kwargs"].items():
-                        val = self._read(v) if k == "chan" else v
-                        if isinstance(val, _DagTaskError):
-                            err = val
-                        kw[name] = val
+                    operands, kw = self._operands(t)
+                    err = next(
+                        (
+                            v
+                            for v in [*operands, *kw.values()]
+                            if isinstance(v, _DagTaskError)
+                        ),
+                        None,
+                    )
                     if err is None:
                         try:
-                            result = getattr(self.instance, t["method"])(
-                                *operands, **kw
-                            )
+                            result = self._invoke(t, operands, kw)
                         except Exception as e:  # noqa: BLE001
                             result = _DagTaskError(e)
                     else:
@@ -143,7 +273,3 @@ class DagLoop:
             logging.getLogger("ray_tpu").exception(
                 "compiled-DAG loop died"
             )
-
-
-class _StopLoop(Exception):
-    pass

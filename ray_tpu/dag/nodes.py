@@ -68,3 +68,43 @@ class MultiOutputNode(DAGNode):
 
     def __init__(self, outputs: list):
         super().__init__(args=tuple(outputs))
+
+
+class CollectiveNode(ClassMethodNode):
+    """One rank's participation in an in-DAG collective (reference:
+    python/ray/experimental/collective/operations.py:151 —
+    ``allreduce.bind([...])``). Runs on the SAME actor as its upstream
+    node; the DagLoop executes the collective library call instead of an
+    instance method, so the gang's calls rendezvous across actors while
+    each actor's loop stays serial. Built via
+    :func:`ray_tpu.dag.collective.allreduce.bind`."""
+
+    def __init__(
+        self,
+        upstream: ClassMethodNode,
+        *,
+        group_name: str,
+        rank: int,
+        world_size: int,
+        op: str,
+        backend: str,
+        collective: str = "allreduce",
+    ):
+        super().__init__(
+            upstream.actor, f"__dag_{collective}__", (upstream,), {}
+        )
+        self.collective = {
+            "kind": collective,
+            "group_name": group_name,
+            "rank": rank,
+            "world_size": world_size,
+            "op": op,
+            "backend": backend,
+        }
+
+    def __repr__(self):
+        c = self.collective
+        return (
+            f"CollectiveNode({c['kind']}, rank={c['rank']}/"
+            f"{c['world_size']}, id={self.node_id})"
+        )
