@@ -18,6 +18,7 @@ single_agent_env_runner.py:67), redesigned TPU-first:
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.appo import Appo, AppoConfig, AppoLearner
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNLearner, QModule
 from ray_tpu.rllib.offline import (
     BC,
@@ -36,6 +37,9 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "Appo",
+    "AppoConfig",
+    "AppoLearner",
     "BC",
     "BCConfig",
     "BCLearner",

@@ -306,3 +306,395 @@ class MultiAgentPPO(PPO):
             env_to_module=config.env_to_module,
             module_to_env=config.module_to_env,
         )
+
+
+# ---------------------------------------------------------------------------
+# Per-policy (independent-learner) multi-agent
+# ---------------------------------------------------------------------------
+
+
+class MultiAgentPolicyEnvRunner:
+    """Per-policy rollout actor (reference: the policy_mapping_fn +
+    MultiRLModule split in rllib/env/multi_agent_env.py and
+    rllib/core/rl_module/multi_rl_module.py). A mapping fn assigns each
+    agent id to a policy id; each policy's module steps its own agents'
+    stacked observations (one jitted call per policy per step), and
+    ``sample()`` returns one row-major SampleBatch PER POLICY — so
+    heterogeneous teams train independent learners on disjoint
+    experience."""
+
+    def __init__(
+        self,
+        env_maker: Callable,
+        modules: dict,
+        policy_mapping_fn: Callable,
+        *,
+        rollout_fragment_length: int = 128,
+        gamma: float = 0.99,
+        lambda_: float = 0.95,
+        seed: int = 0,
+        worker_index: int = 0,
+    ):
+        self._env: MultiAgentEnv = env_maker()
+        self.agents = list(self._env.agents)
+        self.modules = dict(modules)
+        self._map = {a: policy_mapping_fn(a) for a in self.agents}
+        unknown = {p for p in self._map.values()} - set(self.modules)
+        if unknown:
+            raise ValueError(
+                f"policy_mapping_fn produced unknown policy ids {unknown}"
+            )
+        # Per-policy agent index groups (stable order within the policy).
+        self._groups: dict[str, list[int]] = {}
+        for i, a in enumerate(self.agents):
+            self._groups.setdefault(self._map[a], []).append(i)
+        self.fragment_len = rollout_fragment_length
+        self.gamma = gamma
+        self.lam = lambda_
+        self._key = jax.random.key(seed * 100003 + worker_index)
+        obs, _ = self._env.reset(seed=seed * 7919 + worker_index)
+        self._obs = self._stack(obs)
+        try:
+            self._cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # pragma: no cover
+            self._cpu = None
+        self._params: dict = {}
+        self._ep_return = 0.0
+        self._ep_len = 0
+        self._episode_returns: collections.deque = collections.deque(
+            maxlen=100
+        )
+        self._episode_lengths: collections.deque = collections.deque(
+            maxlen=100
+        )
+        self._total_steps = 0
+        self._policy_steps = {}
+        self._vfs = {}
+        for pid, module in self.modules.items():
+
+            def _mk(mod):
+                @jax.jit
+                def _step(params, obs, key):
+                    out = mod.forward(params, obs)
+                    actions = mod.dist_sample(out, key)
+                    logp = mod.dist_logp(out, actions)
+                    return actions, logp, out["vf"]
+
+                return _step, jax.jit(
+                    lambda params, obs: mod.forward(params, obs)["vf"]
+                )
+
+            self._policy_steps[pid], self._vfs[pid] = _mk(module)
+
+    def _stack(self, obs_dict: dict) -> np.ndarray:
+        return np.stack(
+            [np.asarray(obs_dict[a], np.float32) for a in self.agents]
+        )
+
+    def set_weights(self, weights: dict, version: int = 0) -> bool:
+        for pid, params in weights.items():
+            params = to_numpy(params)
+            if self._cpu is not None:
+                params = jax.device_put(params, self._cpu)
+            self._params[pid] = params
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+    def sample(self) -> dict:
+        """{policy_id: SampleBatch} — each policy sees only its agents."""
+        if not self._params:
+            raise RuntimeError("set_weights() before sample()")
+        T, N = self.fragment_len, len(self.agents)
+        obs_buf = np.empty((T,) + self._obs.shape, np.float32)
+        act_buf = None
+        logp_buf = np.empty((T, N), np.float32)
+        vf_buf = np.empty((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        term_buf = np.zeros((T, N), np.float32)
+        trunc_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            obs_buf[t] = self._obs
+            step_actions: list = [None] * N
+            for pid, idxs in self._groups.items():
+                self._key, k = jax.random.split(self._key)
+                actions, logp, vf = self._policy_steps[pid](
+                    self._params[pid], self._obs[idxs], k
+                )
+                a_np = np.asarray(actions)
+                for j, gi in enumerate(idxs):
+                    step_actions[gi] = a_np[j]
+                logp_buf[t, idxs] = np.asarray(logp)
+                vf_buf[t, idxs] = np.asarray(vf)
+            acts = np.stack(step_actions)  # [N] or [N, act_dim]
+            if act_buf is None:
+                act_buf = np.empty((T,) + acts.shape, acts.dtype)
+            act_buf[t] = acts
+            action_dict = {
+                a: step_actions[i] for i, a in enumerate(self.agents)
+            }
+            obs, rew, term, trunc, _ = self._env.step(action_dict)
+            for i, a in enumerate(self.agents):
+                rew_buf[t, i] = rew.get(a, 0.0)
+                term_buf[t, i] = float(term.get(a, False))
+                trunc_buf[t, i] = float(trunc.get(a, False))
+            self._ep_return += float(sum(rew.values()))
+            self._ep_len += 1
+            done_all = term.get("__all__", False) or trunc.get(
+                "__all__", False
+            )
+            if done_all:
+                self._episode_returns.append(self._ep_return)
+                self._episode_lengths.append(self._ep_len)
+                self._ep_return = 0.0
+                self._ep_len = 0
+                if trunc.get("__all__", False):
+                    # Same fold as the shared-policy runner: bake
+                    # gamma*V(final) into the reward, mark terminated.
+                    final = self._stack(obs)
+                    for pid, idxs in self._groups.items():
+                        fv = np.asarray(
+                            self._vfs[pid](self._params[pid], final[idxs])
+                        )
+                        rew_buf[t, idxs] += self.gamma * fv
+                term_buf[t] = 1.0
+                trunc_buf[t] = 0.0
+                obs, _ = self._env.reset()
+            self._obs = self._stack(obs)
+        self._total_steps += T * N
+
+        out: dict[str, SampleBatch] = {}
+        for pid, idxs in self._groups.items():
+            last_vf = np.asarray(
+                self._vfs[pid](self._params[pid], self._obs[idxs])
+            )
+            adv, targets = compute_gae(
+                rew_buf[:, idxs],
+                vf_buf[:, idxs],
+                last_vf,
+                term_buf[:, idxs],
+                trunc_buf[:, idxs],
+                self.gamma,
+                self.lam,
+            )
+            n = len(idxs)
+            flat = lambda a: a.reshape((T * n,) + a.shape[2:])  # noqa: E731
+            out[pid] = SampleBatch(
+                {
+                    sb.OBS: flat(obs_buf[:, idxs]),
+                    sb.ACTIONS: flat(act_buf[:, idxs]),
+                    sb.LOGP: flat(logp_buf[:, idxs]),
+                    sb.VF_PREDS: flat(vf_buf[:, idxs]),
+                    sb.REWARDS: flat(rew_buf[:, idxs]),
+                    sb.TERMINATEDS: flat(term_buf[:, idxs]),
+                    sb.TRUNCATEDS: flat(trunc_buf[:, idxs]),
+                    sb.ADVANTAGES: flat(adv),
+                    sb.VALUE_TARGETS: flat(targets),
+                    sb.LOSS_MASK: np.ones((T * n,), np.float32),
+                }
+            )
+        return out
+
+    def metrics(self) -> dict:
+        rets = list(self._episode_returns)
+        return {
+            "num_env_steps_sampled": self._total_steps,
+            "num_episodes": len(rets),
+            "episode_return_mean": (
+                float(np.mean(rets)) if rets else np.nan
+            ),
+            "episode_len_mean": (
+                float(np.mean(self._episode_lengths))
+                if self._episode_lengths
+                else np.nan
+            ),
+        }
+
+    def stop(self) -> None:
+        self._env.close()
+
+
+class IndependentMultiAgentPPOConfig(PPOConfig):
+    """PPO config + the per-policy fields (reference: the policies /
+    policy_mapping_fn entries of AlgorithmConfig.multi_agent())."""
+
+    policies: tuple = ()
+    policy_mapping_fn: Callable | None = None
+
+    def multi_agent(self, *, policies, policy_mapping_fn):
+        import copy as _copy
+
+        c = _copy.copy(self)
+        c.policies = tuple(policies)
+        c.policy_mapping_fn = policy_mapping_fn
+        return c
+
+    @property
+    def algo_class(self) -> type:
+        return IndependentMultiAgentPPO
+
+
+class IndependentMultiAgentPPO:
+    """Per-policy PPO: one learner per policy id, independent weights,
+    experience routed by the policy_mapping_fn (reference: independent
+    learners in rllib's MultiRLModule setup). The driver surface matches
+    Algorithm (train/save/restore/stop) without inheriting its
+    single-module plumbing."""
+
+    def __init__(self, config: IndependentMultiAgentPPOConfig):
+        import ray_tpu
+        from ray_tpu.rllib.ppo import PPOLearner
+        from ray_tpu.rllib.rl_module import MLPModule
+
+        if not config.policies or config.policy_mapping_fn is None:
+            raise ValueError(
+                "config.multi_agent(policies=..., policy_mapping_fn=...) "
+                "is required"
+            )
+        self.config = config
+        self.iteration = 0
+        self._total_env_steps = 0
+        maker = (
+            config.env if callable(config.env) else None
+        )
+        if maker is None:
+            raise ValueError("config.env must be a MultiAgentEnv factory")
+        env = maker()
+        try:
+            obs_dim = int(np.prod(env.observation_space.shape))
+            space = env.action_space
+            discrete = hasattr(space, "n")
+            num_out = (
+                int(space.n) if discrete else int(np.prod(space.shape))
+            )
+        finally:
+            env.close()
+        self.modules = {}
+        self.learners = {}
+        for j, pid in enumerate(config.policies):
+            hps = config.hyperparams()
+            hps.seed = config.seed + 1000 * j  # independent inits
+            module = MLPModule(
+                obs_dim=obs_dim,
+                num_outputs=num_out,
+                hidden=tuple(config.hidden),
+                discrete=discrete,
+            )
+            self.modules[pid] = module
+            learner = PPOLearner(module, hps, self._ppo_params())
+            learner.build()
+            self.learners[pid] = learner
+        runner_opts = config.env_runner_resources or {"num_cpus": 1}
+        self.env_runners = [
+            ray_tpu.remote(MultiAgentPolicyEnvRunner)
+            .options(**runner_opts)
+            .remote(
+                maker,
+                self.modules,
+                config.policy_mapping_fn,
+                rollout_fragment_length=config.rollout_fragment_length,
+                gamma=config.gamma,
+                lambda_=config.lambda_,
+                seed=config.seed,
+                worker_index=i,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        self._sync_weights()
+
+    def _ppo_params(self):
+        from ray_tpu.rllib.ppo import PPOParams
+
+        c = self.config
+        return PPOParams(
+            clip_param=c.clip_param,
+            vf_clip_param=c.vf_clip_param,
+            vf_loss_coeff=c.vf_loss_coeff,
+            entropy_coeff=c.entropy_coeff,
+        )
+
+    def get_weights(self) -> dict:
+        return {
+            pid: lr.get_weights() for pid, lr in self.learners.items()
+        }
+
+    def _sync_weights(self) -> None:
+        import ray_tpu
+
+        weights = self.get_weights()
+        ray_tpu.get(
+            [r.set_weights.remote(weights) for r in self.env_runners]
+        )
+
+    def train(self) -> dict:
+        import ray_tpu
+
+        per_runner = ray_tpu.get(
+            [r.sample.remote() for r in self.env_runners]
+        )
+        learn_stats = {}
+        steps = 0
+        for pid, learner in self.learners.items():
+            parts = [b[pid] for b in per_runner if pid in b]
+            if not parts:
+                continue
+            batch = SampleBatch.concat(parts)
+            steps += len(batch)
+            learn_stats[pid] = learner.update(batch)
+        self._sync_weights()
+        self._total_env_steps += steps
+        self.iteration += 1
+        runner_metrics = ray_tpu.get(
+            [r.metrics.remote() for r in self.env_runners]
+        )
+        rets = [
+            m["episode_return_mean"]
+            for m in runner_metrics
+            if not np.isnan(m["episode_return_mean"])
+        ]
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
+            "learner": learn_stats,
+        }
+
+    def save(self, path: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "learners": {
+                pid: lr.get_state() for pid, lr in self.learners.items()
+            },
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+        }
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        for pid, st in state["learners"].items():
+            self.learners[pid].set_state(st)
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self._sync_weights()
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self.env_runners:
+            try:
+                r.stop.remote()
+                ray_tpu.kill(r)
+            except Exception:
+                pass
