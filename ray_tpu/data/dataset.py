@@ -146,6 +146,40 @@ class Dataset:
             refs.extend(ref for ref, _ in o._executor().iter_blocks())
         return Dataset(DataPlan(input_refs=refs))
 
+    def join(
+        self,
+        other: "Dataset",
+        on: str,
+        *,
+        how: str = "inner",
+        num_partitions: Optional[int] = None,
+    ) -> "Dataset":
+        """Hash join on column ``on`` (reference: Dataset.join backed by
+        the hash-shuffle operators). The right side materializes to block
+        refs; the left side streams — each arriving left block is
+        hash-partitioned immediately, and per-partition join tasks run in
+        parallel. ``how``: inner | left_outer | right_outer | full_outer.
+        """
+        from ray_tpu.data.plan import JoinOp
+
+        aliases = {
+            "inner": "inner",
+            "left": "left outer",
+            "left_outer": "left outer",
+            "right": "right outer",
+            "right_outer": "right outer",
+            "outer": "full outer",
+            "full_outer": "full outer",
+        }
+        if how not in aliases:
+            raise ValueError(
+                f"how={how!r}; expected one of {sorted(aliases)}"
+            )
+        right_refs = [ref for ref, _ in other._executor().iter_blocks()]
+        return self._with_op(
+            JoinOp(on, right_refs, aliases[how], num_partitions)
+        )
+
     def zip(self, other: "Dataset") -> "Dataset":
         """Horizontal concat (column-wise); materializes both sides."""
         left = concat_blocks(self._fetch_blocks())

