@@ -28,6 +28,7 @@ from ray_tpu.tune.search_alg import (
     GridSearcher,
     RandomSearcher,
     Searcher,
+    TPESearcher,
 )
 from ray_tpu.tune.tuner import (
     TuneConfig,
@@ -46,6 +47,7 @@ __all__ = [
     "GridSearcher",
     "RandomSearcher",
     "Searcher",
+    "TPESearcher",
     "PopulationBasedTraining",
     "ResultGrid",
     "RunConfig",

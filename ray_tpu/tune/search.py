@@ -21,6 +21,13 @@ class _Grid:
 @dataclass
 class _Sampler:
     fn: Any  # rng -> value
+    # Distribution metadata: model-based searchers (TPE) need the shape
+    # of the space, not just a draw function. kind in {"uniform",
+    # "loguniform", "randint", "choice", "custom"}.
+    kind: str = "custom"
+    low: Any = None
+    high: Any = None
+    values: Any = None
 
 
 def grid_search(values) -> _Grid:
@@ -29,23 +36,32 @@ def grid_search(values) -> _Grid:
 
 def choice(values) -> _Sampler:
     vals = list(values)
-    return _Sampler(lambda rng: rng.choice(vals))
+    return _Sampler(
+        lambda rng: rng.choice(vals), kind="choice", values=vals
+    )
 
 
 def uniform(low: float, high: float) -> _Sampler:
-    return _Sampler(lambda rng: rng.uniform(low, high))
+    return _Sampler(
+        lambda rng: rng.uniform(low, high),
+        kind="uniform", low=low, high=high,
+    )
 
 
 def loguniform(low: float, high: float) -> _Sampler:
     import math
 
     return _Sampler(
-        lambda rng: math.exp(rng.uniform(math.log(low), math.log(high)))
+        lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))),
+        kind="loguniform", low=low, high=high,
     )
 
 
 def randint(low: int, high: int) -> _Sampler:
-    return _Sampler(lambda rng: rng.randrange(low, high))
+    return _Sampler(
+        lambda rng: rng.randrange(low, high),
+        kind="randint", low=low, high=high,
+    )
 
 
 def sample_config(param_space: dict, rng: random.Random) -> dict:

@@ -1,0 +1,147 @@
+"""TPE searcher: model-based search beats random at equal budget.
+
+Reference parity: the Optuna/HyperOpt searcher role
+(python/ray/tune/search/optuna/optuna_search.py) as a native
+zero-dependency TPE on the Searcher seam — the round-4 verdict's
+missing #6.
+"""
+
+import math
+
+import pytest
+
+from ray_tpu.tune import (
+    RandomSearcher,
+    TPESearcher,
+    choice,
+    loguniform,
+    uniform,
+)
+
+
+def _drive(searcher, fn, budget):
+    """Sequential suggest/complete loop; returns best (lowest) value."""
+    best = math.inf
+    for i in range(budget):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        val = fn(cfg)
+        searcher.on_trial_complete(tid, {"loss": val})
+        best = min(best, val)
+    return best
+
+
+def test_tpe_beats_random_on_2d_quadratic():
+    """Seeded 2-D quadratic: at a 40-trial budget TPE's best-found beats
+    random search's on average across seeds (the done-criterion A/B)."""
+    space = {"x": uniform(-1.0, 1.0), "y": uniform(-1.0, 1.0)}
+
+    def f(cfg):
+        return (cfg["x"] - 0.3) ** 2 + (cfg["y"] + 0.2) ** 2
+
+    tpe_bests, rnd_bests = [], []
+    for seed in range(5):
+        tpe_bests.append(
+            _drive(
+                TPESearcher(space, "loss", "min", n_startup=8, seed=seed),
+                f,
+                40,
+            )
+        )
+        rnd_bests.append(_drive(RandomSearcher(space, seed=seed), f, 40))
+    tpe_mean = sum(tpe_bests) / len(tpe_bests)
+    rnd_mean = sum(rnd_bests) / len(rnd_bests)
+    assert tpe_mean < rnd_mean, (tpe_bests, rnd_bests)
+
+
+def test_tpe_beats_random_on_ml_shaped_surface():
+    """Mixed space shaped like an LR/weight-decay/activation sweep:
+    loguniform lr with optimum at 1e-2, uniform decay at 0.1, a
+    categorical activation with one clearly-better arm."""
+    space = {
+        "lr": loguniform(1e-5, 1.0),
+        "decay": uniform(0.0, 0.5),
+        "act": choice(["relu", "tanh", "sigmoid"]),
+    }
+
+    def f(cfg):
+        lr_err = (math.log10(cfg["lr"]) + 2.0) ** 2  # best at 1e-2
+        decay_err = 4.0 * (cfg["decay"] - 0.1) ** 2
+        act_pen = {"relu": 0.0, "tanh": 0.6, "sigmoid": 1.2}[cfg["act"]]
+        return lr_err + decay_err + act_pen
+
+    tpe_bests, rnd_bests = [], []
+    for seed in range(8):
+        tpe_bests.append(
+            _drive(
+                TPESearcher(space, "loss", "min", n_startup=10, seed=seed),
+                f,
+                60,
+            )
+        )
+        rnd_bests.append(_drive(RandomSearcher(space, seed=seed), f, 60))
+    tpe_mean = sum(tpe_bests) / len(tpe_bests)
+    rnd_mean = sum(rnd_bests) / len(rnd_bests)
+    assert tpe_mean < rnd_mean, (tpe_bests, rnd_bests)
+
+
+def test_tpe_mode_max_and_state_roundtrip():
+    space = {"x": uniform(0.0, 1.0)}
+    s = TPESearcher(space, "acc", "max", n_startup=4, seed=0)
+    for i in range(12):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        s.on_trial_complete(tid, {"acc": 1.0 - (cfg["x"] - 0.8) ** 2})
+    # Restore into a fresh searcher: suggestions keep exploiting history.
+    clone = TPESearcher(space, "acc", "max", n_startup=4, seed=1)
+    clone.restore_state(s.save_state())
+    sug = [clone.suggest(f"c{i}")["x"] for i in range(8)]
+    # Model-based phase: suggestions concentrate near the optimum 0.8.
+    assert sum(1 for x in sug if 0.5 < x < 1.0) >= 5, sug
+
+
+def test_tpe_handles_randint_and_rejects_bare_lambda():
+    from ray_tpu.tune import randint
+    from ray_tpu.tune.search import _Sampler
+
+    space = {"n": randint(1, 9)}
+    s = TPESearcher(space, "loss", seed=0, n_startup=4)
+    for i in range(10):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        assert 1 <= cfg["n"] < 9 and isinstance(cfg["n"], int)
+        s.on_trial_complete(tid, {"loss": abs(cfg["n"] - 4)})
+
+    with pytest.raises(ValueError, match="metadata"):
+        TPESearcher({"x": _Sampler(lambda rng: 1.0)}, "loss")
+
+
+def test_tpe_in_tuner():
+    """End-to-end through the Tuner: TPE drives trial configs."""
+    import ray_tpu
+    from ray_tpu.tune import RunConfig, TuneConfig, Tuner, report
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        space = {"x": uniform(-1.0, 1.0)}
+
+        def objective(config):
+            report(loss=(config["x"] - 0.25) ** 2)
+
+        tuner = Tuner(
+            objective,
+            param_space=space,
+            tune_config=TuneConfig(
+                metric="loss",
+                mode="min",
+                num_samples=12,
+                search_alg=TPESearcher(
+                    space, "loss", "min", n_startup=6, seed=3
+                ),
+            ),
+        )
+        results = tuner.fit()
+        best = results.get_best_result()
+        assert best.metrics["loss"] < 0.2
+    finally:
+        ray_tpu.shutdown()
