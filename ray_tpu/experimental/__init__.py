@@ -15,6 +15,12 @@ from ray_tpu.experimental.device_objects import (
     device_store_stats,
     enable_device_objects,
 )
+from ray_tpu.experimental.multiworld import (
+    arm_shards,
+    export_shards,
+    plan_pulls,
+    pull_and_assemble,
+)
 from ray_tpu.experimental.transfer import (
     decomposition_of,
     transfer_stats,
@@ -22,11 +28,15 @@ from ray_tpu.experimental.transfer import (
 
 __all__ = [
     "DeviceRef",
+    "arm_shards",
     "decomposition_of",
     "device_free",
     "device_get",
     "device_put",
     "device_store_stats",
     "enable_device_objects",
+    "export_shards",
+    "plan_pulls",
+    "pull_and_assemble",
     "transfer_stats",
 ]

@@ -28,8 +28,10 @@ Protocol (one producer process -> one consumer process):
 The fabric requires the shard layouts on both ends to match byte-for-byte
 (the engine moves shards, it does not reshard) — that is why the producer
 re-lays-out first. Arrays must be fully addressable in the owner process
-(one-controller worlds; each process of a multi-controller world owns its
-own addressable shards and would run this protocol per process).
+(one-controller worlds). Multi-controller worlds — where each process
+owns only its addressable shards — use the per-process catalog/arm/pull
+protocol in :mod:`ray_tpu.experimental.multiworld` on top of this same
+fabric.
 """
 
 from __future__ import annotations
