@@ -94,6 +94,12 @@ class Config:
     # rows). RAY_TPU_TASK_EVENTS_ENABLED=0 to turn off.
     task_events_enabled: bool = True
     metrics_report_interval_s: float = 2.0
+    # Dashboard metric time-series (reference: dashboard/modules/metrics —
+    # the Grafana-backed panels): the GCS samples the merged cluster
+    # snapshot into a bounded per-series history ring that
+    # /api/metrics/history serves. window = samples retained per series.
+    metrics_history_interval_s: float = 5.0
+    metrics_history_window: int = 360
     # Task-push pipelining (reference: the submitter keeps the leased
     # worker's queue non-empty instead of one in-flight task per lease):
     # how many pushes may be in flight per lease. 1 = the old behavior.

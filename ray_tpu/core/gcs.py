@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -113,6 +113,11 @@ class GcsServer:
         # snapshots arrive with heartbeats.
         self.task_events: "OrderedDict[str, dict]" = OrderedDict()
         self.node_metrics: dict[str, list] = {}
+        # Metric time-series: bounded per-series rings sampled from the
+        # merged cluster snapshot as reports arrive (reference: the
+        # dashboard metrics module's Grafana time-series role).
+        self.metric_history: dict[str, "deque"] = {}
+        self._history_last_sample = 0.0
         # Versioned view sync: bumped only on REAL state changes so idle
         # clusters gossip ~nothing (reference: delta-streaming RaySyncer).
         self.view_version = 0
@@ -686,11 +691,53 @@ class GcsServer:
         view = self.nodes.get(p["node_id"])
         if view is not None and view.alive:
             self.node_metrics[p["node_id"]] = p["snapshots"]
+            self._sample_history()
         return True
+
+    def _sample_history(self) -> None:
+        """Append the merged cluster snapshot to the per-series rings,
+        rate-limited to one sample per history interval (reports arrive
+        per node; sampling each would skew the time axis by node count)."""
+        from ray_tpu.core.config import GLOBAL_CONFIG as cfg
+        from ray_tpu.util.metrics import merge_snapshots
+
+        now = time.time()
+        if now - self._history_last_sample < cfg.metrics_history_interval_s:
+            return
+        self._history_last_sample = now
+        snaps = [s for lst in self.node_metrics.values() for s in lst]
+        merged = merge_snapshots(snaps)
+        meta = merged.get("meta", {})
+        window = max(2, cfg.metrics_history_window)
+        for name, tags, value in merged.get("points", []):
+            kind = meta.get(name, {}).get("kind", "gauge")
+            if isinstance(value, dict):  # histogram: track the count
+                value = value.get("count", 0)
+            key = name
+            if tags:
+                key += "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(tags.items())
+                ) + "}"
+            ring = self.metric_history.get(key)
+            if ring is None or ring.maxlen != window:
+                ring = deque(ring or (), maxlen=window)
+                self.metric_history[key] = ring
+            ring.append((round(now, 3), value))
 
     async def _h_dump_metrics(self, conn, p):
         snaps = [s for lst in self.node_metrics.values() for s in lst]
         return snaps
+
+    async def _h_metrics_history(self, conn, p):
+        """{series: [[ts, value], ...]} — optionally filtered by metric
+        name prefix (reference: the dashboard metrics module's
+        time-series endpoint)."""
+        prefix = p.get("name") or ""
+        return {
+            k: list(ring)
+            for k, ring in self.metric_history.items()
+            if k.startswith(prefix)
+        }
 
     # -- structured events (reference: ray_event_recorder.h + aggregator) ----
 

@@ -222,6 +222,19 @@ class DashboardHead:
                             "text": text or "",
                         }
                 return {"error": f"node {target_node!r} not found"}
+            if path == "/api/metrics/history":
+                # Bounded per-series time-series rings sampled by the GCS
+                # (reference: dashboard modules/metrics — the Grafana
+                # panels' role, served natively).
+                from ray_tpu.core import api as core_api
+
+                worker = core_api._require_worker()
+                return _jsonable(
+                    worker.gcs.call(
+                        "metrics_history",
+                        {"name": query.get("name", "")},
+                    )
+                )
             if path == "/api/events":
                 # Structured definition/lifecycle events (the aggregator
                 # role; reference: dashboard modules/aggregator).

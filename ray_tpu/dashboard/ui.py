@@ -32,6 +32,8 @@ PAGE = """<!DOCTYPE html>
 <h1>ray_tpu cluster <span id="version" class="muted"></span>
     <span id="refreshed" class="muted"></span></h1>
 <h2>Resources</h2><div id="resources"></div>
+<h2>Metrics <span class="muted">(history)</span></h2>
+<div id="sparks"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Task summary
   <a href="#" id="tasktoggle" class="muted">[show tasks]</a></h2>
@@ -69,7 +71,35 @@ function row(cells, tag) {
 function fmtRes(r) {
   return esc(Object.entries(r || {}).map(([k, v]) => `${k}:${v}`).join(" "));
 }
+function sparkline(points, w, h) {
+  // points: [[ts, value], ...] -> inline SVG polyline (no deps).
+  if (!points || points.length < 2) return '<span class="muted">–</span>';
+  const vs = points.map(p => +p[1]);
+  const lo = Math.min(...vs), hi = Math.max(...vs);
+  const span = (hi - lo) || 1;
+  const xs = points.map((p, i) => [
+    (i / (points.length - 1)) * (w - 2) + 1,
+    h - 2 - ((+p[1] - lo) / span) * (h - 4),
+  ]);
+  const pts = xs.map(([x, y]) => `${x.toFixed(1)},${y.toFixed(1)}`).join(" ");
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle">` +
+    `<polyline points="${pts}" fill="none" stroke="#58a6ff" ` +
+    `stroke-width="1.2"/></svg>`;
+}
+async function refreshSparks() {
+  const hist = await j("/api/metrics/history");
+  if (!hist) return;
+  const names = Object.keys(hist).sort();
+  document.getElementById("sparks").innerHTML = names.slice(0, 24).map(n => {
+    const pts = hist[n];
+    const last = pts.length ? (+pts[pts.length - 1][1]).toPrecision(4) : "?";
+    return `<div style="display:inline-block;margin:.15rem 1rem .15rem 0">` +
+      `<span class="muted">${esc(n)}</span> ${sparkline(pts, 140, 28)} ` +
+      `<b>${esc(last)}</b></div>`;
+  }).join("") || '<span class="muted">no samples yet</span>';
+}
 async function refresh() {
+  refreshSparks();
   const [ver, nodes, actors, tasks, jobs, pgs, workers, total, avail] =
     await Promise.all([
       j("/api/version"), j("/api/nodes"), j("/api/actors"),

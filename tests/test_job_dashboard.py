@@ -120,6 +120,8 @@ def test_dashboard_state_endpoints(cluster, dashboard):
     assert "CPU" in _get(port, "/api/cluster_resources")
     metrics = _get(port, "/metrics")
     assert isinstance(metrics, str)
+    hist = _get(port, "/api/metrics/history")
+    assert isinstance(hist, dict)  # series -> [[ts, value], ...]
 
 
 def test_dashboard_job_api_and_http_client(cluster, dashboard):

@@ -226,3 +226,80 @@ def test_worker_logs_reach_driver(cluster, capfd):
         acc += capfd.readouterr().err
         seen = "hello-from-worker-stdout" in acc
     assert seen, f"worker log line never reached driver; got: {acc[-500:]}"
+
+
+def test_metrics_history_ring_bounded_and_served(cluster):
+    """The GCS samples merged metrics into bounded per-series rings
+    (reference: the dashboard metrics module's time-series role). Window
+    bound: 12 samples through a 5-slot ring keep only the newest 5."""
+    from ray_tpu.core import api as core_api
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    worker = core_api._require_worker()
+    node_id = state.list_nodes()[0]["NodeID"]
+    old_i = GLOBAL_CONFIG.metrics_history_interval_s
+    old_w = GLOBAL_CONFIG.metrics_history_window
+    GLOBAL_CONFIG.metrics_history_interval_s = 0.0
+    GLOBAL_CONFIG.metrics_history_window = 5
+    try:
+        for i in range(12):
+            worker.gcs.call(
+                "report_metrics",
+                {
+                    "node_id": node_id,
+                    "snapshots": [
+                        {
+                            "meta": {
+                                "test_hist_gauge": {
+                                    "kind": "gauge", "help": "",
+                                }
+                            },
+                            "points": [
+                                ["test_hist_gauge", {"shard": "a"},
+                                 float(i)],
+                            ],
+                        }
+                    ],
+                },
+            )
+        hist = worker.gcs.call(
+            "metrics_history", {"name": "test_hist_gauge"}
+        )
+        assert list(hist) == ["test_hist_gauge{shard=a}"]
+        pts = hist["test_hist_gauge{shard=a}"]
+        assert len(pts) == 5  # ring bound, not 12
+        assert [v for _ts, v in pts] == [7.0, 8.0, 9.0, 10.0, 11.0]
+        assert all(pts[i][0] <= pts[i + 1][0] for i in range(4))
+        # Name filtering: unrelated prefixes return nothing.
+        assert worker.gcs.call(
+            "metrics_history", {"name": "no_such_metric"}
+        ) == {}
+    finally:
+        GLOBAL_CONFIG.metrics_history_interval_s = old_i
+        GLOBAL_CONFIG.metrics_history_window = old_w
+
+
+def test_metrics_history_samples_real_heartbeats(cluster):
+    """Node heartbeat reports populate history without synthetic calls."""
+    from ray_tpu.core import api as core_api
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    old_i = GLOBAL_CONFIG.metrics_history_interval_s
+    GLOBAL_CONFIG.metrics_history_interval_s = 0.0
+    try:
+        worker = core_api._require_worker()
+        hist = _wait_for(
+            lambda: (
+                h := worker.gcs.call(
+                    "metrics_history", {"name": "raytpu_node_workers"}
+                )
+            )
+            and h
+            or None,
+            timeout=20,
+        )
+        series = next(iter(hist.values()))
+        assert len(series) >= 1
+        assert all(isinstance(v, (int, float)) for _t, v in series)
+    finally:
+        GLOBAL_CONFIG.metrics_history_interval_s = old_i
