@@ -15,6 +15,7 @@ from ray_tpu.serve.api import (
     delete,
     deployment,
     get_handle,
+    ingress,
     run,
     shutdown,
     status,
@@ -38,6 +39,7 @@ __all__ = [
     "deploy_from_file",
     "deployment",
     "get_handle",
+    "ingress",
     "load_serve_config",
     "get_multiplexed_model_id",
     "multiplexed",

@@ -82,6 +82,19 @@ def deployment(_target=None, **kw):
     return wrap
 
 
+def ingress(app_or_factory, *, name: str = "asgi", **kw) -> Application:
+    """Mount an ASGI 3.0 app (FastAPI/Starlette/bare callable) as a
+    deployment (reference: serve.ingress + the ASGI replica wrapper,
+    serve/_private/replica.py:1139). Pass the app object, or a zero-arg
+    factory when the app doesn't pickle (the usual FastAPI case); the
+    proxy then serves /{name}/* with the app's own status, headers, and
+    body — streamed responses (SSE) forward chunk-by-chunk."""
+    from ray_tpu.serve.asgi import ASGIAppWrapper
+
+    cfg = DeploymentConfig(name=name, **kw)
+    return Deployment(ASGIAppWrapper, cfg).bind(app_or_factory)
+
+
 def _get_or_create_controller():
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)

@@ -18,6 +18,16 @@ from urllib.parse import parse_qs, urlparse
 
 from ray_tpu.serve.handle import DeploymentHandle
 
+_ASGI = object()  # _route's "raw ASGI response" status sentinel
+
+_REASONS = {
+    200: "OK", 201: "Created", 204: "No Content", 301: "Moved Permanently",
+    302: "Found", 304: "Not Modified", 400: "Bad Request",
+    401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
+    405: "Method Not Allowed", 422: "Unprocessable Entity",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
 
 class HTTPProxyActor:
     def __init__(self, controller):
@@ -82,12 +92,15 @@ class HTTPProxyActor:
                 parsed = self._parse_body(body)
                 if self._wants_stream(headers, parsed):
                     await self._route_stream(
-                        writer, method, target, headers, parsed
+                        writer, method, target, headers, parsed, body
                     )
                     return  # streamed responses close the connection
                 status, payload = await self._route(
-                    method, target, headers, parsed
+                    method, target, headers, parsed, body
                 )
+                if status is _ASGI:
+                    await self._respond_asgi(writer, payload)
+                    return  # raw responses close the connection
                 keep = (
                     headers.get("connection", "keep-alive").lower() != "close"
                 )
@@ -103,9 +116,11 @@ class HTTPProxyActor:
                 pass
 
     @staticmethod
-    def _parse(method: str, target: str, headers: dict, parsed):
+    def _parse(method: str, target: str, headers: dict, parsed, raw=b""):
         """(request_dict, deployment, error): the user-callable request shape
-        shared by the buffered and streaming paths."""
+        shared by the buffered and streaming paths. ``raw_body`` carries
+        the unparsed payload bytes — ASGI deployments must see the wire
+        bytes, not the proxy's JSON view."""
         url = urlparse(target)
         parts = [p for p in url.path.split("/") if p]
         if not parts:
@@ -116,16 +131,17 @@ class HTTPProxyActor:
             "query": {k: v[-1] for k, v in parse_qs(url.query).items()},
             "headers": dict(headers),
             "body": parsed,
+            "raw_body": raw,
         }
         return request, parts[0], None
 
     async def _route(
-        self, method: str, target: str, headers: dict, parsed
+        self, method: str, target: str, headers: dict, parsed, raw=b""
     ):
         from ray_tpu.serve.router import DeploymentNotFoundError
 
         request, deployment, err = self._parse(
-            method, target, headers, parsed
+            method, target, headers, parsed, raw
         )
         if err is not None:
             return 404, {"error": err}
@@ -135,6 +151,15 @@ class HTTPProxyActor:
             if model_id:
                 handle = handle.options(multiplexed_model_id=model_id)
             result = await handle.remote_async(request)
+            if (
+                isinstance(result, list)
+                and result
+                and isinstance(result[0], dict)
+                and result[0].get("__asgi__")
+            ):
+                # A drained ASGI generator: [head, chunk, chunk, ...] —
+                # reply with the app's own status/headers/body.
+                return _ASGI, result
             return 200, result
         except DeploymentNotFoundError as e:
             return 404, {"error": str(e)}
@@ -160,17 +185,21 @@ class HTTPProxyActor:
             return True
         return bool(isinstance(parsed, dict) and parsed.get("stream"))
 
-    async def _route_stream(self, writer, method, target, headers, parsed):
+    async def _route_stream(
+        self, writer, method, target, headers, parsed, raw=b""
+    ):
         """Route to the deployment's streaming path and write each chunk as
         a server-sent event the moment it arrives; terminate with
         `data: [DONE]` (the OpenAI wire convention). The first chunk is
         pulled BEFORE the status line goes out, so routing failures (unknown
         deployment, no replicas) surface as proper HTTP errors instead of a
-        200 that then errors mid-stream."""
+        200 that then errors mid-stream. ASGI deployments announce
+        themselves in their first chunk and stream RAW under the app's own
+        headers instead of SSE-wrapped."""
         from ray_tpu.serve.router import DeploymentNotFoundError
 
         request, deployment, err = self._parse(
-            method, target, headers, parsed
+            method, target, headers, parsed, raw
         )
         if err is not None:
             await self._respond(writer, 404, {"error": err})
@@ -197,6 +226,13 @@ class HTTPProxyActor:
                 writer, 500, {"error": f"{type(e).__name__}: {e}"}
             )
             return
+        if (
+            not exhausted
+            and isinstance(first, dict)
+            and first.get("__asgi__")
+        ):
+            await self._stream_asgi(writer, first, chunks)
+            return
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
             b"Content-Type: text/event-stream\r\n"
@@ -222,6 +258,51 @@ class HTTPProxyActor:
         # clients never hang on an errored stream.
         writer.write(b"data: [DONE]\n\n")
         await writer.drain()
+
+    @staticmethod
+    def _asgi_head_bytes(head: dict, *, content_length=None) -> bytes:
+        status = int(head.get("status", 200))
+        reason = _REASONS.get(status, "OK")
+        lines = [f"HTTP/1.1 {status} {reason}"]
+        seen = set()
+        for k, v in head.get("headers", []):
+            lk = k.lower()
+            if lk in ("connection", "content-length", "transfer-encoding"):
+                continue  # the proxy owns framing
+            seen.add(lk)
+            lines.append(f"{k}: {v}")
+        if content_length is not None:
+            lines.append(f"Content-Length: {content_length}")
+        lines.append("Connection: close")
+        return ("\r\n".join(lines) + "\r\n\r\n").encode("latin1")
+
+    async def _respond_asgi(self, writer, result: list):
+        """Buffered ASGI reply: [head, chunk, ...] with the app's own
+        status/headers/body (reference: replica.py:1139's ASGI wrapper —
+        the response is the app's, not the proxy's JSON envelope)."""
+        head = result[0]
+        body = b"".join(
+            c if isinstance(c, (bytes, bytearray)) else str(c).encode()
+            for c in result[1:]
+        )
+        writer.write(
+            self._asgi_head_bytes(head, content_length=len(body)) + body
+        )
+        await writer.drain()
+
+    async def _stream_asgi(self, writer, head: dict, chunks):
+        """Raw streamed ASGI reply: forward body chunks as they arrive
+        under the app's own headers (SSE apps stream intact)."""
+        writer.write(self._asgi_head_bytes(head))
+        await writer.drain()
+        try:
+            async for chunk in chunks:
+                if not isinstance(chunk, (bytes, bytearray)):
+                    chunk = str(chunk).encode()
+                writer.write(bytes(chunk))
+                await writer.drain()
+        except Exception:  # noqa: BLE001 — mid-stream: connection close
+            pass
 
     async def _respond(self, writer, status: int, payload, keep=False):
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
