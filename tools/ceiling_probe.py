@@ -1,0 +1,115 @@
+"""Control-plane ceiling probe: where does the driver core actually go?
+
+Round-4 verdict #8: PERF.md claims the single driver core is the
+tasks_async bottleneck — this tool tests that claim instead of asserting
+it. (The other suggested experiment — disjoint cgroup cpu quotas to
+emulate two cores — is impossible here: nproc == 1, there is no second
+core to carve out.)
+
+Method: run the ray_perf tasks_async workload while wall-sampling every
+thread of the DRIVER process (the GCS and the endpoint/event loops are
+threads of this process; only worker executors are separate processes),
+then attribute non-idle samples to buckets:
+
+  serialization  pickle/cloudpickle/serialization.py dumps+loads
+  eventloop      asyncio machinery + protocol framing + socket transport
+  control-plane  core_worker/node/gcs/scheduler bookkeeping
+  other          everything else (workload fn, numpy, interpreter misc)
+
+Prints one JSON line; PERF.md records the conclusion.
+
+Caveat: wall sampling on a timesharing core counts runnable-but-
+preempted frames as on-CPU, so the split is approximate — but the
+question is whether serialization+eventloop DOMINATE, and a dominance
+signal survives that noise.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import ray_tpu
+from ray_tpu.util.profiling import sample_collapsed_stacks
+
+BUCKETS = (
+    ("serialization", (
+        "/pickle.py", "cloudpickle", "serialization.py", "_Pickler",
+    )),
+    ("eventloop", (
+        "/asyncio/", "protocol.py", "selectors.py", "/socket.py",
+        "struct.py", "ssl.py",
+    )),
+    ("control-plane", (
+        "core_worker.py", "node.py", "gcs.py", "scheduler.py",
+        "object_store.py", "ids.py",
+    )),
+)
+
+
+def classify(stack: str) -> str:
+    # Leaf-most wins: walk frames from the leaf inward so a pickle call
+    # made by core_worker counts as serialization, not bookkeeping.
+    for frame in reversed(stack.split(";")):
+        for name, needles in BUCKETS:
+            if any(n in frame for n in needles):
+                return name
+    return "other"
+
+
+def main() -> None:
+    ray_tpu.init(num_cpus=4)
+
+    @ray_tpu.remote
+    def small():
+        return b"ok"
+
+    # Warm the worker pool / code paths.
+    ray_tpu.get([small.remote() for _ in range(100)])
+
+    stop = threading.Event()
+    reqs = {"n": 0}
+
+    def drive():
+        while not stop.is_set():
+            ray_tpu.get([small.remote() for _ in range(100)])
+            reqs["n"] += 100
+
+    t0 = time.perf_counter()
+    driver = threading.Thread(target=drive, daemon=True)
+    driver.start()
+    prof = sample_collapsed_stacks(duration_s=12.0, interval_s=0.005)
+    stop.set()
+    driver.join(timeout=30)
+    elapsed = time.perf_counter() - t0
+
+    totals: dict[str, int] = {}
+    for stack, n in prof["stacks"].items():
+        totals[classify(stack)] = totals.get(classify(stack), 0) + n
+    busy = sum(totals.values())
+    shares = {
+        k: round(v / busy, 4) for k, v in sorted(
+            totals.items(), key=lambda kv: -kv[1]
+        )
+    } if busy else {}
+    top = sorted(prof["stacks"].items(), key=lambda kv: -kv[1])[:8]
+    print(json.dumps({
+        "metric": "tasks_async_ceiling_probe",
+        "throughput_per_s": round(reqs["n"] / elapsed, 1),
+        "busy_samples": busy,
+        "total_sample_rounds": prof["samples"],
+        "shares": shares,
+        "pickle_plus_eventloop": round(
+            (totals.get("serialization", 0) + totals.get("eventloop", 0))
+            / busy, 4,
+        ) if busy else None,
+        "top_stacks": [
+            {"n": n, "leaf": s.split(";")[-1]} for s, n in top
+        ],
+    }))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
