@@ -194,9 +194,11 @@ def _probe_backend() -> str:
     error).
 
     The tunnel wedges in windows: one dead probe does not mean a dead round.
-    So the probe runs up to RAY_TPU_BENCH_PROBE_ROUNDS rounds (default 3),
-    spaced RAY_TPU_BENCH_PROBE_SPACING_S apart (default 300 s), and only
-    writes the skip record after the whole ~15-minute window comes up dry.
+    So the probe runs up to RAY_TPU_BENCH_PROBE_ROUNDS rounds (default 6 —
+    rounds 2-4 skipped on a 15-minute window that kept coming up dry, so
+    round 5 doubled it per the verdict), spaced
+    RAY_TPU_BENCH_PROBE_SPACING_S apart (default 300 s), and only writes
+    the skip record after the whole ~30-minute window comes up dry.
 
     Returns "ok", "wedged" (every round hung — environmental, skip cleanly)
     or "broken" (fast nonzero exits — a jax/plugin/install regression that
@@ -207,7 +209,7 @@ def _probe_backend() -> str:
         "    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])\n"
         "print(len(jax.devices()), jax.default_backend())"
     )
-    rounds = max(1, int(os.environ.get("RAY_TPU_BENCH_PROBE_ROUNDS", "3")))
+    rounds = max(1, int(os.environ.get("RAY_TPU_BENCH_PROBE_ROUNDS", "6")))
     spacing = float(os.environ.get("RAY_TPU_BENCH_PROBE_SPACING_S", "300"))
     last_outcome = "broken"
     for attempt in range(1, rounds + 1):
