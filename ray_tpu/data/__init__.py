@@ -100,9 +100,18 @@ __all__ = [
     "read_binary_files",
     "read_images",
     "read_json",
+    "read_text",
     "read_tfrecords",
     "read_parquet",
 ]
+
+
+def read_text(paths, *, parallelism: int = -1, **kwargs) -> Dataset:
+    """One row per line as {"text", "path"} (reference:
+    ray.data.read_text; drop_empty_lines=True matches its default)."""
+    from ray_tpu.data.datasource import TextDatasource
+
+    return _from_source(TextDatasource(paths, **kwargs), parallelism)
 
 
 def read_binary_files(paths, *, parallelism: int = -1, **kwargs) -> Dataset:

@@ -169,6 +169,26 @@ class JSONDatasource(FileDatasource):
         return pajson.read_json(path, **self._kwargs)
 
 
+class TextDatasource(FileDatasource):
+    """One row per line: {"text", "path"} (reference:
+    ray.data.read_text). drop_empty_lines matches the reference default."""
+
+    suffixes = (".txt", ".text", ".log", ".md")
+
+    def read_file(self, path: str) -> Block:
+        from ray_tpu.data.block import BlockAccessor
+
+        drop_empty = self._kwargs.get("drop_empty_lines", True)
+        encoding = self._kwargs.get("encoding", "utf-8")
+        with open(path, "r", encoding=encoding, errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        if drop_empty:
+            lines = [ln for ln in lines if ln.strip()]
+        return BlockAccessor.batch_to_block(
+            {"text": lines, "path": [path] * len(lines)}
+        )
+
+
 class NumpyDatasource(Datasource):
     def __init__(self, arrays: "np.ndarray | list[np.ndarray]", column: str = "data"):
         if isinstance(arrays, np.ndarray):

@@ -84,3 +84,17 @@ def test_range_tensor(cluster):
     np.testing.assert_array_equal(
         sorted(int(r["data"][0]) for r in doubled), [0, 2, 4, 6]
     )
+
+
+def test_read_text_lines(cluster, tmp_path):
+    (tmp_path / "a.txt").write_text("alpha\nbeta\n\ngamma\n")
+    (tmp_path / "b.txt").write_text("delta\n")
+    import ray_tpu.data as rd
+
+    ds = rd.read_text([str(tmp_path / "a.txt"), str(tmp_path / "b.txt")])
+    rows = ds.take_all()
+    assert [r["text"] for r in rows] == ["alpha", "beta", "gamma", "delta"]
+    assert rows[0]["path"].endswith("a.txt")
+    # Empty lines kept on request.
+    ds2 = rd.read_text(str(tmp_path / "a.txt"), drop_empty_lines=False)
+    assert len(ds2.take_all()) == 4
