@@ -30,6 +30,7 @@ from ray_tpu.rllib.offline import (
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
+from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner, SACModule
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rl_module import MLPModule, RLModule
@@ -43,6 +44,8 @@ __all__ = [
     "AppoLearner",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "BCLearner",
     "read_experience",
     "write_experience",

@@ -1,0 +1,152 @@
+"""CQL: conservative Q-learning — offline RL beyond behavior cloning.
+
+Reference parity: rllib/algorithms/cql/cql.py (SAC + the CQL(H)
+conservative penalty, trained from an offline dataset). Redesign: the
+penalty lives in :class:`~ray_tpu.rllib.sac.SACLearner`'s critic step
+(SACParams.cql_alpha > 0); this module adds the offline driver — the BC
+train-loop shape (stream the parquet experience dataset, no environment
+interaction) over the SAC learner.
+
+Dataset contract: the transition columns the off-policy runners emit
+(OBS, ACTIONS in the canonical [-1,1] space, REWARDS, NEXT_OBS,
+TERMINATEDS), written with :func:`ray_tpu.rllib.offline.write_experience`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.learner import LearnerHyperparams
+from ray_tpu.rllib.offline import _batch_to_samples, read_experience
+from ray_tpu.rllib.sac import SACLearner, SACModule, SACParams
+
+
+@dataclasses.dataclass
+class CQLConfig:
+    input_path: str = ""
+    lr: float = 3e-4  # actor
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    cql_alpha: float = 1.0
+    cql_n_actions: int = 4
+    train_batch_size: int = 256
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    # Module shape; inferred from the dataset when left at 0. Actions are
+    # canonical [-1,1] (the SAC runner convention); env bounds only
+    # matter at evaluate() time.
+    obs_dim: int = 0
+    act_dim: int = 0
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    """Offline conservative Q-learning over a parquet experience dataset."""
+
+    def __init__(self, config: CQLConfig, module: Optional[SACModule] = None):
+        if not config.input_path:
+            raise ValueError("CQLConfig.input_path is required")
+        self.config = config = dataclasses.replace(config)
+        self.dataset = read_experience(config.input_path)
+        if module is None:
+            if not (config.obs_dim and config.act_dim):
+                for b in self.dataset.iter_batches(
+                    batch_size=1024, batch_format="numpy"
+                ):
+                    obs = np.asarray(b[sb.OBS].tolist())
+                    act = np.asarray(b[sb.ACTIONS].tolist())
+                    config.obs_dim = config.obs_dim or (
+                        int(np.prod(obs.shape[1:])) or 1
+                    )
+                    config.act_dim = config.act_dim or (
+                        int(np.prod(act.shape[1:])) or 1
+                    )
+                    break
+            module = SACModule(
+                obs_dim=config.obs_dim,
+                act_dim=config.act_dim,
+                low=np.full((config.act_dim,), -1.0, np.float32),
+                high=np.full((config.act_dim,), 1.0, np.float32),
+                hidden=tuple(config.hidden),
+            )
+        self.module = module
+        self.learner = SACLearner(
+            module,
+            LearnerHyperparams(lr=config.lr, seed=config.seed),
+            SACParams(
+                gamma=config.gamma,
+                tau=config.tau,
+                alpha_lr=config.alpha_lr,
+                critic_lr=config.critic_lr,
+                cql_alpha=config.cql_alpha,
+                cql_n_actions=config.cql_n_actions,
+            ),
+        )
+        self.learner.build()
+        self.iteration = 0
+
+    def train(self) -> dict:
+        """One streamed pass over the dataset, one update per batch."""
+        stats: dict = {}
+        rows = 0
+        for np_batch in self.dataset.iter_batches(
+            batch_size=self.config.train_batch_size, batch_format="numpy"
+        ):
+            batch = _batch_to_samples(np_batch)
+            rows += len(batch)
+            stats = self.learner.update(batch)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "num_rows_trained": rows,
+            "learner": stats,
+        }
+
+    def get_policy_weights(self):
+        return self.learner.get_weights()
+
+    def evaluate(
+        self, env_name: str, episodes: int = 5, *, to_env=None
+    ) -> dict:
+        """Deterministic-policy rollouts (the offline->online check).
+        ``to_env`` maps canonical [-1,1] actions to env scale (default:
+        the env's own Box bounds)."""
+        import gymnasium as gym
+        import jax.numpy as jnp
+
+        env = gym.make(env_name)
+        if to_env is None:
+            space = env.action_space
+            lo = np.broadcast_to(space.low, space.shape)
+            hi = np.broadcast_to(space.high, space.shape)
+            to_env = lambda a: (  # noqa: E731
+                (hi + lo) / 2 + (hi - lo) / 2 * np.asarray(a)
+            )
+        params = self.learner.params
+        returns = []
+        for ep in range(episodes):
+            obs, _ = env.reset(seed=self.config.seed * 1000 + ep)
+            done = trunc = False
+            total = 0.0
+            while not (done or trunc):
+                a = self.module.deterministic_action(
+                    params, jnp.asarray(np.asarray(obs, np.float32))[None]
+                )
+                obs, rew, done, trunc, _ = env.step(
+                    to_env(np.asarray(a)[0])
+                )
+                total += float(rew)
+            returns.append(total)
+        env.close()
+        return {
+            "episodes": episodes,
+            "episode_return_mean": float(np.mean(returns)),
+        }

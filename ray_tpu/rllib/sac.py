@@ -203,6 +203,12 @@ class SACParams:
     target_entropy: float | None = None
     alpha_lr: float = 3e-4
     critic_lr: float = 3e-4
+    # CQL(H) conservative penalty (Kumar et al. 2020): > 0 adds
+    # cql_alpha * (E_s[logsumexp_a Q(s,a)] - E_D[Q(s,a)]) to the critic
+    # loss, pushing Q down on out-of-distribution actions — what makes
+    # the SAC machinery safe to train OFFLINE (see :class:`CQL`).
+    cql_alpha: float = 0.0
+    cql_n_actions: int = 4
 
 
 class SACLearner(Learner):
@@ -246,8 +252,9 @@ class SACLearner(Learner):
         )
 
         def critic_step(params, target_q, st_q, mb, key):
+            k_boot, k_cql = jax.random.split(key)
             a2, logp2 = self.module.sample_action(
-                params, mb[sb.NEXT_OBS], key
+                params, mb[sb.NEXT_OBS], k_boot
             )
             tq = dict(params, q1=target_q["q1"], q2=target_q["q2"])
             q1t, q2t = self.module.q_values(tq, mb[sb.NEXT_OBS], a2)
@@ -260,18 +267,64 @@ class SACLearner(Learner):
             def loss_fn(qp):
                 full = dict(params, **qp)
                 q1, q2 = self.module.q_values(full, mb[sb.OBS], mb[sb.ACTIONS])
-                return (
-                    jnp.mean(jnp.square(q1 - y))
-                    + jnp.mean(jnp.square(q2 - y))
-                ), (q1, q2)
+                l = jnp.mean(jnp.square(q1 - y)) + jnp.mean(
+                    jnp.square(q2 - y)
+                )
+                gap = jnp.zeros(())
+                if p.cql_alpha > 0.0:
+                    # CQL(H): logsumexp over a mixture of uniform and
+                    # current-policy actions, importance-corrected by each
+                    # proposal's log density (the reference CQL detail).
+                    B = mb[sb.OBS].shape[0]
+                    n = p.cql_n_actions
+                    kr, kp = jax.random.split(k_cql)
+                    obs_rep = jnp.repeat(mb[sb.OBS], n, axis=0)
+                    a_rand = jax.random.uniform(
+                        kr,
+                        (B * n, self.module.act_dim),
+                        minval=-1.0,
+                        maxval=1.0,
+                    )
+                    logp_rand = jnp.full(
+                        (B * n,), -self.module.act_dim * jnp.log(2.0)
+                    )
+                    a_pi, logp_pi = self.module.sample_action(
+                        dict(params, **qp), obs_rep, kp
+                    )
+                    a_pi = jax.lax.stop_gradient(a_pi)
+                    logp_pi = jax.lax.stop_gradient(logp_pi)
+
+                    def lse(qv_rand, qv_pi):
+                        cat = jnp.concatenate(
+                            [
+                                qv_rand.reshape(B, n) - logp_rand.reshape(B, n),
+                                qv_pi.reshape(B, n) - logp_pi.reshape(B, n),
+                            ],
+                            axis=1,
+                        )
+                        return jax.nn.logsumexp(cat, axis=1) - jnp.log(
+                            2.0 * n
+                        )
+
+                    q1r, q2r = self.module.q_values(full, obs_rep, a_rand)
+                    q1p, q2p = self.module.q_values(full, obs_rep, a_pi)
+                    gap = (
+                        jnp.mean(lse(q1r, q1p)) - jnp.mean(q1)
+                        + jnp.mean(lse(q2r, q2p)) - jnp.mean(q2)
+                    )
+                    l = l + p.cql_alpha * gap
+                return l, (q1, q2, gap)
 
             qp = {"q1": params["q1"], "q2": params["q2"]}
-            (l, (q1, q2)), g = jax.value_and_grad(loss_fn, has_aux=True)(qp)
+            (l, (q1, q2, gap)), g = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(qp)
             up, st_q = self._opt_q.update(g, st_q, qp)
             qp = optax.apply_updates(qp, up)
             stats = {
                 "critic_loss": l,
                 "mean_q": jnp.mean(jnp.minimum(q1, q2)),
+                "cql_gap": gap,
             }
             return qp, st_q, stats
 

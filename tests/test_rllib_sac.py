@@ -149,3 +149,89 @@ def test_sac_pendulum_learns(cluster):
         assert np.isfinite(last["learner"]["critic_loss"])
     finally:
         algo.stop()
+
+
+# -- CQL (offline, on the SAC machinery) --------------------------------------
+
+
+def _experience_path(tmp_path):
+    """Synthetic Pendulum-ish transitions with actions in [-1, 1]."""
+    from ray_tpu.rllib.offline import write_experience
+
+    rng = np.random.default_rng(0)
+    n = 2048
+    batch = SampleBatch(
+        {
+            sb.OBS: rng.normal(size=(n, 3)).astype(np.float32),
+            sb.ACTIONS: rng.uniform(-0.3, 0.3, size=(n, 1)).astype(
+                np.float32
+            ),  # narrow behavior policy: OOD actions exist
+            sb.REWARDS: rng.normal(size=(n,)).astype(np.float32),
+            sb.NEXT_OBS: rng.normal(size=(n, 3)).astype(np.float32),
+            sb.TERMINATEDS: (rng.random(n) < 0.01).astype(np.float32),
+        }
+    )
+    return write_experience([batch], str(tmp_path / "exp"))
+
+
+def _ood_gap(learner, seed=5):
+    """mean Q(dataset-like actions) - mean Q(random actions): positive =
+    conservative (in-distribution actions valued higher)."""
+    import jax
+
+    rng = np.random.default_rng(seed)
+    obs = rng.normal(size=(512, 3)).astype(np.float32)
+    a_data = rng.uniform(-0.3, 0.3, size=(512, 1)).astype(np.float32)
+    a_ood = rng.uniform(0.7, 1.0, size=(512, 1)).astype(
+        np.float32
+    ) * rng.choice([-1.0, 1.0], size=(512, 1)).astype(np.float32)
+    q1d, q2d = learner.module.q_values(learner.params, obs, a_data)
+    q1o, q2o = learner.module.q_values(learner.params, obs, a_ood)
+    qd = np.minimum(np.asarray(q1d), np.asarray(q2d)).mean()
+    qo = np.minimum(np.asarray(q1o), np.asarray(q2o)).mean()
+    return float(qd - qo)
+
+
+def test_cql_penalizes_out_of_distribution_actions(cluster, tmp_path):
+    """The defining CQL property: after offline training on a NARROW
+    behavior policy, out-of-distribution actions get lower Q than
+    dataset-support actions — and more so than the unpenalized SAC
+    baseline trained identically."""
+    from ray_tpu.rllib.cql import CQLConfig
+
+    path = _experience_path(tmp_path)
+
+    def run(alpha):
+        algo = CQLConfig(
+            input_path=path, cql_alpha=alpha, hidden=(32, 32),
+            train_batch_size=256, lr=1e-3, critic_lr=3e-3, seed=1,
+        ).build()
+        last = {}
+        for _ in range(12):
+            last = algo.train()
+        return algo, last
+
+    cql, cql_stats = run(10.0)
+    base, _base_stats = run(0.0)
+    assert np.isfinite(cql_stats["learner"]["critic_loss"])
+    gap_cql = _ood_gap(cql.learner)
+    gap_base = _ood_gap(base.learner)
+    # Conservative: the penalty pushed OOD Q below dataset-action Q by
+    # far more than the unpenalized baseline (probe run: 2.55 vs 0.15).
+    assert gap_cql > gap_base + 0.5, (gap_cql, gap_base)
+    assert gap_cql > 0, gap_cql
+    # And the logsumexp-vs-data gap the loss minimizes went negative.
+    assert cql_stats["learner"]["cql_gap"] < 0.5
+
+
+def test_cql_infers_dims_and_evaluates(cluster, tmp_path):
+    from ray_tpu.rllib.cql import CQLConfig
+
+    path = _experience_path(tmp_path)
+    algo = CQLConfig(
+        input_path=path, hidden=(16,), train_batch_size=512, seed=0
+    ).build()
+    assert algo.config.obs_dim == 3 and algo.config.act_dim == 1
+    algo.train()
+    out = algo.evaluate("Pendulum-v1", episodes=1)
+    assert np.isfinite(out["episode_return_mean"])
