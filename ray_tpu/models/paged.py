@@ -207,6 +207,73 @@ def paged_prefill(
     return pool, logits
 
 
+def paged_verify(
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32 — token t of row b sits at absolute
+    #                      position positions[b] + t
+    positions: jax.Array,  # [B] int32 — first write position per slot
+    tables: jax.Array,  # [B, W] int32
+    pool,
+    cfg,
+    *,
+    block_size: int,
+):
+    """Multi-token decode: score T consecutive tokens per slot in ONE
+    forward — the target-model verification pass of speculative decoding
+    (and a strict generalization of :func:`paged_decode`, which is the
+    T=1 case). Returns (pool, logits [B, T, vocab] f32): logits[b, t] is
+    the next-token distribution after consuming tokens[b, t].
+
+    Callers must keep positions + T <= max_seq (the engine falls back to
+    plain decode near the boundary): out-of-range scatter indices would
+    clamp into the slot's last real block and corrupt it."""
+    B, T = tokens.shape
+    W = tables.shape[1]
+    S = W * block_size
+    embed, qkv, finish, final, H, KH, Dh = _family(cfg, S)
+    group = H // KH
+
+    pos2d = positions[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    x = embed(params, tokens, pos2d)  # [B, T, D]
+    rows = jnp.arange(B)
+    bids = tables[rows[:, None], pos2d // block_size]  # [B, T]
+    offs = pos2d % block_size
+    khi = jnp.arange(KH)
+    cols = jnp.arange(S)
+    mask = cols[None, None, :] <= pos2d[:, :, None]  # [B, T, S]
+    scale = 1.0 / (Dh**0.5)
+
+    def body(x, layer):
+        p, pk, pv = layer  # [N, KH, block, Dh]
+        q, k, v = qkv(x, p, pos2d)  # q [B,H,T,Dh], k/v [B,KH,T,Dh]
+        kt = k.transpose(0, 2, 1, 3)  # [B, T, KH, Dh]
+        vt = v.transpose(0, 2, 1, 3)
+        pk = pk.at[
+            bids[:, :, None], khi[None, None, :], offs[:, :, None]
+        ].set(kt)
+        pv = pv.at[
+            bids[:, :, None], khi[None, None, :], offs[:, :, None]
+        ].set(vt)
+        kd = pk[tables].transpose(0, 2, 1, 3, 4).reshape(B, KH, S, Dh)
+        vd = pv[tables].transpose(0, 2, 1, 3, 4).reshape(B, KH, S, Dh)
+        qg = q.reshape(B, KH, group, T, Dh)
+        s = jnp.einsum("bkgtd,bksd->bkgts", qg, kd).astype(jnp.float32)
+        s = jnp.where(mask[:, None, None], s * scale, -1e30)
+        pa = jax.nn.softmax(s, axis=-1).astype(vd.dtype)
+        attn = jnp.einsum("bkgts,bksd->bkgtd", pa, vd).reshape(B, H, T, Dh)
+        return finish(x, attn, p), (pk, pv)
+
+    x, (ks, vs) = jax.lax.scan(
+        lambda c, lyr: body(c, lyr),
+        x,
+        (params["blocks"], pool["k"], pool["v"]),
+    )
+    pool = {"k": ks, "v": vs}
+    D = x.shape[-1]
+    logits = final(params, x.reshape(B * T, D)).reshape(B, T, -1)
+    return pool, logits
+
+
 def paged_decode(
     params: Params,
     last_tokens: jax.Array,  # [B] int32
