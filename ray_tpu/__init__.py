@@ -33,6 +33,7 @@ _CORE_API = (
     "cluster_resources",
     "available_resources",
     "get_runtime_context",
+    "transport_stats",
     "ObjectRef",
     "ObjectRefGenerator",
     "ActorHandle",

@@ -338,6 +338,16 @@ def is_initialized() -> bool:
     return _worker is not None
 
 
+def transport_stats() -> dict:
+    """Cumulative RPC transport counters of this driver process (frames
+    sent, socket writes, frames-per-write, drains skipped...) — the
+    strace-free view of the frame-coalescing tier (PERF.md round-6).
+    Empty in client mode (the proxy owns the endpoint)."""
+    w = _require_worker(auto_init=False)
+    ep = getattr(w, "endpoint", None)
+    return ep.transport_stats() if ep is not None else {}
+
+
 _was_initialized = False
 
 

@@ -76,6 +76,15 @@ class Config:
     default_max_restarts: int = 0
     # RPC
     rpc_connect_timeout_s: float = 30.0
+    # Transport-level frame coalescing (PERF.md round-5 ceiling probe: the
+    # driver core is consumed by one write()+event-loop-wakeup pair per RPC
+    # frame). Outgoing frames queue per connection and one loop callback
+    # concatenates them into a single write(); the caps bound frames and
+    # bytes per write. The kill switch restores one-write-per-frame (and
+    # disables the message-level lease/completion batches riding on it).
+    rpc_coalesce_enabled: bool = True
+    rpc_coalesce_max_frames: int = 64
+    rpc_coalesce_max_bytes: int = 1024 * 1024
     # Memory monitor (reference: memory_monitor.h:52 +
     # worker_killing_policy.h:33): when the node's memory usage fraction
     # exceeds the threshold, the newest leased task worker is killed (its

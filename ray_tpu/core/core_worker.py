@@ -127,6 +127,12 @@ class CoreWorker:
 
         self._queues: dict[Any, _QueueState] = {}
         self._task_specs: dict[str, TaskSpec] = {}  # task_id -> spec (lineage)
+        # node addr -> lease ids awaiting a batched return (one flush per
+        # loop tick per node; see _return_lease)
+        self._lease_returns: dict[tuple, list] = {}
+        # submissions from non-loop threads awaiting the drain callback
+        self._submit_lock = threading.Lock()
+        self._submit_buf: list = []
         # owner side: task_id -> worker addr while a push RPC is in flight
         self._inflight_push: dict[str, tuple] = {}
         # owner side: task_id -> future, in-flight lineage resubmissions
@@ -221,6 +227,13 @@ class CoreWorker:
 
     def stop(self) -> None:
         self._stopped = True
+        # Close buffered submissions the drain callback will never run
+        # (their refs are dead with this worker anyway; closing avoids
+        # "coroutine never awaited" noise at interpreter exit).
+        with self._submit_lock:
+            stranded, self._submit_buf = self._submit_buf, []
+        for coro in stranded:
+            coro.close()
         object_ref_mod.clear_hooks()
         if self._task_flush_task is not None:
             self._task_flush_task.cancel()
@@ -289,9 +302,18 @@ class CoreWorker:
         metrics_agent.py OpenCensusProxyCollector)."""
         from ray_tpu.util.metrics import registry
 
+        from ray_tpu.core.protocol import transport_metric_snapshot
+
         while not self._stopped:
             await asyncio.sleep(GLOBAL_CONFIG.metrics_report_interval_s)
             snap = registry().snapshot()
+            tstats = self.endpoint.transport_stats()
+            if tstats["frames_sent"]:
+                tmeta, tpoints = transport_metric_snapshot(
+                    tstats, {"worker_id": self.worker_id[:12]}
+                )
+                snap["meta"].update(tmeta)
+                snap["points"].extend(tpoints)
             if not snap["points"]:
                 continue
             try:
@@ -869,8 +891,17 @@ class CoreWorker:
         )
         if streaming:
             refs = [self._make_stream(task_id, refs[0])]
-        self._run_on_loop(self._enqueue_task(spec))
+        self._run_on_loop(self._guarded_enqueue(self._enqueue_task(spec), spec))
         return refs
+
+    async def _guarded_enqueue(self, coro, spec: TaskSpec) -> None:
+        """An enqueue that raises must FAIL the task's refs: the buffered
+        submission path has no caller to propagate to, and a silently
+        dropped enqueue would leave every return ref pending forever."""
+        try:
+            await coro
+        except Exception as e:  # noqa: BLE001
+            await self._fail_task(spec, e)
 
     def on_endpoint_loop(self) -> bool:
         """True when the caller is running ON this worker's endpoint loop
@@ -881,11 +912,32 @@ class CoreWorker:
         """Run an enqueue coroutine on the endpoint loop. From the loop
         itself (async actor methods submitting work), schedule it without
         blocking; scheduling order is FIFO, so submission order (and thus
-        actor-task seq order) is preserved."""
+        actor-task seq order) is preserved.
+
+        From other threads the coroutine is BUFFERED and drained by one
+        loop callback: one self-pipe wakeup per submission burst instead of
+        a blocking round-trip per task (the round-5 ceiling probe's
+        dominant cost was exactly these per-task wakeups). Correct because
+        enqueue coroutines are await-free — every later loop submission
+        (get/wait/cancel) runs after the drain callback, so it observes the
+        owner-store entries already registered."""
         if self.on_endpoint_loop():
             asyncio.ensure_future(_logged(coro, "task enqueue"))
-        else:
+            return
+        if not GLOBAL_CONFIG.rpc_coalesce_enabled:
             self.endpoint.submit(coro).result(timeout=30)
+            return
+        with self._submit_lock:
+            self._submit_buf.append(coro)
+            wake = len(self._submit_buf) == 1
+        if wake:
+            self.endpoint.loop.call_soon_threadsafe(self._drain_submissions)
+
+    def _drain_submissions(self) -> None:
+        with self._submit_lock:
+            coros, self._submit_buf = self._submit_buf, []
+        for coro in coros:
+            asyncio.ensure_future(_logged(coro, "task enqueue"))
 
     def _encode_arg(self, value: Any, ref_bag: "set | None" = None):
         if isinstance(value, ObjectRef):
@@ -930,17 +982,66 @@ class CoreWorker:
         # requests — never subtract granted leases or sequential submissions
         # serialize behind one busy lease.
         want = min(len(qs.queue), self.max_pending_leases) - qs.inflight
-        for _ in range(max(0, want)):
+        if want <= 0:
+            return
+        if want > 1 and GLOBAL_CONFIG.rpc_coalesce_enabled:
+            # A deep queue's whole lease wave rides ONE RPC (PERF.md
+            # round-5: the driver->node leg was still one frame per lease).
+            qs.inflight += want
+            asyncio.ensure_future(self._acquire_batch_and_run(key, qs, want))
+            return
+        for _ in range(want):
             qs.inflight += 1
             asyncio.ensure_future(self._acquire_and_run(key, qs))
 
-    async def _acquire_and_run(self, key, qs: _QueueState) -> None:
+    async def _acquire_batch_and_run(
+        self, key, qs: _QueueState, want: int
+    ) -> None:
+        sample = qs.queue[0] if qs.queue else None
+        if sample is None:
+            qs.inflight -= want
+            return
+        payload = self._lease_payload(sample)
+        payload["count"] = want
+        try:
+            replies = await self.endpoint.acall(
+                self.node_addr, "node.request_lease_batch", payload
+            )
+        except Exception as e:
+            qs.inflight -= want
+            while qs.queue:
+                spec = qs.queue.pop(0)
+                await self._fail_task(spec, e)
+            return
+        # Each entry continues on its own acquire path (a grant drains a
+        # lease; a fallback/spill/retry re-enters the individual loop);
+        # the inflight slots hand off 1:1.
+        for reply in replies:
+            first = None if reply.get("fallback") else reply
+            asyncio.ensure_future(
+                self._acquire_and_run(key, qs, first_reply=first)
+            )
+
+    async def _acquire_and_run(
+        self, key, qs: _QueueState, first_reply: dict | None = None
+    ) -> None:
         sample = qs.queue[0] if qs.queue else None
         if sample is None:
             qs.inflight -= 1
+            if first_reply is not None and "lease_id" in first_reply:
+                # Batch over-acquired (the queue emptied meanwhile): give
+                # the unused lease straight back.
+                try:
+                    await self.endpoint.acall(
+                        self.node_addr,
+                        "node.return_lease",
+                        {"lease_id": first_reply["lease_id"]},
+                    )
+                except Exception:
+                    pass
             return
         try:
-            grant = await self._request_lease(sample)
+            grant = await self._request_lease(sample, first_reply=first_reply)
         except Exception as e:
             qs.inflight -= 1
             # Fail every queued task in this class with the scheduling error.
@@ -958,15 +1059,43 @@ class CoreWorker:
             await self._drain_lease(qs, grant)
         finally:
             qs.leases.pop(lease_id, None)
+            await self._return_lease(grant["node_addr"], lease_id)
+            if qs.queue:
+                self._pump_queue(key, qs)
+
+    async def _return_lease(self, node_addr, lease_id: str) -> None:
+        """Return a drained lease. Coalescing on: returns to one node are
+        microbatched within a loop tick and ride one
+        ``node.return_lease_batch`` frame (a drain wave's returns all land
+        together); off: the old one-RPC-per-return path."""
+        if not GLOBAL_CONFIG.rpc_coalesce_enabled:
             try:
                 await self.endpoint.acall(
-                    grant["node_addr"], "node.return_lease",
-                    {"lease_id": lease_id},
+                    node_addr, "node.return_lease", {"lease_id": lease_id}
                 )
             except Exception:
                 pass
-            if qs.queue:
-                self._pump_queue(key, qs)
+            return
+        addr = tuple(node_addr)
+        buf = self._lease_returns.setdefault(addr, [])
+        buf.append(lease_id)
+        if len(buf) > 1:
+            return  # a flush for this node is already scheduled
+
+        async def flush():
+            ids = self._lease_returns.pop(addr, [])
+            if not ids:
+                return
+            try:
+                await self.endpoint.acall(
+                    addr, "node.return_lease_batch", {"lease_ids": ids}
+                )
+            except Exception:
+                pass
+
+        asyncio.get_running_loop().call_soon(
+            lambda: asyncio.ensure_future(flush())
+        )
 
     async def _drain_lease(self, qs: "_QueueState", grant: dict) -> None:
         """Feed the leased worker until the class queue empties or the
@@ -1136,20 +1265,33 @@ class CoreWorker:
             "streaming": spec.streaming,
         }
 
-    async def _request_lease(self, spec: TaskSpec) -> dict | None:
-        payload = {
+    @staticmethod
+    def _lease_payload(spec: TaskSpec) -> dict:
+        return {
             "resources": spec.resources,
             "label_selector": spec.label_selector,
             "soft_label_selector": spec.soft_label_selector,
             "policy": spec.policy,
             "runtime_env": spec.runtime_env,
         }
+
+    async def _request_lease(
+        self, spec: TaskSpec, first_reply: dict | None = None
+    ) -> dict | None:
+        payload = self._lease_payload(spec)
         node_addr = self.node_addr
         deadline = time.monotonic() + GLOBAL_CONFIG.lease_request_timeout_s
         while True:
-            reply = await self.endpoint.acall(
-                node_addr, "node.request_lease", payload
-            )
+            if first_reply is not None:
+                # An entry of a request_lease_batch reply (always from our
+                # own node): consume it as this iteration's answer.
+                reply, first_reply = first_reply, None
+            else:
+                reply = await self.endpoint.acall(
+                    node_addr, "node.request_lease", payload
+                )
+            if "error" in reply:
+                raise reply["error"]
             if "lease_id" in reply:
                 reply["node_addr"] = node_addr
                 return reply
@@ -1557,7 +1699,9 @@ class CoreWorker:
             actor_id=actor_id,
             **tfields,
         )
-        self._run_on_loop(self._submit_actor_async(spec))
+        self._run_on_loop(
+            self._guarded_enqueue(self._submit_actor_async(spec), spec)
+        )
         return refs
 
     async def _submit_actor_async(self, spec: TaskSpec) -> None:
@@ -2212,14 +2356,27 @@ class CoreWorker:
 
     async def _flush_created(self, results: list) -> None:
         """Tell our node about sealed shm objects BEFORE the reply releases
-        the owner to hand out the location (avoids a pull/adopt race)."""
-        for res in results:
-            if res[0] == "location":
+        the owner to hand out the location (avoids a pull/adopt race). A
+        multi-return task's notifications ride one completions_batch
+        frame instead of one RPC per sealed object."""
+        created = [
+            {"oid": res[3], "size": res[2]}
+            for res in results
+            if res[0] == "location"
+        ]
+        if not created:
+            return
+        if len(created) == 1 or not GLOBAL_CONFIG.rpc_coalesce_enabled:
+            # Kill switch honors config.py's promise: the "off" arm is
+            # fully unbatched (one object_created RPC per sealed object).
+            for c in created:
                 await self.endpoint.acall(
-                    self.node_addr,
-                    "node.object_created",
-                    {"oid": res[3], "size": res[2]},
+                    self.node_addr, "node.object_created", c
                 )
+            return
+        await self.endpoint.acall(
+            self.node_addr, "node.completions_batch", {"created": created}
+        )
 
     def _error_results(self, p, exc: Exception) -> list:
         if isinstance(exc, TaskCancelledError):

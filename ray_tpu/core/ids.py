@@ -7,6 +7,26 @@ Equivalent role to the reference's id types (reference: src/ray/common/id.h)
 from __future__ import annotations
 
 import os
+import random
+import threading
+
+# Ids need uniqueness, not cryptographic strength — and os.urandom is a
+# syscall, two of which (task id + return object id) used to ride EVERY
+# task submission (64% of the driver-thread submit profile on a slow
+# kernel). One urandom seed per process, then a userspace PRNG. Re-seeded
+# after fork so worker processes never replay the parent's stream.
+_rng = random.Random(os.urandom(16))
+_rng_pid = os.getpid()
+_rng_lock = threading.Lock()
+
+
+def _random_hex() -> str:
+    global _rng, _rng_pid
+    with _rng_lock:
+        if os.getpid() != _rng_pid:
+            _rng = random.Random(os.urandom(16))
+            _rng_pid = os.getpid()
+        return _rng.getrandbits(128).to_bytes(16, "big").hex()
 
 
 class BaseID:
@@ -18,7 +38,7 @@ class BaseID:
 
     @classmethod
     def random(cls):
-        return cls(os.urandom(16).hex())
+        return cls(_random_hex())
 
     @classmethod
     def from_hex(cls, hex_str: str):

@@ -663,16 +663,60 @@ class NodeManager:
 
     # -- leases --------------------------------------------------------------
 
-    async def _h_request_lease(self, conn, p):
-        req = SchedulingRequest(
+    @staticmethod
+    def _req_of_payload(p) -> SchedulingRequest:
+        return SchedulingRequest(
             resources=p.get("resources", {}),
             label_selector=p.get("label_selector", {}),
             soft_label_selector=p.get("soft_label_selector", {}),
             policy=p.get("policy", "hybrid"),
             runtime_env=p.get("runtime_env") or {},
         )
+
+    async def _h_request_lease(self, conn, p):
+        req = self._req_of_payload(p)
         deadline = time.monotonic() + GLOBAL_CONFIG.lease_request_timeout_s
         return await self._lease_or_spill(req, deadline)
+
+    async def _h_request_lease_batch(self, conn, p):
+        """N identical lease requests in ONE frame (the driver->node leg of
+        the coalescing tier: a deep queue's lease wave rides one RPC).
+
+        Only plain, immediately-grantable entries resolve here — the rest
+        return ``{"fallback": True}`` and the caller re-issues them as
+        individual (server-side queueing) request_lease calls. Entries must
+        never queue inside the batch: the combined reply would make an
+        early grant wait on a contended sibling, which deadlocks when the
+        sibling's resources are freed by the early grant's own task."""
+        req = self._req_of_payload(p)
+        n = max(1, int(p.get("count", 1)))
+        plain = (
+            req.policy == "hybrid"
+            and not req.soft_label_selector
+            and labels_match(self.labels, req.label_selector)
+        )
+        coros = []
+        for _ in range(n):
+            if plain and fits(self.available, req.resources):
+                # Reserve synchronously so each fits() sees the prior
+                # entries' demand; the grants then spawn workers
+                # concurrently.
+                subtract(self.available, req.resources)
+                coros.append(self._grant(req, pre_reserved=True))
+            else:
+                coros.append(None)
+        granted = await asyncio.gather(
+            *(c for c in coros if c is not None), return_exceptions=True
+        )
+        it = iter(granted)
+        out = []
+        for c in coros:
+            if c is None:
+                out.append({"fallback": True})
+                continue
+            r = next(it)
+            out.append({"error": r} if isinstance(r, BaseException) else r)
+        return out
 
     async def _lease_or_spill(self, req: SchedulingRequest, deadline: float):
         local_ok = labels_match(self.labels, req.label_selector)
@@ -837,8 +881,14 @@ class NodeManager:
             return {"spill": tuple(self.cluster_view[choice].addr)}
         return None
 
-    async def _grant(self, req: SchedulingRequest, for_actor: bool = False):
-        subtract(self.available, req.resources)
+    async def _grant(
+        self,
+        req: SchedulingRequest,
+        for_actor: bool = False,
+        pre_reserved: bool = False,
+    ):
+        if not pre_reserved:
+            subtract(self.available, req.resources)
         try:
             info = await self._get_idle_worker(
                 for_actor=for_actor, runtime_env=req.runtime_env
@@ -860,8 +910,8 @@ class NodeManager:
             "worker_id": info.worker_id,
         }
 
-    async def _h_return_lease(self, conn, p):
-        lease = self.leases.pop(p["lease_id"], None)
+    def _return_one_lease(self, lease_id: str) -> bool:
+        lease = self.leases.pop(lease_id, None)
         if lease is None:
             return False
         add(self.available, lease.resources)
@@ -872,8 +922,21 @@ class NodeManager:
             info.idle_since = time.monotonic()
             self.idle_workers.append(info.worker_id)
             self._notify_idle()
-        await self._drain_pending()
         return True
+
+    async def _h_return_lease(self, conn, p):
+        ok = self._return_one_lease(p["lease_id"])
+        if ok:
+            await self._drain_pending()
+        return ok
+
+    async def _h_return_lease_batch(self, conn, p):
+        """A whole drain wave's lease returns in one frame; pending leases
+        re-evaluate once, against all the freed resources at once."""
+        out = [self._return_one_lease(lid) for lid in p["lease_ids"]]
+        if any(out):
+            await self._drain_pending()
+        return out
 
     async def _drain_pending(self):
         # Snapshot-and-clear FIRST: drains can run concurrently (lease
@@ -1046,6 +1109,14 @@ class NodeManager:
     async def _h_object_created(self, conn, p):
         """A local worker sealed an object file in our shm root."""
         await self._store_call(self.store.adopt, p["oid"], p["size"])
+        return True
+
+    async def _h_completions_batch(self, conn, p):
+        """Task-completion notifications batched into one frame (mirrors
+        worker.push_batch on the push side): adopt every object the
+        completing task sealed in our shm root."""
+        for c in p["created"]:
+            await self._store_call(self.store.adopt, c["oid"], c["size"])
         return True
 
     async def _h_free_object(self, conn, p):
@@ -1260,6 +1331,15 @@ class NodeManager:
                 float(self.available.get("CPU", 0.0)),
             ],
         ]
+        # Transport coalescing counters (PERF.md round-6): how many RPC
+        # frames each socket write amortizes on this node's endpoint.
+        from ray_tpu.core.protocol import transport_metric_snapshot
+
+        tmeta, tpoints = transport_metric_snapshot(
+            self.endpoint.transport_stats(), tags
+        )
+        meta.update(tmeta)
+        points.extend(tpoints)
         return {"meta": meta, "points": points}
 
     async def _metrics_report_loop(self):

@@ -9,6 +9,16 @@ worker), and connections are dialed on demand and cached by address.
 Wire format: 4-byte big-endian length | pickled (msg_type, msg_id, reply_to,
 payload). A request carries msg_id; the reply echoes it in reply_to with type
 "$reply" (result) or "$error" (pickled exception, re-raised caller-side).
+
+Frame coalescing (PERF.md round-5: the driver core goes to one write() +
+event-loop wakeup per frame, not to pickle): outgoing frames are appended to
+a per-connection queue and flushed by a single loop callback that
+concatenates every queued frame into ONE ``writer.write`` — so all frames
+produced in one loop tick (a burst of requests, a wave of dispatch replies)
+cost one syscall. ``drain()`` is awaited only above the transport's
+high-water mark; below it the write buffer absorbs the bytes without a
+second coroutine hop. ``rpc_coalesce_enabled=False`` restores the old
+one-write-plus-drain-per-frame path.
 """
 
 from __future__ import annotations
@@ -19,10 +29,61 @@ import threading
 import traceback
 from typing import Any, Awaitable, Callable, Optional
 
+from ray_tpu.core.config import GLOBAL_CONFIG
+
 Address = tuple  # (host: str, port: int)
 
 _REPLY = "$reply"
 _ERROR = "$error"
+
+_READ_CHUNK = 256 * 1024
+
+# Cumulative per-connection transport counters (all plain ints: the hot path
+# must not pay a lock or a metrics-registry lookup per frame). Aggregated
+# across connections by Endpoint.transport_stats() and exported as gauges
+# through the observability tier.
+STAT_KEYS = (
+    "frames_sent",  # frames handed to the transport
+    "writes",  # writer.write() calls issued for those frames
+    "max_frames_per_write",  # largest single coalesced write
+    "drains",  # flushes that awaited writer.drain()
+    "drains_skipped",  # flushes below the high-water mark (no drain)
+    "frames_received",  # frames decoded from the read side
+    "reads",  # read wakeups that produced bytes
+)
+
+# Gauge name -> (stat key, description) for the metrics tier.
+TRANSPORT_METRICS = {
+    "raytpu_rpc_frames_sent": ("frames_sent", "RPC frames handed to the transport"),
+    "raytpu_rpc_writes": ("writes", "socket writes issued for those frames"),
+    "raytpu_rpc_frames_per_write": (
+        "frames_per_write",
+        "mean frames coalesced into one socket write",
+    ),
+    "raytpu_rpc_drains_skipped": (
+        "drains_skipped",
+        "flushes below the transport high-water mark (drain skipped)",
+    ),
+    "raytpu_rpc_frames_received": (
+        "frames_received",
+        "RPC frames decoded from socket reads",
+    ),
+}
+
+
+def transport_metric_snapshot(stats: dict, tags: dict) -> tuple[dict, list]:
+    """(meta, points) for the metrics tier from an Endpoint's transport
+    stats — cumulative totals, so they are exported as gauges (a counter
+    kind would re-add the running total every report interval)."""
+    meta = {
+        name: {"kind": "gauge", "description": desc, "boundaries": []}
+        for name, (_, desc) in TRANSPORT_METRICS.items()
+    }
+    points = [
+        [name, tags, float(stats.get(key, 0.0))]
+        for name, (key, _) in TRANSPORT_METRICS.items()
+    ]
+    return meta, points
 
 
 class RpcError(Exception):
@@ -54,16 +115,118 @@ class Connection:
         self._next_id = 1
         self._pending: dict[int, asyncio.Future] = {}
         self._closed = False
-        self._send_lock = asyncio.Lock()
+        self._send_lock = asyncio.Lock()  # legacy (kill-switch) path only
+        self._loop = asyncio.get_running_loop()
+        # Coalescing state: frames queued for the next flush callback.
+        self._send_buf: list[bytes] = []
+        self._flush_scheduled = False
+        # Set while the transport is below its high-water mark; cleared when
+        # a flush overruns it, re-set by the drain task — senders await it,
+        # which is the backpressure the old per-frame drain() provided.
+        self._drained = asyncio.Event()
+        self._drained.set()
+        self._drain_task: asyncio.Future | None = None
+        self.stats = dict.fromkeys(STAT_KEYS, 0)
         self.peer: Any = None  # set by servers after registration
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
     async def _send(self, msg_type: str, msg_id, reply_to, payload) -> None:
         data = pickle.dumps((msg_type, msg_id, reply_to, payload), protocol=5)
-        async with self._send_lock:
-            self.writer.write(len(data).to_bytes(4, "big"))
-            self.writer.write(data)
+        frame = len(data).to_bytes(4, "big") + data
+        if not GLOBAL_CONFIG.rpc_coalesce_enabled:
+            async with self._send_lock:
+                if self._closed:
+                    raise ConnectionLost(
+                        f"connection closed (sending {msg_type})"
+                    )
+                self.writer.write(frame)
+                st = self.stats
+                st["frames_sent"] += 1
+                st["writes"] += 1
+                if st["max_frames_per_write"] < 1:
+                    st["max_frames_per_write"] = 1
+                st["drains"] += 1
+                await self.writer.drain()
+            return
+        if self._closed:
+            raise ConnectionLost(f"connection closed (sending {msg_type})")
+        self._send_buf.append(frame)
+        if not self._flush_scheduled:
+            # call_soon lands AFTER every callback already in this loop
+            # tick's ready queue — so all frames produced by the tick
+            # (concurrent requests, a wave of dispatch replies) are queued
+            # before the flush concatenates them into one write.
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush)
+        if not self._drained.is_set():
+            await self._drained.wait()
+            if self._closed:
+                raise ConnectionLost(
+                    f"connection lost (sending {msg_type})"
+                )
+
+    def _flush(self) -> None:
+        """Flush callback: ONE write for everything queued this tick,
+        bounded by the byte/frame caps (the remainder reflushes next
+        tick)."""
+        self._flush_scheduled = False
+        if self._closed:
+            self._send_buf.clear()
+            return
+        buf = self._send_buf
+        if not buf:
+            return
+        max_frames = max(1, GLOBAL_CONFIG.rpc_coalesce_max_frames)
+        max_bytes = max(1, GLOBAL_CONFIG.rpc_coalesce_max_bytes)
+        n, size = 0, 0
+        while n < len(buf) and n < max_frames:
+            size += len(buf[n])
+            n += 1
+            if size >= max_bytes:
+                break
+        chunk = buf[0] if n == 1 else b"".join(buf[:n])
+        del buf[:n]
+        try:
+            self.writer.write(chunk)
+        except Exception:
+            self._teardown()
+            return
+        st = self.stats
+        st["writes"] += 1
+        st["frames_sent"] += n
+        if n > st["max_frames_per_write"]:
+            st["max_frames_per_write"] = n
+        if buf and not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush)
+        self._maybe_drain()
+
+    def _maybe_drain(self) -> None:
+        """Drain only above the transport high-water mark: below it the
+        write buffer absorbs the frames and a drain() await would be a pure
+        event-loop tax (the round-5 probe's dominant cost)."""
+        try:
+            transport = self.writer.transport
+            size = transport.get_write_buffer_size()
+            high = transport.get_write_buffer_limits()[1]
+        except Exception:
+            size, high = 0, 1
+        if size <= high:
+            self.stats["drains_skipped"] += 1
+            return
+        if self._drain_task is None:
+            self.stats["drains"] += 1
+            self._drained.clear()
+            self._drain_task = asyncio.ensure_future(self._drain())
+
+    async def _drain(self) -> None:
+        try:
             await self.writer.drain()
+        except Exception:
+            self._teardown()
+        finally:
+            self._drain_task = None
+            self._drained.set()
 
     async def request(self, msg_type: str, payload: Any = None) -> Any:
         if self._closed:
@@ -72,7 +235,16 @@ class Connection:
         self._next_id += 1
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        await self._send(msg_type, msg_id, None, payload)
+        try:
+            await self._send(msg_type, msg_id, None, payload)
+        except BaseException:
+            # The send failed (teardown raced the queue): the caller gets
+            # THIS error; consume the future so its teardown-set exception
+            # is never reported as unretrieved.
+            self._pending.pop(msg_id, None)
+            if fut.done() and not fut.cancelled():
+                fut.exception()
+            raise
         return await fut
 
     async def notify(self, msg_type: str, payload: Any = None) -> None:
@@ -81,27 +253,29 @@ class Connection:
         await self._send(msg_type, None, None, payload)
 
     async def _read_loop(self) -> None:
+        # One read() wakeup decodes EVERY complete frame it delivered
+        # before yielding back to the loop (the readexactly-per-frame shape
+        # paid a coroutine hop per 4-byte header even when the bytes were
+        # already buffered).
+        buf = bytearray()
         try:
             while True:
-                header = await self.reader.readexactly(4)
-                length = int.from_bytes(header, "big")
-                data = await self.reader.readexactly(length)
-                msg_type, msg_id, reply_to, payload = pickle.loads(data)
-                if msg_type == _REPLY:
-                    fut = self._pending.pop(reply_to, None)
-                    if fut is not None and not fut.done():
-                        fut.set_result(payload)
-                elif msg_type == _ERROR:
-                    fut = self._pending.pop(reply_to, None)
-                    if fut is not None and not fut.done():
-                        exc = payload
-                        if isinstance(exc, str):
-                            exc = RemoteError(exc)
-                        fut.set_exception(exc)
-                else:
-                    asyncio.ensure_future(
-                        self._dispatch(msg_type, msg_id, payload)
-                    )
+                chunk = await self.reader.read(_READ_CHUNK)
+                if not chunk:
+                    break  # EOF
+                buf += chunk
+                self.stats["reads"] += 1
+                off, end = 0, len(buf)
+                while end - off >= 4:
+                    length = int.from_bytes(buf[off : off + 4], "big")
+                    if end - off - 4 < length:
+                        break  # partial frame: wait for more bytes
+                    frame = pickle.loads(bytes(buf[off + 4 : off + 4 + length]))
+                    off += 4 + length
+                    self.stats["frames_received"] += 1
+                    self._handle_frame(*frame)
+                if off:
+                    del buf[:off]
         except (
             asyncio.IncompleteReadError,
             ConnectionError,
@@ -110,6 +284,21 @@ class Connection:
             pass
         finally:
             self._teardown()
+
+    def _handle_frame(self, msg_type, msg_id, reply_to, payload) -> None:
+        if msg_type == _REPLY:
+            fut = self._pending.pop(reply_to, None)
+            if fut is not None and not fut.done():
+                fut.set_result(payload)
+        elif msg_type == _ERROR:
+            fut = self._pending.pop(reply_to, None)
+            if fut is not None and not fut.done():
+                exc = payload
+                if isinstance(exc, str):
+                    exc = RemoteError(exc)
+                fut.set_exception(exc)
+        else:
+            asyncio.ensure_future(self._dispatch(msg_type, msg_id, payload))
 
     async def _dispatch(self, msg_type: str, msg_id, payload) -> None:
         try:
@@ -131,6 +320,8 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        self._send_buf.clear()
+        self._drained.set()  # wake senders blocked on backpressure
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionLost("connection lost"))
@@ -169,6 +360,14 @@ class Endpoint:
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[Address, Connection] = {}
         self._conn_locks: dict[Address, asyncio.Lock] = {}
+        # Every live connection (inbound + outbound) for transport-stat
+        # aggregation; closed connections fold into the totals. The lock
+        # makes fold-on-close atomic w.r.t. off-loop readers, so the
+        # cumulative counters never transiently go backward (a conn must
+        # be counted from exactly one of the two sources).
+        self._live_conns: set[Connection] = set()
+        self._transport_totals = dict.fromkeys(STAT_KEYS, 0)
+        self._stats_lock = threading.Lock()
         self.address: Address | None = None
         self._started = threading.Event()
         self.on_connection_lost: Optional[Callable[[Connection], None]] = None
@@ -274,14 +473,50 @@ class Endpoint:
     async def _accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        Connection(reader, writer, self._handle, on_close=self._conn_closed)
+        conn = Connection(
+            reader, writer, self._handle, on_close=self._conn_closed
+        )
+        with self._stats_lock:
+            self._live_conns.add(conn)
 
     def _conn_closed(self, conn: Connection) -> None:
+        with self._stats_lock:
+            if conn in self._live_conns:
+                self._live_conns.discard(conn)
+                self._fold_stats(self._transport_totals, conn.stats)
         for addr, c in list(self._conns.items()):
             if c is conn:
                 del self._conns[addr]
         if self.on_connection_lost is not None:
             self.on_connection_lost(conn)
+
+    @staticmethod
+    def _fold_stats(acc: dict, stats: dict) -> None:
+        for k, v in stats.items():
+            if k == "max_frames_per_write":
+                acc[k] = max(acc.get(k, 0), v)
+            else:
+                acc[k] = acc.get(k, 0) + v
+
+    def transport_stats(self) -> dict:
+        """Cumulative transport counters over every connection this
+        endpoint ever carried (live + closed), plus the derived
+        frames_per_write ratio — the strace-free view of how many frames
+        each syscall amortizes."""
+        with self._stats_lock:
+            out = dict(self._transport_totals)
+            for conn in list(self._live_conns):
+                self._fold_stats(out, conn.stats)
+        out["frames_per_write"] = (
+            out["frames_sent"] / out["writes"] if out["writes"] else 0.0
+        )
+        return out
+
+    def connection_stats(self, addr: Address) -> dict | None:
+        """Live counters of the cached outbound connection to ``addr``
+        (e.g. the driver->node hop), or None when not connected."""
+        conn = self._conns.get(tuple(addr))
+        return dict(conn.stats) if conn is not None else None
 
     async def _handle(self, conn: Connection, msg_type: str, payload: Any):
         handler = self.handlers.get(msg_type)
@@ -308,6 +543,8 @@ class Endpoint:
             conn = Connection(
                 reader, writer, self._handle, on_close=self._conn_closed
             )
+            with self._stats_lock:
+                self._live_conns.add(conn)
             self._conns[addr] = conn
             return conn
 

@@ -60,6 +60,9 @@ class _Request:
     slot: int = -1
     finished: bool = False
     blocks: list = dataclasses.field(default_factory=list)  # paged mode
+    # Admission failure surfaced via pop_finished (an impossible
+    # reservation must fail the REQUEST, not wedge the engine loop).
+    error: Optional[str] = None
 
 
 class LLMEngine:
@@ -326,11 +329,7 @@ class LLMEngine:
             and self._prefix_tokens_cached + p
             > self.config.max_prefix_cache_tokens
         ):
-            victim = min(self._prefix_pool, key=lambda k: self._prefix_pool[k]["used"])
-            evicted = self._prefix_pool.pop(victim)
-            self._prefix_tokens_cached -= evicted["len"]
-            if "blocks" in evicted:
-                self.block_mgr.decref(evicted["blocks"])
+            self._evict_one_prefix()
         entry = {
             "len": p,
             "used": self._prefix_clock,
@@ -367,6 +366,12 @@ class LLMEngine:
                 logits = self._admit_paged(req, slot)
             else:
                 logits = self._admit_dense(req, slot)
+            if req.finished:
+                # Permanently unadmittable (oversized reservation): it
+                # finished with an error; the wave continues — an
+                # impossible request must not starve admittable ones.
+                admit_finished.append(req)
+                continue
             if logits is None:
                 return admit_finished
             T = len(req.prompt)
@@ -416,14 +421,33 @@ class LLMEngine:
             )
         nb_total = -(-total // bs)
         need = max(nb_total - P // bs, 0)
-        if need > self.block_mgr.num_blocks - 1:
-            raise ValueError(
-                f"request {req.request_id} needs {need} KV blocks but the "
-                f"pool only has {self.block_mgr.num_blocks - 1}; raise "
+        if nb_total > self.block_mgr.num_blocks - 1:
+            # The FULL table (shared prefix blocks included — they must be
+            # live simultaneously) can never fit the pool: checking only
+            # the new-block count would let a prefix-sharing request slip
+            # past and wait forever on an impossible reservation.
+            # A reservation no pool state can ever satisfy: finish THIS
+            # request with an error (surfaced via pop_finished). Raising
+            # here would re-raise from every subsequent step() and wedge
+            # admission for all other requests (ADVICE round 5).
+            req.error = (
+                f"request {req.request_id} needs {nb_total} KV blocks but "
+                f"the pool only has {self.block_mgr.num_blocks - 1}; raise "
                 f"num_kv_blocks or lower max_tokens"
             )
-        if not self.block_mgr.can_alloc(need):
+            req.finished = True
             return None
+        if not self.block_mgr.can_alloc(need):
+            # Under allocation pressure the prefix pool must give way:
+            # its pinned refs can otherwise hold enough blocks that a
+            # max-length request is unadmittable FOREVER (the pool only
+            # self-evicts on its token budget). LRU-evict entries — the
+            # one this request is about to share is kept — until the
+            # reservation fits or the pool is dry (vLLM frees cached
+            # blocks on demand the same way).
+            self._evict_prefixes_until(need, keep=entry)
+            if not self.block_mgr.can_alloc(need):
+                return None
         shared: list = []
         if entry is not None:
             shared = list(entry["blocks"])
@@ -449,6 +473,30 @@ class LLMEngine:
             self.stats["prefix_tokens_reused"] += P
         self._insert_prefix(req.prompt, slot, blocks=table)
         return logits
+
+    def _evict_one_prefix(self, keep=None) -> bool:
+        """Drop the LRU prefix-pool entry (skipping ``keep``), returning
+        its tokens to the budget and its block refs to the pool. THE one
+        copy of the eviction bookkeeping — both the insert-time token
+        budget and allocation-pressure eviction go through it."""
+        victims = [k for k, e in self._prefix_pool.items() if e is not keep]
+        if not victims:
+            return False
+        victim = min(victims, key=lambda k: self._prefix_pool[k]["used"])
+        evicted = self._prefix_pool.pop(victim)
+        self._prefix_tokens_cached -= evicted["len"]
+        if "blocks" in evicted:
+            self.block_mgr.decref(evicted["blocks"])
+        return True
+
+    def _evict_prefixes_until(self, need: int, keep=None) -> None:
+        """LRU-evict prefix-pool entries until ``need`` blocks are
+        allocatable or nothing evictable remains. Entries whose blocks are
+        still shared by running requests free nothing when dropped — the
+        loop keeps going past them."""
+        while not self.block_mgr.can_alloc(need):
+            if not self._evict_one_prefix(keep=keep):
+                return
 
     def _admit_dense(self, req: _Request, slot: int):
         """Legacy dense per-slot cache admission (kv_block_size=0)."""
@@ -622,6 +670,7 @@ class LLMEngine:
                     "token_ids": list(req.generated),
                     "text": self.tokenizer.decode(toks),
                     "num_generated": len(req.generated),
+                    "error": req.error,
                 }
             )
         return out

@@ -112,6 +112,10 @@ class LLMServer:
             "text": self.engine.tokenizer.decode(toks),
             "token_ids": list(req.generated),
             "num_generated": len(req.generated),
+            # Admission failure (e.g. a reservation the KV pool can never
+            # satisfy): the engine finishes the request with req.error set
+            # instead of wedging; it must not leave here as an empty 200.
+            "error": getattr(req, "error", None),
         }
 
     async def _stream_tokens(self, prompt, sampling: SamplingParams):
@@ -128,6 +132,11 @@ class LLMServer:
             while True:
                 tok = await q.get()
                 if tok is None:
+                    done = self._finished.get(rid)
+                    if done is not None and getattr(done, "error", None):
+                        # Surface through the SSE error channel (the proxy
+                        # emits a data: {"error": ...} event + [DONE]).
+                        raise RuntimeError(done.error)
                     break
                 req = self.engine.requests.get(rid) or self._finished.get(rid)
                 if req is not None and tok == req.stop_token:
@@ -214,6 +223,8 @@ class LLMServer:
             if body.get("stream"):
                 return self._stream_chunks(prompt, body, created, chat=True)
             out = await self._generate(prompt, self._sampling(body))
+            if out.get("error"):
+                return {"error": out["error"]}
             return {
                 "id": "chatcmpl-raytpu",
                 "object": "chat.completion",
@@ -236,6 +247,8 @@ class LLMServer:
         if body.get("stream"):
             return self._stream_chunks(prompt, body, created, chat=False)
         out = await self._generate(prompt, self._sampling(body))
+        if out.get("error"):
+            return {"error": out["error"]}
         return {
             "id": "cmpl-raytpu",
             "object": "text_completion",

@@ -80,6 +80,7 @@ class ASGIAppWrapper:
             return {"type": "http.disconnect"}
 
         q: asyncio.Queue = asyncio.Queue()
+        done = object()  # sentinel: the app task exited
 
         async def send(msg):
             await q.put(msg)
@@ -87,19 +88,22 @@ class ASGIAppWrapper:
         task = asyncio.ensure_future(
             self._app(self._scope(request), receive, send)
         )
+        # Done-callback sentinel instead of timeout polling: the queue
+        # wakes exactly when a message (or app exit) arrives — the old
+        # 50 ms wait_for poll added up to 50 ms latency per chunk gap and
+        # busy-woke the loop in between. FIFO guarantees the sentinel
+        # lands after everything the app sent.
+        task.add_done_callback(lambda t: q.put_nowait(done))
         try:
             while True:
-                if task.done() and q.empty():
+                msg = await q.get()
+                if msg is done:
                     # App returned: surface its error (pre-head errors
                     # become 500s at the proxy) or end the stream.
                     exc = task.exception()
                     if exc is not None:
                         raise exc
                     return
-                try:
-                    msg = await asyncio.wait_for(q.get(), timeout=0.05)
-                except asyncio.TimeoutError:
-                    continue
                 if msg["type"] == "http.response.start":
                     yield {
                         "__asgi__": True,
@@ -117,9 +121,17 @@ class ASGIAppWrapper:
                         return
         finally:
             if not task.done():
-                # Final-body sent but the app is still unwinding: give it
-                # a moment to finish cleanup before cancelling.
+                # Final-body sent (or early close) but the app is still
+                # unwinding: give it a moment, then cancel AND await the
+                # cancellation so cleanup is never abandoned mid-unwind.
                 try:
                     await asyncio.wait_for(asyncio.shield(task), 1.0)
-                except Exception:
+                except BaseException:
                     task.cancel()
+                    try:
+                        # Bounded: an app that swallows CancelledError (or
+                        # whose cleanup wedges) must not hang the replica's
+                        # close path forever.
+                        await asyncio.wait_for(asyncio.shield(task), 1.0)
+                    except BaseException:
+                        pass

@@ -264,12 +264,9 @@ class HTTPProxyActor:
         status = int(head.get("status", 200))
         reason = _REASONS.get(status, "OK")
         lines = [f"HTTP/1.1 {status} {reason}"]
-        seen = set()
         for k, v in head.get("headers", []):
-            lk = k.lower()
-            if lk in ("connection", "content-length", "transfer-encoding"):
+            if k.lower() in ("connection", "content-length", "transfer-encoding"):
                 continue  # the proxy owns framing
-            seen.add(lk)
             lines.append(f"{k}: {v}")
         if content_length is not None:
             lines.append(f"Content-Length: {content_length}")

@@ -162,6 +162,11 @@ class TPESearcher(Searcher):
 
         tf = math.log if log else (lambda v: v)
         lo, hi = tf(low), tf(high)
+        if hi <= lo:
+            # Degenerate space (uniform(x, x) / loguniform with low ==
+            # high): every draw IS the bound; the Parzen bandwidths below
+            # would divide by the zero width (floor == cap == 0).
+            return [low] * self.n_candidates, [0.0] * self.n_candidates
         good = sorted(tf(x) for x in xs_good)
         bad = [tf(x) for x in xs_bad]
 

@@ -312,7 +312,11 @@ def test_paged_block_reuse_no_cross_request_contamination():
     assert [o["token_ids"] for o in again] == [o["token_ids"] for o in ref]
 
 
-def test_paged_oversized_request_rejected_upfront():
+def test_paged_oversized_request_finishes_with_error_not_wedge():
+    """A reservation exceeding the whole pool fails THAT request with an
+    error surfaced via pop_finished — the old behavior raised from the
+    admission loop, so every later step() re-raised and the engine wedged
+    forever (ADVICE round 5)."""
     model = tiny_cfg()
     eng = LLMEngine(
         LLMConfig(
@@ -322,5 +326,46 @@ def test_paged_oversized_request_rejected_upfront():
         )
     )
     eng.add_request("big", [1] * 10, SamplingParams(max_tokens=50))
-    with pytest.raises(ValueError, match="KV blocks"):
+    done = eng.step()
+    assert [r.request_id for r in done] == ["big"]
+    assert "KV blocks" in done[0].error
+    popped = eng.pop_finished()
+    assert popped and popped[0].error is not None
+    assert not eng.has_unfinished()
+    # The engine is NOT wedged: an admittable request still completes.
+    eng.add_request("ok", [2] * 6, SamplingParams(max_tokens=4))
+    while eng.has_unfinished():
         eng.step()
+    ok = eng.pop_finished()
+    assert len(ok) == 1 and ok[0].error is None and len(ok[0].generated) == 4
+
+
+def test_paged_prefix_pool_evicted_under_allocation_pressure():
+    """Pinned prefix-pool blocks are LRU-evicted when an admission can't
+    reserve — without this, a pool-heavy engine makes a max-length request
+    unadmittable forever and the engine stalls (ADVICE round 5 medium)."""
+    model = tiny_cfg()
+    eng = LLMEngine(
+        LLMConfig(
+            model_config=model, max_slots=2, max_seq=64,
+            prefill_buckets=(16, 32), kv_block_size=16, num_kv_blocks=5,
+            prefix_chunk=16, seed=0,
+        )
+    )  # 4 usable blocks
+    # Park two distinct prefixes in the pool (each pins 1 block).
+    sampling = SamplingParams(max_tokens=2, temperature=0.0)
+    eng.generate([[3] * 17], sampling)
+    eng.generate([[4] * 17], sampling)
+    assert len(eng._prefix_pool) == 2
+    assert eng.kv_stats()["blocks_free"] == 2
+    # A request needing 4 blocks (64 rows) can only fit if the pool gives
+    # its blocks back. Pre-fix this waited forever (has_unfinished stuck).
+    eng.add_request("big", [9] * 10, SamplingParams(max_tokens=54))
+    steps = 0
+    while eng.has_unfinished():
+        eng.step()
+        steps += 1
+        assert steps < 200, "engine wedged: prefix pool never gave way"
+    done = eng.pop_finished()
+    assert len(done) == 1 and done[0].error is None
+    assert len(eng._prefix_pool) < 2  # at least one entry was evicted

@@ -162,3 +162,71 @@ def test_asgi_buffered_streaming_same_payload(asgi_port):
     status, _headers, data = _request(asgi_port, "GET", "/web/chunks")
     assert status == 200
     assert data.count(b"data: part-") == 4
+
+
+# -- wrapper unit tests (ADVICE round 5: sentinel + awaited cancel) ----------
+
+
+def test_asgi_wrapper_sentinel_no_polling_and_error_surfaces():
+    """The wrapper's queue wakes on the done-callback sentinel, so an app
+    that returns WITHOUT a final more_body=False still ends the stream,
+    and a pre-head exception surfaces to the caller."""
+    import asyncio
+
+    from ray_tpu.serve.asgi import ASGIAppWrapper
+
+    async def quiet_app(scope, receive, send):
+        await send({"type": "http.response.start", "status": 204,
+                    "headers": []})
+        # returns with no body message at all
+
+    async def drive(app):
+        out = []
+        async for item in ASGIAppWrapper(app)({"path": "/x"}):
+            out.append(item)
+        return out
+
+    out = asyncio.run(drive(quiet_app))
+    assert out and out[0]["status"] == 204
+
+    async def broken_app(scope, receive, send):
+        raise RuntimeError("boom before head")
+
+    with pytest.raises(RuntimeError, match="boom before head"):
+        asyncio.run(drive(broken_app))
+
+
+def test_asgi_wrapper_early_close_awaits_app_cleanup():
+    """Closing the response generator mid-stream must cancel the app task
+    AND await it, so `finally` cleanup inside the app completes instead of
+    being abandoned mid-unwind (ADVICE round 5)."""
+    import asyncio
+
+    from ray_tpu.serve.asgi import ASGIAppWrapper
+
+    cleaned = []
+
+    async def streaming_app(scope, receive, send):
+        await send({"type": "http.response.start", "status": 200,
+                    "headers": []})
+        try:
+            for i in range(100):
+                await send({"type": "http.response.body",
+                            "body": b"chunk%d" % i, "more_body": True})
+                await asyncio.sleep(0)
+        finally:
+            # Takes a real await to finish: an abandoned cancel would
+            # never run past this line.
+            await asyncio.sleep(0.01)
+            cleaned.append(True)
+
+    async def drive():
+        gen = ASGIAppWrapper(streaming_app)({"path": "/s"})
+        head = await gen.__anext__()
+        assert head["status"] == 200
+        first = await gen.__anext__()
+        assert first.startswith(b"chunk")
+        await gen.aclose()  # early client disconnect
+
+    asyncio.run(drive())
+    assert cleaned == [True]

@@ -145,3 +145,28 @@ def test_tpe_in_tuner():
         assert best.metrics["loss"] < 0.2
     finally:
         ray_tpu.shutdown()
+
+
+def test_tpe_degenerate_continuous_space_returns_constant():
+    """uniform(x, x) / loguniform(low == high) must suggest the constant
+    instead of dividing by the zero-width range in the Parzen bandwidths
+    (ADVICE round 5: ZeroDivisionError in mix_logpdf)."""
+    space = {
+        "frozen": uniform(0.7, 0.7),
+        "frozen_log": loguniform(1e-3, 1e-3),
+        "free": uniform(0.0, 1.0),
+    }
+
+    def f(cfg):
+        assert cfg["frozen"] == 0.7
+        assert cfg["frozen_log"] == pytest.approx(1e-3)
+        return (cfg["free"] - 0.5) ** 2
+
+    s = TPESearcher(space, "loss", "min", n_startup=4, seed=0)
+    # Past n_startup the Parzen path runs — pre-fix this raised.
+    for i in range(12):
+        tid = f"t{i}"
+        cfg = s.suggest(tid)
+        assert cfg["frozen"] == 0.7
+        assert cfg["frozen_log"] == pytest.approx(1e-3)
+        s.on_trial_complete(tid, {"loss": f(cfg)})

@@ -1,0 +1,87 @@
+"""Same-session A/B of the RPC coalescing tier (PERF.md round-6).
+
+Runs tools/ray_perf.py alternately with coalescing ON (HEAD defaults) and
+OFF (--no-coalesce kill switch: one-write-per-frame transport, unbatched
+lease/submission paths) on the SAME commit, interleaved so ambient box
+load hits both arms equally (PERF.md round-3 lesson: cross-session rows
+are noise-dominated). Prints per-metric medians and the ratio.
+
+    python tools/ab_coalesce.py [--rounds 3] [--full]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_once(no_coalesce: bool, quick: bool) -> dict:
+    cmd = [sys.executable, os.path.join(REPO, "tools", "ray_perf.py")]
+    if quick:
+        cmd.append("--quick")
+    if no_coalesce:
+        cmd.append("--no-coalesce")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=1800, cwd=REPO, env=env
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"ray_perf failed ({cmd}):\n{out.stdout[-2000:]}\n"
+            f"{out.stderr[-2000:]}"
+        )
+    # The JSON summary is the last line that parses.
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError("no JSON summary line in ray_perf output")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument(
+        "--full", action="store_true", help="full (not --quick) perf runs"
+    )
+    args = ap.parse_args()
+
+    on_runs, off_runs = [], []
+    for i in range(args.rounds):
+        # Alternate starting arm each round so slow drift is symmetric.
+        order = [(False, on_runs), (True, off_runs)]
+        if i % 2:
+            order.reverse()
+        for no_coalesce, sink in order:
+            arm = "off" if no_coalesce else "on "
+            print(f"[round {i}] coalesce {arm} ...", flush=True)
+            sink.append(run_once(no_coalesce, quick=not args.full))
+
+    keys = sorted(
+        k
+        for k in on_runs[0]
+        if all(k in r for r in on_runs + off_runs)
+        and isinstance(on_runs[0][k], (int, float))
+    )
+    summary = {}
+    print(f"\n{'metric':<40} {'on':>12} {'off':>12} {'on/off':>8}")
+    for k in keys:
+        on_med = statistics.median(r[k] for r in on_runs)
+        off_med = statistics.median(r[k] for r in off_runs)
+        ratio = on_med / off_med if off_med else float("inf")
+        summary[k] = {"on": on_med, "off": off_med, "ratio": round(ratio, 3)}
+        print(f"{k:<40} {on_med:>12,.1f} {off_med:>12,.1f} {ratio:>8.2f}")
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,9 +59,21 @@ class Sink:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--no-coalesce",
+        action="store_true",
+        help="kill switch: one-write-per-frame transport, unbatched "
+        "lease/submission paths (the A/B baseline for PERF.md round-6)",
+    )
     args = ap.parse_args()
     batch = 20 if args.quick else 100
     min_s = 0.5 if args.quick else 2.0
+
+    if args.no_coalesce:
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        # Before init: the head ships this config to every node/worker.
+        GLOBAL_CONFIG.rpc_coalesce_enabled = False
 
     ray_tpu.init(num_cpus=16)
     results = {}
@@ -152,6 +164,26 @@ def main() -> int:
         ray_tpu.get(refs)
 
     record("n_n_actor_calls_async", n_n_async, batch * 2 * len(sinks))
+
+    # Transport counters: the strace-free syscall-reduction view
+    # (PERF.md round-6 A/B rides these).
+    from ray_tpu.core import api as _api
+
+    t = _api.transport_stats()
+    if t:
+        results["transport_frames_sent"] = t["frames_sent"]
+        results["transport_writes"] = t["writes"]
+        results["transport_frames_per_write"] = round(
+            t["frames_per_write"], 3
+        )
+        results["transport_drains_skipped"] = t["drains_skipped"]
+        print(
+            f"transport: {t['frames_sent']} frames / {t['writes']} writes "
+            f"= {t['frames_per_write']:.2f} frames/write "
+            f"(max {t['max_frames_per_write']}, drains awaited "
+            f"{t['drains']}, skipped {t['drains_skipped']})",
+            flush=True,
+        )
 
     print(json.dumps(results), flush=True)
     ray_tpu.shutdown()
