@@ -102,6 +102,20 @@ class Config:
     # observability tax on the submit path; timeline/state API lose task
     # rows). RAY_TPU_TASK_EVENTS_ENABLED=0 to turn off.
     task_events_enabled: bool = True
+    # Runtime telemetry kill switch (RAY_TPU_METRICS_ENABLED=0): disables
+    # every hot-layer instrumentation site (RPC method histograms, loop-lag
+    # probe, scheduler/serve/llm/data/train series) so the telemetry tax
+    # can be A/B-measured (tools/ray_perf.py --no-metrics). The metrics
+    # *pipeline* (registry, push, scrape) stays up either way.
+    metrics_enabled: bool = True
+    # Event-loop-lag probe: each Endpoint self-times an asyncio.sleep of
+    # this period and records the overshoot (the classic saturated-loop
+    # symptom). <= 0 disables the probe task. Deliberately SLOW: the A/B
+    # for this tier measured 0.5 s probes across a 16-worker cluster at
+    # ~40% off the sync-RPC rows on a 2-core box (timer wakeups in every
+    # process steal the benchmark's cores); at 2.5 s the probe disappears
+    # into the existing periodic work while still catching loop stalls.
+    loop_lag_probe_interval_s: float = 2.5
     metrics_report_interval_s: float = 2.0
     # Dashboard metric time-series (reference: dashboard/modules/metrics —
     # the Grafana-backed panels): the GCS samples the merged cluster

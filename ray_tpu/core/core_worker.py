@@ -302,18 +302,18 @@ class CoreWorker:
         metrics_agent.py OpenCensusProxyCollector)."""
         from ray_tpu.util.metrics import registry
 
-        from ray_tpu.core.protocol import transport_metric_snapshot
-
         while not self._stopped:
             await asyncio.sleep(GLOBAL_CONFIG.metrics_report_interval_s)
             snap = registry().snapshot()
-            tstats = self.endpoint.transport_stats()
-            if tstats["frames_sent"]:
-                tmeta, tpoints = transport_metric_snapshot(
-                    tstats, {"worker_id": self.worker_id[:12]}
-                )
-                snap["meta"].update(tmeta)
-                snap["points"].extend(tpoints)
+            tags = {"worker_id": self.worker_id[:12]}
+            # This process's endpoint telemetry (transport coalescing +
+            # per-method service stats like push_task handler latency):
+            # the worker-side half of the task hot path. A process that
+            # never sent a frame has nothing worth shipping.
+            if self.endpoint.transport_stats()["frames_sent"]:
+                emeta, epoints = self.endpoint.service_metric_snapshot(tags)
+                snap["meta"].update(emeta)
+                snap["points"].extend(epoints)
             if not snap["points"]:
                 continue
             try:
@@ -891,15 +891,18 @@ class CoreWorker:
         )
         if streaming:
             refs = [self._make_stream(task_id, refs[0])]
-        self._run_on_loop(self._guarded_enqueue(self._enqueue_task(spec), spec))
+        self._run_on_loop(self._guarded_enqueue(self._enqueue_task, spec))
         return refs
 
-    async def _guarded_enqueue(self, coro, spec: TaskSpec) -> None:
+    async def _guarded_enqueue(self, make_coro, spec: TaskSpec) -> None:
         """An enqueue that raises must FAIL the task's refs: the buffered
         submission path has no caller to propagate to, and a silently
-        dropped enqueue would leave every return ref pending forever."""
+        dropped enqueue would leave every return ref pending forever.
+        Takes the coroutine FUNCTION, not a coroutine object: a stranded
+        wrapper closed at stop() must not leave an eagerly-created inner
+        coroutine to die un-awaited (interpreter-exit RuntimeWarning)."""
         try:
-            await coro
+            await make_coro(spec)
         except Exception as e:  # noqa: BLE001
             await self._fail_task(spec, e)
 
@@ -1700,7 +1703,7 @@ class CoreWorker:
             **tfields,
         )
         self._run_on_loop(
-            self._guarded_enqueue(self._submit_actor_async(spec), spec)
+            self._guarded_enqueue(self._submit_actor_async, spec)
         )
         return refs
 

@@ -308,7 +308,16 @@ class GcsServer:
     async def _h_node_heartbeat(self, conn, p):
         view = self.nodes.get(p["node_id"])
         if view is None:
-            return False
+            return False  # piggybacked sections dropped too: re-register first
+        # Heartbeat piggybacking (ROADMAP): the envelope may carry the
+        # node's merged metric snapshots and staged log batches — one
+        # node->GCS stream instead of three.
+        if p.get("metrics") is not None:
+            self._ingest_node_metrics(p["node_id"], p["metrics"])
+        if p.get("logs"):
+            await self._publish(
+                "logs", {"node_id": p["node_id"], "batches": p["logs"]}
+            )
         new_avail = dict(p["available"])
         new_total = dict(p.get("total", view.total))
         if new_avail != view.available or new_total != view.total:
@@ -685,13 +694,21 @@ class GcsServer:
         await self._publish("logs", p)
         return True
 
-    async def _h_report_metrics(self, conn, p):
-        # Ignore reports from nodes already declared dead (stale series
-        # would otherwise be re-merged into every scrape forever).
-        view = self.nodes.get(p["node_id"])
+    def _ingest_node_metrics(self, node_id: str, snapshots: list) -> None:
+        """THE guarded ingest for node metric snapshots — shared by the
+        heartbeat piggyback path and the direct report_metrics RPC.
+        Reports from nodes already declared dead are ignored (stale series
+        would otherwise be re-merged into every scrape forever)."""
+        view = self.nodes.get(node_id)
         if view is not None and view.alive:
-            self.node_metrics[p["node_id"]] = p["snapshots"]
+            self.node_metrics[node_id] = snapshots
             self._sample_history()
+
+    async def _h_report_metrics(self, conn, p):
+        """Direct metric push. No production caller since snapshots ride
+        the heartbeat envelope — kept for external pushers and tests, on
+        the same guarded ingest as the heartbeat path."""
+        self._ingest_node_metrics(p["node_id"], p["snapshots"])
         return True
 
     def _sample_history(self) -> None:
@@ -724,8 +741,18 @@ class GcsServer:
                 self.metric_history[key] = ring
             ring.append((round(now, 3), value))
 
+    def _own_metric_snapshot(self) -> dict:
+        """The GCS process's own service stats (per-RPC-method latency,
+        in-flight, loop lag, transport counters). The GCS is the metrics
+        sink, so nothing pushes them — they join at scrape time."""
+        meta, points = self.endpoint.service_metric_snapshot(
+            {"process": "gcs"}
+        )
+        return {"meta": meta, "points": points}
+
     async def _h_dump_metrics(self, conn, p):
         snaps = [s for lst in self.node_metrics.values() for s in lst]
+        snaps.append(self._own_metric_snapshot())
         return snaps
 
     async def _h_metrics_history(self, conn, p):

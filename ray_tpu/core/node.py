@@ -29,6 +29,7 @@ from ray_tpu.core.object_store import ShmObjectStore, default_shm_root
 from ray_tpu.core.protocol import Endpoint
 from ray_tpu.core.scheduler import (
     NodeView,
+    SchedulerMetrics,
     SchedulingRequest,
     add,
     any_feasible,
@@ -37,6 +38,55 @@ from ray_tpu.core.scheduler import (
     pick_node,
     subtract,
 )
+from ray_tpu.util.metrics import declare_runtime_metric
+
+# Node-level series (beyond the worker/cpu gauges of earlier rounds):
+# object-plane occupancy and churn, plus the heartbeat-piggyback saving.
+_NODE_METRIC_META = {
+    "raytpu_node_workers": declare_runtime_metric(
+        "raytpu_node_workers", "gauge",
+        "worker processes on this node", layer="core",
+    ),
+    "raytpu_node_object_store_bytes": declare_runtime_metric(
+        "raytpu_node_object_store_bytes", "gauge",
+        "bytes resident in the shm object store", layer="core",
+    ),
+    "raytpu_node_cpu_available": declare_runtime_metric(
+        "raytpu_node_cpu_available", "gauge",
+        "unleased CPU resource", layer="core",
+    ),
+    "raytpu_object_store_objects": declare_runtime_metric(
+        "raytpu_object_store_objects", "gauge",
+        "objects tracked by the shm store (resident + spilled)",
+        layer="core",
+    ),
+    "raytpu_object_store_capacity_bytes": declare_runtime_metric(
+        "raytpu_object_store_capacity_bytes", "gauge",
+        "configured shm store capacity", layer="core",
+    ),
+    "raytpu_object_store_spills_total": declare_runtime_metric(
+        "raytpu_object_store_spills_total", "counter",
+        "blobs evicted from shm to the disk spill tier", layer="core",
+    ),
+    "raytpu_object_store_spilled_bytes_total": declare_runtime_metric(
+        "raytpu_object_store_spilled_bytes_total", "counter",
+        "bytes evicted from shm to the disk spill tier", layer="core",
+    ),
+    "raytpu_object_store_restores_total": declare_runtime_metric(
+        "raytpu_object_store_restores_total", "counter",
+        "spilled blobs restored into shm on access", layer="core",
+    ),
+    "raytpu_object_store_deletes_total": declare_runtime_metric(
+        "raytpu_object_store_deletes_total", "counter",
+        "objects freed from the shm store", layer="core",
+    ),
+    "raytpu_gcs_piggyback_frames_saved_total": declare_runtime_metric(
+        "raytpu_gcs_piggyback_frames_saved_total", "counter",
+        "metric/log RPCs folded into heartbeat envelopes instead of "
+        "riding their own frames",
+        layer="core",
+    ),
+}
 
 IDLE = "idle"
 LEASED = "leased"
@@ -141,6 +191,15 @@ class NodeManager:
         self._worker_metric_snaps: dict[str, dict] = {}
         self._log_offsets: dict[str, int] = {}
         self.log_dir: str | None = None
+        self.sched_metrics = SchedulerMetrics()
+        # Heartbeat piggybacking (ROADMAP): metric snapshots and log
+        # batches ride the periodic heartbeat envelope instead of their own
+        # node->GCS streams. The log monitor stages batches here; the
+        # heartbeat flushes them and attaches metrics when the report
+        # interval elapses.
+        self._pending_log_batches: list = []
+        self._last_metrics_report = 0.0
+        self._piggyback_saved = 0
         # Injectable for tests (simulate pressure without consuming RAM).
         self._memory_usage_fn = self._memory_usage_fraction
         for n in [n for n in dir(self) if n.startswith("_h_")]:
@@ -194,9 +253,10 @@ class NodeManager:
             tempfile.gettempdir(), "raytpu-sessions", self.session_id, "logs"
         )
         os.makedirs(self.log_dir, exist_ok=True)
+        # Metric snapshots and log batches piggyback on the heartbeat loop
+        # (one node->GCS stream), so there is no dedicated metrics RPC loop.
         self._tasks.append(self.endpoint.submit(self._heartbeat_loop()))
         self._tasks.append(self.endpoint.submit(self._worker_monitor_loop()))
-        self._tasks.append(self.endpoint.submit(self._metrics_report_loop()))
         self._tasks.append(self.endpoint.submit(self._log_monitor_loop()))
         self._tasks.append(self.endpoint.submit(self._memory_monitor_loop()))
         return addr
@@ -236,6 +296,36 @@ class NodeManager:
 
     # -- loops ---------------------------------------------------------------
 
+    def _piggyback_payload(self) -> dict:
+        """Metric snapshots + staged log batches for the next heartbeat
+        envelope. Each attached section replaces one RPC frame the old
+        dedicated streams would have sent — counted in
+        raytpu_gcs_piggyback_frames_saved_total."""
+        extra: dict = {}
+        now = time.monotonic()
+        if (
+            now - self._last_metrics_report
+            >= GLOBAL_CONFIG.metrics_report_interval_s
+        ):
+            self._last_metrics_report = now
+            # Only hand-built node series + worker-pushed snapshots travel.
+            # The process REGISTRY is deliberately absent: every process
+            # with a registry (driver included) pushes it through its own
+            # CoreWorker, and in-process clusters share this process
+            # between node manager and driver — attaching registry()
+            # here double-counted every driver-side counter.
+            snaps = [self._own_metric_snapshot()]
+            snaps.extend(self._worker_metric_snaps.values())
+            extra["metrics"] = snaps
+            self._piggyback_saved += 1
+        if self._pending_log_batches:
+            extra["logs"], self._pending_log_batches = (
+                self._pending_log_batches,
+                [],
+            )
+            self._piggyback_saved += 1
+        return extra
+
     async def _heartbeat_loop(self):
         while not self._stopping:
             try:
@@ -258,6 +348,7 @@ class NodeManager:
                         "idle": not self.leases
                         and not self._pending_leases
                         and self._task_worker_count() == 0,
+                        **self._piggyback_payload(),
                     },
                 )
                 if ok is False:
@@ -675,8 +766,25 @@ class NodeManager:
 
     async def _h_request_lease(self, conn, p):
         req = self._req_of_payload(p)
-        deadline = time.monotonic() + GLOBAL_CONFIG.lease_request_timeout_s
-        return await self._lease_or_spill(req, deadline)
+        t0 = time.monotonic()
+        deadline = t0 + GLOBAL_CONFIG.lease_request_timeout_s
+        if not GLOBAL_CONFIG.metrics_enabled:
+            return await self._lease_or_spill(req, deadline)
+        sm = self.sched_metrics
+        try:
+            reply = await self._lease_or_spill(req, deadline)
+        except Exception:
+            sm.errors += 1
+            raise
+        # Wait = arrival to grant, queueing included (the SLO number an
+        # operator reads to see scheduling pressure); spills/retries are
+        # counted, not timed — the granting node times them.
+        if "lease_id" in reply:
+            sm.granted += 1
+            sm.lease_wait.observe(time.monotonic() - t0)
+        elif "spill" in reply:
+            sm.spilled += 1
+        return reply
 
     async def _h_request_lease_batch(self, conn, p):
         """N identical lease requests in ONE frame (the driver->node leg of
@@ -705,6 +813,7 @@ class NodeManager:
                 coros.append(self._grant(req, pre_reserved=True))
             else:
                 coros.append(None)
+        t0 = time.monotonic()
         granted = await asyncio.gather(
             *(c for c in coros if c is not None), return_exceptions=True
         )
@@ -716,6 +825,15 @@ class NodeManager:
                 continue
             r = next(it)
             out.append({"error": r} if isinstance(r, BaseException) else r)
+        if GLOBAL_CONFIG.metrics_enabled:
+            sm = self.sched_metrics
+            wait = time.monotonic() - t0
+            for r in out:
+                if isinstance(r, dict) and "lease_id" in r:
+                    sm.granted += 1
+                    sm.lease_wait.observe(wait)
+                elif isinstance(r, dict) and "error" in r:
+                    sm.errors += 1
         return out
 
     async def _lease_or_spill(self, req: SchedulingRequest, deadline: float):
@@ -1299,72 +1417,89 @@ class NodeManager:
     # -- observability -------------------------------------------------------
 
     def _own_metric_snapshot(self) -> dict:
-        """Node-level gauges, merged with user metrics at the GCS."""
+        """Node-level series, merged with user metrics at the GCS: worker
+        pool + resource gauges, object-plane occupancy and churn, scheduler
+        queue/wait, per-RPC-method service histograms, and the transport
+        coalescing counters."""
         tags = {"node_id": self.node_id[:12]}
-        meta = {
-            "raytpu_node_workers": {
-                "kind": "gauge",
-                "description": "worker processes on this node",
-                "boundaries": [],
-            },
-            "raytpu_node_object_store_bytes": {
-                "kind": "gauge",
-                "description": "bytes resident in the shm object store",
-                "boundaries": [],
-            },
-            "raytpu_node_cpu_available": {
-                "kind": "gauge",
-                "description": "unleased CPU resource",
-                "boundaries": [],
-            },
-        }
+        meta = dict(_NODE_METRIC_META)
         points = [
             ["raytpu_node_workers", tags, float(len(self.workers))],
-            [
-                "raytpu_node_object_store_bytes",
-                tags,
-                float(self.store.used if self.store else 0),
-            ],
             [
                 "raytpu_node_cpu_available",
                 tags,
                 float(self.available.get("CPU", 0.0)),
             ],
+            [
+                "raytpu_gcs_piggyback_frames_saved_total",
+                tags,
+                float(self._piggyback_saved),
+            ],
         ]
-        # Transport coalescing counters (PERF.md round-6): how many RPC
-        # frames each socket write amortizes on this node's endpoint.
-        from ray_tpu.core.protocol import transport_metric_snapshot
-
-        tmeta, tpoints = transport_metric_snapshot(
-            self.endpoint.transport_stats(), tags
+        if self.store is not None:
+            st = self.store.stats()
+            points.extend(
+                [
+                    [
+                        "raytpu_node_object_store_bytes",
+                        tags,
+                        float(st["used_bytes"]),
+                    ],
+                    [
+                        "raytpu_object_store_objects",
+                        tags,
+                        float(st["objects"]),
+                    ],
+                    [
+                        "raytpu_object_store_capacity_bytes",
+                        tags,
+                        float(st["capacity_bytes"]),
+                    ],
+                    [
+                        "raytpu_object_store_spills_total",
+                        tags,
+                        float(st["spills"]),
+                    ],
+                    [
+                        "raytpu_object_store_spilled_bytes_total",
+                        tags,
+                        float(st["bytes_spilled"]),
+                    ],
+                    [
+                        "raytpu_object_store_restores_total",
+                        tags,
+                        float(st["restores"]),
+                    ],
+                    [
+                        "raytpu_object_store_deletes_total",
+                        tags,
+                        float(st["deletes"]),
+                    ],
+                ]
+            )
+        else:
+            points.append(["raytpu_node_object_store_bytes", tags, 0.0])
+        smeta, spoints = self.sched_metrics.snapshot(
+            tags, len(self._pending_leases)
         )
-        meta.update(tmeta)
-        points.extend(tpoints)
+        meta.update(smeta)
+        points.extend(spoints)
+        # Per-method service stats + transport coalescing counters
+        # (PERF.md round-6) for this node's endpoint.
+        emeta, epoints = self.endpoint.service_metric_snapshot(tags)
+        meta.update(emeta)
+        points.extend(epoints)
         return {"meta": meta, "points": points}
-
-    async def _metrics_report_loop(self):
-        from ray_tpu.util.metrics import registry
-
-        while not self._stopping:
-            await asyncio.sleep(GLOBAL_CONFIG.metrics_report_interval_s)
-            snaps = [self._own_metric_snapshot(), registry().snapshot()]
-            snaps.extend(self._worker_metric_snaps.values())
-            try:
-                await self.endpoint.acall(
-                    self.gcs_addr,
-                    "gcs.report_metrics",
-                    {"node_id": self.node_id, "snapshots": snaps},
-                )
-            except Exception:
-                pass
 
     async def _h_report_metrics(self, conn, p):
         self._worker_metric_snaps[p["worker_id"]] = p["snapshot"]
         return True
 
     async def _log_monitor_loop(self):
-        """Tail worker log files; publish new lines to the GCS "logs"
-        channel (reference: python/ray/_private/log_monitor.py)."""
+        """Tail worker log files; stage new lines for the next heartbeat
+        envelope, which publishes them to the GCS "logs" channel
+        (reference: python/ray/_private/log_monitor.py, minus the
+        dedicated publish stream — ROADMAP heartbeat piggybacking)."""
         while not self._stopping:
             await asyncio.sleep(GLOBAL_CONFIG.log_monitor_interval_s)
             if self.log_dir is None:
@@ -1401,14 +1536,12 @@ class NodeManager:
                 )
             if not batches:
                 continue
-            try:
-                await self.endpoint.acall(
-                    self.gcs_addr,
-                    "gcs.publish_logs",
-                    {"node_id": self.node_id, "batches": batches},
-                )
-            except Exception:
-                pass
+            self._pending_log_batches.extend(batches)
+            # Bounded staging: a long GCS outage must not grow the buffer
+            # without limit (observability is deliberately lossy under
+            # failure, like the task-event buffer).
+            if len(self._pending_log_batches) > 200:
+                del self._pending_log_batches[:100]
 
     async def _h_list_objects(self, conn, p):
         """Objects resident in this node's store (reference: list_objects

@@ -51,6 +51,18 @@ class ShmObjectStore:
         # object hex -> [size, sealed, last_access, location("shm"|"spill")]
         self.meta: dict[str, list] = {}
         self._maps: dict[str, tuple[mmap.mmap, memoryview]] = {}
+        # Cumulative operation counters (mutated under self._lock, exported
+        # by the node's metric snapshot): spills are this store's eviction
+        # mechanism, so spill counts/bytes ARE the eviction series.
+        self.op_stats = {
+            "creates": 0,
+            "adopts": 0,
+            "deletes": 0,
+            "spills": 0,
+            "restores": 0,
+            "bytes_spilled": 0,
+            "bytes_restored": 0,
+        }
         # Spill tier: sealed blobs LRU-move to durable disk when shm is at
         # capacity, and restore on access (reference:
         # src/ray/raylet/local_object_manager.h:44 spill/restore).
@@ -104,6 +116,8 @@ class ShmObjectStore:
             os.unlink(self._path(oid_hex))
             entry[3] = "spill"
             self.used -= entry[0]
+            self.op_stats["spills"] += 1
+            self.op_stats["bytes_spilled"] += entry[0]
 
     def _restore(self, oid_hex: str) -> None:
         with self._lock:
@@ -117,6 +131,8 @@ class ShmObjectStore:
             os.unlink(self._spill_path(oid_hex))
             entry[3] = "shm"
             self.used += entry[0]
+            self.op_stats["restores"] += 1
+            self.op_stats["bytes_restored"] += entry[0]
 
     def create(self, oid_hex: str, size: int) -> memoryview:
         with self._lock:
@@ -132,6 +148,7 @@ class ShmObjectStore:
                 os.close(fd)
             self.meta[oid_hex] = [size, False, time.monotonic(), "shm"]
             self.used += size
+            self.op_stats["creates"] += 1
             self._maps[oid_hex] = (mm, memoryview(mm)[:size])
             return self._maps[oid_hex][1]
 
@@ -153,6 +170,7 @@ class ShmObjectStore:
                 return
             self.meta[oid_hex] = [size, True, time.monotonic(), "shm"]
             self.used += size
+            self.op_stats["adopts"] += 1
             if self.used > self.capacity:
                 try:
                     self._ensure_capacity(0)
@@ -198,11 +216,26 @@ class ShmObjectStore:
             view = self.get(oid_hex)
             return bytes(view[offset : offset + length])
 
+    def stats(self) -> dict:
+        """Occupancy + cumulative operation counters for the node's metric
+        snapshot (one lock hold per report interval, not per operation)."""
+        with self._lock:
+            return {
+                **self.op_stats,
+                "used_bytes": self.used,
+                "capacity_bytes": self.capacity,
+                "objects": len(self.meta),
+                "spilled_objects": sum(
+                    1 for e in self.meta.values() if e[3] == "spill"
+                ),
+            }
+
     def delete(self, oid_hex: str) -> None:
         with self._lock:
             entry = self.meta.pop(oid_hex, None)
             if entry is None:
                 return
+            self.op_stats["deletes"] += 1
             if entry[3] == "shm":
                 self.used -= entry[0]
             pair = self._maps.pop(oid_hex, None)

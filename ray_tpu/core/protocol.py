@@ -26,10 +26,16 @@ from __future__ import annotations
 import asyncio
 import pickle
 import threading
+import time
 import traceback
 from typing import Any, Awaitable, Callable, Optional
 
 from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.util.metrics import (
+    LATENCY_BOUNDARIES_S,
+    LocalHistogram,
+    declare_runtime_metric,
+)
 
 Address = tuple  # (host: str, port: int)
 
@@ -86,6 +92,48 @@ def transport_metric_snapshot(stats: dict, tags: dict) -> tuple[dict, list]:
     return meta, points
 
 
+# Per-RPC-method service instrumentation (SLO tier): server-side handler
+# latency + error counts per msg_type, an in-flight gauge, and the
+# event-loop-lag probe. All mutate loop-thread-local LocalHistograms /
+# plain ints — no lock, no registry lookup on the frame path — and fold
+# into snapshot points at report time, like the transport counters above.
+_RPC_METRIC_META = {
+    "raytpu_rpc_method_latency_seconds": declare_runtime_metric(
+        "raytpu_rpc_method_latency_seconds",
+        "histogram",
+        "server-side RPC handler latency per method",
+        tag_keys=("method",),
+        boundaries=LATENCY_BOUNDARIES_S,
+        layer="core",
+    ),
+    "raytpu_rpc_method_errors_total": declare_runtime_metric(
+        "raytpu_rpc_method_errors_total",
+        "counter",
+        "RPC handler invocations that raised, per method",
+        tag_keys=("method",),
+        layer="core",
+    ),
+    "raytpu_rpc_inflight": declare_runtime_metric(
+        "raytpu_rpc_inflight",
+        "gauge",
+        "RPC handler invocations currently executing on this endpoint",
+        layer="core",
+    ),
+    "raytpu_event_loop_lag_seconds": declare_runtime_metric(
+        "raytpu_event_loop_lag_seconds",
+        "histogram",
+        "event-loop scheduling lag (self-timed sleep overshoot)",
+        boundaries=LATENCY_BOUNDARIES_S,
+        layer="core",
+    ),
+}
+
+# Register the round-6 transport gauges in the lint catalog too (they are
+# built directly, not through the user API, so they don't self-register).
+for _name, (_key, _desc) in TRANSPORT_METRICS.items():
+    declare_runtime_metric(_name, "gauge", _desc, layer="core")
+
+
 class RpcError(Exception):
     pass
 
@@ -139,6 +187,12 @@ class Connection:
                     raise ConnectionLost(
                         f"connection closed (sending {msg_type})"
                     )
+                # The knob can flip at runtime (kill-switch tests/tools):
+                # frames still queued for the coalesced flush must hit the
+                # wire BEFORE this direct write, or wire order diverges
+                # from send order (actor seq dispatch relies on it).
+                while self._send_buf:
+                    self._flush()
                 self.writer.write(frame)
                 st = self.stats
                 st["frames_sent"] += 1
@@ -368,6 +422,13 @@ class Endpoint:
         self._live_conns: set[Connection] = set()
         self._transport_totals = dict.fromkeys(STAT_KEYS, 0)
         self._stats_lock = threading.Lock()
+        # Per-method service stats: mutated only on the endpoint loop
+        # (LocalHistogram is lock-free by design); folded into snapshot
+        # points by rpc_metric_snapshot().
+        self._method_hists: dict[str, LocalHistogram] = {}
+        self._method_errors: dict[str, int] = {}
+        self._inflight = 0
+        self._loop_lag = LocalHistogram(LATENCY_BOUNDARIES_S)
         self.address: Address | None = None
         self._started = threading.Event()
         self.on_connection_lost: Optional[Callable[[Connection], None]] = None
@@ -419,6 +480,11 @@ class Endpoint:
             sock = self._server.sockets[0]
             bound_port = sock.getsockname()[1]
             self.address = (self._advertise_host(host), bound_port)
+            if (
+                GLOBAL_CONFIG.metrics_enabled
+                and GLOBAL_CONFIG.loop_lag_probe_interval_s > 0
+            ):
+                asyncio.ensure_future(self._lag_probe_loop())
             self._started.set()
 
         self._loop.run_until_complete(boot())
@@ -518,11 +584,89 @@ class Endpoint:
         conn = self._conns.get(tuple(addr))
         return dict(conn.stats) if conn is not None else None
 
+    async def _lag_probe_loop(self) -> None:
+        """Event-loop-lag probe: a sleep's overshoot is pure scheduling lag
+        — the first symptom of a saturated loop (missed heartbeats, stalled
+        flush callbacks) and the metric an operator checks before blaming
+        the network."""
+        loop = asyncio.get_running_loop()
+        while True:
+            interval = GLOBAL_CONFIG.loop_lag_probe_interval_s
+            if interval <= 0:
+                return
+            t0 = loop.time()
+            await asyncio.sleep(interval)
+            self._loop_lag.observe(max(0.0, loop.time() - t0 - interval))
+
+    def rpc_metric_snapshot(self, tags: dict) -> tuple[dict, list]:
+        """(meta, points) of this endpoint's per-method service stats for
+        the metrics tier. Histograms/counters are cumulative per process;
+        each report replaces the process's previous snapshot upstream, so
+        cross-process merging keeps Prometheus semantics."""
+        points: list = [
+            ["raytpu_rpc_inflight", dict(tags), float(self._inflight)]
+        ]
+        for method, h in list(self._method_hists.items()):
+            points.append(
+                [
+                    "raytpu_rpc_method_latency_seconds",
+                    {**tags, "method": method},
+                    h.as_value(),
+                ]
+            )
+        for method, n in list(self._method_errors.items()):
+            points.append(
+                [
+                    "raytpu_rpc_method_errors_total",
+                    {**tags, "method": method},
+                    float(n),
+                ]
+            )
+        if self._loop_lag.count:
+            points.append(
+                [
+                    "raytpu_event_loop_lag_seconds",
+                    dict(tags),
+                    self._loop_lag.as_value(),
+                ]
+            )
+        return dict(_RPC_METRIC_META), points
+
+    def service_metric_snapshot(self, tags: dict) -> tuple[dict, list]:
+        """THE combined per-process endpoint telemetry: per-method service
+        stats + transport coalescing counters, assembled once here so
+        worker/node/GCS reporters can't drift apart series-wise."""
+        meta, points = self.rpc_metric_snapshot(tags)
+        tmeta, tpoints = transport_metric_snapshot(
+            self.transport_stats(), tags
+        )
+        meta.update(tmeta)
+        points.extend(tpoints)
+        return meta, points
+
     async def _handle(self, conn: Connection, msg_type: str, payload: Any):
         handler = self.handlers.get(msg_type)
         if handler is None:
             raise RpcError(f"{self.name}: no handler for {msg_type!r}")
-        return await handler(conn, payload)
+        if not GLOBAL_CONFIG.metrics_enabled:
+            return await handler(conn, payload)
+        t0 = time.perf_counter()
+        self._inflight += 1
+        try:
+            return await handler(conn, payload)
+        except Exception:
+            self._method_errors[msg_type] = (
+                self._method_errors.get(msg_type, 0) + 1
+            )
+            raise
+        finally:
+            self._inflight -= 1
+            h = self._method_hists.get(msg_type)
+            if h is None:
+                h = self._method_hists[msg_type] = LocalHistogram(
+                    LATENCY_BOUNDARIES_S
+                )
+            h.observe(time.perf_counter() - t0)
 
     def register(self, msg_type: str, handler: Callable) -> None:
         self.handlers[msg_type] = handler

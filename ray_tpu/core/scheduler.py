@@ -17,7 +17,91 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Optional
 
+from ray_tpu.util.metrics import LocalHistogram, declare_runtime_metric
+
 EPS = 1e-9
+
+# Lease-wait boundaries: sub-ms immediate grants through multi-second
+# queueing under contention.
+LEASE_WAIT_BOUNDARIES_S = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0,
+]
+
+_SCHED_METRIC_META = {
+    "raytpu_sched_lease_wait_seconds": declare_runtime_metric(
+        "raytpu_sched_lease_wait_seconds",
+        "histogram",
+        "time from lease request arrival to grant on this node",
+        boundaries=LEASE_WAIT_BOUNDARIES_S,
+        layer="core",
+    ),
+    "raytpu_sched_pending_leases": declare_runtime_metric(
+        "raytpu_sched_pending_leases",
+        "gauge",
+        "lease requests queued on this node (scheduler queue depth)",
+        layer="core",
+    ),
+    "raytpu_sched_leases_granted_total": declare_runtime_metric(
+        "raytpu_sched_leases_granted_total",
+        "counter",
+        "leases granted by this node",
+        layer="core",
+    ),
+    "raytpu_sched_leases_spilled_total": declare_runtime_metric(
+        "raytpu_sched_leases_spilled_total",
+        "counter",
+        "lease requests redirected to a peer node",
+        layer="core",
+    ),
+    "raytpu_sched_lease_errors_total": declare_runtime_metric(
+        "raytpu_sched_lease_errors_total",
+        "counter",
+        "lease requests that failed (timeout or infeasible)",
+        layer="core",
+    ),
+}
+
+
+class SchedulerMetrics:
+    """Per-node-manager scheduling accumulators.
+
+    Mutated only on the node's event loop (no locks); the node folds them
+    into its metric snapshot each report, passing the live pending-queue
+    depth so the gauge reads current state.
+    """
+
+    def __init__(self):
+        self.lease_wait = LocalHistogram(LEASE_WAIT_BOUNDARIES_S)
+        self.granted = 0
+        self.spilled = 0
+        self.errors = 0
+
+    def snapshot(self, tags: dict, pending_depth: int) -> tuple[dict, list]:
+        points = [
+            [
+                "raytpu_sched_lease_wait_seconds",
+                dict(tags),
+                self.lease_wait.as_value(),
+            ],
+            ["raytpu_sched_pending_leases", dict(tags), float(pending_depth)],
+            [
+                "raytpu_sched_leases_granted_total",
+                dict(tags),
+                float(self.granted),
+            ],
+            [
+                "raytpu_sched_leases_spilled_total",
+                dict(tags),
+                float(self.spilled),
+            ],
+            [
+                "raytpu_sched_lease_errors_total",
+                dict(tags),
+                float(self.errors),
+            ],
+        ]
+        return dict(_SCHED_METRIC_META), points
 
 
 def fits(avail: Mapping[str, float], demand: Mapping[str, float]) -> bool:

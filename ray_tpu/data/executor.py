@@ -19,6 +19,47 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.data.block import BlockAccessor, concat_blocks
+from ray_tpu.util import metrics as _metrics
+
+# Per-operator pipeline series. The "operator" tag is the fused chain's
+# class-name string — bounded by the op vocabulary, not by data volume.
+# Stage wall time is recorded driver-side; rows/bytes are recorded inside
+# the block tasks (worker-side, so they flow through the push path and
+# count remote work even when the driver never materializes the blocks).
+_STAGE_SECONDS = _metrics.Histogram(
+    "raytpu_data_stage_seconds",
+    "wall time one streamed stage spent producing blocks",
+    tag_keys=("operator",),
+)
+_STAGE_ROWS = _metrics.Counter(
+    "raytpu_data_stage_rows_total",
+    "rows produced per streamed stage",
+    tag_keys=("operator",),
+)
+_STAGE_BLOCKS = _metrics.Counter(
+    "raytpu_data_stage_blocks_total",
+    "blocks produced per streamed stage",
+    tag_keys=("operator",),
+)
+_TASK_ROWS = _metrics.Counter(
+    "raytpu_data_block_rows_total",
+    "rows produced by data block tasks (worker-side)",
+)
+_TASK_BYTES = _metrics.Counter(
+    "raytpu_data_block_bytes_total",
+    "bytes produced by data block tasks (worker-side)",
+)
+
+
+def _record_block_output(block) -> None:
+    """Worker-side rows/bytes accounting for one produced block."""
+    if not _metrics.metrics_enabled():
+        return
+    try:
+        _TASK_ROWS.inc(float(block.num_rows))
+        _TASK_BYTES.inc(float(block.nbytes))
+    except Exception:
+        pass  # never fail a data task over telemetry
 from ray_tpu.data.plan import (
     DataPlan,
     JoinOp,
@@ -44,6 +85,7 @@ def _run_chain(chain_payload: bytes, source, is_read_task: bool):
     block = source() if is_read_task else source
     for op in chain:
         block = apply_chain_op(op, block)
+    _record_block_output(block)
     return block, block.num_rows
 
 
@@ -59,6 +101,7 @@ class _ChainActor:
         block = source() if is_read_task else source
         for op in self._chain:
             block = apply_chain_op(op, block)
+        _record_block_output(block)
         return block, block.num_rows
 
     def ping(self) -> bool:
@@ -413,6 +456,13 @@ class StreamingExecutor:
         finally:
             inner.close()
             self.stats.total_wall_s += rec.wall_s
+            if _metrics.metrics_enabled():
+                tags = {"operator": rec.name}
+                _STAGE_SECONDS.observe(rec.wall_s, tags)
+                if rec.rows_out:
+                    _STAGE_ROWS.inc(float(rec.rows_out), tags)
+                if rec.blocks_out:
+                    _STAGE_BLOCKS.inc(float(rec.blocks_out), tags)
 
     def _stream_stage_inner(
         self, chain, sources, is_read, apply_shard, apply_limit

@@ -23,6 +23,7 @@ import dataclasses
 import functools
 import os
 import pickle
+import time as _time
 from typing import Optional
 
 import jax
@@ -32,6 +33,64 @@ import numpy as np
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models import gpt2
+from ray_tpu.util import metrics as _metrics
+
+# Serving SLO series (recorded per step, not per frame: a decode step is
+# milliseconds-scale, so registry locking is negligible here). TTFT =
+# admission to first sampled token; ITL = gap between a request's
+# consecutive tokens. Tokens-per-second is the rate of the counters.
+_TTFT_SECONDS = _metrics.Histogram(
+    "raytpu_llm_ttft_seconds",
+    "time to first token (request admission to first sample)",
+    boundaries=_metrics.LATENCY_BOUNDARIES_S,
+)
+_ITL_SECONDS = _metrics.Histogram(
+    "raytpu_llm_itl_seconds",
+    "inter-token latency (gap between consecutive generated tokens)",
+    boundaries=_metrics.LATENCY_BOUNDARIES_S,
+)
+_PROMPT_TOKENS = _metrics.Counter(
+    "raytpu_llm_prompt_tokens_total",
+    "prompt tokens admitted (prefix-cache reuse included)",
+)
+_GEN_TOKENS = _metrics.Counter(
+    "raytpu_llm_generated_tokens_total",
+    "tokens sampled by the decode loop",
+)
+_REQUESTS = _metrics.Counter(
+    "raytpu_llm_requests_total", "requests admitted to the engine"
+)
+# Gauges carry a replica tag: merge is last-wins per (name, tags), so an
+# untagged gauge from N engine replicas would show one arbitrary
+# replica's value. Histograms/counters sum correctly and stay untagged.
+_KV_UTIL = _metrics.Gauge(
+    "raytpu_llm_kv_utilization",
+    "fraction of KV blocks in use (paged mode)",
+    tag_keys=("replica",),
+)
+_PREFIX_HIT_RATE = _metrics.Gauge(
+    "raytpu_llm_prefix_hit_rate",
+    "fraction of prefix-pool lookups that reused cached KV",
+    tag_keys=("replica",),
+)
+
+_replica_tags_cache: dict | None = None
+
+
+def _replica_tags() -> dict:
+    """Engine-identity gauge tags: the hosting actor's truncated id
+    (bounded by live replicas; series vanish with the process's
+    snapshot), or "local" outside an actor (tests, batch inference)."""
+    global _replica_tags_cache
+    if _replica_tags_cache is None:
+        try:
+            from ray_tpu.core import api as core_api
+
+            rid = core_api.get_runtime_context().actor_id or ""
+        except Exception:
+            rid = ""
+        _replica_tags_cache = {"replica": rid[:12] or "local"}
+    return _replica_tags_cache
 
 
 def _model_ops(cfg):
@@ -63,6 +122,10 @@ class _Request:
     # Admission failure surfaced via pop_finished (an impossible
     # reservation must fail the REQUEST, not wedge the engine loop).
     error: Optional[str] = None
+    # Telemetry anchors: admission wall-clock and the previous token's
+    # timestamp (TTFT / inter-token latency).
+    t_admit: float = 0.0
+    t_last_token: float = 0.0
 
 
 class LLMEngine:
@@ -172,7 +235,9 @@ class LLMEngine:
         self.stats = {
             "prefill_tokens": 0,  # tokens that PAID prefill compute
             "prefix_hits": 0,
+            "prefix_lookups": 0,
             "prefix_tokens_reused": 0,
+            "tokens_generated": 0,
         }
         # Host-side slot state (numpy: mutated per step)
         self.positions = np.zeros(B, np.int32)  # next write position
@@ -182,6 +247,7 @@ class LLMEngine:
         self._slot_req: list = [None] * B
         self._rng = np.random.default_rng(config.seed)
         self._steps = 0
+        self._published_tokens = 0  # tokens already inc'd into the counter
 
     # -- jitted bodies (slot-batched cache update) ---------------------------
     def _prefill_impl(self, params, tokens, length, cache, slot, cfg):
@@ -270,7 +336,11 @@ class LLMEngine:
             max_tokens=sampling.max_tokens,
             temperature=sampling.temperature,
             stop_token=stop,
+            t_admit=_time.perf_counter(),
         )
+        if _metrics.metrics_enabled():
+            _REQUESTS.inc(1.0)
+            _PROMPT_TOKENS.inc(float(len(ids)))
 
     # -- prefix pool ---------------------------------------------------------
 
@@ -298,6 +368,7 @@ class LLMEngine:
         can never serve another prompt's KV."""
         if not self.config.enable_prefix_caching:
             return None
+        self.stats["prefix_lookups"] += 1
         chain = self._chain_hashes(prompt)
         for p in sorted(chain, reverse=True):
             entry = self._prefix_pool.get((chain[p], p))
@@ -378,6 +449,10 @@ class LLMEngine:
             tok = self._sample(np.asarray(logits), req)
             req.slot = slot
             req.generated.append(tok)
+            self.stats["tokens_generated"] += 1
+            req.t_last_token = _time.perf_counter()
+            if _metrics.metrics_enabled():
+                _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
             self.slot_free[slot] = False
             self._slot_req[slot] = req
             self.positions[slot] = T
@@ -594,6 +669,7 @@ class LLMEngine:
     def step(self) -> list:
         """Admit + one decode step for all active slots. Returns the
         requests that finished this step."""
+        instrument = _metrics.metrics_enabled()
         finished = self._admit_waiting()
         active = [r for r in self._slot_req if r is not None]
         if active:
@@ -613,17 +689,43 @@ class LLMEngine:
                     self.cache,
                 )
             logits_np = np.asarray(logits)
+            now = _time.perf_counter()
             for req in active:
                 slot = req.slot
                 self.positions[slot] += 1
                 tok = self._sample(logits_np[slot], req)
                 req.generated.append(tok)
+                self.stats["tokens_generated"] += 1
+                if instrument and req.t_last_token:
+                    _ITL_SECONDS.observe(now - req.t_last_token)
+                req.t_last_token = now
                 self.last_tokens[slot] = tok
                 self._maybe_finish(req)
                 if req.finished:
                     finished.append(req)
         self._steps += 1
+        if instrument:
+            self._publish_metrics()
         return finished
+
+    def _publish_metrics(self) -> None:
+        """Per-step gauge/counter publication: the generated-token delta
+        since the last publish, KV-block utilization (the batching
+        headroom signal), and the prefix-pool hit rate."""
+        delta = self.stats["tokens_generated"] - self._published_tokens
+        if delta:
+            _GEN_TOKENS.inc(float(delta))
+            self._published_tokens = self.stats["tokens_generated"]
+        tags = _replica_tags()
+        if self.paged:
+            total = self.block_mgr.num_blocks - 1
+            if total > 0:
+                _KV_UTIL.set(self.block_mgr.used_blocks / total, tags)
+        lookups = self.stats["prefix_lookups"]
+        if lookups:
+            _PREFIX_HIT_RATE.set(
+                self.stats["prefix_hits"] / lookups, tags
+            )
 
     def has_unfinished(self) -> bool:
         return any(not r.finished for r in self.requests.values())

@@ -21,6 +21,22 @@ import time
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.engine import LLMEngine
 from ray_tpu.serve import api as serve_api
+from ray_tpu.util import metrics as _metrics
+
+# Replica-level serving view on top of the engine's own series (TTFT/ITL/
+# token counters/KV gauges live in llm/engine.py): how long each
+# continuous-batching step holds the executor thread and how many requests
+# are riding the batch.
+_STEP_SECONDS = _metrics.Histogram(
+    "raytpu_llm_engine_step_seconds",
+    "wall time of one continuous-batching step (admissions included)",
+    boundaries=_metrics.LATENCY_BOUNDARIES_S,
+)
+_ACTIVE_REQUESTS = _metrics.Gauge(
+    "raytpu_llm_active_requests",
+    "requests admitted or decoding on this engine replica",
+    tag_keys=("replica",),  # gauge: untagged would last-wins across replicas
+)
 
 
 class LLMServer:
@@ -81,9 +97,18 @@ class LLMServer:
         between steps so new requests can join the batch."""
         loop = asyncio.get_running_loop()
         while True:
+            instrument = _metrics.metrics_enabled()
+            t0 = time.perf_counter() if instrument else 0.0
             finished, more = await loop.run_in_executor(
                 None, self._step_with_admissions
             )
+            if instrument:
+                from ray_tpu.llm.engine import _replica_tags
+
+                _STEP_SECONDS.observe(time.perf_counter() - t0)
+                _ACTIVE_REQUESTS.set(
+                    float(len(self.engine.requests)), _replica_tags()
+                )
             self._push_new_tokens(finished)
             for req in finished:
                 self._finished[req.request_id] = req

@@ -11,10 +11,28 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import inspect
+import time as _time
 
 import cloudpickle
 
 from ray_tpu.core import serialization
+from ray_tpu.util import metrics as _metrics
+
+# Replica-side half of the serve request breakdown (router wait is
+# recorded by the routing process): user-callable execution time and the
+# queue-length gauge the autoscaler's table is fed from — exported here
+# too so an operator sees per-replica load in the same scrape.
+_EXEC_SECONDS = _metrics.Histogram(
+    "raytpu_serve_replica_exec_seconds",
+    "user-callable execution time on the replica",
+    boundaries=_metrics.LATENCY_BOUNDARIES_S,
+    tag_keys=("deployment", "replica"),
+)
+_QUEUE_LEN = _metrics.Gauge(
+    "raytpu_serve_replica_queue_len",
+    "requests in flight on this replica (autoscaling signal)",
+    tag_keys=("deployment", "replica"),
+)
 
 
 class ReplicaActor:
@@ -42,6 +60,7 @@ class ReplicaActor:
             self._callable.reconfigure(user_config)
         self._inflight = 0
         self._reporter = None
+        self._metric_tags: dict | None = None
 
     def _ensure_reporter(self) -> None:
         """Start the queue-length push loop (autoscaling metric) on the
@@ -83,6 +102,22 @@ class ReplicaActor:
                 controller = None  # re-resolve next round
             await asyncio.sleep(1.0)
 
+    def _tags(self) -> dict:
+        """Replica-identity metric tags (truncated id: bounded by live
+        replica membership, not a per-request value)."""
+        if self._metric_tags is None:
+            try:
+                from ray_tpu.core import api as core_api
+
+                rid = core_api.get_runtime_context().actor_id or ""
+            except Exception:
+                rid = ""
+            self._metric_tags = {
+                "deployment": self._deployment,
+                "replica": rid[:12],
+            }
+        return self._metric_tags
+
     async def ping(self) -> bool:
         self._ensure_reporter()
         return True
@@ -108,7 +143,11 @@ class ReplicaActor:
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
         _set_model_id(model_id)
+        instrument = _metrics.metrics_enabled()
+        t0 = _time.perf_counter() if instrument else 0.0
         self._inflight += 1
+        if instrument:
+            _QUEUE_LEN.set(float(self._inflight), self._tags())
         try:
             if inspect.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
@@ -127,6 +166,10 @@ class ReplicaActor:
             return result
         finally:
             self._inflight -= 1
+            if instrument:
+                tags = self._tags()
+                _EXEC_SECONDS.observe(_time.perf_counter() - t0, tags)
+                _QUEUE_LEN.set(float(self._inflight), tags)
 
     async def handle_streaming(
         self, method: str, payload: bytes, model_id: str = ""
@@ -143,7 +186,11 @@ class ReplicaActor:
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
         _set_model_id(model_id)
+        instrument = _metrics.metrics_enabled()
+        t0 = _time.perf_counter() if instrument else 0.0
         self._inflight += 1
+        if instrument:
+            _QUEUE_LEN.set(float(self._inflight), self._tags())
         try:
             if inspect.isasyncgenfunction(fn):
                 async for item in fn(*args, **kwargs):
@@ -171,3 +218,9 @@ class ReplicaActor:
                 yield result
         finally:
             self._inflight -= 1
+            if instrument:
+                tags = self._tags()
+                # For a stream this is first-byte to last-byte, consumer
+                # pacing included — the replica-occupancy view.
+                _EXEC_SECONDS.observe(_time.perf_counter() - t0, tags)
+                _QUEUE_LEN.set(float(self._inflight), tags)

@@ -11,10 +11,34 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time as _time
 
 from ray_tpu.core import api as core_api
 from ray_tpu.core import serialization
 from ray_tpu.core.errors import ActorDiedError, ActorUnavailableError
+from ray_tpu.util import metrics as _metrics
+
+# Serve request SLO series, recorded in the routing process (driver or
+# proxy) and shipped through the standard push path. Request latency
+# decomposes as router wait (here) + replica execution
+# (raytpu_serve_replica_exec_seconds, recorded replica-side).
+_ROUTER_WAIT = _metrics.Histogram(
+    "raytpu_serve_router_wait_seconds",
+    "time a request spends in the router before replica dispatch "
+    "(table refresh + retry backoff included)",
+    boundaries=_metrics.LATENCY_BOUNDARIES_S,
+    tag_keys=("deployment",),
+)
+_REQUESTS = _metrics.Counter(
+    "raytpu_serve_requests_total",
+    "requests routed, per deployment (QPS = rate of this)",
+    tag_keys=("deployment",),
+)
+_ERRORS = _metrics.Counter(
+    "raytpu_serve_errors_total",
+    "requests that failed after all routing retries, per deployment",
+    tag_keys=("deployment",),
+)
 
 
 class DeploymentNotFoundError(ValueError):
@@ -262,6 +286,8 @@ class Router:
     ):
         """Route one request; returns the result value."""
         payload = serialization.dumps((args, kwargs))[0]
+        instrument = _metrics.metrics_enabled()
+        t0 = _time.perf_counter() if instrument else 0.0
         last_err: Exception | None = None
         for attempt in range(ROUTE_RETRIES):
             if self._version < -1 or not self._replicas:
@@ -273,6 +299,11 @@ class Router:
             replica = self._pick(pick_key)
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            if instrument:
+                tags = {"deployment": self._deployment}
+                _ROUTER_WAIT.observe(_time.perf_counter() - t0, tags)
+                _REQUESTS.inc(1.0, tags)
+                instrument = False  # one wait + one request per route()
             try:
                 ref = replica.handle.remote(method, payload, model_id)
                 result = await core_api.get_async(ref)
@@ -294,6 +325,8 @@ class Router:
             finally:
                 if rid in self._inflight:
                     self._inflight[rid] -= 1
+        if _metrics.metrics_enabled():
+            _ERRORS.inc(1.0, {"deployment": self._deployment})
         raise last_err or RuntimeError(
             f"routing to {self._deployment!r} failed after "
             f"{ROUTE_RETRIES} attempts"
@@ -307,6 +340,8 @@ class Router:
         once items flowed, a failure surfaces to the caller (the reference
         behaves the same: a stream is not transparently restartable)."""
         payload = serialization.dumps((args, kwargs))[0]
+        instrument = _metrics.metrics_enabled()
+        t0 = _time.perf_counter() if instrument else 0.0
         last_err: Exception | None = None
         for attempt in range(ROUTE_RETRIES):
             if self._version < -1 or not self._replicas:
@@ -318,6 +353,11 @@ class Router:
             replica = self._pick(pick_key)
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
+            if instrument:
+                tags = {"deployment": self._deployment}
+                _ROUTER_WAIT.observe(_time.perf_counter() - t0, tags)
+                _REQUESTS.inc(1.0, tags)
+                instrument = False
             delivered = False
             try:
                 gen = replica.handle_streaming.options(
@@ -345,6 +385,8 @@ class Router:
             finally:
                 if rid in self._inflight:
                     self._inflight[rid] -= 1
+        if _metrics.metrics_enabled():
+            _ERRORS.inc(1.0, {"deployment": self._deployment})
         raise last_err or RuntimeError(
             f"streaming route to {self._deployment!r} failed after "
             f"{ROUTE_RETRIES} attempts"

@@ -9,11 +9,28 @@ TrainWorker before the user's train loop runs on its thread.
 from __future__ import annotations
 
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.storage import StorageContext
+from ray_tpu.util import metrics as _metrics
+
+# Step-time telemetry: train loops call report() once per step (reference
+# convention), so the gap between consecutive report() calls on one worker
+# IS the step time — data loading, compute, and collectives included.
+# Counters/histograms sum across ranks at merge time.
+_STEP_SECONDS = _metrics.Histogram(
+    "raytpu_train_step_seconds",
+    "time between consecutive train.report() calls on one worker",
+    boundaries=[0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                60.0, 300.0],
+)
+_REPORTS = _metrics.Counter(
+    "raytpu_train_reports_total",
+    "train.report() calls (steps) across all workers",
+)
 
 _ctx_local = threading.local()
 
@@ -32,6 +49,7 @@ class TrainContext:
     _reports: list = field(default_factory=list)
     _report_index: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock)
+    _last_report_t: float = 0.0  # step-time anchor (perf_counter)
 
     # -- user API ------------------------------------------------------------
 
@@ -76,6 +94,12 @@ class TrainContext:
         with self._lock:
             index = self._report_index
             self._report_index += 1
+        if _metrics.metrics_enabled():
+            now = _time.perf_counter()
+            _REPORTS.inc(1.0)
+            if self._last_report_t:
+                _STEP_SECONDS.observe(now - self._last_report_t)
+            self._last_report_t = now
         # Persist OUTSIDE the lock: a multi-GB copytree must not block the
         # controller's status() polls (it would read as a dead worker).
         persisted = None

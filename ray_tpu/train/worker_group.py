@@ -24,6 +24,15 @@ import cloudpickle
 
 import ray_tpu
 from ray_tpu.accelerators.tpu import TPU_SLICE_NAME_LABEL, TPU_WORKER_ID_LABEL
+from ray_tpu.util import metrics as _metrics
+
+# Gang liveness gauge: 1 while this rank's train fn thread is running.
+# The rank tag is bounded by world size.
+_WORKER_RUNNING = _metrics.Gauge(
+    "raytpu_train_worker_running",
+    "1 while this rank's train loop thread is running",
+    tag_keys=("rank",),
+)
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import ScalingConfig
 from ray_tpu.train.context import TrainContext, set_context
@@ -116,8 +125,12 @@ class TrainWorker:
         self._state = "running"
         self._error = None
 
+        rank_tag = {"rank": str(context_spec["world_rank"])}
+
         def run():
             set_context(self._ctx)
+            if _metrics.metrics_enabled():
+                _WORKER_RUNNING.set(1.0, rank_tag)
             try:
                 if takes_config:
                     fn(config)
@@ -131,6 +144,8 @@ class TrainWorker:
                 self._state = "failed"
             finally:
                 set_context(None)
+                if _metrics.metrics_enabled():
+                    _WORKER_RUNNING.set(0.0, rank_tag)
 
         self._thread = threading.Thread(
             target=run, name="train-loop", daemon=True

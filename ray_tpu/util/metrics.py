@@ -8,16 +8,144 @@ the existing RPC fabric, node managers attach the merged snapshot to their
 GCS heartbeat, and the GCS renders the cluster-wide scrape as Prometheus
 text (``ray_tpu.util.state.cluster_metrics_text``) — no sidecar agent
 process, no OpenCensus dependency.
+
+Runtime telemetry rides the same pipeline: every hot layer (RPC fabric,
+scheduler, object store, serve, llm, data, train) records into either the
+process registry (request-scale paths) or a lock-free ``LocalHistogram``
+(frame-scale paths, folded into snapshots at report time). All runtime
+series carry the ``raytpu_`` prefix and are declared in a process-wide
+catalog that ``tools/metrics_lint.py`` checks for prefix/kind/cardinality
+hygiene. ``RAY_TPU_METRICS_ENABLED=0`` is the global kill switch.
 """
 
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left as _bisect_left
 from typing import Dict, Optional, Tuple
 
 _DEFAULT_HIST_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
 ]
+
+# Latency boundaries for sub-second hot paths (RPC handlers, router waits,
+# token latencies): finer low end than the generic default.
+LATENCY_BOUNDARIES_S = [
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+]
+
+RUNTIME_PREFIX = "raytpu_"
+
+# Tag keys whose values are per-entity ids — unbounded cardinality that
+# would blow up the scrape and the history rings. metrics_lint (and the
+# catalog declaration below) reject them outright. Truncated process-scoped
+# ids (node_id[:12], worker_id[:12]) are bounded by live membership and
+# allowed.
+CARDINALITY_DENYLIST = frozenset(
+    {"task_id", "object_id", "request_id", "lease_id", "actor_id", "oid"}
+)
+
+
+def metrics_enabled() -> bool:
+    """Global instrumentation kill switch (RAY_TPU_METRICS_ENABLED=0):
+    hot-path record sites check this so the A/B overhead of telemetry can
+    be measured (tools/ray_perf.py --no-metrics)."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    return GLOBAL_CONFIG.metrics_enabled
+
+
+# -- runtime series catalog ---------------------------------------------------
+
+_runtime_catalog: Dict[str, dict] = {}
+_catalog_lock = threading.Lock()
+
+
+def declare_runtime_metric(
+    name: str,
+    kind: str,
+    description: str = "",
+    tag_keys: tuple = (),
+    boundaries: Optional[list] = None,
+    layer: str = "",
+) -> dict:
+    """Register a runtime-owned series in the process-wide catalog and
+    return its snapshot ``meta`` dict. The catalog is what
+    tools/metrics_lint.py walks: it enforces the ``raytpu_`` prefix, one
+    kind per name, and no unbounded-cardinality tag keys at declaration
+    time, so a bad series fails in CI instead of polluting the scrape."""
+    if not name.startswith(RUNTIME_PREFIX):
+        raise ValueError(
+            f"runtime metric {name!r} must carry the {RUNTIME_PREFIX!r} prefix"
+        )
+    bad = CARDINALITY_DENYLIST.intersection(tag_keys)
+    if bad:
+        raise ValueError(
+            f"runtime metric {name!r} declares unbounded-cardinality tag "
+            f"key(s) {sorted(bad)}"
+        )
+    entry = {
+        "kind": kind,
+        "description": description,
+        "tag_keys": tuple(tag_keys),
+        "boundaries": list(boundaries or _DEFAULT_HIST_BOUNDARIES),
+        "layer": layer,
+    }
+    with _catalog_lock:
+        existing = _runtime_catalog.get(name)
+        if existing is not None and existing["kind"] != kind:
+            raise ValueError(
+                f"runtime metric {name!r} already declared as "
+                f"{existing['kind']}, now {kind}"
+            )
+        _runtime_catalog[name] = entry
+    return {
+        "kind": kind,
+        "description": description,
+        "boundaries": entry["boundaries"],
+    }
+
+
+def runtime_catalog() -> Dict[str, dict]:
+    """Copy of the declared runtime series (for the lint tool and docs)."""
+    with _catalog_lock:
+        return {k: dict(v) for k, v in _runtime_catalog.items()}
+
+
+class LocalHistogram:
+    """Lock-free histogram accumulator for single-threaded hot paths.
+
+    The registry takes a lock per record — fine at request scale, too much
+    at RPC-frame scale (the round-6 rule: the hot path must not pay a lock
+    or a registry lookup per frame). A LocalHistogram is mutated by exactly
+    one thread (an event loop) and folded into a snapshot point at report
+    time. observe() is one bisect + one increment; buckets cumulate only
+    in as_value() (a sub-ms latency would otherwise bump ~every boundary
+    of a cumulative store on every call).
+    """
+
+    __slots__ = ("boundaries", "count", "sum", "_raw")
+
+    def __init__(self, boundaries: Optional[list] = None):
+        self.boundaries = list(boundaries or _DEFAULT_HIST_BOUNDARIES)
+        self.count = 0
+        self.sum = 0.0
+        # Per-bucket (non-cumulative) counts; the extra slot is overflow
+        # (> every boundary), represented only by `count` on the wire.
+        self._raw = [0] * (len(self.boundaries) + 1)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        self._raw[_bisect_left(self.boundaries, value)] += 1
+
+    def as_value(self) -> dict:
+        buckets, total = [], 0
+        for n in self._raw[:-1]:
+            total += n
+            buckets.append(total)
+        return {"count": self.count, "sum": self.sum, "buckets": buckets}
 
 
 class MetricsRegistry:
@@ -82,11 +210,21 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """Wire format: {"meta": {...}, "points": [[name, tags, value]]}."""
+        def copy_value(value):
+            # Histogram points are mutable (buckets list included): the
+            # snapshot must not alias live registry state, or records
+            # racing the snapshot's serialization corrupt the report.
+            if isinstance(value, dict):
+                out = dict(value)
+                out["buckets"] = list(out["buckets"])
+                return out
+            return value
+
         with self._lock:
             return {
                 "meta": dict(self._meta),
                 "points": [
-                    [name, dict(tags), value]
+                    [name, dict(tags), copy_value(value)]
                     for (name, tags), value in self._points.items()
                 ],
             }
@@ -125,6 +263,17 @@ def merge_snapshots(snaps: list) -> dict:
     }
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition format: label values escape backslash, double
+    quote, and line feed (in that order — escaping the escapes first)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def to_prometheus(snapshot: dict) -> str:
     """Render a (merged) snapshot as Prometheus exposition text."""
 
@@ -132,7 +281,7 @@ def to_prometheus(snapshot: dict) -> str:
         if not tags:
             return ""
         inner = ",".join(
-            f'{k}="{str(v).replace(chr(34), "")}"'
+            f'{k}="{_escape_label_value(v)}"'
             for k, v in sorted(tags.items())
         )
         return "{" + inner + "}"
@@ -156,10 +305,14 @@ def to_prometheus(snapshot: dict) -> str:
                 lines.append(f"{name}{fmt_tags(tags)} {value}")
             else:
                 # record() stores buckets cumulatively already (every
-                # boundary >= value is incremented) — emit as-is.
+                # boundary >= value is incremented) — emit as-is. ``le``
+                # boundaries render as consistent floats per the
+                # exposition format (a mixed "1"/"1.0" pair would read as
+                # two different buckets to a scraper).
                 for b, c in zip(m["boundaries"], value["buckets"]):
                     lines.append(
-                        f"{name}_bucket{fmt_tags({**tags, 'le': b})} {c}"
+                        f"{name}_bucket"
+                        f"{fmt_tags({**tags, 'le': float(b)})} {c}"
                     )
                 lines.append(
                     f"{name}_bucket{fmt_tags({**tags, 'le': '+Inf'})} "
@@ -177,6 +330,19 @@ def registry() -> MetricsRegistry:
     return _registry
 
 
+def _rebuild_metric(cls, name, description, tag_keys, boundaries, defaults):
+    """Unpickle hook: re-run the constructor so the metric registers in
+    the DESTINATION process's registry. Metric objects captured in
+    cloudpickled closures (a @remote task/actor defined next to its
+    metrics) would otherwise arrive attribute-copied but unregistered,
+    and the first record() in the worker would raise."""
+    if cls.kind == "histogram":
+        metric = cls(name, description, boundaries, tag_keys)
+    else:
+        metric = cls(name, description, tag_keys)
+    return metric.set_default_tags(defaults)
+
+
 class _Metric:
     kind = ""
 
@@ -188,9 +354,33 @@ class _Metric:
         **kw,
     ):
         self._name = name
-        self._tag_keys = tuple(tag_keys)
+        self._description = description
+        self._tag_keys = frozenset(tag_keys)
+        self._boundaries = kw.get("boundaries")
         self._default_tags: dict = {}
+        if name.startswith(RUNTIME_PREFIX):
+            # Runtime-owned series self-register in the lint catalog.
+            declare_runtime_metric(
+                name,
+                self.kind,
+                description,
+                tuple(tag_keys),
+                boundaries=kw.get("boundaries"),
+            )
         _registry.describe(name, self.kind, description, **kw)
+
+    def __reduce__(self):
+        return (
+            _rebuild_metric,
+            (
+                type(self),
+                self._name,
+                self._description,
+                tuple(self._tag_keys),
+                self._boundaries,
+                self._default_tags,
+            ),
+        )
 
     def set_default_tags(self, tags: dict) -> "_Metric":
         self._default_tags = dict(tags)
@@ -200,6 +390,21 @@ class _Metric:
         out = dict(self._default_tags)
         if tags:
             out.update(tags)
+        # Validate against the declared key set at record time: a tag
+        # outside it (or a declared key omitted) would silently export
+        # inconsistent series under one name.
+        if out.keys() != self._tag_keys:
+            extra = sorted(out.keys() - self._tag_keys)
+            missing = sorted(self._tag_keys - out.keys())
+            parts = []
+            if extra:
+                parts.append(f"undeclared tag key(s) {extra}")
+            if missing:
+                parts.append(f"missing declared tag key(s) {missing}")
+            raise ValueError(
+                f"metric {self._name!r}: {'; '.join(parts)} "
+                f"(declared tag_keys={sorted(self._tag_keys)})"
+            )
         return out
 
 

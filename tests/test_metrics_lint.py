@@ -1,0 +1,76 @@
+"""Metrics hygiene lint (tools/metrics_lint.py): the runtime series
+catalog must pass the prefix / kind-conflict / cardinality rules, and the
+lint must actually catch violations."""
+
+import pytest
+
+from ray_tpu.util import metrics as m
+from tools.metrics_lint import (
+    lint_catalog,
+    lint_kinds,
+    lint_points,
+    populate_catalog,
+)
+
+
+def test_runtime_catalog_passes_lint():
+    # Import every instrumented layer (llm excluded: jax import cost is
+    # covered by its own test modules) and lint the populated catalog.
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    assert len(catalog) >= 30  # every hot layer declared something
+    assert lint_catalog(catalog) == []
+    # All declared series carry the prefix, by construction AND by lint.
+    assert all(k.startswith("raytpu_") for k in catalog)
+
+
+def test_lint_flags_prefix_and_tag_key_violations():
+    bad = {
+        "requests_total": {"kind": "counter", "tag_keys": ()},
+        "raytpu_ok": {"kind": "gauge", "tag_keys": ("task_id",)},
+    }
+    problems = lint_catalog(bad)
+    assert any("prefix" in p for p in problems)
+    assert any("task_id" in p for p in problems)
+
+
+def test_lint_flags_kind_conflicts_across_snapshots():
+    snaps = [
+        {"meta": {"raytpu_x": {"kind": "counter"}}, "points": []},
+        {"meta": {"raytpu_x": {"kind": "gauge"}}, "points": []},
+    ]
+    problems = lint_kinds(snaps)
+    assert problems and "both" in problems[0]
+
+
+def test_lint_flags_unbounded_tag_values():
+    snaps = [
+        {
+            "meta": {},
+            "points": [
+                # Full 32-hex object id as a tag value: one series per
+                # object forever — exactly what the lint exists to stop.
+                ["raytpu_bad", {"obj": "ab" * 16}, 1.0],
+                # Truncated 12-hex process id: bounded, passes.
+                ["raytpu_good", {"node_id": "abcdef012345"}, 1.0],
+                # Denylisted key name.
+                ["raytpu_worse", {"task_id": "t"}, 1.0],
+            ],
+        }
+    ]
+    problems = lint_points(snaps)
+    assert any("raytpu_bad" in p for p in problems)
+    assert any("raytpu_worse" in p for p in problems)
+    assert not any("raytpu_good" in p for p in problems)
+
+
+def test_declare_runtime_metric_enforces_rules():
+    with pytest.raises(ValueError, match="prefix"):
+        m.declare_runtime_metric("unprefixed_series", "counter")
+    with pytest.raises(ValueError, match="cardinality"):
+        m.declare_runtime_metric(
+            "raytpu_test_lint_bad_tags", "counter", tag_keys=("object_id",)
+        )
+    m.declare_runtime_metric("raytpu_test_lint_series", "counter")
+    with pytest.raises(ValueError, match="already declared"):
+        m.declare_runtime_metric("raytpu_test_lint_series", "gauge")

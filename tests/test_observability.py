@@ -50,6 +50,73 @@ def test_metrics_merge_and_prometheus():
     assert 'reqs{app="x"} 4.0' in text
 
 
+def test_to_prometheus_escapes_label_values():
+    """Exposition format: label values escape backslash, quote, newline —
+    not strip them (the old renderer dropped quotes and passed the rest
+    through, corrupting the scrape)."""
+    reg = m.MetricsRegistry()
+    reg.describe("esc", "gauge")
+    reg.record("esc", 1.0, {"p": 'a"b\\c\nd'})
+    text = m.to_prometheus(reg.snapshot())
+    assert 'esc{p="a\\"b\\\\c\\nd"} 1.0' in text
+
+
+def test_to_prometheus_histogram_le_floats_bucket_cumulativity_and_inf():
+    reg = m.MetricsRegistry()
+    reg.describe("lat", "histogram", boundaries=[1, 2.5])
+    for v in (0.5, 0.75, 2.0, 9.0):
+        reg.record("lat", v)
+    text = m.to_prometheus(reg.snapshot())
+    # ``le`` renders as consistent floats even for int boundaries.
+    assert 'lat_bucket{le="1.0"} 2' in text
+    assert 'lat_bucket{le="2.5"} 3' in text  # cumulative, not per-bucket
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 12.25" in text
+
+
+def test_merge_snapshots_histogram_roundtrip():
+    """Histogram merging sums count/sum/buckets element-wise and the
+    merged value renders with cumulative buckets intact."""
+    r1, r2 = m.MetricsRegistry(), m.MetricsRegistry()
+    for r, vals in ((r1, [0.5, 3.0]), (r2, [0.5, 0.5, 30.0])):
+        r.describe("h", "histogram", boundaries=[1.0, 10.0])
+        for v in vals:
+            r.record("h", v, {"shard": "a"})
+    snap1 = r1.snapshot()
+    merged = m.merge_snapshots([snap1, r2.snapshot()])
+    pt = {
+        (n, frozenset(t.items())): v for n, t, v in merged["points"]
+    }[("h", frozenset({("shard", "a")}))]
+    assert pt["count"] == 5
+    assert pt["sum"] == 34.5
+    assert pt["buckets"] == [3, 4]  # le=1.0: 3 obs; le=10.0: +1 (3.0)
+    # Merging must not mutate the input snapshots (they are re-merged on
+    # every scrape from the GCS's latest-per-node table).
+    pt1 = {
+        (n, frozenset(t.items())): v for n, t, v in snap1["points"]
+    }[("h", frozenset({("shard", "a")}))]
+    assert pt1["count"] == 2
+    text = m.to_prometheus(merged)
+    assert 'h_bucket{le="10.0",shard="a"} 4' in text
+    assert 'h_bucket{le="+Inf",shard="a"} 5' in text
+
+
+def test_tag_key_validation_at_record_time():
+    c = m.Counter("test_tagged_counter", "d", tag_keys=("app",))
+    c.inc(1.0, {"app": "x"})  # declared key: fine
+    with pytest.raises(ValueError, match="undeclared tag key"):
+        c.inc(1.0, {"app": "x", "zone": "y"})
+    with pytest.raises(ValueError, match="missing declared tag key"):
+        c.inc(1.0)
+    g = m.Gauge("test_untagged_gauge")
+    with pytest.raises(ValueError, match="undeclared tag key"):
+        g.set(1.0, {"sneaky": "tag"})
+    # Default tags satisfy the declaration.
+    c.set_default_tags({"app": "x"})
+    c.inc(2.0)
+
+
 def test_user_metrics_api():
     c = m.Counter("test_api_counter", "d", tag_keys=("t",))
     c.inc(3.0, {"t": "a"})
@@ -277,6 +344,92 @@ def test_metrics_history_ring_bounded_and_served(cluster):
     finally:
         GLOBAL_CONFIG.metrics_history_interval_s = old_i
         GLOBAL_CONFIG.metrics_history_window = old_w
+
+
+def _scrape_value(text: str, prefix: str) -> float:
+    """Sum of all samples of series lines starting with ``prefix`` (tags
+    vary per node/worker; the assertion cares that the total is live)."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(prefix) and not line.startswith("#"):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def test_runtime_core_series_in_scrape(cluster):
+    """The tentpole's core-layer series reach one /metrics scrape: per-RPC
+    method latency histograms, scheduler lease wait/grants, object-store
+    occupancy/churn, and the heartbeat-piggyback counter."""
+
+    @ray_tpu.remote
+    def spin(x):
+        return x + 1
+
+    ray_tpu.get([spin.remote(i) for i in range(8)])
+    # Exercise the shm store; the ref must outlive the scrape or the blob
+    # is freed before the occupancy gauge reads non-zero.
+    big_ref = ray_tpu.put(b"y" * (2 * 1024 * 1024))
+
+    def ready():
+        t = state.cluster_metrics_text()
+        return (
+            "raytpu_rpc_method_latency_seconds_bucket" in t
+            and _scrape_value(t, "raytpu_sched_leases_granted_total") > 0
+            and _scrape_value(t, "raytpu_object_store_objects") > 0
+            and t
+        ) or None
+
+    text = _wait_for(ready, timeout=25)
+    # Method tag present and bounded (handler names, not ids). The
+    # heartbeat handler runs on every cluster, whatever the task path.
+    assert 'method="gcs.node_heartbeat"' in text
+    assert _scrape_value(text, "raytpu_sched_lease_wait_seconds_count") > 0
+    # One node->GCS stream: metric/log frames rode heartbeat envelopes.
+    assert (
+        _scrape_value(text, "raytpu_gcs_piggyback_frames_saved_total") > 0
+    )
+    # The GCS's own service stats join the scrape at dump time.
+    assert 'process="gcs"' in text
+    del big_ref
+
+
+def test_serve_request_breakdown_in_scrape(cluster):
+    """Serve requests decompose into router wait + replica execution in
+    the same scrape, with per-deployment QPS counters and the replica
+    queue-length gauge."""
+    import ray_tpu.serve as serve
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, request):
+            return request
+
+    handle = serve.run(Echo.bind())
+    try:
+        for i in range(5):
+            assert handle.remote({"i": i}).result(timeout=60) == {"i": i}
+
+        def ready():
+            t = state.cluster_metrics_text()
+            return (
+                _scrape_value(t, "raytpu_serve_requests_total") >= 5
+                and "raytpu_serve_router_wait_seconds_bucket" in t
+                and "raytpu_serve_replica_exec_seconds_bucket" in t
+                and t
+            ) or None
+
+        text = _wait_for(ready, timeout=25)
+        assert 'deployment="Echo"' in text
+        assert (
+            _scrape_value(text, "raytpu_serve_replica_exec_seconds_count")
+            >= 5
+        )
+        assert "raytpu_serve_replica_queue_len" in text
+    finally:
+        serve.shutdown()
 
 
 def test_metrics_history_samples_real_heartbeats(cluster):

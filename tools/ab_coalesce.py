@@ -7,6 +7,9 @@ load hits both arms equally (PERF.md round-3 lesson: cross-session rows
 are noise-dominated). Prints per-metric medians and the ratio.
 
     python tools/ab_coalesce.py [--rounds 3] [--full]
+
+The interleaved-median machinery (run_once / interleaved_ab) is shared:
+tools/ab_metrics.py drives it with the --no-metrics kill switch.
 """
 
 from __future__ import annotations
@@ -21,12 +24,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_once(no_coalesce: bool, quick: bool) -> dict:
+def run_once(quick: bool, extra_flags: tuple = ()) -> dict:
+    """One tools/ray_perf.py run; returns its JSON summary dict."""
     cmd = [sys.executable, os.path.join(REPO, "tools", "ray_perf.py")]
     if quick:
         cmd.append("--quick")
-    if no_coalesce:
-        cmd.append("--no-coalesce")
+    cmd.extend(extra_flags)
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
@@ -46,24 +49,21 @@ def run_once(no_coalesce: bool, quick: bool) -> dict:
     raise RuntimeError("no JSON summary line in ray_perf output")
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--rounds", type=int, default=3)
-    ap.add_argument(
-        "--full", action="store_true", help="full (not --quick) perf runs"
-    )
-    args = ap.parse_args()
-
+def interleaved_ab(
+    off_flag: str, label: str, rounds: int, full: bool
+) -> dict:
+    """Alternate ON (HEAD defaults) vs OFF (``off_flag``) runs, starting
+    arm swapped each round so slow box drift hits both arms equally, and
+    print/return per-metric medians + the on/off ratio."""
     on_runs, off_runs = [], []
-    for i in range(args.rounds):
-        # Alternate starting arm each round so slow drift is symmetric.
-        order = [(False, on_runs), (True, off_runs)]
+    for i in range(rounds):
+        order = [((), on_runs), ((off_flag,), off_runs)]
         if i % 2:
             order.reverse()
-        for no_coalesce, sink in order:
-            arm = "off" if no_coalesce else "on "
-            print(f"[round {i}] coalesce {arm} ...", flush=True)
-            sink.append(run_once(no_coalesce, quick=not args.full))
+        for flags, sink in order:
+            arm = "off" if flags else "on "
+            print(f"[round {i}] {label} {arm} ...", flush=True)
+            sink.append(run_once(quick=not full, extra_flags=flags))
 
     keys = sorted(
         k
@@ -80,7 +80,22 @@ def main() -> int:
         summary[k] = {"on": on_med, "off": off_med, "ratio": round(ratio, 3)}
         print(f"{k:<40} {on_med:>12,.1f} {off_med:>12,.1f} {ratio:>8.2f}")
     print(json.dumps(summary), flush=True)
+    return summary
+
+
+def ab_main(off_flag: str, label: str) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument(
+        "--full", action="store_true", help="full (not --quick) perf runs"
+    )
+    args = ap.parse_args()
+    interleaved_ab(off_flag, label, args.rounds, args.full)
     return 0
+
+
+def main() -> int:
+    return ab_main("--no-coalesce", "coalesce")
 
 
 if __name__ == "__main__":

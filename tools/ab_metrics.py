@@ -1,0 +1,28 @@
+"""Same-session A/B of the runtime telemetry tier.
+
+Runs tools/ray_perf.py alternately with instrumentation ON (HEAD
+defaults) and OFF (--no-metrics, i.e. RAY_TPU_METRICS_ENABLED=0) on the
+SAME commit, interleaved so ambient box load hits both arms equally.
+Prints per-metric medians and the on/off ratio — the acceptance gate is
+tasks_sync and the actor-call rows staying within noise of 1.0
+(PERF.md round-7).
+
+    python tools/ab_metrics.py [--rounds 3] [--full]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import ab_main  # noqa: E402 — shared interleaved harness
+
+
+def main() -> int:
+    return ab_main("--no-metrics", "metrics")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Metrics hygiene lint: walk the runtime series catalog and snapshots.
+
+Rules (CI-enforced via tests/test_metrics_lint.py):
+  1. every runtime series carries the ``raytpu_`` prefix;
+  2. one kind per series name — no duplicate registrations with
+     conflicting kinds (a counter/gauge flip silently corrupts merges);
+  3. bounded tag cardinality — no denylisted id-shaped tag keys
+     (task_id, object_id, ...) and no id-shaped tag VALUES (long hex /
+     uuid strings) sneaking in through an allowed key.
+
+Run standalone:  python tools/metrics_lint.py
+(imports every instrumented layer so the catalog is fully populated, then
+prints violations and exits non-zero if any).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+HEX_ID_RE = re.compile(r"^[0-9a-f]{16,}$")
+UUID_RE = re.compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$"
+)
+MAX_TAG_VALUE_LEN = 48
+
+# Modules whose import populates the runtime catalog. llm is optional:
+# importing it pulls in jax, which a lint environment may not want.
+_CATALOG_MODULES = [
+    "ray_tpu.core.protocol",
+    "ray_tpu.core.scheduler",
+    "ray_tpu.core.node",
+    "ray_tpu.serve.router",
+    "ray_tpu.serve.replica",
+    "ray_tpu.data.executor",
+    "ray_tpu.train.context",
+    "ray_tpu.train.worker_group",
+]
+_OPTIONAL_MODULES = ["ray_tpu.llm.engine", "ray_tpu.llm.serve_llm"]
+
+
+def populate_catalog(include_optional: bool = True) -> None:
+    import importlib
+
+    for mod in _CATALOG_MODULES:
+        importlib.import_module(mod)
+    if include_optional:
+        for mod in _OPTIONAL_MODULES:
+            try:
+                importlib.import_module(mod)
+            except Exception:
+                pass
+
+
+def lint_catalog(catalog: dict) -> list[str]:
+    """Violations in a runtime series catalog ({name: {kind, tag_keys}}).
+
+    declare_runtime_metric() already hard-fails on these at declaration,
+    so on a healthy tree this returns [] — the lint exists to catch series
+    that bypass the declaration helper (hand-built snapshot points)."""
+    from ray_tpu.util.metrics import CARDINALITY_DENYLIST, RUNTIME_PREFIX
+
+    problems = []
+    for name, entry in sorted(catalog.items()):
+        if not name.startswith(RUNTIME_PREFIX):
+            problems.append(
+                f"{name}: missing the {RUNTIME_PREFIX!r} prefix"
+            )
+        bad = CARDINALITY_DENYLIST.intersection(entry.get("tag_keys", ()))
+        if bad:
+            problems.append(
+                f"{name}: unbounded-cardinality tag key(s) {sorted(bad)}"
+            )
+    return problems
+
+
+def lint_kinds(snapshots: list) -> list[str]:
+    """Conflicting kind registrations for one name across snapshots."""
+    seen: dict[str, str] = {}
+    problems = []
+    for snap in snapshots:
+        for name, meta in snap.get("meta", {}).items():
+            kind = meta.get("kind", "gauge")
+            prev = seen.setdefault(name, kind)
+            if prev != kind:
+                problems.append(
+                    f"{name}: registered as both {prev} and {kind}"
+                )
+    return problems
+
+
+def lint_points(snapshots: list, runtime_only: bool = True) -> list[str]:
+    """Id-shaped tag values in snapshot points (unbounded cardinality).
+
+    Truncated process ids (12-hex node_id/worker_id tags) pass: they are
+    bounded by live membership. Full 16+-hex ids, uuids, and very long
+    values fail — those grow a series per entity forever."""
+    from ray_tpu.util.metrics import CARDINALITY_DENYLIST, RUNTIME_PREFIX
+
+    problems = []
+    for snap in snapshots:
+        for name, tags, _value in snap.get("points", []):
+            if runtime_only and not name.startswith(RUNTIME_PREFIX):
+                continue
+            for k, v in (tags or {}).items():
+                v = str(v)
+                if k in CARDINALITY_DENYLIST:
+                    problems.append(
+                        f"{name}: denylisted tag key {k!r}"
+                    )
+                elif HEX_ID_RE.match(v) or UUID_RE.match(v):
+                    problems.append(
+                        f"{name}: tag {k}={v[:20]}... looks like an "
+                        f"unbounded id"
+                    )
+                elif len(v) > MAX_TAG_VALUE_LEN:
+                    problems.append(
+                        f"{name}: tag {k} value exceeds "
+                        f"{MAX_TAG_VALUE_LEN} chars"
+                    )
+    return problems
+
+
+def main() -> int:
+    populate_catalog()
+    from ray_tpu.util.metrics import registry, runtime_catalog
+
+    problems = lint_catalog(runtime_catalog())
+    problems += lint_points([registry().snapshot()])
+    if problems:
+        for p in problems:
+            print(f"FAIL {p}")
+        return 1
+    print(f"ok: {len(runtime_catalog())} runtime series pass lint")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

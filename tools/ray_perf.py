@@ -65,15 +65,25 @@ def main() -> int:
         help="kill switch: one-write-per-frame transport, unbatched "
         "lease/submission paths (the A/B baseline for PERF.md round-6)",
     )
+    ap.add_argument(
+        "--no-metrics",
+        action="store_true",
+        help="kill switch: disable all runtime telemetry (equivalent to "
+        "RAY_TPU_METRICS_ENABLED=0) — the A/B baseline proving the "
+        "instrumentation tax stays within the 5%% budget",
+    )
     args = ap.parse_args()
     batch = 20 if args.quick else 100
     min_s = 0.5 if args.quick else 2.0
 
-    if args.no_coalesce:
+    if args.no_coalesce or args.no_metrics:
         from ray_tpu.core.config import GLOBAL_CONFIG
 
         # Before init: the head ships this config to every node/worker.
-        GLOBAL_CONFIG.rpc_coalesce_enabled = False
+        if args.no_coalesce:
+            GLOBAL_CONFIG.rpc_coalesce_enabled = False
+        if args.no_metrics:
+            GLOBAL_CONFIG.metrics_enabled = False
 
     ray_tpu.init(num_cpus=16)
     results = {}
