@@ -257,20 +257,66 @@ def _skip(reason: str) -> dict:
     }
 
 
+def _data_plane_rows() -> dict:
+    """Large-object data-plane rows (put_large / get_large /
+    actor_array_args, MB/s) via ``tools/ray_perf.py --data-plane-only``.
+    CPU-only (a wedged TPU tunnel can't block them) and best-effort: any
+    failure returns {} so the headline one-JSON-line contract stands."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "tools", "ray_perf.py"),
+                "--quick",
+                "--data-plane-only",
+            ],
+            timeout=420,
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=repo,
+        )
+        if r.returncode != 0:
+            _log(f"data-plane rows failed rc={r.returncode}; skipping")
+            return {}
+        for line in reversed(r.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    except Exception as e:  # noqa: BLE001 — never fail the headline bench
+        _log(f"data-plane rows skipped: {type(e).__name__}: {e}")
+    return {}
+
+
+def _emit(record: dict, data_plane: dict) -> None:
+    if data_plane:
+        record = {**record, "data_plane": data_plane}
+    print(json.dumps(record), flush=True)
+
+
 def main() -> None:
     if "--run" in sys.argv:
         # Measurement subprocess: this is the only process that imports jax.
         print(json.dumps(run_bench()), flush=True)
         return
 
+    # Data-plane rows first: CPU-only, so they report even when the TPU
+    # tunnel is wedged (BENCH_r* keeps tracking the object plane).
+    data_plane = _data_plane_rows()
+
     probe = _probe_backend()
     if probe == "wedged":
-        print(json.dumps(_skip("tpu-unavailable")), flush=True)
+        _emit(_skip("tpu-unavailable"), data_plane)
         return
     if probe == "broken":
         # Fast nonzero exits mean jax/the plugin is broken, not that the
         # tunnel is down — a real regression must go red, not skip.
-        print(json.dumps(_skip("backend-probe-failed")), flush=True)
+        _emit(_skip("backend-probe-failed"), data_plane)
         sys.exit(1)
 
     try:
@@ -284,21 +330,24 @@ def main() -> None:
         )
     except subprocess.TimeoutExpired:
         _log(f"bench subprocess exceeded {BENCH_TIMEOUT_S}s; tunnel wedge?")
-        print(json.dumps(_skip("tpu-unavailable")), flush=True)
+        _emit(_skip("tpu-unavailable"), data_plane)
         return
     if r.returncode != 0:
         # The backend was alive (probe passed), so a failing measurement is a
         # real bug: emit the marker for machine readability but FAIL the gate.
         _log(f"bench subprocess failed rc={r.returncode}")
-        print(json.dumps(_skip(f"bench-failed-rc{r.returncode}")), flush=True)
+        _emit(_skip(f"bench-failed-rc{r.returncode}"), data_plane)
         sys.exit(1)
     # Forward the subprocess's final JSON line as our one-line contract.
     for line in reversed(r.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
-            print(line, flush=True)
+            try:
+                _emit(json.loads(line), data_plane)
+            except json.JSONDecodeError:
+                print(line, flush=True)
             return
-    print(json.dumps(_skip("no-output")), flush=True)
+    _emit(_skip("no-output"), data_plane)
 
 
 if __name__ == "__main__":

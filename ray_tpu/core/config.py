@@ -85,6 +85,17 @@ class Config:
     rpc_coalesce_enabled: bool = True
     rpc_coalesce_max_frames: int = 64
     rpc_coalesce_max_bytes: int = 1024 * 1024
+    # Scatter-gather data plane (PERF.md round-8): RPC frames carrying
+    # large buffers (FramedPayload values, numpy args/results) are encoded
+    # as a small pickled envelope plus out-of-band segments that go to the
+    # socket as separate writes — the payload bytes are never flattened
+    # into an intermediate ``bytes`` on the send side. The kill switch
+    # restores in-band pickling and the join-based flush.
+    rpc_scatter_gather_enabled: bool = True
+    # Contiguous buffers at least this large stay out-of-band in
+    # serialization.dumps_oob AND in the frame encoder; smaller ones are
+    # pickled in-band (framing overhead beats the copy win).
+    oob_min_buffer_bytes: int = 4096
     # Memory monitor (reference: memory_monitor.h:52 +
     # worker_killing_policy.h:33): when the node's memory usage fraction
     # exceeds the threshold, the newest leased task worker is killed (its

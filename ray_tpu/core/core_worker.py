@@ -130,9 +130,11 @@ class CoreWorker:
         # node addr -> lease ids awaiting a batched return (one flush per
         # loop tick per node; see _return_lease)
         self._lease_returns: dict[tuple, list] = {}
-        # submissions from non-loop threads awaiting the drain callback
+        # submissions from non-loop threads awaiting the drain callback;
+        # the pending flag dedups the self-pipe wakeup (see _run_on_loop)
         self._submit_lock = threading.Lock()
         self._submit_buf: list = []
+        self._submit_wake_pending = False
         # owner side: task_id -> worker addr while a push RPC is in flight
         self._inflight_push: dict[str, tuple] = {}
         # owner side: task_id -> future, in-flight lineage resubmissions
@@ -551,9 +553,18 @@ class CoreWorker:
         framed = isinstance(payload, serialization.FramedPayload)
         size = payload.nbytes if framed else len(payload)
         if size <= GLOBAL_CONFIG.max_inline_object_bytes:
-            self.owner_store.put_inline(
-                oid, payload.to_bytes() if framed else payload
-            )
+            # Framed payloads stay SEGMENTED in the owner store: snapshot()
+            # copies the buffers once into private storage (put semantics —
+            # a later mutation of the caller's array must not rewrite the
+            # object) but never flattens, so serving the object over RPC
+            # rides the scatter-gather frame path with zero further copies.
+            # Kill switch: the round-7 flatten.
+            if not framed:
+                self.owner_store.put_inline(oid, payload)
+            elif GLOBAL_CONFIG.rpc_scatter_gather_enabled:
+                self.owner_store.put_inline(oid, payload.snapshot())
+            else:
+                self.owner_store.put_inline(oid, payload.to_bytes())
         else:
             if framed:
                 self.shm_writer.write_framed(oid, payload)
@@ -930,24 +941,53 @@ class CoreWorker:
         if not GLOBAL_CONFIG.rpc_coalesce_enabled:
             self.endpoint.submit(coro).result(timeout=30)
             return
+        # Wakeup coalescing: the pending flag (not buffer emptiness) gates
+        # the call_soon_threadsafe self-pipe write, and it stays set until
+        # the drain callback confirms the buffer empty under the lock — so
+        # a submit wave landing WHILE the drain is processing rides the
+        # running callback's next sweep instead of paying another ~0.3 ms
+        # wakeup. Only the empty->nonempty transition writes the pipe.
         with self._submit_lock:
             self._submit_buf.append(coro)
-            wake = len(self._submit_buf) == 1
+            wake = not self._submit_wake_pending
+            if wake:
+                self._submit_wake_pending = True
         if wake:
             self.endpoint.loop.call_soon_threadsafe(self._drain_submissions)
 
     def _drain_submissions(self) -> None:
-        with self._submit_lock:
-            coros, self._submit_buf = self._submit_buf, []
-        for coro in coros:
-            asyncio.ensure_future(_logged(coro, "task enqueue"))
+        while True:
+            with self._submit_lock:
+                coros, self._submit_buf = self._submit_buf, []
+                if not coros:
+                    # Empty confirmed under the lock: clear the flag so the
+                    # next submit pays the one wakeup. (Clearing earlier
+                    # would lose wakeups; clearing later would leak coros.)
+                    self._submit_wake_pending = False
+                    return
+            for coro in coros:
+                asyncio.ensure_future(_logged(coro, "task enqueue"))
 
     def _encode_arg(self, value: Any, ref_bag: "set | None" = None):
         if isinstance(value, ObjectRef):
             if ref_bag is not None:
                 ref_bag.add(value.hex())
             return ("r", value)
-        payload, refs = serialization.dumps(value)
+        # Out-of-band arg encoding: a large numpy arg becomes a
+        # FramedPayload whose buffers ride the push frame as scatter-gather
+        # segments — pickle never copies the array into the payload and
+        # the transport never joins it into an intermediate bytes.
+        # CONTRACT (the zero-copy tradeoff, documented in README
+        # "Transport"): the frame views the caller's buffer, so mutating
+        # an array argument after .remote() returns races the flush and
+        # the bytes a retry resends. Callers needing copy-at-call-time
+        # semantics copy the array themselves or disable the tier
+        # (rpc_scatter_gather_enabled=0, which restores the round-7
+        # flat-bytes encode).
+        if GLOBAL_CONFIG.rpc_scatter_gather_enabled:
+            payload, refs = serialization.dumps_oob(value)
+        else:
+            payload, refs = serialization.dumps(value)
         if ref_bag is not None:
             # Refs NESTED in containers count too: a batch member that
             # consumes such a ref from an earlier member would deadlock
@@ -2344,13 +2384,26 @@ class CoreWorker:
         ]
 
     def _encode_one(self, oid: str, value) -> tuple:
-        """("inline", bytes) or ("location", node_id, size, oid) — small
-        values ride the reply; big ones are sealed into this node's shm."""
+        """("inline", bytes | FramedPayload) or ("location", node_id,
+        size, oid) — small values ride the reply; big ones are sealed into
+        this node's shm. An inline FramedPayload travels the reply frame
+        as out-of-band segments: the result's array data goes from the
+        executor's buffers to the socket without ever being flattened."""
         payload, _ = serialization.dumps_oob(value)
         framed = isinstance(payload, serialization.FramedPayload)
         size = payload.nbytes if framed else len(payload)
         if size <= GLOBAL_CONFIG.max_inline_object_bytes:
-            return ("inline", payload.to_bytes() if framed else payload)
+            if framed and not GLOBAL_CONFIG.rpc_scatter_gather_enabled:
+                return ("inline", payload.to_bytes())  # round-7 flatten
+            if framed:
+                # snapshot(): the raw payload views the executor's LIVE
+                # value; an actor returning a view of its own state could
+                # mutate it from the next pipelined call before the reply
+                # frame flushes. One bounded (<= inline cap) copy detaches
+                # the reply; it stays segmented, so the send is still
+                # flatten-free.
+                return ("inline", payload.snapshot())
+            return ("inline", payload)
         if framed:
             self.shm_writer.write_framed(oid, payload)
         else:

@@ -345,7 +345,9 @@ class OwnedObject:
     """Owner's record of one object (reference_counter + memory_store entry)."""
 
     state: str = PENDING
-    inline: Optional[bytes] = None  # serialized value, if small
+    # Serialized value, if small: plain bytes, or a serialization
+    # .FramedPayload kept segmented so RPC serves re-ship it zero-copy.
+    inline: Optional[Any] = None
     locations: set = field(default_factory=set)  # node id hex strings
     size: int = 0
     error: Optional[Exception] = None
@@ -370,12 +372,43 @@ class OwnerStore:
             obj = self.objects[oid_hex] = OwnedObject()
         return obj
 
-    def put_inline(self, oid_hex: str, payload: bytes) -> None:
+    def put_inline(self, oid_hex: str, payload) -> None:
+        """Store a small serialized value: bytes, or a FramedPayload whose
+        buffers are adopted as-is (the decoded frame's views / the put
+        snapshot) — no flatten on the way in or out."""
         obj = self.ensure(oid_hex)
+        if hasattr(payload, "exclusive"):
+            # Stored = shared: every future get() must copy out of it, even
+            # if it arrived as one frame's private reconstruction.
+            payload.exclusive = False
+            payload = self._maybe_compact(payload)
         obj.inline = payload
-        obj.size = len(payload)
+        obj.size = (
+            payload.nbytes if hasattr(payload, "nbytes") else len(payload)
+        )
         obj.state = READY
         self._wake(obj)
+
+    @staticmethod
+    def _maybe_compact(payload):
+        """A decoded FramedPayload's buffers view the whole RPC frame body
+        they arrived in — storing one small result of a large batch reply
+        would pin the entire multi-MB frame for the object's lifetime.
+        When the views cover less than half their backing buffer, spend
+        one copy to detach (snapshot); otherwise adopt the views as-is
+        (the frame is mostly this object anyway)."""
+        bufs = getattr(payload, "buffers", None)
+        if not bufs:
+            return payload
+        base = getattr(bufs[0], "obj", None)
+        try:
+            base_len = len(base) if base is not None else 0
+        except TypeError:
+            return payload
+        owned = sum(b.nbytes for b in bufs)
+        if base_len > 2 * owned and hasattr(payload, "snapshot"):
+            return payload.snapshot()
+        return payload
 
     def put_location(self, oid_hex: str, node_id_hex: str, size: int) -> None:
         obj = self.ensure(oid_hex)

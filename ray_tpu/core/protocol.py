@@ -6,9 +6,12 @@ one simplification the TPU design allows: a single event-loop thread per
 process carries *all* services that process hosts (GCS, node manager, core
 worker), and connections are dialed on demand and cached by address.
 
-Wire format: 4-byte big-endian length | pickled (msg_type, msg_id, reply_to,
-payload). A request carries msg_id; the reply echoes it in reply_to with type
-"$reply" (result) or "$error" (pickled exception, re-raised caller-side).
+Wire format: 4-byte big-endian length | body. A plain body is pickled
+(msg_type, msg_id, reply_to, payload); a segmented body (scatter-gather data
+plane, round-8) starts with the "RTS1" magic and carries the pickled
+envelope plus its out-of-band buffers as contiguous segments. A request
+carries msg_id; the reply echoes it in reply_to with type "$reply" (result)
+or "$error" (pickled exception, re-raised caller-side).
 
 Frame coalescing (PERF.md round-5: the driver core goes to one write() +
 event-loop wakeup per frame, not to pickle): outgoing frames are appended to
@@ -25,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import pickle
+import struct
 import threading
 import time
 import traceback
@@ -42,7 +46,32 @@ Address = tuple  # (host: str, port: int)
 _REPLY = "$reply"
 _ERROR = "$error"
 
-_READ_CHUNK = 256 * 1024
+# StreamReader buffer limit. The asyncio default (64 KiB) pauses/resumes
+# the transport ~128 times per 8 MiB frame — pure loop churn that dwarfs
+# the copies the data plane saves. 8 MiB of read-ahead keeps a multi-MB
+# frame's bytes flowing in big recv()s.
+_STREAM_LIMIT = 8 * 1024 * 1024
+
+# read() size must MATCH the limit: StreamReader.read(n) extracts n bytes
+# and memmoves the rest of its buffer down, so chunked reads from a big
+# read-ahead buffer go quadratic. Draining the whole buffer per wakeup is
+# one copy, no shift.
+_READ_CHUNK = _STREAM_LIMIT
+
+# Segmented (scatter-gather) frame body marker. A plain frame body is a
+# pickle stream and starts with b"\x80", so the magic is unambiguous.
+# Body layout (little-endian):
+#   "RTS1" | u32 nseg | u64 env_len | u64 seg_len * nseg | env | seg0 | ...
+# where env is the pickled (msg_type, msg_id, reply_to, payload) tuple with
+# its large buffers replaced by out-of-band opcodes, and the segments are
+# those buffers in callback order.
+_SEG_MAGIC = b"RTS1"
+
+# Segments at least this large are handed to the transport as their own
+# write (the kernel copies straight out of the source buffer when the
+# socket keeps up); smaller ones are gathered into one joined write so tiny
+# envelopes never pay a syscall each.
+_GATHER_CUTOVER = 64 * 1024
 
 # Cumulative per-connection transport counters (all plain ints: the hot path
 # must not pay a lock or a metrics-registry lookup per frame). Aggregated
@@ -56,6 +85,8 @@ STAT_KEYS = (
     "drains_skipped",  # flushes below the high-water mark (no drain)
     "frames_received",  # frames decoded from the read side
     "reads",  # read wakeups that produced bytes
+    "segments_written",  # scatter-gather segments handed to the transport
+    "oob_bytes",  # payload bytes sent out-of-band (never flattened)
 )
 
 # Gauge name -> (stat key, description) for the metrics tier.
@@ -73,6 +104,16 @@ TRANSPORT_METRICS = {
     "raytpu_rpc_frames_received": (
         "frames_received",
         "RPC frames decoded from socket reads",
+    ),
+    "raytpu_rpc_segments_per_write": (
+        "segments_per_write",
+        "mean frame-encoder segments per socket write (join collapse "
+        "factor)",
+    ),
+    "raytpu_oob_bytes_zero_copy_total": (
+        "oob_bytes",
+        "payload bytes shipped as out-of-band segments (no intermediate "
+        "flatten on the send side)",
     ),
 }
 
@@ -166,7 +207,10 @@ class Connection:
         self._send_lock = asyncio.Lock()  # legacy (kill-switch) path only
         self._loop = asyncio.get_running_loop()
         # Coalescing state: frames queued for the next flush callback.
-        self._send_buf: list[bytes] = []
+        # Each entry is one frame as a list of segments (a plain frame is
+        # a single-segment list; a scatter-gather frame is
+        # [prefix+header+envelope, buffer_view, ...]).
+        self._send_buf: list[list] = []
         self._flush_scheduled = False
         # Set while the transport is below its high-water mark; cleared when
         # a flush overruns it, re-set by the drain task — senders await it,
@@ -178,9 +222,43 @@ class Connection:
         self.peer: Any = None  # set by servers after registration
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
+    def _encode_frame(self, msg_type, msg_id, reply_to, payload) -> list:
+        """Encode one frame as a list of wire segments.
+
+        With scatter-gather on, large buffers reached during pickling
+        (FramedPayload values, raw numpy arrays) are taken out-of-band and
+        returned as their own segments — the payload bytes are never
+        flattened into an intermediate ``bytes``. Off (or when nothing is
+        large enough), the frame is one plain pickled segment."""
+        tup = (msg_type, msg_id, reply_to, payload)
+        if GLOBAL_CONFIG.rpc_scatter_gather_enabled:
+            oob: list = []
+            threshold = max(1, GLOBAL_CONFIG.oob_min_buffer_bytes)
+
+            def cb(pb: pickle.PickleBuffer) -> bool:
+                try:
+                    raw = pb.raw()
+                except BufferError:
+                    return True  # non-contiguous: keep in-band
+                if raw.nbytes < threshold:
+                    return True
+                oob.append(raw)
+                return False
+
+            env = pickle.dumps(tup, protocol=5, buffer_callback=cb)
+            if oob:
+                lens = [m.nbytes for m in oob]
+                head = struct.pack(
+                    f"<4sIQ{len(oob)}Q", _SEG_MAGIC, len(oob), len(env), *lens
+                )
+                total = len(head) + len(env) + sum(lens)
+                return [total.to_bytes(4, "big") + head + env, *oob]
+        else:
+            env = pickle.dumps(tup, protocol=5)
+        return [len(env).to_bytes(4, "big") + env]
+
     async def _send(self, msg_type: str, msg_id, reply_to, payload) -> None:
-        data = pickle.dumps((msg_type, msg_id, reply_to, payload), protocol=5)
-        frame = len(data).to_bytes(4, "big") + data
+        frame = self._encode_frame(msg_type, msg_id, reply_to, payload)
         if not GLOBAL_CONFIG.rpc_coalesce_enabled:
             async with self._send_lock:
                 if self._closed:
@@ -193,10 +271,15 @@ class Connection:
                 # from send order (actor seq dispatch relies on it).
                 while self._send_buf:
                     self._flush()
-                self.writer.write(frame)
+                # Legacy one-write-per-frame path: segments join here (the
+                # A/B baseline arm is deliberately copy-heavy).
+                self.writer.write(
+                    frame[0] if len(frame) == 1 else b"".join(frame)
+                )
                 st = self.stats
                 st["frames_sent"] += 1
                 st["writes"] += 1
+                st["segments_written"] += len(frame)
                 if st["max_frames_per_write"] < 1:
                     st["max_frames_per_write"] = 1
                 st["drains"] += 1
@@ -220,9 +303,10 @@ class Connection:
                 )
 
     def _flush(self) -> None:
-        """Flush callback: ONE write for everything queued this tick,
-        bounded by the byte/frame caps (the remainder reflushes next
-        tick)."""
+        """Flush callback: drain everything queued this tick to the
+        transport, bounded by the byte/frame caps (the remainder reflushes
+        next tick). Byte caps count SEGMENT bytes — an out-of-band numpy
+        buffer weighs its full size even though it was never flattened."""
         self._flush_scheduled = False
         if self._closed:
             self._send_buf.clear()
@@ -234,26 +318,66 @@ class Connection:
         max_bytes = max(1, GLOBAL_CONFIG.rpc_coalesce_max_bytes)
         n, size = 0, 0
         while n < len(buf) and n < max_frames:
-            size += len(buf[n])
+            size += sum(len(s) for s in buf[n])
             n += 1
             if size >= max_bytes:
                 break
-        chunk = buf[0] if n == 1 else b"".join(buf[:n])
+        segs = [s for frame in buf[:n] for s in frame]
         del buf[:n]
         try:
-            self.writer.write(chunk)
+            writes = self._write_segments(segs)
         except Exception:
             self._teardown()
             return
         st = self.stats
-        st["writes"] += 1
         st["frames_sent"] += n
-        if n > st["max_frames_per_write"]:
+        if writes == 1 and n > st["max_frames_per_write"]:
             st["max_frames_per_write"] = n
+        elif st["max_frames_per_write"] < 1:
+            st["max_frames_per_write"] = 1
         if buf and not self._flush_scheduled:
             self._flush_scheduled = True
             self._loop.call_soon(self._flush)
         self._maybe_drain()
+
+    def _write_segments(self, segs: list) -> int:
+        """Emit segments to the transport. Small segments gather into one
+        joined write; large ones (>= _GATHER_CUTOVER) go out as their own
+        write so the transport sends straight from the source buffer —
+        the writev-style scatter output of the round-8 tier. Returns the
+        number of writes issued."""
+        st = self.stats
+        st["segments_written"] += len(segs)
+        if len(segs) == 1:
+            self.writer.write(segs[0])
+            st["writes"] += 1
+            return 1
+        writes = 0
+        small: list = []
+        for s in segs:
+            if len(s) >= _GATHER_CUTOVER:
+                if small:
+                    self.writer.write(
+                        small[0] if len(small) == 1 else b"".join(small)
+                    )
+                    writes += 1
+                    small = []
+                self.writer.write(s)
+                writes += 1
+                # Counted HERE, not at encode: only a segment written
+                # unjoined actually reached the socket with no
+                # intermediate flatten (the legacy/kill-switch paths join,
+                # and must read 0).
+                st["oob_bytes"] += len(s)
+            else:
+                small.append(s)
+        if small:
+            self.writer.write(
+                small[0] if len(small) == 1 else b"".join(small)
+            )
+            writes += 1
+        st["writes"] += writes
+        return writes
 
     def _maybe_drain(self) -> None:
         """Drain only above the transport high-water mark: below it the
@@ -317,19 +441,40 @@ class Connection:
                 chunk = await self.reader.read(_READ_CHUNK)
                 if not chunk:
                     break  # EOF
-                buf += chunk
                 self.stats["reads"] += 1
-                off, end = 0, len(buf)
+                if buf:
+                    buf += chunk
+                    data = buf
+                else:
+                    # No partial frame pending: decode straight from the
+                    # read's own bytes — skips re-buffering a whole multi-MB
+                    # frame through the accumulator.
+                    data = chunk
+                off, end = 0, len(data)
+                mv = memoryview(data) if data is chunk else None
                 while end - off >= 4:
-                    length = int.from_bytes(buf[off : off + 4], "big")
+                    length = int.from_bytes(data[off : off + 4], "big")
                     if end - off - 4 < length:
                         break  # partial frame: wait for more bytes
-                    frame = pickle.loads(bytes(buf[off + 4 : off + 4 + length]))
+                    # Slicing yields a standalone WRITABLE per-frame copy —
+                    # the ONE receive-side copy (decoded numpy values view
+                    # it, and views must be mutable like any unpickled
+                    # array). Decoded out-of-band buffers alias the slice,
+                    # so the accumulator bookkeeping below never
+                    # invalidates them.
+                    if mv is None:
+                        body = data[off + 4 : off + 4 + length]
+                    else:
+                        body = bytearray(mv[off + 4 : off + 4 + length])
+                    frame = self._decode_body(body)
                     off += 4 + length
                     self.stats["frames_received"] += 1
                     self._handle_frame(*frame)
-                if off:
-                    del buf[:off]
+                if data is buf:
+                    if off:
+                        del buf[:off]
+                elif off < end:
+                    buf += memoryview(chunk)[off:]  # stash the partial tail
         except (
             asyncio.IncompleteReadError,
             ConnectionError,
@@ -338,6 +483,28 @@ class Connection:
             pass
         finally:
             self._teardown()
+
+    @staticmethod
+    def _decode_body(body: bytearray):
+        """Decode one frame body: either a plain pickle stream or a
+        segmented scatter-gather layout. Segmented buffers are handed to
+        the unpickler as writable memoryviews of the frame's own storage
+        (no per-segment copy); the consumers that persist them
+        (serialization.loads, the object stores) make the one final copy
+        into their destination."""
+        if len(body) >= 4 and body[:4] == _SEG_MAGIC:
+            nseg, env_len = struct.unpack_from("<IQ", body, 4)
+            lens = struct.unpack_from(f"<{nseg}Q", body, 16)
+            mv = memoryview(body)
+            off = 16 + 8 * nseg
+            env = mv[off : off + env_len]
+            off += env_len
+            buffers = []
+            for ln in lens:
+                buffers.append(mv[off : off + ln])
+                off += ln
+            return pickle.loads(env, buffers=buffers)
+        return pickle.loads(body)
 
     def _handle_frame(self, msg_type, msg_id, reply_to, payload) -> None:
         if msg_type == _REPLY:
@@ -475,7 +642,7 @@ class Endpoint:
 
         async def boot():
             self._server = await asyncio.start_server(
-                self._accept, host=host, port=port
+                self._accept, host=host, port=port, limit=_STREAM_LIMIT
             )
             sock = self._server.sockets[0]
             bound_port = sock.getsockname()[1]
@@ -575,6 +742,9 @@ class Endpoint:
                 self._fold_stats(out, conn.stats)
         out["frames_per_write"] = (
             out["frames_sent"] / out["writes"] if out["writes"] else 0.0
+        )
+        out["segments_per_write"] = (
+            out["segments_written"] / out["writes"] if out["writes"] else 0.0
         )
         return out
 
@@ -683,7 +853,9 @@ class Endpoint:
             conn = self._conns.get(addr)
             if conn is not None and not conn.closed:
                 return conn
-            reader, writer = await asyncio.open_connection(addr[0], addr[1])
+            reader, writer = await asyncio.open_connection(
+                addr[0], addr[1], limit=_STREAM_LIMIT
+            )
             conn = Connection(
                 reader, writer, self._handle, on_close=self._conn_closed
             )

@@ -23,6 +23,8 @@ KNOBS = (
     "rpc_coalesce_enabled",
     "rpc_coalesce_max_frames",
     "rpc_coalesce_max_bytes",
+    "rpc_scatter_gather_enabled",
+    "oob_min_buffer_bytes",
 )
 
 
@@ -145,6 +147,41 @@ def test_kill_switch_restores_one_write_per_frame(pair):
     assert st["writes"] == st["frames_sent"]
     assert st["max_frames_per_write"] == 1
     assert st["drains"] == st["writes"]  # legacy path drains every frame
+
+
+def test_coalescing_with_segmented_frames_interleaved(pair):
+    """Round-8 interaction: a burst mixing plain frames with
+    scatter-gather (array-bearing) frames keeps the coalescing
+    guarantees — send order is dispatch order and small frames still
+    amortize writes around the out-of-band segments."""
+    import numpy as np
+
+    from ray_tpu.core import serialization
+
+    server, client, addr, received = pair
+    fp = serialization.dumps_oob(np.arange(9000, dtype=np.float64))[0]
+
+    async def go():
+        conn = await client.connect(addr)
+        reqs = []
+        for i in range(24):
+            reqs.append(
+                conn.request("echo", fp if i % 6 == 0 else i)
+            )
+        return await asyncio.gather(*reqs)
+
+    res = client.submit(go()).result(timeout=30)
+    assert len(res) == len(received) == 24
+    for i in range(24):
+        if i % 6 == 0:
+            got = serialization.loads(res[i])[0]
+            assert got[0] == 0.0 and got[-1] == 8999.0
+        else:
+            assert res[i] == i and received[i] == i
+    st = client.transport_stats()
+    assert st["frames_sent"] == 24
+    assert st["oob_bytes"] >= 4 * 72_000
+    assert st["segments_written"] >= st["frames_sent"] + 4
 
 
 def test_connection_loss_mid_queue_fails_pending_futures(pair):

@@ -1,0 +1,362 @@
+"""Zero-copy data plane: scatter-gather transport frames (PERF.md round-8).
+
+Round 8 makes large payloads travel copy-free from pickler to socket: RPC
+frames carrying FramedPayload values / numpy buffers are encoded as a small
+pickled envelope plus out-of-band segments, the flush emits large segments
+as their own writes (no ``b"".join`` flatten), and both
+``FramedPayload.to_bytes()`` call sites on the put and inline-return paths
+are gone. These tests pin the semantics: ordering and reply correlation
+with mixed segmented + plain frames, byte/frame caps counting SEGMENT
+bytes, the kill switch restoring the join-based flush, connection loss
+mid-queue, and the end-to-end zero-to_bytes round trip of a >1 MB numpy
+value.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.protocol import ConnectionLost, Endpoint
+
+KNOBS = (
+    "rpc_coalesce_enabled",
+    "rpc_coalesce_max_frames",
+    "rpc_coalesce_max_bytes",
+    "rpc_scatter_gather_enabled",
+    "oob_min_buffer_bytes",
+)
+
+
+@pytest.fixture()
+def knobs():
+    old = {k: getattr(GLOBAL_CONFIG, k) for k in KNOBS}
+    yield GLOBAL_CONFIG
+    for k, v in old.items():
+        setattr(GLOBAL_CONFIG, k, v)
+
+
+@pytest.fixture()
+def pair(knobs):
+    """(server, client, addr, received): echo server recording payloads."""
+    server = Endpoint("sg-srv")
+    received = []
+
+    async def echo(conn, p):
+        received.append(p)
+        return p
+
+    server.register("echo", echo)
+    addr = server.start()
+    client = Endpoint("sg-cli")
+    client.start()
+    yield server, client, addr, received
+    client.stop()
+    server.stop()
+
+
+def _array_payload(n_float64=200_000):
+    fp, _ = serialization.dumps_oob(np.arange(n_float64, dtype=np.float64))
+    assert isinstance(fp, serialization.FramedPayload)
+    return fp
+
+
+def _roundtrip_value(p):
+    """Decode an echoed payload back to a comparable value."""
+    if isinstance(p, serialization.FramedPayload):
+        return serialization.loads(p)[0]
+    return p
+
+
+def test_mixed_segmented_and_plain_frames_order_and_correlation(pair):
+    """A one-tick burst interleaving segmented (array-bearing) and plain
+    frames: dispatch order is send order, every reply lands on its own
+    future, and the decoded arrays are intact and independently writable."""
+    server, client, addr, received = pair
+    arrays = {
+        i: np.full(50_000, i, dtype=np.float64) for i in (1, 4, 7)
+    }
+
+    async def go():
+        conn = await client.connect(addr)
+        reqs = []
+        for i in range(9):
+            if i in arrays:
+                payload = serialization.dumps_oob(arrays[i])[0]
+            else:
+                payload = i
+            reqs.append(conn.request("echo", payload))
+        return await asyncio.gather(*reqs)
+
+    res = client.submit(go()).result(timeout=30)
+    assert len(received) == 9
+    for i in range(9):
+        if i in arrays:
+            echoed = _roundtrip_value(res[i])
+            assert np.array_equal(echoed, arrays[i])
+            echoed[0] = -1.0  # writable, private copy
+            dispatched = _roundtrip_value(received[i])
+            assert np.array_equal(dispatched, arrays[i])
+        else:
+            assert res[i] == i and received[i] == i
+    st = client.transport_stats()
+    assert st["oob_bytes"] >= 3 * arrays[1].nbytes
+    assert st["segments_written"] > st["frames_sent"]
+
+
+def test_byte_cap_counts_segment_bytes(pair):
+    """The flush byte cap must weigh out-of-band segments: four frames
+    carrying ~800 KB arrays against a 1 MiB cap flush at most two frames
+    per callback (counting only envelope bytes would batch all four)."""
+    server, client, addr, _ = pair
+    GLOBAL_CONFIG.rpc_coalesce_max_bytes = 1024 * 1024
+    flush_frames = []
+
+    async def go():
+        conn = await client.connect(addr)
+        orig = conn._write_segments
+
+        def spy(segs):
+            flush_frames.append(len(segs))
+            return orig(segs)
+
+        conn._write_segments = spy
+        fp = serialization.dumps_oob(
+            np.zeros(100_000, dtype=np.float64)  # 800 KB
+        )[0]
+        return await asyncio.gather(
+            *(conn.request("echo", fp) for _ in range(4))
+        )
+
+    res = client.submit(go()).result(timeout=30)
+    assert len(res) == 4
+    # Each frame is [envelope, buffer] = 2 segments; the 1 MiB cap cuts
+    # after the second frame's bytes at the latest, so no flush callback
+    # may carry all four frames (8 segments).
+    assert flush_frames and max(flush_frames) <= 4
+
+
+def test_frame_cap_applies_to_segmented_frames(pair):
+    server, client, addr, _ = pair
+    GLOBAL_CONFIG.rpc_coalesce_max_frames = 1
+    fp = _array_payload(2_000)
+    GLOBAL_CONFIG.oob_min_buffer_bytes = 1024
+
+    async def go():
+        conn = await client.connect(addr)
+        return await asyncio.gather(
+            *(conn.request("echo", fp) for _ in range(6))
+        )
+
+    res = client.submit(go()).result(timeout=30)
+    assert len(res) == 6
+    assert client.transport_stats()["max_frames_per_write"] <= 1
+
+
+def test_kill_switch_restores_join_based_flush(pair):
+    """rpc_scatter_gather_enabled=False: every frame is one in-band pickled
+    segment (no out-of-band bytes), values still round-trip."""
+    server, client, addr, _ = pair
+    GLOBAL_CONFIG.rpc_scatter_gather_enabled = False
+    arr = np.arange(200_000, dtype=np.float64)
+    fp = serialization.dumps_oob(arr)[0]
+
+    async def go():
+        conn = await client.connect(addr)
+        return await asyncio.gather(
+            *(conn.request("echo", fp) for _ in range(3))
+        )
+
+    res = client.submit(go()).result(timeout=30)
+    for r in res:
+        assert np.array_equal(_roundtrip_value(r), arr)
+    st = client.transport_stats()
+    assert st["oob_bytes"] == 0
+    assert st["segments_written"] == st["frames_sent"]
+
+
+def test_connection_loss_mid_queue_fails_segmented_futures(pair):
+    server, client, addr, _ = pair
+    fp = _array_payload()
+
+    async def go():
+        conn = await client.connect(addr)
+        futs = [
+            asyncio.ensure_future(
+                conn.request("echo", fp if i % 2 else i)
+            )
+            for i in range(8)
+        ]
+        conn.close()
+        return await asyncio.gather(*futs, return_exceptions=True)
+
+    res = client.submit(go()).result(timeout=30)
+    assert len(res) == 8
+    assert all(isinstance(r, ConnectionLost) for r in res)
+
+
+def test_oob_threshold_knob_controls_out_of_band(knobs):
+    GLOBAL_CONFIG.oob_min_buffer_bytes = 1 << 30
+    p, _ = serialization.dumps_oob(np.zeros(10_000, dtype=np.float64))
+    assert isinstance(p, bytes)  # everything in-band above the threshold
+    GLOBAL_CONFIG.oob_min_buffer_bytes = 64
+    p, _ = serialization.dumps_oob(np.zeros(10_000, dtype=np.float64))
+    assert isinstance(p, serialization.FramedPayload)
+
+
+def test_framed_payload_snapshot_isolates_caller_memory():
+    arr = np.arange(10_000, dtype=np.float64)
+    fp, _ = serialization.dumps_oob(arr)
+    snap = fp.snapshot()
+    arr[0] = -123.0
+    val, _ = serialization.loads(snap)
+    assert val[0] == 0.0  # snapshot took its copy before the mutation
+    live, _ = serialization.loads(fp)
+    assert live[0] == -123.0  # the un-snapshotted payload aliases
+
+
+def test_oob_bytes_wrapper_roundtrip(pair):
+    """OobBytes (node.fetch_object chunk replies) travels as its own
+    segment and decodes to a bytes-like of the same content. The server
+    re-wraps before replying — a decoded OobBytes is a consume-once view
+    (its real consumer memcpys it into the shm map), not a picklable."""
+    server, client, addr, _ = pair
+    blob = bytes(range(256)) * 64  # 16 KB
+
+    async def rewrap(conn, p):
+        return serialization.OobBytes(bytes(p))
+
+    server.register("rewrap", rewrap)
+
+    async def go():
+        conn = await client.connect(addr)
+        return await conn.request(
+            "rewrap", serialization.OobBytes(blob)
+        )
+
+    out = client.submit(go()).result(timeout=30)
+    assert bytes(out) == blob
+
+
+# -- cluster-level ------------------------------------------------------------
+
+
+@pytest.fixture()
+def cluster(knobs):
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@pytest.fixture()
+def no_to_bytes(monkeypatch):
+    """Fail the test if any FramedPayload is flattened (the acceptance
+    criterion: zero intermediate to_bytes() on put/get/task paths)."""
+
+    def boom(self):
+        raise AssertionError(
+            "FramedPayload.to_bytes() called on a zero-copy path"
+        )
+
+    monkeypatch.setattr(serialization.FramedPayload, "to_bytes", boom)
+
+
+def test_put_get_large_numpy_zero_to_bytes(cluster, no_to_bytes):
+    """>1 MB numpy round-trips put->shm->get with no intermediate flatten,
+    and the returned array is writable and isolated from the stored
+    object."""
+    arr = np.arange(1 << 18, dtype=np.float64)  # 2 MB -> shm path
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    assert np.array_equal(out, arr)
+    out[0] = -1.0
+    assert ray_tpu.get(ref)[0] == 0.0
+
+
+def test_put_get_inline_framed_zero_to_bytes(cluster, no_to_bytes):
+    """Sub-inline-threshold array (framed, stored segmented in the owner
+    store): snapshot semantics hold — mutating the source after put() or
+    the result after get() never rewrites the stored object."""
+    arr = np.arange(50_000, dtype=np.float64)  # 400 KB -> inline path
+    ref = ray_tpu.put(arr)
+    arr[1] = 999.0
+    got = ray_tpu.get(ref)
+    assert got[1] == 1.0
+    got[2] = -7.0
+    assert ray_tpu.get(ref)[2] == 2.0
+
+
+def test_task_array_results_and_args_zero_to_bytes(cluster, no_to_bytes):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2.0
+
+    arr = np.ones(120_000, dtype=np.float64)
+    out = ray_tpu.get(double.remote(arr))
+    assert out.shape == arr.shape and float(out[0]) == 2.0
+
+
+def test_actor_array_args_pipelined(cluster, no_to_bytes):
+    """Pipelined actor calls with array args: ordered delivery and intact
+    data through the scatter-gather frames."""
+
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0.0
+
+        def add(self, x):
+            self.total += float(x.sum())
+            return self.total
+
+    acc = Acc.remote()
+    arr = np.ones(100_000, dtype=np.float64)
+    vals = ray_tpu.get([acc.add.remote(arr) for _ in range(5)])
+    assert vals == [100_000.0 * (i + 1) for i in range(5)]
+
+
+def test_scatter_gather_off_cluster_roundtrip(knobs):
+    """Whole-cluster kill-switch arm: the config ships to every worker, so
+    the A/B baseline must be byte-for-byte correct too."""
+    GLOBAL_CONFIG.rpc_scatter_gather_enabled = False
+    ray_tpu.init(num_cpus=2)
+    try:
+        arr = np.arange(200_000, dtype=np.float64)
+        assert np.array_equal(ray_tpu.get(ray_tpu.put(arr)), arr)
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2.0
+
+        out = ray_tpu.get(double.remote(arr))
+        assert float(out[-1]) == arr[-1] * 2.0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_segment_metrics_exported(pair):
+    """raytpu_rpc_segments_per_write / raytpu_oob_bytes_zero_copy_total
+    flow through the transport metric snapshot and the lint catalog."""
+    from ray_tpu.core.protocol import transport_metric_snapshot
+    from ray_tpu.util.metrics import runtime_catalog
+
+    server, client, addr, _ = pair
+    fp = _array_payload()
+
+    async def go():
+        conn = await client.connect(addr)
+        return await conn.request("echo", fp)
+
+    client.submit(go()).result(timeout=30)
+    meta, points = transport_metric_snapshot(
+        client.transport_stats(), {"worker_id": "w1"}
+    )
+    by_name = {name: val for name, _tags, val in points}
+    assert by_name["raytpu_oob_bytes_zero_copy_total"] >= fp.nbytes / 2
+    assert by_name["raytpu_rpc_segments_per_write"] > 0
+    cat = runtime_catalog()
+    assert "raytpu_rpc_segments_per_write" in cat
+    assert "raytpu_oob_bytes_zero_copy_total" in cat

@@ -1,0 +1,30 @@
+"""Same-session A/B of the scatter-gather data plane (PERF.md round-8).
+
+Runs tools/ray_perf.py alternately with the zero-copy frame path ON (HEAD
+defaults) and OFF (--no-scatter-gather kill switch: in-band frame
+pickling + join-based flush) on the SAME commit, interleaved so ambient
+box load hits both arms equally. The interesting rows are the
+large-object ones (get_large, actor_array_args — the legs where payload
+bytes actually ride RPC frames); small-frame rows must stay within noise.
+
+    python tools/ab_scatter_gather.py [--rounds 3] [--full]
+
+The interleaved-median machinery is shared with tools/ab_coalesce.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import ab_main  # noqa: E402 — shared interleaved harness
+
+
+def main() -> int:
+    return ab_main("--no-scatter-gather", "scatter-gather")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

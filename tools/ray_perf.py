@@ -72,11 +72,23 @@ def main() -> int:
         "RAY_TPU_METRICS_ENABLED=0) — the A/B baseline proving the "
         "instrumentation tax stays within the 5%% budget",
     )
+    ap.add_argument(
+        "--no-scatter-gather",
+        action="store_true",
+        help="kill switch: in-band frame pickling + join-based flush "
+        "(the A/B baseline for the PERF.md round-8 data plane)",
+    )
+    ap.add_argument(
+        "--data-plane-only",
+        action="store_true",
+        help="run only the large-object rows (bench.py rides this for "
+        "the BENCH_r* data-plane record)",
+    )
     args = ap.parse_args()
     batch = 20 if args.quick else 100
     min_s = 0.5 if args.quick else 2.0
 
-    if args.no_coalesce or args.no_metrics:
+    if args.no_coalesce or args.no_metrics or args.no_scatter_gather:
         from ray_tpu.core.config import GLOBAL_CONFIG
 
         # Before init: the head ships this config to every node/worker.
@@ -84,6 +96,8 @@ def main() -> int:
             GLOBAL_CONFIG.rpc_coalesce_enabled = False
         if args.no_metrics:
             GLOBAL_CONFIG.metrics_enabled = False
+        if args.no_scatter_gather:
+            GLOBAL_CONFIG.rpc_scatter_gather_enabled = False
 
     ray_tpu.init(num_cpus=16)
     results = {}
@@ -91,6 +105,69 @@ def main() -> int:
     def record(name, fn, multiplier=1):
         n, rate = timeit(name, fn, multiplier, min_s=min_s)
         results[n] = rate
+
+    # -- large objects (round-8 data plane) ----------------------------------
+    # put_large: driver put through the shm single-copy path. get_large:
+    # a BORROWER (actor-side) get of a driver-owned inline object — the
+    # leg where the value actually rides RPC frames, so the scatter-gather
+    # A/B shows here. actor_array_args: multi-MB array args on pipelined
+    # actor calls (args always ride the push frame, at any size).
+    from ray_tpu.core.config import GLOBAL_CONFIG as _CFG
+
+    large = np.zeros(8 * 1024 * 1024, dtype=np.uint8)  # 8 MB
+    mb = large.nbytes / 1e6
+
+    def put_large():
+        ref = ray_tpu.put(large)
+        del ref
+
+    n, rate = timeit("put_large", put_large, 1, min_s=min_s, max_iters=30)
+    results[n] = round(rate * mb, 2)
+    print(f"  -> {results[n]:.1f} MB/s", flush=True)
+
+    @ray_tpu.remote
+    class _DataSink:
+        def checksum(self, x):
+            return int(x[0]) + int(x[-1])
+
+        def fetch(self, ref):
+            return int(ray_tpu.get(ref[0])[0])
+
+    dsink = _DataSink.remote()
+    ray_tpu.get(dsink.checksum.remote(np.zeros(8, dtype=np.uint8)))
+
+    # Owner-side inline storage for the borrower-get row: bump the inline
+    # cap (driver-side decision only) so the 8 MB value is served from the
+    # owner's memory store over RPC instead of the shm file plane.
+    old_inline = _CFG.max_inline_object_bytes
+    _CFG.max_inline_object_bytes = large.nbytes + 1
+    try:
+        inline_ref = ray_tpu.put(large)
+    finally:
+        _CFG.max_inline_object_bytes = old_inline
+
+    def get_large():
+        ray_tpu.get(dsink.fetch.remote([inline_ref]))
+
+    n, rate = timeit("get_large", get_large, 1, min_s=min_s, max_iters=30)
+    results[n] = round(rate * mb, 2)
+    print(f"  -> {results[n]:.1f} MB/s", flush=True)
+
+    def actor_array_args():
+        ray_tpu.get(
+            [dsink.checksum.remote(large) for _ in range(4)]
+        )
+
+    n, rate = timeit(
+        "actor_array_args", actor_array_args, 4, min_s=min_s, max_iters=20
+    )
+    results[n] = round(rate * mb, 2)
+    print(f"  -> {results[n]:.1f} MB/s", flush=True)
+
+    if args.data_plane_only:
+        print(json.dumps(results), flush=True)
+        ray_tpu.shutdown()
+        return 0
 
     # -- objects -------------------------------------------------------------
     small = b"x" * 1024
