@@ -76,6 +76,38 @@ class Config:
     default_max_restarts: int = 0
     # RPC
     rpc_connect_timeout_s: float = 30.0
+    # RPC survival semantics (robustness round). Every acall/call carries a
+    # per-call deadline: a hung or partitioned peer fails the call with
+    # DeadlineExceededError instead of wedging the caller forever.
+    # rpc_deadline_s is the control-plane default; heartbeat / data-plane /
+    # slow (lease + actor-start, bounded by their own server-side timeouts)
+    # classes override it per method (protocol.method_deadline_s), and RPCs
+    # whose reply is the completion of arbitrarily long user work (task
+    # pushes, owner get/wait, wait_actor_alive, whole-object pulls) are
+    # exempt — their lifetime belongs to the task layer, and worker death
+    # still surfaces as ConnectionLost. <= 0 disables all deadlines.
+    rpc_deadline_s: float = 30.0
+    rpc_heartbeat_deadline_s: float = 5.0
+    rpc_data_deadline_s: float = 120.0
+    rpc_slow_deadline_s: float = 90.0
+    # Endpoint.start() boot wait (was a hard-coded 30 in protocol.py).
+    endpoint_start_timeout_s: float = 30.0
+    # Automatic retry with jittered exponential backoff, ONLY for methods
+    # on the explicit idempotency allowlist (protocol.IDEMPOTENT_RPCS:
+    # lease requests, heartbeats, location lookups, chunk fetches — never
+    # task pushes), and ONLY on transport errors (connection loss,
+    # deadline), never on application exceptions.
+    rpc_max_retries: int = 3
+    rpc_retry_backoff_s: float = 0.05
+    rpc_retry_backoff_max_s: float = 2.0
+    # Per-peer circuit breaker: after N consecutive transport failures,
+    # calls to the peer fail fast (PeerUnavailableError) instead of each
+    # burning a full deadline; after rpc_breaker_reset_s the breaker
+    # half-opens and one probe call is let through. Schedulers treat a
+    # tripped peer as SUSPECT — no new leases or spills are directed at it
+    # until the breaker closes — rather than surfacing an error storm.
+    rpc_breaker_threshold: int = 5
+    rpc_breaker_reset_s: float = 5.0
     # Transport-level frame coalescing (PERF.md round-5 ceiling probe: the
     # driver core is consumed by one write()+event-loop-wakeup pair per RPC
     # frame). Outgoing frames queue per connection and one loop callback

@@ -34,8 +34,10 @@ from ray_tpu.core import serialization
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.errors import (
     ActorDiedError,
+    DeadlineExceededError,
     GetTimeoutError,
     ObjectLostError,
+    PeerUnavailableError,
     RayTpuError,
     TaskCancelledError,
     TaskError,
@@ -52,7 +54,11 @@ from ray_tpu.core.object_store import (
     ShmReader,
     ShmWriter,
 )
-from ray_tpu.core.protocol import ConnectionLost, Endpoint
+from ray_tpu.core.protocol import (
+    ConnectionLost,
+    Endpoint,
+    method_deadline_s,
+)
 
 
 @dataclass
@@ -117,6 +123,7 @@ class CoreWorker:
         self.node_addr = tuple(node_addr)
         self.gcs = GcsClient(self.endpoint, gcs_addr)
         self.max_pending_leases = max_pending_leases
+        self._bg_tasks: set = set()  # strong refs for fire-and-forget tasks
 
         self.owner_store: OwnerStore | None = None  # created on loop start
         self.node_id: str | None = None
@@ -203,7 +210,6 @@ class CoreWorker:
             self.node_addr,
             "node.register_worker",
             {"worker_id": self.worker_id, "addr": addr, "kind": self.kind},
-            timeout=30,
         )
         self.node_id = reply["node_id"]
         self.shm_root = reply["shm_root"]
@@ -1046,11 +1052,18 @@ class CoreWorker:
             return
         payload = self._lease_payload(sample)
         payload["count"] = want
+        # Same idempotency key contract as _request_lease: if the batch
+        # deadlines while a merely-slow node is still mid-grant, the
+        # abandon below makes it return the whole wave's leases instead
+        # of leaking them (node._h_request_lease_batch reply cache).
+        payload["req_id"] = req_id = TaskID.random().hex()
         try:
             replies = await self.endpoint.acall(
                 self.node_addr, "node.request_lease_batch", payload
             )
         except Exception as e:
+            if not getattr(e, "_raytpu_remote", False):
+                self._abandon_lease_request(self.node_addr, req_id)
             qs.inflight -= want
             while qs.queue:
                 spec = qs.queue.pop(0)
@@ -1318,6 +1331,29 @@ class CoreWorker:
             "runtime_env": spec.runtime_env,
         }
 
+    def _abandon_lease_request(self, node_addr, req_id: str) -> None:
+        """Best-effort, bounded, fire-and-forget node.cancel_lease_request:
+        the target may be wedged (that is why we are abandoning), so the
+        notify runs as its own task under the connect timeout instead of
+        stalling the lease loop."""
+
+        async def _fire():
+            try:
+                await asyncio.wait_for(
+                    self.endpoint.anotify(
+                        tuple(node_addr),
+                        "node.cancel_lease_request",
+                        {"req_id": req_id},
+                    ),
+                    GLOBAL_CONFIG.rpc_connect_timeout_s,
+                )
+            except Exception:
+                pass  # peer truly gone: nothing granted, nothing to leak
+
+        t = asyncio.get_running_loop().create_task(_fire())
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+
     async def _request_lease(
         self, spec: TaskSpec, first_reply: dict | None = None
     ) -> dict | None:
@@ -1330,9 +1366,77 @@ class CoreWorker:
                 # own node): consume it as this iteration's answer.
                 reply, first_reply = first_reply, None
             else:
-                reply = await self.endpoint.acall(
-                    node_addr, "node.request_lease", payload
-                )
+                # Fresh idempotency key per LOGICAL attempt; transport
+                # retries inside acall reuse it, so a retry attaches to
+                # the server's in-flight grant instead of double-
+                # granting (node._h_request_lease dedup).
+                req_id = TaskID.random().hex()
+                if tuple(node_addr) != tuple(self.node_addr):
+                    # Spill target: a wedged peer must fail with lease
+                    # budget left for the home-failover below, but the
+                    # default transport schedule (rpc_max_retries x
+                    # rpc_slow_deadline_s) is several times
+                    # lease_request_timeout_s. The failover IS this
+                    # call's retry — one attempt, bounded to half the
+                    # remaining budget so home still gets a real turn.
+                    kw = {"retries": 0}
+                    per = method_deadline_s("node.request_lease")
+                    if per > 0:
+                        remaining = max(deadline - time.monotonic(), 1.0)
+                        kw["deadline_s"] = min(per, remaining * 0.5)
+                else:
+                    kw = {}
+                try:
+                    reply = await self.endpoint.acall(
+                        node_addr,
+                        "node.request_lease",
+                        {**payload, "req_id": req_id},
+                        **kw,
+                    )
+                except (
+                    DeadlineExceededError,
+                    PeerUnavailableError,
+                    ConnectionLost,
+                    ConnectionError,
+                    OSError,
+                ) as e:
+                    if getattr(e, "_raytpu_remote", False) or tuple(
+                        node_addr
+                    ) == tuple(self.node_addr):
+                        if not getattr(e, "_raytpu_remote", False):
+                            # Own node deadlined/unreachable — fatal for
+                            # the class, but a merely-STALLED node may
+                            # still finish the grant nobody will consume:
+                            # tell it to return that lease, same as the
+                            # spill-target path below.
+                            self._abandon_lease_request(node_addr, req_id)
+                        raise  # our OWN node is gone — fatal for the class
+                    # Abandoning req_id for a fresh attempt from home: a
+                    # merely-SLOW target may still complete the grant
+                    # nobody will consume — tell it to return that lease
+                    # rather than leak it (fire-and-forget: the notify
+                    # must not stall this loop on the wedged peer).
+                    self._abandon_lease_request(node_addr, req_id)
+                    # A spill target that hangs or breaker-fails: report it
+                    # suspect to our home node (its scheduler stops
+                    # spilling there for one breaker window) and retry
+                    # from home instead of failing every queued task.
+                    try:
+                        await self.endpoint.anotify(
+                            self.node_addr,
+                            "node.peer_suspect",
+                            {"addr": tuple(node_addr)},
+                        )
+                    except Exception:
+                        pass
+                    if time.monotonic() > deadline:
+                        raise asyncio.TimeoutError(
+                            "lease request timed out (spill target "
+                            "unreachable)"
+                        )
+                    await asyncio.sleep(0.2)
+                    node_addr = self.node_addr
+                    continue
             if "error" in reply:
                 raise reply["error"]
             if "lease_id" in reply:

@@ -46,6 +46,28 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class DeadlineExceededError(RayTpuError, TimeoutError):
+    """An RPC got no reply within its per-call deadline (hung or partitioned
+    peer). Transport-level: retried automatically for idempotent methods
+    (protocol.IDEMPOTENT_RPCS); counts toward the peer's circuit breaker."""
+
+
+class PeerUnavailableError(RayTpuError, ConnectionError):
+    """The peer's circuit breaker is open: N consecutive transport failures
+    tripped it, and calls fail fast until the half-open timer elapses.
+    Schedulers treat such peers as suspect (no new leases) instead of
+    surfacing this as an exception storm. A ConnectionError subclass so
+    every existing peer-down handler (owner loss -> ObjectLostError,
+    worker loss -> reap and retry) treats a fast-fail exactly like the
+    connection loss it stands in for."""
+
+
+class FaultInjectedError(RayTpuError):
+    """Raised by the deterministic fault-injection plane (core/faults.py);
+    never seen in production (the injector is off unless RAY_TPU_FAULTS or
+    an explicit install() enables it)."""
+
+
 class TaskCancelledError(RayTpuError):
     """The task was cancelled via cancel(); raised at get() on its outputs
     (reference: python/ray/exceptions.py TaskCancelledError)."""

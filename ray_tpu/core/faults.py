@@ -1,0 +1,250 @@
+"""Deterministic, seeded fault-injection plane (the chaos tier).
+
+One process-global :class:`FaultInjector` is threaded through the runtime's
+failure-prone seams:
+
+=========  =====================  ==============================================
+site       actions                injected where
+=========  =====================  ==============================================
+``send``   drop delay dup sever   ``protocol.Connection._send`` (per frame)
+``recv``   drop delay dup         ``protocol.Connection._handle_frame``
+``node``   kill_worker            node worker-monitor sweep (leased task worker)
+``node``   lease_delay            ``node._h_request_lease`` entry
+``gcs``    heartbeat_blackhole    ``gcs._h_node_heartbeat`` (partition)
+``store``  pull_corrupt           ``node._h_fetch_object`` (flip served bytes)
+``store``  pull_lose              ``node._h_fetch_object`` (raise)
+``chan``   read_delay             dag channel ``read()`` (simulated transfer)
+=========  =====================  ==============================================
+
+Determinism: every rule owns a ``random.Random`` seeded from
+``(injector seed, rule index, site.action)``, and consumes exactly one draw
+per matching opportunity — so a schedule replays bit-identically from its
+seed for the same sequence of decision points. Probability-1 rules replay
+identically regardless of interleaving.
+
+Off by default and ZERO overhead when off: every hook is gated on a single
+``faults._ACTIVE is None`` module-attribute check. Enable per process with
+
+    RAY_TPU_FAULTS="<seed>:<rule>[;<rule>...]"
+    rule  = <site>.<action>[,<field>=<value>...]
+    field = p (probability, default 1) | ms (delay millis; "inf" = blackhole)
+          | match (fnmatch glob on the operation name, e.g. the RPC
+            msg_type, a node id, an object id; default *)
+          | peer (fnmatch glob on the dialed "host:port"; outbound frames
+            only; default *)
+          | count (fire at most N times; 0 = unlimited)
+          | after (skip the first N matching opportunities)
+
+e.g. ``RAY_TPU_FAULTS="7:send.delay,p=0.2,ms=20;recv.dup,p=0.1,match=$reply"``.
+The env var is inherited by spawned workers; in-process test clusters
+install() programmatically (driver/GCS/node endpoints only). ``tools/chaos.py``
+sweeps seeds over real workloads; ``tests/test_chaos.py`` is the CI tier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import math
+import os
+import random
+import threading
+from typing import Optional, Sequence
+
+INF = math.inf
+
+_SITE_ACTIONS = {
+    "send": frozenset({"drop", "delay", "dup", "sever"}),
+    "recv": frozenset({"drop", "delay", "dup"}),
+    "node": frozenset({"kill_worker", "lease_delay"}),
+    "gcs": frozenset({"heartbeat_blackhole"}),
+    "store": frozenset({"pull_corrupt", "pull_lose"}),
+    "chan": frozenset({"read_delay"}),
+}
+
+
+@dataclasses.dataclass
+class FaultRule:
+    site: str
+    action: str
+    prob: float = 1.0
+    delay_s: float = 0.0
+    match: str = "*"
+    peer: str = "*"
+    count: int = 0  # max fires; 0 = unlimited
+    after: int = 0  # skip the first N matching opportunities
+    # runtime state (reset when the rule is installed into an injector)
+    seen: int = 0
+    fired: int = 0
+    rng: Optional[random.Random] = None
+    _lock: Optional[threading.Lock] = None
+
+    def __post_init__(self):
+        actions = _SITE_ACTIONS.get(self.site)
+        if actions is None:
+            raise ValueError(
+                f"unknown fault site {self.site!r} "
+                f"(sites: {sorted(_SITE_ACTIONS)})"
+            )
+        if self.action not in actions:
+            raise ValueError(
+                f"unknown action {self.action!r} for site {self.site!r} "
+                f"(actions: {sorted(actions)})"
+            )
+
+    def choice(self, seq: Sequence):
+        """Deterministic pick from the rule's own stream (victim choice).
+        Takes the injector lock: in-process clusters run several node
+        monitor loops against one injector, and an unlocked draw would
+        interleave the stream differently run-to-run."""
+        with self._lock:
+            return seq[self.rng.randrange(len(seq))]
+
+
+class FaultInjector:
+    """A seeded schedule of fault rules. First matching rule that fires
+    wins a decision point; callers switch on ``rule.action``."""
+
+    def __init__(self, seed: int, rules: Sequence[FaultRule]):
+        self.seed = int(seed)
+        self.rules = list(rules)
+        # In-process clusters run driver/GCS/node endpoint loops on separate
+        # threads sharing this one injector; rule state (seen/fired/rng)
+        # must mutate atomically or count= rules overfire and a failing
+        # seed stops being a repro.
+        self._lock = threading.Lock()
+        for i, r in enumerate(self.rules):
+            r.rng = random.Random(f"{self.seed}:{i}:{r.site}.{r.action}")
+            r.seen = 0
+            r.fired = 0
+            r._lock = self._lock
+
+    def decide(
+        self,
+        site: str,
+        name: str = "",
+        peer: str = "",
+        actions: Optional[frozenset] = None,
+    ) -> Optional[FaultRule]:
+        """The rule to apply at this decision point, or None. ``actions``
+        restricts to what the call site can apply (a transport hook cannot
+        kill a worker). Each matching rule consumes exactly one probability
+        draw per opportunity, which is what keeps replays seed-exact."""
+        with self._lock:
+            for r in self.rules:
+                if r.site != site:
+                    continue
+                if actions is not None and r.action not in actions:
+                    continue
+                if r.match != "*" and not fnmatch.fnmatchcase(name, r.match):
+                    continue
+                if r.peer != "*" and not fnmatch.fnmatchcase(peer, r.peer):
+                    continue
+                r.seen += 1
+                if r.seen <= r.after:
+                    continue
+                if r.count and r.fired >= r.count:
+                    continue
+                if r.prob < 1.0 and r.rng.random() >= r.prob:
+                    continue
+                r.fired += 1
+                return r
+            return None
+
+    def stats(self) -> list:
+        with self._lock:
+            return [
+                {
+                    "rule": f"{r.site}.{r.action}",
+                    "match": r.match,
+                    "seen": r.seen,
+                    "fired": r.fired,
+                }
+                for r in self.rules
+            ]
+
+
+def parse_rule(text: str) -> FaultRule:
+    parts = [p.strip() for p in text.split(",") if p.strip()]
+    if not parts:
+        raise ValueError("empty fault rule")
+    site, _, action = parts[0].partition(".")
+    kwargs: dict = {}
+    for p in parts[1:]:
+        k, eq, v = p.partition("=")
+        if not eq:
+            raise ValueError(f"fault rule field {p!r} is not k=v")
+        if k == "p":
+            kwargs["prob"] = float(v)
+        elif k == "ms":
+            kwargs["delay_s"] = INF if v.lower() == "inf" else float(v) / 1e3
+        elif k == "match":
+            kwargs["match"] = v
+        elif k == "peer":
+            kwargs["peer"] = v
+        elif k == "count":
+            kwargs["count"] = int(v)
+        elif k == "after":
+            kwargs["after"] = int(v)
+        else:
+            raise ValueError(
+                f"unknown fault rule field {k!r} "
+                f"(fields: p, ms, match, peer, count, after)"
+            )
+    return FaultRule(site=site, action=action, **kwargs)
+
+
+def parse_spec(seed: int, spec: str) -> FaultInjector:
+    rules = [parse_rule(t) for t in spec.split(";") if t.strip()]
+    if not rules:
+        raise ValueError(f"fault spec {spec!r} contains no rules")
+    return FaultInjector(seed, rules)
+
+
+def parse_env(value: str) -> FaultInjector:
+    """``RAY_TPU_FAULTS`` format: ``<seed>:<rule>[;<rule>...]``."""
+    seed, sep, spec = value.partition(":")
+    if not sep:
+        raise ValueError(
+            f"RAY_TPU_FAULTS={value!r} must be '<seed>:<rule>[;<rule>...]'"
+        )
+    return parse_spec(int(seed), spec)
+
+
+# The process-global injector. None = chaos off (production): hot paths
+# gate on this single attribute check and pay nothing else.
+_ACTIVE: Optional[FaultInjector] = None
+
+_env_spec = os.environ.get("RAY_TPU_FAULTS")
+if _env_spec:
+    _ACTIVE = parse_env(_env_spec)
+
+
+def install(inj: FaultInjector) -> FaultInjector:
+    global _ACTIVE
+    _ACTIVE = inj
+    return inj
+
+
+def clear() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def sleep_if_delayed(site: str, name: str = "") -> None:
+    """Synchronous delay hook for non-async seams (dag channel reads)."""
+    inj = _ACTIVE
+    if inj is None:
+        return
+    rule = inj.decide(site, name, actions=frozenset({"read_delay"}))
+    if rule is None or rule.delay_s <= 0.0:
+        return
+    import time
+
+    while rule.delay_s >= INF:  # ms=inf: blackhole — the read never returns
+        time.sleep(3600)
+    time.sleep(rule.delay_s)

@@ -16,12 +16,18 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu.core import faults
 from ray_tpu.core.config import GLOBAL_CONFIG
-from ray_tpu.core.errors import ActorDiedError, SchedulingError
+from ray_tpu.core.errors import (
+    ActorDiedError,
+    FaultInjectedError,
+    SchedulingError,
+)
 from ray_tpu.core.protocol import Connection, Endpoint
 from ray_tpu.core.scheduler import (
     NodeView,
     SchedulingRequest,
+    SuspectStamper,
     any_feasible,
     pick_node,
 )
@@ -106,6 +112,10 @@ class GcsServer:
         self.named_pgs: dict[str, str] = {}
         self.pending_pgs: list[str] = []
         self.pg_release_retries: list[tuple] = []  # (node_id, pg_id)
+        self._suspect_stamper = SuspectStamper(
+            lambda: bool(self.endpoint._breakers),
+            lambda addr: self.endpoint.peer_suspect(addr),
+        )
         self.subs: dict[str, list[Connection]] = {}
         # Observability: bounded task-event store (reference:
         # GcsTaskManager, gcs_task_manager.h) keyed by task_id — each
@@ -288,10 +298,14 @@ class GcsServer:
             labels=dict(p.get("labels", {})),
         )
         self.nodes[p["node_id"]] = view
-        self.node_meta[p["node_id"]] = {
-            "shm_root": p.get("shm_root"),
-            "hostname": p.get("hostname", "localhost"),
-        }
+        meta = self.node_meta.setdefault(p["node_id"], {})
+        meta["shm_root"] = p.get("shm_root")
+        meta["hostname"] = p.get("hostname", "localhost")
+        # Deliberately NOT resetting meta["log_bid"]: a partition-survivor
+        # re-registering under the same node_id is the same process with
+        # the same monotonic batch counter, and its restaged heartbeat
+        # cargo must still dedup against the high-water mark or subscribers
+        # see every already-published batch again.
         self.node_last_seen[p["node_id"]] = time.monotonic()
         self._bump_node_version(p["node_id"])
         self.events.record(
@@ -306,8 +320,27 @@ class GcsServer:
         return {"session_id": self.session_id, "config": self.internal_config}
 
     async def _h_node_heartbeat(self, conn, p):
+        if faults._ACTIVE is not None:
+            rule = faults._ACTIVE.decide(
+                "gcs", p["node_id"],
+                actions=frozenset({"heartbeat_blackhole"}),
+            )
+            if rule is not None:
+                # Simulated partition: the heartbeat "never arrived". The
+                # node sees a failed RPC; this GCS eventually declares it
+                # dead; when the rule stops firing, the next heartbeat's
+                # False reply drives re-registration — the same healing
+                # path a real partition exercises.
+                raise FaultInjectedError(
+                    f"heartbeat from {p['node_id'][:8]} blackholed"
+                )
         view = self.nodes.get(p["node_id"])
-        if view is None:
+        if view is None or not view.alive:
+            # Unknown, OR declared dead by the health loop (a partition
+            # outlived node_death_timeout_s but the node itself survived):
+            # either way the node must re-register before its state counts
+            # again — replying True here would leave a zombie heartbeating
+            # into a view that stays dead forever.
             return False  # piggybacked sections dropped too: re-register first
         # Heartbeat piggybacking (ROADMAP): the envelope may carry the
         # node's merged metric snapshots and staged log batches — one
@@ -315,9 +348,26 @@ class GcsServer:
         if p.get("metrics") is not None:
             self._ingest_node_metrics(p["node_id"], p["metrics"])
         if p.get("logs"):
-            await self._publish(
-                "logs", {"node_id": p["node_id"], "batches": p["logs"]}
-            )
+            # Restaged heartbeat cargo makes log delivery at-least-once (a
+            # beat whose reply was lost re-sends its batches); the node
+            # stamps every batch with a monotonic "bid", so dropping ids at
+            # or below the per-node high-water mark makes it exactly-once
+            # for subscribers. Unstamped batches (other producers) pass.
+            meta = self.node_meta.setdefault(p["node_id"], {})
+            seen = meta.get("log_bid", 0)
+            fresh = []
+            for b in p["logs"]:
+                bid = b.get("bid")
+                if bid is None:
+                    fresh.append(b)
+                elif bid > seen:
+                    seen = bid
+                    fresh.append({k: v for k, v in b.items() if k != "bid"})
+            meta["log_bid"] = seen
+            if fresh:
+                await self._publish(
+                    "logs", {"node_id": p["node_id"], "batches": fresh}
+                )
         new_avail = dict(p["available"])
         new_total = dict(p.get("total", view.total))
         if new_avail != view.available or new_total != view.total:
@@ -462,6 +512,13 @@ class GcsServer:
         await self._schedule_actor(rec)
         return self._actor_info(rec)
 
+    def _stamp_suspects(self) -> None:
+        """Refresh node views' suspect flags from this GCS's own breaker
+        verdicts before actor/bundle placement: a node it can't talk to
+        takes no new placements until the breaker half-opens, while the
+        record stays pending (see scheduler.SuspectStamper)."""
+        self._suspect_stamper.stamp(self.nodes.values())
+
     async def _schedule_actor(self, rec: ActorRecord) -> None:
         req = SchedulingRequest(
             resources=rec.spec.get("resources", {}),
@@ -469,6 +526,7 @@ class GcsServer:
             soft_label_selector=rec.spec.get("soft_label_selector", {}),
             policy=rec.spec.get("policy", "hybrid"),
         )
+        self._stamp_suspects()
         node_id = pick_node(req, "", self.nodes)
         if node_id is None:
             if any_feasible(req, self.nodes):
@@ -832,10 +890,14 @@ class GcsServer:
         {index: node_id} or None if no placement exists right now."""
         from ray_tpu.core.scheduler import fits, labels_match, subtract
 
+        # Same breaker-verdict gate as actor placement: bundles never land
+        # on a node this GCS can't currently talk to (the 2PC prepare RPCs
+        # would just burn deadlines). Unplaceable groups stay pending.
+        self._stamp_suspects()
         avail = {
             nid: dict(v.available)
             for nid, v in self.nodes.items()
-            if v.alive
+            if v.alive and not v.suspect
         }
         if not avail:
             return None

@@ -22,8 +22,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ray_tpu.core import faults
 from ray_tpu.core.config import GLOBAL_CONFIG
-from ray_tpu.core.errors import SchedulingError
+from ray_tpu.core.errors import FaultInjectedError, SchedulingError
 from ray_tpu.core.ids import NodeID, WorkerID
 from ray_tpu.core.object_store import ShmObjectStore, default_shm_root
 from ray_tpu.core.protocol import Endpoint
@@ -31,6 +32,7 @@ from ray_tpu.core.scheduler import (
     NodeView,
     SchedulerMetrics,
     SchedulingRequest,
+    SuspectStamper,
     add,
     any_feasible,
     fits,
@@ -159,6 +161,24 @@ class NodeManager:
         self._pg_state_cache: dict[str, tuple] = {}  # pg_id -> (ts, pending)
         self.cluster_view: dict[str, NodeView] = {}
         self.view_meta: dict[str, dict] = {}
+        # Peers reported suspect by drivers whose direct RPCs to them
+        # tripped a breaker (node.peer_suspect), with a TTL matching the
+        # breaker's half-open window; merged with this endpoint's OWN
+        # breaker verdicts when stamping views before placement decisions.
+        self._suspect_until: dict[tuple, float] = {}
+        self._suspect_stamper = SuspectStamper(
+            lambda: bool(self._suspect_until or self.endpoint._breakers),
+            self._addr_suspect,
+        )
+        self._bg_tasks: set = set()  # strong refs for fire-and-forget tasks
+        # request_lease idempotency dedup: req_id -> (ts, reply future).
+        # A transport retry of an in-flight lease request attaches to the
+        # original grant instead of double-granting (see _h_request_lease).
+        self._lease_reply_cache: dict[str, tuple] = {}
+        # req_ids the client abandoned (cancel_lease_request): a chaos-
+        # delayed retry of a cancelled attempt that lands AFTER the cancel
+        # must not re-grant — nobody will ever consume or cancel it again.
+        self._lease_cancel_tombstones: dict[str, float] = {}
         self._pending_leases: list = []  # (req, future, deadline)
         self._idle_waiters: list = []  # futures waiting for an idle worker
         self._terminated_procs: list = []  # reaped, awaiting exit collection
@@ -198,6 +218,11 @@ class NodeManager:
         # heartbeat flushes them and attaches metrics when the report
         # interval elapses.
         self._pending_log_batches: list = []
+        # Monotonic id stamped on every staged log batch: the heartbeat
+        # restage path makes log delivery at-least-once, and the GCS drops
+        # batches whose id it has already processed (see _h_node_heartbeat)
+        # so subscribers never see duplicates.
+        self._log_batch_seq = 0
         self._last_metrics_report = 0.0
         self._piggyback_saved = 0
         # Injectable for tests (simulate pressure without consuming RAM).
@@ -216,9 +241,7 @@ class NodeManager:
     def start(self) -> tuple:
         addr = self.endpoint.start()
         if self.session_id is None:
-            info = self.endpoint.call(
-                self.gcs_addr, "gcs.get_session", {}, timeout=30
-            )
+            info = self.endpoint.call(self.gcs_addr, "gcs.get_session", {})
             self.session_id = info["session_id"]
             # The head's config is cluster-authoritative (config.py promises
             # consistency): apply BEFORE creating the store, whose capacity
@@ -237,7 +260,6 @@ class NodeManager:
                 "hostname": socket.gethostname(),
                 "session_id": self.session_id,
             },
-            timeout=30,
         )
         if reply["session_id"] != self.session_id:
             raise RuntimeError(
@@ -328,12 +350,48 @@ class NodeManager:
 
     async def _heartbeat_loop(self):
         while not self._stopping:
+            # Stage the beat's one-shot cargo OUTSIDE the try: a dropped
+            # beat (5s deadline makes that routine under GCS stalls) must
+            # re-stage it for the next interval, not lose it — heartbeat
+            # piggybacking is the ONLY transport for log batches, and the
+            # freed-resources edge triggers pending-lease re-scheduling.
+            freed, self._resources_freed = self._resources_freed, False
+            prev_metrics_report = self._last_metrics_report
+            extra = self._piggyback_payload()
+            restaged = False
+
+            def _restage_cargo():
+                # Once per beat: the ok-False path restages and then
+                # re-registers, and if THAT raises, the outer except calls
+                # here again — a second run would extend the pending-log
+                # list with itself, duplicating every staged batch.
+                nonlocal restaged
+                if restaged:
+                    return
+                restaged = True
+                # The beat's cargo never landed: put it back. Logs prepend
+                # ahead of anything staged meanwhile (order preserved);
+                # metric sections re-cut fresh next beat (worker snaps
+                # live in _worker_metric_snaps and are read, not drained);
+                # a freed edge survives unless a new one already fired.
+                self._resources_freed = freed or self._resources_freed
+                if "logs" in extra:
+                    extra["logs"].extend(self._pending_log_batches)
+                    self._pending_log_batches = extra["logs"]
+                if "metrics" in extra:
+                    self._last_metrics_report = prev_metrics_report
+
             try:
-                freed, self._resources_freed = self._resources_freed, False
+                # retries=0: a retried heartbeat carries STALE state —
+                # the loop's next interval sends a fresh one, which both
+                # arrives sooner than a third deadline-burning resend and
+                # reports current availability. (The method stays on the
+                # idempotency allowlist for any out-of-band caller.)
                 ok = await self.endpoint.acall(
                     self.gcs_addr,
                     "gcs.node_heartbeat",
-                    {
+                    retries=0,
+                    payload={
                         "node_id": self.node_id,
                         "available": self.available,
                         "total": self.total,
@@ -348,10 +406,15 @@ class NodeManager:
                         "idle": not self.leases
                         and not self._pending_leases
                         and self._task_worker_count() == 0,
-                        **self._piggyback_payload(),
+                        **extra,
                     },
                 )
                 if ok is False:
+                    # The GCS does not know us (it restarted, or declared
+                    # us dead across a partition) and dropped the beat's
+                    # piggybacked sections unprocessed — re-stage them for
+                    # the first post-re-register beat.
+                    _restage_cargo()
                     # The GCS does not know us: it restarted from durable
                     # storage (reference: NotifyGCSRestart,
                     # node_manager.proto:454) — re-register and resume.
@@ -378,7 +441,7 @@ class NodeManager:
                             return  # orphaned: stop heartbeating for good
                         raise
             except Exception:
-                pass
+                _restage_cargo()
             await self._refresh_cluster_view(force=True)
             await asyncio.sleep(GLOBAL_CONFIG.resource_report_interval_s)
 
@@ -425,6 +488,8 @@ class NodeManager:
     async def _worker_monitor_loop(self):
         while not self._stopping:
             await asyncio.sleep(GLOBAL_CONFIG.worker_poll_interval_s)
+            if faults._ACTIVE is not None:
+                self._chaos_kill_worker()
             for wid, w in list(self.workers.items()):
                 if w.proc is not None and w.proc.poll() is not None:
                     await self._on_worker_death(wid, f"exit {w.proc.returncode}")
@@ -436,6 +501,36 @@ class NodeManager:
                 self._cgroup_pending = self._cgroups.retire_pass(
                     self._cgroup_pending
                 )
+
+    def _chaos_kill_worker(self) -> None:
+        """Fault-injection hook (node.kill_worker): kill one LEASED task
+        worker, chosen deterministically from the rule's own stream. The
+        death flows through the ordinary reap-and-retry path — that path
+        surviving randomized kill schedules is what the chaos suite
+        asserts. Actor workers are exempt here (actor restart policy has
+        its own chaos coverage via die_silently/kill)."""
+        rule = faults._ACTIVE.decide(
+            "node", self.name, actions=frozenset({"kill_worker"})
+        )
+        if rule is None:
+            return
+        victims = sorted(
+            {
+                lease.worker_id
+                for lease in self.leases.values()
+                if lease.worker_id in self.workers
+                and self.workers[lease.worker_id].proc is not None
+                and not self.workers[lease.worker_id].actor_ids
+            }
+        )
+        if not victims:
+            return
+        info = self.workers[rule.choice(victims)]
+        try:
+            info.proc.kill()
+        except OSError:
+            pass
+        # The monitor loop's poll sweep (this very tick) reaps the corpse.
 
     def _reap_idle_workers(self) -> None:
         """Kill workers idle past their TTL, keeping a warm floor so the
@@ -765,6 +860,146 @@ class NodeManager:
         )
 
     async def _h_request_lease(self, conn, p):
+        if faults._ACTIVE is not None:
+            rule = faults._ACTIVE.decide(
+                "node", self.name, actions=frozenset({"lease_delay"})
+            )
+            if rule is not None and rule.delay_s > 0:
+                await asyncio.sleep(rule.delay_s)
+        # Idempotency dedup: request_lease is on the transport retry
+        # allowlist, and a retry whose original attempt is still mid-grant
+        # (worker spawn, queueing) must ATTACH to that attempt — a second
+        # independent grant would leak a lease + its resources every time
+        # a reply is lost or a deadline fires mid-spawn. The client sends
+        # one req_id per logical attempt, reused across transport retries.
+        return await self._lease_dedup(
+            p, self._request_lease_impl, lambda: {"cancelled": True}
+        )
+
+    async def _lease_dedup(self, p, impl, tombstone_reply):
+        """The req_id dedup bracket shared by request_lease and
+        request_lease_batch: tombstone check, reply-cache attach (shielded
+        — a cancelled duplicate must not kill the original grant), future
+        creation + sweep, and the set_result/set_exception bookkeeping.
+        One implementation on purpose: the tombstone-before-cache ordering
+        and consumed-exception dance are the double-grant guard, and a fix
+        applied to only one lease path would silently re-open the window
+        on the other."""
+        req_id = p.get("req_id")
+        if not req_id:
+            return await impl(p)
+        if req_id in self._lease_cancel_tombstones:
+            # The client already abandoned this logical attempt (its
+            # cancel overtook this delayed/retried frame); granting now
+            # would leak the lease — no consumer, no second cancel.
+            return tombstone_reply()
+        ent = self._lease_reply_cache.get(req_id)
+        if ent is not None:
+            return await asyncio.shield(ent[1])
+        fut = asyncio.get_running_loop().create_future()
+        self._lease_reply_cache[req_id] = (time.monotonic(), fut)
+        if len(self._lease_reply_cache) > 256:
+            self._sweep_lease_cache()
+        try:
+            reply = await impl(p)
+        except BaseException as e:
+            if not fut.done():
+                fut.set_exception(e)
+                fut.exception()  # consumed: a retry may never arrive
+            raise
+        if not fut.done():
+            fut.set_result(reply)
+        return reply
+
+    @staticmethod
+    def _lease_cache_ttl() -> float:
+        # Entries must outlive the WORST-CASE transport-retried schedule —
+        # attempts * (dial + deadline) + backoff, measured from the first
+        # attempt's ARRIVAL — or a late retry misses the cache and
+        # double-grants the lease the dedup exists to stop.
+        cfg = GLOBAL_CONFIG
+        return (
+            (cfg.rpc_max_retries + 1)
+            * (cfg.rpc_slow_deadline_s + cfg.rpc_connect_timeout_s)
+            + cfg.rpc_max_retries * cfg.rpc_retry_backoff_max_s
+        )
+
+    def _sweep_lease_cache(self) -> None:
+        cut = time.monotonic() - self._lease_cache_ttl()
+        stale = []
+        for rid, (ts, fut) in self._lease_reply_cache.items():
+            if ts >= cut:
+                break  # insertion-ordered by ts: everything later is fresh
+            if fut.done():
+                stale.append(rid)
+        for rid in stale:
+            del self._lease_reply_cache[rid]
+        # Hard memory bound: a busy node grants leases far faster than the
+        # TTL retires them (hundreds/s against a multi-minute window), and
+        # every entry pins its reply dict. Past the cap, evict the oldest
+        # SETTLED entries early; that re-opens the double-grant window only
+        # for a transport retry of an attempt >4096 grants old that is
+        # somehow still in flight — and only if its reply frame was also
+        # lost, since a delivered reply means no retry ever comes.
+        over = len(self._lease_reply_cache) - 4096
+        if over > 0:
+            for rid, (_, fut) in list(self._lease_reply_cache.items()):
+                if over <= 0:
+                    break
+                if fut.done():
+                    del self._lease_reply_cache[rid]
+                    over -= 1
+
+    async def _h_cancel_lease_request(self, conn, p):
+        """The client abandoned this logical lease attempt (every
+        transport retry deadlined; it re-requests from home under a FRESH
+        req_id), so no caller will ever consume this req_id's reply. If
+        the in-flight grant completes anyway — the classic case is a
+        target whose event loop stalled past the deadline but is otherwise
+        healthy — return the lease on the spot instead of leaking its
+        worker and resources until node death."""
+        req_id = p.get("req_id", "")
+        if req_id:
+            # Tombstone first, unconditionally: a chaos-delayed transport
+            # retry of this req_id may still be in flight and land after
+            # the pop below — without the tombstone it would miss the
+            # cache and grant a lease nobody consumes or cancels.
+            self._lease_cancel_tombstones[req_id] = time.monotonic()
+            if len(self._lease_cancel_tombstones) > 256:
+                cut = time.monotonic() - self._lease_cache_ttl()
+                for rid, ts in list(self._lease_cancel_tombstones.items()):
+                    if ts >= cut:
+                        break  # insertion-ordered: everything later is fresh
+                    del self._lease_cancel_tombstones[rid]
+        ent = self._lease_reply_cache.pop(req_id, None)
+        if ent is None:
+            return False
+        fut = ent[1]
+
+        def _return_orphan(f):
+            if f.cancelled() or f.exception() is not None:
+                return
+            reply = f.result()
+            # request_lease caches a single grant dict; request_lease_batch
+            # caches the whole wave's list — return every granted entry.
+            entries = reply if isinstance(reply, list) else [reply]
+            freed = False
+            for r in entries:
+                if isinstance(r, dict) and "lease_id" in r:
+                    freed |= self._return_one_lease(r["lease_id"])
+            if freed:
+                # Strong ref until done: a bare create_task can be
+                # collected mid-flight.
+                t = asyncio.get_running_loop().create_task(
+                    self._drain_pending()
+                )
+                self._bg_tasks.add(t)
+                t.add_done_callback(self._bg_tasks.discard)
+
+        fut.add_done_callback(_return_orphan)  # fires now if already done
+        return True
+
+    async def _request_lease_impl(self, p):
         req = self._req_of_payload(p)
         t0 = time.monotonic()
         deadline = t0 + GLOBAL_CONFIG.lease_request_timeout_s
@@ -795,7 +1030,18 @@ class NodeManager:
         individual (server-side queueing) request_lease calls. Entries must
         never queue inside the batch: the combined reply would make an
         early grant wait on a contended sibling, which deadlocks when the
-        sibling's resources are freed by the early grant's own task."""
+        sibling's resources are freed by the early grant's own task.
+
+        Rides the same req_id reply-cache as _h_request_lease so a
+        deadline-abandoned batch (cancel_lease_request) returns every
+        granted lease instead of leaking the whole wave's resources."""
+        return await self._lease_dedup(
+            p,
+            self._request_lease_batch_impl,
+            lambda: [{"fallback": True}] * max(1, int(p.get("count", 1))),
+        )
+
+    async def _request_lease_batch_impl(self, p):
         req = self._req_of_payload(p)
         n = max(1, int(p.get("count", 1)))
         plain = (
@@ -836,7 +1082,42 @@ class NodeManager:
                     sm.errors += 1
         return out
 
+    def _addr_suspect(self, addr) -> bool:
+        """A peer is suspect while this endpoint's OWN breaker to it is
+        tripped, or while a driver-reported suspicion (node.peer_suspect)
+        is inside its TTL. Both self-heal: the breaker half-opens and the
+        TTL expires, so a recovered node starts taking leases again
+        without any explicit un-suspect signal."""
+        addr = tuple(addr)
+        if self.endpoint.peer_suspect(addr):
+            return True
+        until = self._suspect_until.get(addr)
+        if until is None:
+            return False
+        if time.monotonic() >= until:
+            del self._suspect_until[addr]
+            return False
+        return True
+
+    def _stamp_suspects(self) -> None:
+        """Refresh the cluster view's suspect flags from this endpoint's
+        breakers merged with driver-reported suspects (_suspect_until)
+        before a placement decision (see scheduler.SuspectStamper)."""
+        self._suspect_stamper.stamp(self.cluster_view.values())
+
+    async def _h_peer_suspect(self, conn, p):
+        """A driver's direct RPCs to the given peer tripped its breaker
+        (e.g. a spill target that accepts connections but never replies).
+        Remember it for one breaker window so THIS node's scheduler stops
+        spilling leases there — the degradation the breaker buys is 'stop
+        placing work on the suspect', not an exception storm."""
+        self._suspect_until[tuple(p["addr"])] = (
+            time.monotonic() + GLOBAL_CONFIG.rpc_breaker_reset_s
+        )
+        return True
+
     async def _lease_or_spill(self, req: SchedulingRequest, deadline: float):
+        self._stamp_suspects()
         local_ok = labels_match(self.labels, req.label_selector)
         soft_target_is_self = False
         if req.policy.startswith(("node_affinity:", "strict_node_affinity:")):
@@ -985,6 +1266,7 @@ class NodeManager:
         """Pick a peer that fits the request now, or None. With
         ``require_soft``, only peers matching the soft label selector
         qualify (used to honor the preference over a local grant)."""
+        self._stamp_suspects()
         views = dict(self.cluster_view)
         views.pop(self.node_id, None)
         if require_soft:
@@ -1276,6 +1558,23 @@ class NodeManager:
             chunk = await self._store_call(
                 self.store.read_range, p["oid"], p["offset"], p["length"]
             )
+            if faults._ACTIVE is not None:
+                rule = faults._ACTIVE.decide(
+                    "store", p["oid"],
+                    actions=frozenset({"pull_corrupt", "pull_lose"}),
+                )
+                if rule is not None:
+                    if rule.action == "pull_lose":
+                        raise FaultInjectedError(
+                            f"chunk of {p['oid'][:12]} lost in transfer "
+                            f"(fault-injected)"
+                        )
+                    # pull_corrupt: flip the first served byte — caught by
+                    # the verify_transfers fingerprint, surfacing as a
+                    # failed pull the owner recovers from.
+                    chunk = bytearray(chunk)
+                    chunk[0] ^= 0xFF
+                    chunk = bytes(chunk)
             if not GLOBAL_CONFIG.rpc_scatter_gather_enabled:
                 return chunk
             return OobBytes(chunk)
@@ -1314,16 +1613,29 @@ class NodeManager:
             off = 0
             while off < size:
                 ln = min(chunk, size - off)
-                # Per-chunk deadline: a TCP-alive-but-wedged source must
-                # fail the pull and release its admission slot, not hold it
-                # (and every queued pull behind it) forever.
+                # Per-chunk bound, SINGLE attempt (retries=0): a wedged
+                # source must fail the pull and release its admission slot
+                # in ~object_chunk_timeout_s — transport retries against
+                # the same dead source would multiply that bound and starve
+                # every queued pull behind the slot. Layering: the inner
+                # deadline_s fires FIRST on a wedged request (instant dial,
+                # the common case) so the failure feeds the breaker and
+                # deadline metrics; a wedged DIAL fails at
+                # rpc_connect_timeout_s inside acall (also counted); the
+                # outer wait_for — chunk timeout plus a grace so it never
+                # races the inner timer — is only the backstop for slow
+                # dial + wedged request, keeping the slot bounded either
+                # way. Pull-level recovery (drop the location, use another
+                # replica, reconstruct) lives with the owner.
                 data = await asyncio.wait_for(
                     self.endpoint.acall(
                         src_addr,
                         "node.fetch_object",
                         {"oid": oid, "offset": off, "length": ln},
+                        deadline_s=GLOBAL_CONFIG.object_chunk_timeout_s,
+                        retries=0,
                     ),
-                    timeout=GLOBAL_CONFIG.object_chunk_timeout_s,
+                    GLOBAL_CONFIG.object_chunk_timeout_s + 5.0,
                 )
                 # data is bytes or a decoded-frame memoryview (OobBytes);
                 # the native multi-threaded memcpy lands it in the shm map.
@@ -1549,6 +1861,9 @@ class NodeManager:
                 )
             if not batches:
                 continue
+            for b in batches:
+                self._log_batch_seq += 1
+                b["bid"] = self._log_batch_seq
             self._pending_log_batches.extend(batches)
             # Bounded staging: a long GCS outage must not grow the buffer
             # without limit (observability is deliberately lossy under

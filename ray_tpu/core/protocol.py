@@ -28,13 +28,16 @@ from __future__ import annotations
 
 import asyncio
 import pickle
+import random
 import struct
 import threading
 import time
 import traceback
 from typing import Any, Awaitable, Callable, Optional
 
+from ray_tpu.core import faults
 from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import DeadlineExceededError, PeerUnavailableError
 from ray_tpu.util.metrics import (
     LATENCY_BOUNDARIES_S,
     LocalHistogram,
@@ -167,12 +170,194 @@ _RPC_METRIC_META = {
         boundaries=LATENCY_BOUNDARIES_S,
         layer="core",
     ),
+    # RPC survival semantics (robustness round): retry / deadline / breaker
+    # observability — the first series an operator checks when a fleet
+    # starts limping from gray failures rather than clean crashes.
+    "raytpu_rpc_retries_total": declare_runtime_metric(
+        "raytpu_rpc_retries_total",
+        "counter",
+        "idempotent RPC attempts re-sent after a transport failure "
+        "(jittered-exponential-backoff retry path)",
+        layer="core",
+    ),
+    "raytpu_rpc_deadline_exceeded_total": declare_runtime_metric(
+        "raytpu_rpc_deadline_exceeded_total",
+        "counter",
+        "RPC attempts that got no reply within their per-call deadline",
+        layer="core",
+    ),
+    "raytpu_rpc_breaker_state": declare_runtime_metric(
+        "raytpu_rpc_breaker_state",
+        "gauge",
+        "peers whose circuit breaker is currently tripped (open or "
+        "half-open) on this endpoint; 0 = all peers healthy",
+        layer="core",
+    ),
 }
 
 # Register the round-6 transport gauges in the lint catalog too (they are
 # built directly, not through the user API, so they don't self-register).
 for _name, (_key, _desc) in TRANSPORT_METRICS.items():
     declare_runtime_metric(_name, "gauge", _desc, layer="core")
+
+
+# -- RPC survival semantics (robustness round) --------------------------------
+# Per-call deadlines: every acall/call is bounded so a hung or partitioned
+# peer fails the call (DeadlineExceededError) instead of wedging the caller.
+# Methods whose reply is the COMPLETION of arbitrarily long user work are
+# exempt — a task push replies when the task finishes, an owner.get_object
+# replies when the object exists — so their lifetime belongs to the task
+# layer (worker death still surfaces as ConnectionLost), not to an RPC
+# timer that would kill legitimate multi-hour work.
+RPC_DEADLINE_EXEMPT = frozenset(
+    {
+        "worker.push_task",
+        "worker.push_batch",
+        "worker.start_dag_loop",  # waits out actor init (rendezvous)
+        "worker.profile",  # caller-chosen sampling duration
+        "worker.jax_trace",
+        "worker.rdt_arm",  # device staging of arbitrarily large arrays
+        "worker.rdt_fetch",
+        "owner.get_object",
+        "owner.wait_ready",
+        "owner.stream_item",  # backpressure ack: held while consumer lags
+        "gcs.wait_actor_alive",  # server enforces the payload timeout
+        "gcs.wait_pg_ready",
+        "node.pull_object",  # whole-object; per-chunk deadlines inside
+        "client.get",  # client-mode proxies of the above
+        "client.wait",
+        "client.stream_next",
+        "client.gcs_call",
+    }
+)
+_HEARTBEAT_RPCS = frozenset({"gcs.node_heartbeat"})
+_DATA_PLANE_RPCS = frozenset(
+    {
+        # Store-touching RPCs: chunk reads/copies + anything serialized
+        # behind the store lock, which a multi-GB spill can hold for a
+        # while. Generous but bounded.
+        "node.fetch_object",
+        "node.object_fingerprint",
+        "node.object_created",
+        "node.completions_batch",
+        "node.restore_object",
+        "node.free_object",
+    }
+)
+_SLOW_RPCS = frozenset(
+    {
+        # Bounded by their own server-side timeouts (lease queueing up to
+        # lease_request_timeout_s, worker spawn up to
+        # worker_start_timeout_s) plus margin.
+        "node.request_lease",
+        "node.request_lease_batch",
+        "node.start_actor",
+        "gcs.create_actor",
+        "gcs.create_placement_group",
+    }
+)
+
+# Methods safe to retry automatically on TRANSPORT errors (connection loss,
+# deadline): pure reads, heartbeats, and requests the server dedups
+# (pull_object coalesces by oid). An explicit allowlist — never task or
+# actor pushes, whose replay would double-execute user code.
+IDEMPOTENT_RPCS = frozenset(
+    {
+        "gcs.node_heartbeat",
+        "gcs.get_cluster_view",
+        "gcs.get_session",
+        "gcs.get_internal_config",
+        "gcs.kv_get",
+        "gcs.kv_keys",
+        "gcs.get_actor",
+        "gcs.get_placement_group",
+        "gcs.list_actors",
+        "gcs.list_placement_groups",
+        "gcs.list_task_events",
+        "gcs.get_autoscaler_state",
+        "node.request_lease",
+        "node.fetch_object",
+        "node.restore_object",
+        "node.object_fingerprint",
+        "node.get_info",
+        "node.list_objects",
+        "owner.get_object",
+        "owner.wait_ready",
+        "worker.ping",
+    }
+)
+
+
+def method_deadline_s(msg_type: str) -> float:
+    """Resolve the per-call deadline for an RPC method (0 = unbounded)."""
+    cfg = GLOBAL_CONFIG
+    if cfg.rpc_deadline_s <= 0 or msg_type in RPC_DEADLINE_EXEMPT:
+        return 0.0
+    if msg_type in _HEARTBEAT_RPCS:
+        return cfg.rpc_heartbeat_deadline_s
+    if msg_type in _DATA_PLANE_RPCS:
+        return cfg.rpc_data_deadline_s
+    if msg_type in _SLOW_RPCS:
+        return cfg.rpc_slow_deadline_s
+    return cfg.rpc_deadline_s
+
+
+class _Breaker:
+    """Per-peer circuit breaker. closed -> (threshold consecutive transport
+    failures) -> open: calls fail fast with PeerUnavailableError instead of
+    each burning a deadline. After the reset interval one caller is let
+    through as the half-open probe; its outcome closes or re-opens."""
+
+    __slots__ = ("state", "failures", "opened_at", "touched")
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self):
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        self.touched = 0.0  # last caller interest; stale entries are swept
+
+    def allow(self, now: float, reset_s: float) -> bool:
+        self.touched = now
+        if self.state == self.CLOSED:
+            return True
+        if now - self.opened_at >= reset_s:
+            # OPEN past the reset window: this caller becomes the probe.
+            # HALF_OPEN past the window: the previous probe has been in
+            # flight longer than a whole reset interval (a deadline-exempt
+            # RPC can legitimately run for minutes) — let another caller
+            # probe rather than wedging every call behind it.
+            self.state = self.HALF_OPEN
+            self.opened_at = now
+            return True
+        return False  # inside the window (open, or a probe in flight)
+
+    def suspect(self, now: float, reset_s: float) -> bool:
+        """True while schedulers should avoid placing work on the peer:
+        tripped and not yet eligible for (or mid-) half-open probing."""
+        return self.state != self.CLOSED and now - self.opened_at < reset_s
+
+    def release(self) -> None:
+        """A HALF_OPEN probe ended without a transport verdict (cancelled,
+        or failed before reaching the wire): return to OPEN with the
+        reset window already expired, so the very next caller may probe
+        again — never leave the breaker wedged in HALF_OPEN, and never
+        charge a full extra window for a probe that proved nothing."""
+        if self.state == self.HALF_OPEN:
+            self.state = self.OPEN
+            self.opened_at = float("-inf")
+
+    def failure(self, now: float, threshold: int) -> None:
+        self.touched = now
+        self.failures += 1
+        if self.state == self.HALF_OPEN or self.failures >= max(1, threshold):
+            self.state = self.OPEN
+            self.opened_at = now
+
+
+# Actions the transport seams can apply (see faults.py).
+_SEND_FAULTS = frozenset({"drop", "delay", "dup", "sever"})
+_RECV_FAULTS = frozenset({"drop", "delay", "dup"})
 
 
 class RpcError(Exception):
@@ -220,6 +405,9 @@ class Connection:
         self._drain_task: asyncio.Future | None = None
         self.stats = dict.fromkeys(STAT_KEYS, 0)
         self.peer: Any = None  # set by servers after registration
+        # "host:port" of the DIALED address for outbound connections (set
+        # by Endpoint.connect); "" for inbound. Fault rules match on it.
+        self.peer_label: str = ""
         self._reader_task = asyncio.ensure_future(self._read_loop())
 
     def _encode_frame(self, msg_type, msg_id, reply_to, payload) -> list:
@@ -258,6 +446,28 @@ class Connection:
         return [len(env).to_bytes(4, "big") + env]
 
     async def _send(self, msg_type: str, msg_id, reply_to, payload) -> None:
+        dup = False
+        if faults._ACTIVE is not None:
+            rule = faults._ACTIVE.decide(
+                "send", msg_type, self.peer_label, _SEND_FAULTS
+            )
+            if rule is not None:
+                if rule.action == "sever":
+                    self._teardown()
+                    raise ConnectionLost(
+                        f"fault-injected sever (sending {msg_type})"
+                    )
+                if rule.action == "drop" or (
+                    rule.action == "delay" and rule.delay_s == faults.INF
+                ):
+                    return  # blackhole: the frame silently vanishes
+                if rule.action == "delay":
+                    # NB: deliberately breaks same-tick FIFO framing — a
+                    # delayed peer reorders against later frames, which is
+                    # exactly the gray failure under test.
+                    await asyncio.sleep(rule.delay_s)
+                elif rule.action == "dup":
+                    dup = True
         frame = self._encode_frame(msg_type, msg_id, reply_to, payload)
         if not GLOBAL_CONFIG.rpc_coalesce_enabled:
             async with self._send_lock:
@@ -276,6 +486,10 @@ class Connection:
                 self.writer.write(
                     frame[0] if len(frame) == 1 else b"".join(frame)
                 )
+                if dup:  # fault-injected duplicate delivery
+                    self.writer.write(
+                        frame[0] if len(frame) == 1 else b"".join(frame)
+                    )
                 st = self.stats
                 st["frames_sent"] += 1
                 st["writes"] += 1
@@ -288,6 +502,8 @@ class Connection:
         if self._closed:
             raise ConnectionLost(f"connection closed (sending {msg_type})")
         self._send_buf.append(frame)
+        if dup:  # fault-injected duplicate delivery
+            self._send_buf.append(frame)
         if not self._flush_scheduled:
             # call_soon lands AFTER every callback already in this loop
             # tick's ready queue — so all frames produced by the tick
@@ -406,7 +622,9 @@ class Connection:
             self._drain_task = None
             self._drained.set()
 
-    async def request(self, msg_type: str, payload: Any = None) -> Any:
+    async def request(
+        self, msg_type: str, payload: Any = None, timeout: float | None = None
+    ) -> Any:
         if self._closed:
             raise ConnectionLost(f"connection closed (sending {msg_type})")
         msg_id = self._next_id
@@ -423,7 +641,28 @@ class Connection:
             if fut.done() and not fut.cancelled():
                 fut.exception()
             raise
-        return await fut
+        if not timeout or timeout <= 0:
+            return await fut
+        # Deadline via call_later, not wait_for: no extra task per request
+        # (the hot path must not pay a wrapper coroutine for a timer that
+        # almost never fires).
+        handle = self._loop.call_later(
+            timeout, self._expire_request, msg_id, msg_type, timeout
+        )
+        try:
+            return await fut
+        finally:
+            handle.cancel()
+
+    def _expire_request(self, msg_id, msg_type: str, timeout: float) -> None:
+        fut = self._pending.pop(msg_id, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(
+                DeadlineExceededError(
+                    f"{msg_type} got no reply within its {timeout:g}s "
+                    f"deadline (peer {self.peer_label or 'inbound'})"
+                )
+            )
 
     async def notify(self, msg_type: str, payload: Any = None) -> None:
         if self._closed:
@@ -507,6 +746,30 @@ class Connection:
         return pickle.loads(body)
 
     def _handle_frame(self, msg_type, msg_id, reply_to, payload) -> None:
+        if faults._ACTIVE is not None:
+            rule = faults._ACTIVE.decide(
+                "recv", msg_type, self.peer_label, _RECV_FAULTS
+            )
+            if rule is not None:
+                if rule.action == "drop" or (
+                    rule.action == "delay" and rule.delay_s == faults.INF
+                ):
+                    return  # frame lost on the receive side
+                if rule.action == "delay":
+                    self._loop.call_later(
+                        rule.delay_s,
+                        self._deliver_frame,
+                        msg_type,
+                        msg_id,
+                        reply_to,
+                        payload,
+                    )
+                    return
+                if rule.action == "dup":
+                    self._deliver_frame(msg_type, msg_id, reply_to, payload)
+        self._deliver_frame(msg_type, msg_id, reply_to, payload)
+
+    def _deliver_frame(self, msg_type, msg_id, reply_to, payload) -> None:
         if msg_type == _REPLY:
             fut = self._pending.pop(reply_to, None)
             if fut is not None and not fut.done():
@@ -517,6 +780,13 @@ class Connection:
                 exc = payload
                 if isinstance(exc, str):
                     exc = RemoteError(exc)
+                try:
+                    # Mark application-level errors so the retry/breaker
+                    # layer never mistakes a remote OSError/TimeoutError
+                    # for a transport failure of THIS hop.
+                    exc._raytpu_remote = True
+                except Exception:
+                    pass
                 fut.set_exception(exc)
         else:
             asyncio.ensure_future(self._dispatch(msg_type, msg_id, payload))
@@ -596,6 +866,12 @@ class Endpoint:
         self._method_errors: dict[str, int] = {}
         self._inflight = 0
         self._loop_lag = LocalHistogram(LATENCY_BOUNDARIES_S)
+        # RPC survival state: per-peer circuit breakers plus retry/deadline
+        # counters (plain ints — mutated on the endpoint loop, folded into
+        # rpc_metric_snapshot like the rest of the service stats).
+        self._breakers: dict[Address, _Breaker] = {}
+        self._rpc_retries = 0
+        self._rpc_deadline_exceeded = 0
         self.address: Address | None = None
         self._started = threading.Event()
         self.on_connection_lost: Optional[Callable[[Connection], None]] = None
@@ -616,7 +892,9 @@ class Endpoint:
             daemon=True,
         )
         self._thread.start()
-        if not self._started.wait(timeout=30):
+        if not self._started.wait(
+            timeout=GLOBAL_CONFIG.endpoint_start_timeout_s
+        ):
             raise RpcError(f"endpoint {self.name} failed to start")
         assert self.address is not None
         return self.address
@@ -774,7 +1052,22 @@ class Endpoint:
         each report replaces the process's previous snapshot upstream, so
         cross-process merging keeps Prometheus semantics."""
         points: list = [
-            ["raytpu_rpc_inflight", dict(tags), float(self._inflight)]
+            ["raytpu_rpc_inflight", dict(tags), float(self._inflight)],
+            [
+                "raytpu_rpc_retries_total",
+                dict(tags),
+                float(self._rpc_retries),
+            ],
+            [
+                "raytpu_rpc_deadline_exceeded_total",
+                dict(tags),
+                float(self._rpc_deadline_exceeded),
+            ],
+            [
+                "raytpu_rpc_breaker_state",
+                dict(tags),
+                float(self.tripped_breakers()),
+            ],
         ]
         for method, h in list(self._method_hists.items()):
             points.append(
@@ -859,14 +1152,158 @@ class Endpoint:
             conn = Connection(
                 reader, writer, self._handle, on_close=self._conn_closed
             )
+            conn.peer_label = f"{addr[0]}:{addr[1]}"
             with self._stats_lock:
                 self._live_conns.add(conn)
             self._conns[addr] = conn
             return conn
 
-    async def acall(self, addr: Address, msg_type: str, payload: Any = None):
-        conn = await self.connect(addr)
-        return await conn.request(msg_type, payload)
+    # -- survival semantics ---------------------------------------------------
+
+    def peer_suspect(self, addr) -> bool:
+        """True while schedulers should stop placing work on this peer:
+        its circuit breaker is tripped and not yet probing half-open.
+        Self-healing by construction — once the reset interval elapses the
+        peer stops reading as suspect, the next call through acts as the
+        probe, and its outcome closes or re-trips the breaker."""
+        br = self._breakers.get(tuple(addr))
+        if br is None:
+            return False
+        return br.suspect(time.monotonic(), GLOBAL_CONFIG.rpc_breaker_reset_s)
+
+    # Entries untouched for this many reset windows are swept: success
+    # evicts (below), but a churned ephemeral peer (reaped worker, removed
+    # node) is never dialed again, so without a sweep its breaker — and an
+    # OPEN verdict in the tripped gauge, and the `_breakers` truthiness
+    # fast path in SuspectStamper — would leak for the life of the process.
+    _BREAKER_STALE_WINDOWS = 8
+
+    def _sweep_breakers(self, now: float) -> None:
+        stale = GLOBAL_CONFIG.rpc_breaker_reset_s * self._BREAKER_STALE_WINDOWS
+        dead = [
+            a for a, b in self._breakers.items() if now - b.touched > stale
+        ]
+        for a in dead:
+            del self._breakers[a]
+
+    def tripped_breakers(self) -> int:
+        # Metrics path: called once per report interval, so it doubles as
+        # the periodic sweep for processes with no new failures.
+        self._sweep_breakers(time.monotonic())
+        return sum(
+            1 for b in self._breakers.values() if b.state != _Breaker.CLOSED
+        )
+
+    def record_peer_failure(self, addr) -> None:
+        """Count one transport failure toward the peer's breaker (public:
+        the task layer reports conn losses it observes out-of-band)."""
+        now = time.monotonic()
+        self._sweep_breakers(now)
+        br = self._breakers.setdefault(tuple(addr), _Breaker())
+        br.failure(now, GLOBAL_CONFIG.rpc_breaker_threshold)
+
+    def _record_peer_success(self, addr) -> None:
+        # Evict rather than reset: healthy peers carry no entry at all, so
+        # _breakers is sized by peers CURRENTLY failing (not every address
+        # that ever blipped over a multi-week run) and the
+        # `if endpoint._breakers` fast-path gates in gcs/node re-arm once
+        # the cluster heals.
+        self._breakers.pop(addr, None)
+
+    async def acall(
+        self,
+        addr: Address,
+        msg_type: str,
+        payload: Any = None,
+        *,
+        deadline_s: float | None = None,
+        retries: int | None = None,
+    ):
+        """One RPC with survival semantics: per-call deadline (resolved
+        from the method class unless overridden), automatic jittered
+        exponential-backoff retry on TRANSPORT errors for allowlisted
+        idempotent methods, and a per-peer circuit breaker that fails fast
+        once the peer looks down. Application exceptions pass through
+        untouched and are never retried."""
+        addr = tuple(addr)
+        cfg = GLOBAL_CONFIG
+        if deadline_s is None:
+            deadline_s = method_deadline_s(msg_type)
+        if retries is None:
+            retries = cfg.rpc_max_retries if msg_type in IDEMPOTENT_RPCS else 0
+        attempt = 0
+        while True:
+            br = self._breakers.get(addr)
+            if br is not None and not br.allow(
+                time.monotonic(), cfg.rpc_breaker_reset_s
+            ):
+                raise PeerUnavailableError(
+                    f"peer {addr[0]}:{addr[1]} circuit breaker is open for "
+                    f"{msg_type} ({br.failures} consecutive transport "
+                    f"failures; half-opens {cfg.rpc_breaker_reset_s:g}s "
+                    f"after the trip)"
+                )
+            try:
+                conn = self._conns.get(addr)
+                if conn is None or conn.closed:
+                    conn = await asyncio.wait_for(
+                        self.connect(addr), cfg.rpc_connect_timeout_s
+                    )
+                result = await conn.request(
+                    msg_type, payload, timeout=deadline_s
+                )
+            except (
+                ConnectionLost,
+                ConnectionError,
+                OSError,
+                asyncio.TimeoutError,
+            ) as e:
+                if getattr(e, "_raytpu_remote", False):
+                    # The remote HANDLER raised this — a reply arrived, so
+                    # the transport works: an app error must close (not
+                    # wedge) a half-open probe, and never count as a
+                    # transport failure.
+                    self._record_peer_success(addr)
+                    raise
+                if isinstance(e, DeadlineExceededError):
+                    self._rpc_deadline_exceeded += 1
+                elif isinstance(e, asyncio.TimeoutError) and not isinstance(
+                    e, ConnectionError
+                ):
+                    # wait_for on the dial itself
+                    self._rpc_deadline_exceeded += 1
+                    e = DeadlineExceededError(
+                        f"connecting to {addr[0]}:{addr[1]} for {msg_type} "
+                        f"exceeded {cfg.rpc_connect_timeout_s:g}s"
+                    )
+                self.record_peer_failure(addr)
+                if attempt >= retries:
+                    raise e
+                attempt += 1
+                self._rpc_retries += 1
+                backoff = min(
+                    cfg.rpc_retry_backoff_s * (2 ** (attempt - 1)),
+                    cfg.rpc_retry_backoff_max_s,
+                )
+                # Full jitter keeps a gang of retriers from re-synchronizing
+                # into the very burst that tripped the peer.
+                await asyncio.sleep(backoff * (0.5 + random.random() * 0.5))
+            except BaseException as e:
+                # Application error or cancellation reached us outside the
+                # transport tuple. A reply-borne error proves the transport
+                # works (close any half-open probe); anything else carries
+                # no transport verdict — release a HALF_OPEN probe so the
+                # breaker can never wedge in that state.
+                if getattr(e, "_raytpu_remote", False):
+                    self._record_peer_success(addr)
+                else:
+                    br = self._breakers.get(addr)
+                    if br is not None:
+                        br.release()
+                raise
+            else:
+                self._record_peer_success(addr)
+                return result
 
     async def anotify(self, addr: Address, msg_type: str, payload: Any = None):
         conn = await self.connect(addr)
@@ -878,15 +1315,52 @@ class Endpoint:
         self, addr: Address, msg_type: str, payload: Any = None,
         timeout: float | None = None,
     ) -> Any:
+        """Sync facade. An EXPLICIT ``timeout`` is the caller's wall-clock
+        bound — it becomes the single attempt's deadline with NO automatic
+        retry, so the call returns or raises within ~timeout as it always
+        did. ``timeout=None`` resolves the deadline from the method class
+        and inherits the full survival semantics (deadline, idempotent
+        retry, breaker); the outer wait then backstops the worst-case
+        retried schedule."""
+        if timeout is not None:
+            deadline, retries = timeout, 0
+        else:
+            deadline = method_deadline_s(msg_type)
+            retries = (
+                GLOBAL_CONFIG.rpc_max_retries
+                if msg_type in IDEMPOTENT_RPCS
+                else 0
+            )
         fut = asyncio.run_coroutine_threadsafe(
-            self.acall(addr, msg_type, payload), self._loop
+            self.acall(
+                addr, msg_type, payload, deadline_s=deadline, retries=retries
+            ),
+            self._loop,
         )
-        return fut.result(timeout=timeout)
+        outer = None
+        if timeout is not None:
+            # Explicit caller bound: hard wall clock, dial included — the
+            # pre-deadline-tier `.result(timeout=X)` contract.
+            outer = timeout + 5.0
+        elif deadline and deadline > 0:
+            # Classification path: each attempt may spend up to the connect
+            # timeout DIALING before its request deadline starts; the
+            # backstop must cover the full retried schedule or it fires
+            # while acall legitimately runs (raising a bare TimeoutError
+            # and orphaning the coroutine).
+            outer = (
+                (deadline + GLOBAL_CONFIG.rpc_connect_timeout_s)
+                * (retries + 1)
+                + GLOBAL_CONFIG.rpc_retry_backoff_max_s * retries
+                + 5.0
+            )
+        return fut.result(timeout=outer)
 
     def notify_sync(self, addr: Address, msg_type: str, payload: Any = None):
+        t = GLOBAL_CONFIG.rpc_deadline_s
         asyncio.run_coroutine_threadsafe(
             self.anotify(addr, msg_type, payload), self._loop
-        ).result(timeout=30)
+        ).result(timeout=t if t > 0 else None)  # <=0 = deadlines disabled
 
     def submit(self, coro) -> "asyncio.Future":
         """Run a coroutine on the endpoint loop from any thread."""

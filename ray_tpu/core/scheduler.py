@@ -159,6 +159,39 @@ class NodeView:
     available: dict = field(default_factory=dict)
     labels: dict = field(default_factory=dict)
     alive: bool = True
+    # Circuit-breaker verdict (stamped by the holder of the view from its
+    # endpoint's per-peer breakers before scheduling decisions): a suspect
+    # node gets NO new placements, but — unlike dead — still counts as
+    # feasible, so demand queues and retries instead of hard-failing while
+    # the breaker waits to half-open.
+    suspect: bool = False
+
+
+class SuspectStamper:
+    """Refreshes node views' ``suspect`` flags from breaker verdicts
+    before a placement decision (``pick_node`` skips suspects;
+    ``any_feasible`` deliberately does not, so demand queues rather than
+    hard-failing). Healthy peers carry no breaker entry at all (success
+    evicts), so ``has_verdicts`` goes falsy once the cluster heals — one
+    clearing sweep resets the stale flags, and every stamp after that
+    costs a single truthiness check."""
+
+    __slots__ = ("_has_verdicts", "_verdict", "_stamped")
+
+    def __init__(self, has_verdicts, verdict):
+        self._has_verdicts = has_verdicts  # () -> bool: any breaker state
+        self._verdict = verdict  # (addr) -> bool: peer currently suspect
+        self._stamped = False
+
+    def stamp(self, views) -> None:
+        if self._has_verdicts():
+            for v in views:
+                v.suspect = self._verdict(v.addr)
+            self._stamped = True
+        elif self._stamped:
+            for v in views:
+                v.suspect = False
+            self._stamped = False
 
 
 @dataclass
@@ -193,6 +226,7 @@ def pick_node(
         if (
             view is not None
             and view.alive
+            and not view.suspect
             and fits(view.available, req.resources)
             and labels_match(view.labels, req.label_selector)
         ):
@@ -205,6 +239,7 @@ def pick_node(
         v
         for v in views.values()
         if v.alive
+        and not v.suspect
         and labels_match(v.labels, req.label_selector)
         and fits(v.available, req.resources)
     ]
@@ -237,6 +272,9 @@ def pick_node(
 
 
 def any_feasible(req: SchedulingRequest, views: Mapping[str, NodeView]) -> bool:
+    # Deliberately IGNORES `suspect`: a breaker-tripped node is still
+    # feasible — demand should queue/retry until the breaker half-opens,
+    # not hard-fail with "no feasible node".
     return any(
         v.alive
         and labels_match(v.labels, req.label_selector)

@@ -30,12 +30,12 @@ import threading
 import time
 import uuid
 
+from ray_tpu.core import faults
+
 _HDR = struct.Struct("<QQQ")  # seq, ack, len
 _U64 = struct.Struct("<Q")
 _OFF_SEQ, _OFF_ACK, _OFF_LEN = 0, 8, 16
 _SPIN_S = 0.0002
-# Chaos knob for scheduling tests: per-read simulated transfer latency.
-_READ_DELAY_S = float(os.environ.get("RAY_TPU_DAG_READ_DELAY_MS", "0")) / 1e3
 
 
 class ChannelTimeout(Exception):
@@ -135,11 +135,12 @@ class ShmChannel:
             time.sleep(_SPIN_S)
         value = pickle.loads(self._mm[_HDR.size : _HDR.size + ln])
         _U64.pack_into(self._mm, _OFF_ACK, seq)  # reader owns ack only
-        if _READ_DELAY_S > 0.0:
-            # Chaos knob — no-op in production (env unset): simulated
-            # transfer latency, so scheduling tests can prove the overlap
-            # pass hides read cost without multi-GB payloads.
-            time.sleep(_READ_DELAY_S)
+        # Chaos hook (chan.read_delay) — no-op in production (injector
+        # off): simulated transfer latency, so scheduling tests can prove
+        # the overlap pass hides read cost without multi-GB payloads.
+        # RAY_TPU_FAULTS="0:chan.read_delay,ms=30" replaces the old
+        # RAY_TPU_DAG_READ_DELAY_MS knob — ONE injection mechanism.
+        faults.sleep_if_delayed("chan", self.path)
         return value
 
     def close(self, unlink: bool = False) -> None:
@@ -306,7 +307,9 @@ class RpcChannel:
             raise RuntimeError("write-end of an RpcChannel cannot read")
         if self._closed:
             raise ChannelClosed(self.chan_id)
-        return pickle.loads(self._box.take(timeout))
+        value = pickle.loads(self._box.take(timeout))
+        faults.sleep_if_delayed("chan", self.chan_id)  # chaos hook
+        return value
 
     def close(self, unlink: bool = False) -> None:
         if self._closed:

@@ -144,3 +144,44 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+# -- condition polling --------------------------------------------------------
+# THE wait helper for distributed assertions: poll a predicate instead of a
+# fixed sleep (fixed sleeps are exactly long enough to flake on a loaded
+# box and exactly short enough to waste time on an idle one). Returns the
+# predicate's first truthy value so callers can assert on it.
+
+
+def wait_for_condition(pred, timeout: float = 20.0, interval: float = 0.05):
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        _time.sleep(interval)
+    raise TimeoutError(f"condition not met within {timeout}s: {pred}")
+
+
+@pytest.fixture
+def wait_for():
+    return wait_for_condition
+
+
+def add_node_and_wait(runtime, wait_for, resources):
+    """Add a node and poll until THIS node's id shows alive in the head's
+    gossiped view (a fixed post-add sleep flakes both ways on a loaded
+    box; matching on a resource marker instead of the id can be satisfied
+    by a just-killed node's stale still-alive view in the
+    kill-then-re-add pattern)."""
+    node = runtime.add_node(dict(resources))
+    wait_for(
+        lambda: (
+            (v := runtime.head.cluster_view.get(node.node_id)) is not None
+            and v.alive
+        ),
+        timeout=30.0,
+    )
+    return node

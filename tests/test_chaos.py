@@ -1,0 +1,554 @@
+"""Chaos suite: the deterministic fault-injection plane + RPC survival
+semantics (deadlines, idempotent retry with backoff, per-peer circuit
+breakers, node-suspect scheduling) under real workloads.
+
+Reference parity: the reference's ResourceKiller/chaos tests
+(python/ray/_private/test_utils.py:1412) and gRPC deadline/retry policy,
+redesigned around a seeded schedule so every chaos failure replays
+bit-identically from its seed (RAY_TPU_FAULTS / faults.install).
+
+Heavy randomized sweeps live behind @pytest.mark.slow (tools/chaos.py runs
+the full schedule sweep); the tier-1 cases here are seeded, probability-1
+or low-iteration schedules that stay deterministic and fast.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from conftest import add_node_and_wait
+from ray_tpu.core import faults
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import DeadlineExceededError, PeerUnavailableError
+from ray_tpu.core.faults import FaultInjector, FaultRule
+from ray_tpu.core.protocol import Endpoint
+
+_CFG_FIELDS = (
+    "rpc_deadline_s",
+    "rpc_heartbeat_deadline_s",
+    "rpc_data_deadline_s",
+    "rpc_slow_deadline_s",
+    "rpc_max_retries",
+    "rpc_retry_backoff_s",
+    "rpc_retry_backoff_max_s",
+    "rpc_breaker_threshold",
+    "rpc_breaker_reset_s",
+    "node_death_timeout_s",
+    "node_heartbeat_interval_s",
+    "verify_transfers",
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    """Every test leaves the process chaos-free and config-clean."""
+    saved = {f: getattr(GLOBAL_CONFIG, f) for f in _CFG_FIELDS}
+    yield
+    faults.clear()
+    for f, v in saved.items():
+        setattr(GLOBAL_CONFIG, f, v)
+
+
+# -- the injector itself ------------------------------------------------------
+
+
+def test_spec_parsing_and_validation():
+    inj = faults.parse_env("42:send.delay,p=0.5,ms=20,match=worker.*;recv.dup")
+    assert inj.seed == 42 and len(inj.rules) == 2
+    r = inj.rules[0]
+    assert (r.site, r.action, r.prob, r.delay_s) == ("send", "delay", 0.5, 0.02)
+    assert r.match == "worker.*"
+    assert faults.parse_rule("send.delay,ms=inf").delay_s == faults.INF
+    with pytest.raises(ValueError):
+        faults.parse_rule("bogus.action")
+    with pytest.raises(ValueError):
+        faults.parse_rule("send.kill_worker")  # action/site mismatch
+    with pytest.raises(ValueError):
+        faults.parse_rule("send.drop,wat=1")
+    with pytest.raises(ValueError):
+        faults.parse_env("no-seed-separator")
+
+
+def test_seeded_schedule_replays_bit_identically():
+    spec = "send.delay,p=0.3,ms=5;recv.drop,p=0.2,match=$reply"
+    pattern = [
+        ("send", "worker.push_task"),
+        ("recv", "$reply"),
+        ("send", "gcs.kv_get"),
+        ("recv", "node.request_lease"),
+    ] * 250
+
+    def run(seed):
+        inj = faults.parse_spec(seed, spec)
+        out = []
+        for site, name in pattern:
+            rule = inj.decide(site, name)
+            out.append(None if rule is None else f"{rule.site}.{rule.action}")
+        return out
+
+    a, b = run(7), run(7)
+    assert a == b, "same seed must replay the exact same schedule"
+    assert any(a), "schedule fired at least once"
+    assert run(8) != a, "a different seed produces a different schedule"
+
+
+def test_rule_count_after_and_peer_matching():
+    inj = FaultInjector(
+        1,
+        [
+            FaultRule(
+                site="send", action="drop", count=2, after=1,
+                peer="10.0.0.1:*",
+            )
+        ],
+    )
+    hits = [
+        inj.decide("send", "x", peer="10.0.0.1:4444") is not None
+        for _ in range(5)
+    ]
+    # first opportunity skipped (after=1), then 2 fires (count=2), then dry
+    assert hits == [False, True, True, False, False]
+    assert inj.decide("send", "x", peer="10.0.0.2:4444") is None
+    assert inj.stats()[0]["fired"] == 2
+
+
+# -- RPC survival semantics (endpoint pair, no cluster) -----------------------
+
+
+@pytest.fixture
+def endpoint_pair():
+    server = Endpoint("chaos-server")
+
+    async def echo(conn, p):
+        return p
+
+    server.register("svc.echo", echo)
+    server.register("worker.ping", echo)  # an allowlisted idempotent method
+    saddr = server.start()
+    client = Endpoint("chaos-client")
+    client.start()
+    yield client, server, saddr
+    client.stop()
+    server.stop()
+
+
+def _fast_rpc_config():
+    GLOBAL_CONFIG.rpc_deadline_s = 0.3
+    GLOBAL_CONFIG.rpc_max_retries = 2
+    GLOBAL_CONFIG.rpc_retry_backoff_s = 0.01
+    GLOBAL_CONFIG.rpc_retry_backoff_max_s = 0.05
+    GLOBAL_CONFIG.rpc_breaker_threshold = 3
+    GLOBAL_CONFIG.rpc_breaker_reset_s = 0.6
+
+
+def test_hung_peer_fails_within_deadline_then_breaker_fails_fast(
+    endpoint_pair,
+):
+    """THE acceptance scenario: an injected infinite frame delay (hung
+    peer) that previously wedged acall forever now (1) fails within the
+    configured deadline, (2) trips the per-peer breaker after N consecutive
+    transport errors, (3) fails fast while the breaker is open, and (4)
+    recovers through the half-open probe once the fault clears. Seeded,
+    probability-1 schedule: replays identically every run."""
+    client, server, saddr = endpoint_pair
+    _fast_rpc_config()
+    # sanity: the path works before chaos
+    assert client.call(saddr, "svc.echo", {"x": 1}) == {"x": 1}
+
+    faults.install(
+        FaultInjector(
+            42,
+            [FaultRule(site="send", action="delay", delay_s=faults.INF,
+                       match="svc.echo")],
+        )
+    )
+    # (1)+(2): three calls, each bounded by the 0.3s deadline (not forever)
+    for i in range(3):
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceededError):
+            client.call(saddr, "svc.echo", {"i": i})
+        dt = time.monotonic() - t0
+        assert 0.2 <= dt < 2.0, f"deadline not enforced (took {dt:.2f}s)"
+    assert client._rpc_deadline_exceeded == 3
+    assert client.tripped_breakers() == 1
+    assert client.peer_suspect(saddr)
+
+    # (3): open breaker fails fast — no deadline burned
+    t0 = time.monotonic()
+    with pytest.raises(PeerUnavailableError):
+        client.call(saddr, "svc.echo", {})
+    assert time.monotonic() - t0 < 0.15
+
+    # (4): clear the fault, wait out the reset window, half-open heals
+    faults.clear()
+    time.sleep(GLOBAL_CONFIG.rpc_breaker_reset_s + 0.05)
+    assert not client.peer_suspect(saddr)
+    assert client.call(saddr, "svc.echo", {"back": True}) == {"back": True}
+    assert client.tripped_breakers() == 0
+
+
+def test_idempotent_rpc_retries_through_transient_blackhole(endpoint_pair):
+    client, server, saddr = endpoint_pair
+    _fast_rpc_config()
+    # the first two attempts vanish; the third gets through — an
+    # allowlisted method retries its way to success automatically
+    faults.install(
+        FaultInjector(
+            9,
+            [FaultRule(site="send", action="drop", match="worker.ping",
+                       count=2)],
+        )
+    )
+    assert client.call(saddr, "worker.ping", {"n": 5}) == {"n": 5}
+    assert client._rpc_retries == 2
+    assert client._rpc_deadline_exceeded == 2
+    assert client.tripped_breakers() == 0  # success reset the count
+
+    # a NON-allowlisted method gets no retry: one attempt, one error
+    faults.install(
+        FaultInjector(
+            9,
+            [FaultRule(site="send", action="drop", match="svc.echo",
+                       count=1)],
+        )
+    )
+    retries_before = client._rpc_retries
+    with pytest.raises(DeadlineExceededError):
+        client.call(saddr, "svc.echo", {})
+    assert client._rpc_retries == retries_before
+
+
+def test_half_open_probe_app_error_closes_breaker(endpoint_pair):
+    """An application error carried by a reply PROVES the transport works:
+    a half-open probe that gets one must close the breaker (a wedged
+    HALF_OPEN state would brick the peer forever), and it never counts as
+    a transport failure."""
+    client, server, saddr = endpoint_pair
+    _fast_rpc_config()
+
+    async def boom(conn, p):
+        raise ValueError("app-level")
+
+    server.register("svc.boom", boom)
+    faults.install(
+        FaultInjector(
+            2, [FaultRule(site="send", action="drop", match="svc.boom")]
+        )
+    )
+    for _ in range(3):
+        with pytest.raises(DeadlineExceededError):
+            client.call(saddr, "svc.boom", {})
+    assert client.tripped_breakers() == 1
+    faults.clear()
+    time.sleep(GLOBAL_CONFIG.rpc_breaker_reset_s + 0.05)
+    with pytest.raises(ValueError, match="app-level"):
+        client.call(saddr, "svc.boom", {})
+    assert client.tripped_breakers() == 0
+    assert client.call(saddr, "svc.echo", {"x": 1}) == {"x": 1}
+
+
+def test_severed_connection_surfaces_and_breaker_counts(endpoint_pair):
+    client, server, saddr = endpoint_pair
+    _fast_rpc_config()
+    assert client.call(saddr, "svc.echo", {}) == {}
+    faults.install(
+        FaultInjector(
+            3,
+            [FaultRule(site="send", action="sever", match="svc.echo",
+                       count=1)],
+        )
+    )
+    from ray_tpu.core.protocol import ConnectionLost
+
+    with pytest.raises(ConnectionLost):
+        client.call(saddr, "svc.echo", {})
+    faults.clear()
+    # redial on the next call works and closes the failure streak
+    assert client.call(saddr, "svc.echo", {"ok": 1}) == {"ok": 1}
+    assert client.tripped_breakers() == 0
+
+
+def test_recv_side_drop_and_dup_replies(endpoint_pair):
+    client, server, saddr = endpoint_pair
+    _fast_rpc_config()
+    # dropped replies: the request reaches the server, the reply vanishes
+    # on the client's read side — same deadline discipline applies
+    faults.install(
+        FaultInjector(
+            5,
+            [FaultRule(site="recv", action="drop", match="$reply", count=1)],
+        )
+    )
+    with pytest.raises(DeadlineExceededError):
+        client.call(saddr, "svc.echo", {})
+    # duplicated replies: the second copy finds no pending future and is
+    # discarded — no crash, no cross-talk
+    faults.install(
+        FaultInjector(
+            5,
+            [FaultRule(site="recv", action="dup", match="$reply")],
+        )
+    )
+    for i in range(5):
+        assert client.call(saddr, "svc.echo", {"i": i}) == {"i": i}
+
+
+def test_stale_breaker_entries_swept(endpoint_pair):
+    """Breakers for peers that never come back (reaped workers, removed
+    nodes) must not accumulate for the life of the process: success evicts,
+    and entries untouched for several reset windows are swept — so the
+    tripped gauge reads peers CURRENTLY failing, not every address that
+    ever blipped."""
+    client, server, saddr = endpoint_pair
+    GLOBAL_CONFIG.rpc_breaker_threshold = 2
+    GLOBAL_CONFIG.rpc_breaker_reset_s = 0.02
+    dead_addr = ("127.0.0.1", 1)  # an ephemeral peer that never dials again
+    for _ in range(2):
+        client.record_peer_failure(dead_addr)
+    assert client.tripped_breakers() == 1
+    # past _BREAKER_STALE_WINDOWS reset windows with no caller interest
+    time.sleep(GLOBAL_CONFIG.rpc_breaker_reset_s
+               * Endpoint._BREAKER_STALE_WINDOWS + 0.1)
+    assert client.tripped_breakers() == 0
+    assert dead_addr not in client._breakers
+
+
+# -- cluster-level chaos ------------------------------------------------------
+
+
+@pytest.fixture
+def chaos_cluster():
+    runtime = ray_tpu.init(num_cpus=2)
+    yield runtime
+    faults.clear()  # before shutdown: teardown RPCs must flow clean
+    ray_tpu.shutdown()
+
+
+def test_suspect_node_stops_taking_leases_then_heals(chaos_cluster, wait_for):
+    """Hung-peer lease path end to end: the driver's lease RPCs to a
+    blackholed node deadline out and trip its breaker; the home node is
+    told the peer is suspect and stops spilling leases there (no exception
+    storm — unrelated work keeps flowing); when the fault clears, the
+    half-open probe lands the queued task on the recovered node."""
+    runtime = chaos_cluster
+    node2 = add_node_and_wait(runtime, wait_for, {"CPU": 1.0, "two": 1.0})
+
+    @ray_tpu.remote(resources={"two": 1.0}, num_cpus=0)
+    def on_two():
+        return "ok"
+
+    @ray_tpu.remote
+    def local(x):
+        return x + 1
+
+    # sanity: both nodes take work before chaos (under default deadlines —
+    # a COLD worker spawn is slower than the aggressive test deadlines
+    # below, which only the fault window should use; these warm the pools)
+    assert ray_tpu.get(on_two.remote(), timeout=60) == "ok"
+    assert ray_tpu.get(local.remote(0), timeout=60) == 1
+
+    GLOBAL_CONFIG.rpc_slow_deadline_s = 1.0
+    GLOBAL_CONFIG.rpc_max_retries = 1
+    GLOBAL_CONFIG.rpc_retry_backoff_s = 0.02
+    GLOBAL_CONFIG.rpc_retry_backoff_max_s = 0.05
+    GLOBAL_CONFIG.rpc_breaker_threshold = 2
+    GLOBAL_CONFIG.rpc_breaker_reset_s = 1.0
+
+    from ray_tpu.core import api as core_api
+
+    driver = core_api._require_worker().endpoint
+    n2 = node2.endpoint.address
+    faults.install(
+        FaultInjector(
+            11,
+            [FaultRule(site="send", action="drop",
+                       match="node.request_lease*",
+                       peer=f"{n2[0]}:{n2[1]}")],
+        )
+    )
+    ref = on_two.remote()
+    # the driver's direct lease RPCs to node2 deadline out -> breaker trips
+    wait_for(lambda: driver.tripped_breakers() >= 1, timeout=30.0)
+    # ...and the home node's scheduler learns the suspicion
+    wait_for(lambda: bool(runtime.head._suspect_until), timeout=30.0)
+    # Spill-target lease attempts are single-shot (the home-failover loop
+    # is their retry, so the lease budget can't be burned re-dialing a
+    # wedged peer); the breaker needs rpc_breaker_threshold=2 consecutive
+    # failures to trip, so two attempts deadlined to get here. Transport-
+    # level retry is covered by
+    # test_idempotent_rpc_retries_through_transient_blackhole.
+    assert driver._rpc_deadline_exceeded >= 2
+    # graceful degradation, not an error storm: unrelated work still flows
+    assert ray_tpu.get(local.remote(41), timeout=60) == 42
+    # heal: clear the fault; the half-open probe re-opens the lease path
+    faults.clear()
+    assert ray_tpu.get(ref, timeout=90) == "ok"
+
+
+def test_abandoned_lease_batch_returns_granted_leases(
+    chaos_cluster, wait_for
+):
+    """A request_lease_batch reply nobody will consume (the client
+    deadlined and abandoned the req_id, as _acquire_batch_and_run does)
+    must not leak the wave: cancel_lease_request returns EVERY granted
+    entry, restoring the node's resources."""
+    runtime = chaos_cluster
+    from ray_tpu.core import api as core_api
+
+    driver = core_api._require_worker().endpoint
+    head = runtime.head
+    addr = tuple(head.endpoint.address)
+    base_cpu = head.available["CPU"]
+    req_id = "batch-orphan-req"
+    replies = driver.call(
+        addr,
+        "node.request_lease_batch",
+        {"resources": {"CPU": 1.0}, "count": 2, "req_id": req_id},
+        timeout=60.0,
+    )
+    granted = [r for r in replies if isinstance(r, dict) and "lease_id" in r]
+    assert granted, replies
+    assert head.available["CPU"] < base_cpu
+    # The abandon path: no caller ever consumes the cached reply, so the
+    # cancel's orphan-return must free each granted lease.
+    assert driver.call(
+        addr, "node.cancel_lease_request", {"req_id": req_id}, timeout=30.0
+    )
+    wait_for(lambda: head.available["CPU"] == base_cpu, timeout=30.0)
+
+
+def test_gcs_heartbeat_blackhole_partitions_then_reregisters(
+    chaos_cluster, wait_for
+):
+    """A heartbeat blackhole (simulated partition) gets the node declared
+    dead; when the partition heals, the heartbeat's False reply drives
+    re-registration and the node serves work again."""
+    GLOBAL_CONFIG.node_death_timeout_s = 1.5
+    GLOBAL_CONFIG.node_heartbeat_interval_s = 0.3
+    runtime = chaos_cluster
+    node2 = add_node_and_wait(runtime, wait_for, {"CPU": 1.0, "two": 1.0})
+    gcs = runtime.gcs
+    faults.install(
+        FaultInjector(
+            21,
+            [FaultRule(site="gcs", action="heartbeat_blackhole",
+                       match=node2.node_id)],
+        )
+    )
+    wait_for(
+        lambda: not gcs.nodes[node2.node_id].alive, timeout=20.0
+    )
+    faults.clear()
+    wait_for(
+        lambda: node2.node_id in gcs.nodes and gcs.nodes[node2.node_id].alive,
+        timeout=20.0,
+    )
+
+    @ray_tpu.remote(resources={"two": 1.0}, num_cpus=0)
+    def back():
+        return "alive"
+
+    assert ray_tpu.get(back.remote(), timeout=60) == "alive"
+
+
+def test_pull_corruption_detected_and_reconstructed(chaos_cluster, wait_for):
+    """A corrupted transfer chunk (store.pull_corrupt) fails the pull via
+    the transfer fingerprint; the owner drops the location and lineage
+    reconstruction re-runs the producer — the consumer still converges to
+    the correct value."""
+    GLOBAL_CONFIG.verify_transfers = True
+    runtime = chaos_cluster
+    add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "two": 1.0})
+
+    @ray_tpu.remote(resources={"two": 1.0}, num_cpus=1)
+    def produce():
+        return np.full((2 << 20,), 9, np.uint8)
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    inj = faults.install(
+        FaultInjector(
+            33,
+            [FaultRule(site="store", action="pull_corrupt", count=1)],
+        )
+    )
+    out = ray_tpu.get(ref, timeout=120)
+    assert out.shape == (2 << 20,) and int(out[0]) == 9
+    assert inj.rules[0].fired == 1, "the corruption actually happened"
+
+
+def test_chaos_task_wave_converges(chaos_cluster):
+    """Task waves under a seeded schedule of frame delays + duplicated
+    replies converge to exact results."""
+    GLOBAL_CONFIG.rpc_retry_backoff_s = 0.01
+    faults.install(
+        faults.parse_spec(
+            123, "send.delay,p=0.2,ms=10;recv.dup,p=0.2,match=$reply"
+        )
+    )
+
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    out = ray_tpu.get([sq.remote(i) for i in range(40)], timeout=120)
+    assert out == [i * i for i in range(40)]
+
+
+def test_chaos_actor_calls_converge(chaos_cluster):
+    """Pipelined actor calls under frame/reply delays keep exactly-once,
+    in-order semantics (the executor's seq buffer absorbs the reordering
+    the injected delays produce)."""
+    faults.install(
+        faults.parse_spec(
+            7, "send.delay,p=0.3,ms=5;recv.delay,p=0.3,ms=5,match=$reply"
+        )
+    )
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    out = ray_tpu.get([c.bump.remote() for _ in range(15)], timeout=120)
+    assert out == list(range(1, 16))
+
+
+@pytest.mark.slow
+def test_chaos_worker_kill_wave_converges(chaos_cluster):
+    """Randomized (seeded) worker kills mid-task: the reap-and-retry path
+    re-runs victims until the whole wave converges."""
+    faults.install(
+        faults.parse_spec(99, "node.kill_worker,p=0.4,count=6")
+    )
+
+    @ray_tpu.remote(max_retries=10)
+    def slow_sq(x):
+        time.sleep(0.3)
+        return x * x
+
+    out = ray_tpu.get([slow_sq.remote(i) for i in range(12)], timeout=180)
+    assert out == [i * i for i in range(12)]
+
+
+@pytest.mark.slow
+def test_chaos_data_pipeline_converges(chaos_cluster):
+    """A real data-pipeline workload (range -> map -> take_all) under
+    frame delays and duplicated replies still produces exact results."""
+    import ray_tpu.data as rd
+
+    faults.install(
+        faults.parse_spec(
+            55, "send.delay,p=0.15,ms=8;recv.dup,p=0.15,match=$reply"
+        )
+    )
+    ds = rd.range(64, parallelism=4).map(lambda r: {"y": r["id"] * 2})
+    out = sorted(r["y"] for r in ds.take_all())
+    assert out == [i * 2 for i in range(64)]

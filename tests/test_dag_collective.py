@@ -145,13 +145,13 @@ def _run_pipelined(compiled, n, window=3):
 
 def _wire_pair():
     """Consumer actor with 30ms simulated per-read transfer latency (the
-    RAY_TPU_DAG_READ_DELAY_MS chaos knob — the stand-in for device pulls
-    / big-tensor deserialization, injected via runtime_env so only the
-    consumer's reads pay it)."""
+    chan.read_delay rule of the fault-injection plane — the stand-in for
+    device pulls / big-tensor deserialization, injected via runtime_env
+    so only the consumer's reads pay it)."""
     a = WireStage.options(num_cpus=0).remote()
     b = WireStage.options(
         num_cpus=0,
-        runtime_env={"env_vars": {"RAY_TPU_DAG_READ_DELAY_MS": "30"}},
+        runtime_env={"env_vars": {"RAY_TPU_FAULTS": "0:chan.read_delay,ms=30"}},
     ).remote()
     ray_tpu.get([a.produce.remote(0), b.produce.remote(0)])  # ready
     return a, b

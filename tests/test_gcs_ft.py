@@ -6,7 +6,6 @@ an sqlite-WAL store (no external redis daemon).
 """
 
 import pickle
-import time
 
 import pytest
 
@@ -38,17 +37,7 @@ def test_in_memory_store_is_default():
     g.store.close()
 
 
-def _wait(pred, timeout=20.0, interval=0.2):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        v = pred()
-        if v:
-            return v
-        time.sleep(interval)
-    raise TimeoutError("condition not met")
-
-
-def test_gcs_restart_preserves_state_and_cluster_recovers(tmp_path):
+def test_gcs_restart_preserves_state_and_cluster_recovers(tmp_path, wait_for):
     GLOBAL_CONFIG.gcs_storage_path = str(tmp_path / "gcs.db")
     try:
         runtime = ray_tpu.init(num_cpus=8)
@@ -76,7 +65,22 @@ def test_gcs_restart_preserves_state_and_cluster_recovers(tmp_path):
         old_addr = runtime.gcs_addr
         session = runtime.session_id
         runtime.gcs.stop()
-        time.sleep(0.5)
+
+        def port_free():
+            import socket
+
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                # Match asyncio.start_server's bind semantics: TIME_WAIT
+                # remnants of the old GCS's connections don't block it.
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(old_addr)
+                s.close()
+                return True
+            except OSError:
+                return False
+
+        wait_for(port_free, timeout=10.0)
         new_gcs = GcsServer(session)
         # Adopted the persisted session id from storage.
         assert new_gcs.session_id == session
@@ -85,7 +89,7 @@ def test_gcs_restart_preserves_state_and_cluster_recovers(tmp_path):
         runtime.gcs = new_gcs
 
         # KV survived the restart.
-        assert _wait(
+        assert wait_for(
             lambda: w.gcs.kv_get("durable_key", ns="test") == b"durable_value"
         )
         # Actor table survived: the name resolves and the handle reaches the
@@ -94,7 +98,7 @@ def test_gcs_restart_preserves_state_and_cluster_recovers(tmp_path):
         assert ray_tpu.get(h.bump.remote()) == 2
 
         # The node re-registered on its next heartbeat: new work schedules.
-        _wait(lambda: len(new_gcs.nodes) >= 1)
+        wait_for(lambda: len(new_gcs.nodes) >= 1)
 
         @ray_tpu.remote
         def after_restart(x):

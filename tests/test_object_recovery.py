@@ -6,12 +6,11 @@ resubmit on lost copies), src/ray/raylet/local_object_manager.h:44
 (python/ray/_private/test_utils.py:1412).
 """
 
-import time
-
 import numpy as np
 import pytest
 
 import ray_tpu
+from conftest import add_node_and_wait
 from ray_tpu.core.errors import ObjectLostError
 
 
@@ -22,12 +21,22 @@ def fresh_cluster():
     ray_tpu.shutdown()
 
 
-def test_lineage_reconstruction_after_node_death(fresh_cluster):
+def _die_silently_and_wait(node, wait_for):
+    """Abrupt node death; polls until its endpoint thread is actually gone
+    so later pulls deterministically hit a dead address."""
+    node.die_silently()
+    wait_for(
+        lambda: node.endpoint._thread is None
+        or not node.endpoint._thread.is_alive(),
+        timeout=15.0,
+    )
+
+
+def test_lineage_reconstruction_after_node_death(fresh_cluster, wait_for):
     """A large object whose ONLY copy dies with its node is transparently
     reconstructed by resubmitting the producing task."""
     runtime = fresh_cluster
-    node2 = runtime.add_node({"CPU": 2.0, "doomed": 1.0})
-    time.sleep(0.5)
+    node2 = add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "doomed": 1.0})
 
     @ray_tpu.remote(resources={"doomed": 1.0}, num_cpus=1)
     def produce():
@@ -38,24 +47,21 @@ def test_lineage_reconstruction_after_node_death(fresh_cluster):
     # Wait until the object exists (don't fetch: fetching would copy it to
     # the head node and defeat the loss scenario).
     ray_tpu.wait([ref], num_returns=1, timeout=60)
-    node2.die_silently()
-    time.sleep(0.5)
+    _die_silently_and_wait(node2, wait_for)
 
     # The only copy is gone; the resubmitted task has no feasible node for
     # {"doomed": 1} until we add one — prove reconstruction re-runs rather
     # than reading a stale copy by re-adding capacity.
-    runtime.add_node({"CPU": 2.0, "doomed": 1.0})
-    time.sleep(0.5)
+    add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "doomed": 1.0})
     out = ray_tpu.get(ref, timeout=120)
     assert out.shape == (1 << 20,) and int(out[0]) == 7
 
 
-def test_lineage_reconstruction_from_borrower(fresh_cluster):
+def test_lineage_reconstruction_from_borrower(fresh_cluster, wait_for):
     """A borrower (another task) triggers owner-side reconstruction when its
     pull of the only copy fails."""
     runtime = fresh_cluster
-    node2 = runtime.add_node({"CPU": 2.0, "doomed": 1.0})
-    time.sleep(0.5)
+    node2 = add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "doomed": 1.0})
 
     @ray_tpu.remote(resources={"doomed": 1.0}, num_cpus=1)
     def produce():
@@ -67,29 +73,25 @@ def test_lineage_reconstruction_from_borrower(fresh_cluster):
 
     ref = produce.remote()
     ray_tpu.wait([ref], num_returns=1, timeout=60)
-    node2.die_silently()
-    time.sleep(0.5)
-    runtime.add_node({"CPU": 2.0, "doomed": 1.0})
-    time.sleep(0.5)
+    _die_silently_and_wait(node2, wait_for)
+    add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "doomed": 1.0})
     assert ray_tpu.get(consume.remote(ref), timeout=120) == 6
 
 
-def test_put_object_lost_is_terminal(fresh_cluster):
+def test_put_object_lost_is_terminal(fresh_cluster, wait_for):
     """put() objects have no lineage: losing the only copy surfaces
     ObjectLostError instead of hanging."""
     runtime = fresh_cluster
 
     # Put on a worker on a doomed node, return the ref to the driver.
-    node2 = runtime.add_node({"CPU": 2.0, "doomed": 1.0})
-    time.sleep(0.5)
+    node2 = add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "doomed": 1.0})
 
     @ray_tpu.remote(resources={"doomed": 1.0}, num_cpus=1)
     def put_there():
         return ray_tpu.put(np.zeros(1 << 20, np.uint8))
 
     inner = ray_tpu.get(put_there.remote(), timeout=60)
-    node2.die_silently()
-    time.sleep(0.5)
+    _die_silently_and_wait(node2, wait_for)
     with pytest.raises(ObjectLostError):
         ray_tpu.get(inner, timeout=30)
 

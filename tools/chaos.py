@@ -1,0 +1,180 @@
+"""Chaos runner: sweep seeded fault schedules over real workloads.
+
+The full-schedule counterpart of tests/test_chaos.py's CI tier (the heavy
+cases there are @pytest.mark.slow): for each seed, install the injector,
+run every selected workload on a fresh in-process cluster, and verify the
+results are EXACTLY correct — chaos may slow the runtime down, never make
+it wrong. A failing seed is a repro: the same seed + spec replays the same
+schedule (see ray_tpu/core/faults.py).
+
+    python tools/chaos.py --seeds 0:5
+    python tools/chaos.py --seeds 7 --spec "send.delay,p=0.3,ms=15;recv.dup,p=0.2,match=\\$reply"
+    python tools/chaos.py --seeds 0:3 --workloads tasks,actors,kills
+
+Exit status: number of failing seeds (0 = all schedules converged).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_SPEC = (
+    "send.delay,p=0.2,ms=10;"
+    "recv.dup,p=0.2,match=$reply;"
+    "node.kill_worker,p=0.2,count=4"
+)
+
+
+def wl_tasks():
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=10)
+    def sq(x):
+        return x * x
+
+    out = ray_tpu.get([sq.remote(i) for i in range(40)], timeout=180)
+    assert out == [i * i for i in range(40)], out
+
+
+def wl_actors():
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    out = ray_tpu.get([c.bump.remote() for _ in range(20)], timeout=180)
+    assert out == list(range(1, 21)), out
+
+
+def wl_objects():
+    import numpy as np
+
+    import ray_tpu
+
+    blobs = [np.full(1 << 20, i, np.uint8) for i in range(4)]
+    refs = [ray_tpu.put(b) for b in blobs]
+    for b, r in zip(blobs, refs):
+        got = ray_tpu.get(r, timeout=120)
+        assert got.shape == b.shape and int(got[0]) == int(b[0])
+
+
+def wl_kills():
+    import time as _t
+
+    import ray_tpu
+
+    @ray_tpu.remote(max_retries=10)
+    def slow(x):
+        _t.sleep(0.2)
+        return x + 1
+
+    out = ray_tpu.get([slow.remote(i) for i in range(10)], timeout=180)
+    assert out == [i + 1 for i in range(10)], out
+
+
+def wl_data():
+    import ray_tpu.data as rd
+
+    ds = rd.range(48, parallelism=4).map(lambda r: {"y": r["id"] * 3})
+    out = sorted(r["y"] for r in ds.take_all())
+    assert out == [i * 3 for i in range(48)], out
+
+
+WORKLOADS = {
+    "tasks": wl_tasks,
+    "actors": wl_actors,
+    "objects": wl_objects,
+    "kills": wl_kills,
+    "data": wl_data,
+}
+
+
+def run_seed(seed: int, spec: str, workloads: list, num_cpus: int) -> dict:
+    import ray_tpu
+    from ray_tpu.core import faults
+
+    result = {"seed": seed, "ok": True, "workloads": {}, "fired": None}
+    ray_tpu.init(num_cpus=num_cpus)
+    try:
+        inj = faults.install(faults.parse_spec(seed, spec))
+        for name in workloads:
+            t0 = time.perf_counter()
+            try:
+                WORKLOADS[name]()
+                result["workloads"][name] = {
+                    "ok": True,
+                    "s": round(time.perf_counter() - t0, 2),
+                }
+            except Exception:
+                result["ok"] = False
+                result["workloads"][name] = {
+                    "ok": False,
+                    "error": traceback.format_exc(limit=4),
+                }
+        result["fired"] = inj.stats()
+    finally:
+        faults.clear()  # teardown RPCs must flow clean
+        ray_tpu.shutdown()
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument(
+        "--seeds",
+        default="0:3",
+        help="one seed ('7') or a half-open range ('0:5')",
+    )
+    ap.add_argument("--spec", default=DEFAULT_SPEC, help="fault rule spec")
+    ap.add_argument(
+        "--workloads",
+        default="tasks,actors,objects,kills",
+        help=f"comma list from {sorted(WORKLOADS)}",
+    )
+    ap.add_argument("--num-cpus", type=int, default=4)
+    args = ap.parse_args()
+
+    if ":" in args.seeds:
+        lo, hi = args.seeds.split(":")
+        seeds = list(range(int(lo), int(hi)))
+    else:
+        seeds = [int(args.seeds)]
+    workloads = [w for w in args.workloads.split(",") if w]
+    unknown = set(workloads) - set(WORKLOADS)
+    if unknown:
+        ap.error(f"unknown workloads {sorted(unknown)}")
+
+    failures = 0
+    for seed in seeds:
+        print(f"=== seed {seed}: spec {args.spec!r}", flush=True)
+        res = run_seed(seed, args.spec, workloads, args.num_cpus)
+        print(json.dumps(res, indent=2), flush=True)
+        if not res["ok"]:
+            failures += 1
+            print(
+                f"REPRO: python tools/chaos.py --seeds {seed} "
+                f"--spec '{args.spec}' --workloads {args.workloads}",
+                flush=True,
+            )
+    print(f"{len(seeds) - failures}/{len(seeds)} seeds converged", flush=True)
+    return failures
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -84,7 +85,22 @@ def main() -> int:
         help="run only the large-object rows (bench.py rides this for "
         "the BENCH_r* data-plane record)",
     )
+    ap.add_argument(
+        "--faults",
+        metavar="SEED:SPEC",
+        help="enable the fault-injection plane for the whole run "
+        "(RAY_TPU_FAULTS syntax) — the chaos-overhead arm of the "
+        "robustness A/B; the default arm (injector off) must stay "
+        "within noise of the pre-robustness numbers",
+    )
     args = ap.parse_args()
+    if args.faults:
+        from ray_tpu.core import faults as _faults
+
+        # Spawned worker processes re-import faults and read the env var;
+        # without this, worker-side fault sites silently never fire.
+        os.environ["RAY_TPU_FAULTS"] = args.faults
+        _faults.install(_faults.parse_env(args.faults))
     batch = 20 if args.quick else 100
     min_s = 0.5 if args.quick else 2.0
 
