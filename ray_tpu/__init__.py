@@ -30,6 +30,7 @@ _CORE_API = (
     "get_actor",
     "method",
     "nodes",
+    "drain_node",
     "cluster_resources",
     "available_resources",
     "get_runtime_context",

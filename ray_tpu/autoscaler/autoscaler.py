@@ -137,10 +137,15 @@ class Autoscaler:
             idle_s = idle_by_id.get(info["cluster_node_id"], 0.0)
             if idle_s >= self.config.idle_timeout_s:
                 try:
+                    # force: the VM is terminated on the next line, so the
+                    # graceful DRAINING window would outlive the node —
+                    # views must flip to DEAD now, not drain_grace_s later.
+                    # An idle node has nothing running to migrate anyway.
                     self.endpoint.call(
                         self.gcs_addr,
                         "gcs.drain_node",
-                        {"node_id": info["cluster_node_id"]},
+                        {"node_id": info["cluster_node_id"], "force": True,
+                         "reason": "idle_terminated"},
                         timeout=10,
                     )
                 except Exception:

@@ -39,6 +39,7 @@ __all__ = [
     "get_actor",
     "method",
     "nodes",
+    "drain_node",
     "cluster_resources",
     "available_resources",
     "get_runtime_context",
@@ -755,9 +756,38 @@ def nodes() -> list[dict]:
     return [
         {"NodeID": nid, "Alive": v["alive"], "Resources": v["total"],
          "Available": v["available"], "Labels": v["labels"],
-         "Address": tuple(v["addr"])}
+         "Address": tuple(v["addr"]),
+         "Draining": v.get("draining", False),
+         "DeathReason": v.get("death_reason")}
         for nid, v in view.items()
     ]
+
+
+def drain_node(
+    node_id: str,
+    grace_s: float | None = None,
+    *,
+    force: bool = False,
+    reason: str = "drained",
+) -> dict:
+    """Gracefully drain a node (reference: gcs_service.proto DrainNode).
+
+    The node stops taking new leases, migrates its sole-copy objects to
+    healthy peers, has its restartable actors restarted elsewhere, and
+    lets running tasks finish — all inside ``grace_s`` (default: the
+    ``drain_grace_s`` config knob). On expiry the GCS falls back to the
+    immediate mark-dead path. ``force=True`` (or zero grace) skips the
+    grace window entirely: the node is killed on the spot and its objects
+    come back via lineage reconstruction, exactly the pre-drain behavior.
+
+    Returns the GCS verdict, e.g. ``{"accepted": True, "state":
+    "DRAINING"}``; draining an unknown or already-dead node returns
+    ``{"accepted": False, "state": "DEAD"}``."""
+    worker = _require_worker()
+    payload: dict = {"node_id": node_id, "reason": reason, "force": force}
+    if grace_s is not None:
+        payload["grace_s"] = float(grace_s)
+    return worker.gcs.call("drain_node", payload)
 
 
 def cluster_resources() -> dict:

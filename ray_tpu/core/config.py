@@ -128,6 +128,15 @@ class Config:
     # serialization.dumps_oob AND in the frame encoder; smaller ones are
     # pickled in-band (framing overhead beats the copy win).
     oob_min_buffer_bytes: int = 4096
+    # Graceful node drain (reference: gcs_service.proto DrainNode + the
+    # raylet's graceful-drain deadline). A draining node stops taking new
+    # leases, migrates its sole-copy (primary) objects to healthy peers,
+    # asks the GCS to restart its restartable actors elsewhere, and lets
+    # running tasks finish — all inside this grace window. On expiry the
+    # GCS falls back to the immediate mark-dead path (post-mortem lineage
+    # reconstruction). 0 disables graceful drain: drain_node() and SIGTERM
+    # kill immediately, exactly the pre-drain behavior.
+    drain_grace_s: float = 30.0
     # Memory monitor (reference: memory_monitor.h:52 +
     # worker_killing_policy.h:33): when the node's memory usage fraction
     # exceeds the threshold, the newest leased task worker is killed (its

@@ -146,6 +146,14 @@ class CoreWorker:
         self._inflight_push: dict[str, tuple] = {}
         # owner side: task_id -> future, in-flight lineage resubmissions
         self._reconstructing: dict[str, asyncio.Future] = {}
+        # Lineage resubmissions actually performed (the number a graceful
+        # drain is supposed to keep at zero — migrated copies resolve
+        # instead; see _migrated_location).
+        self.reconstructions = 0
+        # oid -> node_id of the last location dropped as unreachable:
+        # lets ObjectLostError name WHY the holding node went away
+        # ("preempted" vs "heartbeat_timeout"). Bounded (see note).
+        self._lost_locations: dict[str, str] = {}
         # executor side (all guarded by _cancel_lock):
         self._cancel_lock = threading.Lock()
         self._running_tasks: dict[str, int] = {}  # task_id -> thread ident
@@ -451,6 +459,7 @@ class CoreWorker:
             }
         exclude = set(p.get("exclude_nodes") or [])
         reconstructed = False
+        migration_tried = False
         while True:
             obj = await self.owner_store.wait_ready(oid, timeout)
             if obj.state == FAILED:
@@ -468,7 +477,19 @@ class CoreWorker:
             # instead of stampeding whichever location iterates first.
             node_id = random.choice(tuple(avail)) if avail else None
             if node_id is None:
+                for nid in exclude & obj.locations:
+                    self._note_lost_location(oid, nid)
                 obj.locations -= exclude
+                # Pre-death migration first (the drain protocol): a
+                # draining node may have pushed the sole copy to a peer
+                # before dying — resolving it costs one GCS lookup instead
+                # of a full lineage re-execution.
+                if not migration_tried:
+                    migration_tried = True
+                    moved = await self._migrated_location(oid)
+                    if moved is not None:
+                        obj.locations.add(moved)
+                        continue
                 try:
                     await self._reconstruct(oid)
                     reconstructed = True
@@ -613,6 +634,7 @@ class CoreWorker:
     ) -> bytes:
         oid = ref.hex()
         if self._is_owner(ref):
+            migration_tried = False
             while True:
                 try:
                     obj = await self.owner_store.wait_ready(oid, timeout)
@@ -652,7 +674,17 @@ class CoreWorker:
                     except Exception:
                         # Copy unreachable (node died, blob gone). Drop the
                         # location; try another copy or reconstruct.
+                        self._note_lost_location(oid, node_id)
                         obj.locations.discard(node_id)
+                        continue
+                # Pre-death migration first (drain protocol): one GCS
+                # lookup beats a lineage re-execution when a draining node
+                # pushed its sole copy to a peer before dying.
+                if not migration_tried:
+                    migration_tried = True
+                    moved = await self._migrated_location(oid)
+                    if moved is not None:
+                        obj.locations.add(moved)
                         continue
                 await self._reconstruct(oid)
         # Borrower path: the owner resolves (and if needed reconstructs) the
@@ -706,6 +738,44 @@ class CoreWorker:
                     pass
             return data
 
+    def _note_lost_location(self, oid: str, node_id: str) -> None:
+        """Remember which node's disappearance lost a copy of ``oid`` so
+        the eventual ObjectLostError can say WHY it went away (drained /
+        preempted / heartbeat_timeout vs crash). Bounded FIFO: this is
+        error-message garnish, not tracking state."""
+        self._lost_locations[oid] = node_id
+        if len(self._lost_locations) > 1024:
+            self._lost_locations.pop(next(iter(self._lost_locations)))
+
+    async def _lost_reason_suffix(self, oid: str) -> str:
+        node_id = self._lost_locations.get(oid)
+        if not node_id:
+            return ""
+        try:
+            info = await self._node_info_for(node_id)
+        except Exception:
+            info = None
+        reason = (info or {}).get("death_reason")
+        if reason:
+            return f" (node {node_id[:8]} {reason})"
+        return f" (node {node_id[:8]} unreachable)"
+
+    async def _migrated_location(self, oid: str) -> Optional[str]:
+        """Resolve a pre-death drain migration: the node_id now holding a
+        copy a draining node pushed out before dying, or None. Only an
+        ALIVE holder counts — a migrated copy that died too falls through
+        to lineage reconstruction like before."""
+        try:
+            node_id = await self.gcs.acall("migrated_location", {"oid": oid})
+        except Exception:
+            return None
+        if not node_id:
+            return None
+        info = await self._node_info_for(node_id)
+        if info is None or not info.get("alive"):
+            return None
+        return node_id
+
     async def _reconstruct(self, oid: str) -> None:
         """Resubmit the producing task of a lost owned object (lineage
         reconstruction; reference: object_recovery_manager.h:41,
@@ -716,8 +786,9 @@ class CoreWorker:
         spec = self._task_specs.get(task_id) if task_id else None
         if spec is None or spec.actor_id is not None:
             raise ObjectLostError(
-                f"object {oid[:12]} was lost and has no lineage to "
-                f"reconstruct it"
+                f"object {oid[:12]} was lost"
+                f"{await self._lost_reason_suffix(oid)} and has no "
+                f"lineage to reconstruct it"
             )
         if spec.cancelled:
             raise TaskCancelledError(f"task {spec.name} was cancelled")
@@ -730,10 +801,12 @@ class CoreWorker:
         try:
             if spec.lineage_attempts >= GLOBAL_CONFIG.max_lineage_attempts:
                 raise ObjectLostError(
-                    f"object {oid[:12]} lost; reconstruction gave up after "
-                    f"{spec.lineage_attempts} attempts"
+                    f"object {oid[:12]} lost"
+                    f"{await self._lost_reason_suffix(oid)}; reconstruction "
+                    f"gave up after {spec.lineage_attempts} attempts"
                 )
             spec.lineage_attempts += 1
+            self.reconstructions += 1
             spec.completed = False
             for rid in spec.return_ids:
                 # Reset ONLY return values that are tracked and actually

@@ -10,6 +10,11 @@ site       actions                injected where
 ``recv``   drop delay dup         ``protocol.Connection._handle_frame``
 ``node``   kill_worker            node worker-monitor sweep (leased task worker)
 ``node``   lease_delay            ``node._h_request_lease`` entry
+``node``   preempt                node worker-monitor sweep (preemption
+                                  notice -> graceful self-drain; ``ms``
+                                  overrides the grace window, else config
+                                  ``drain_grace_s`` applies — set that to 0
+                                  for the instant-kill fallback)
 ``gcs``    heartbeat_blackhole    ``gcs._h_node_heartbeat`` (partition)
 ``store``  pull_corrupt           ``node._h_fetch_object`` (flip served bytes)
 ``store``  pull_lose              ``node._h_fetch_object`` (raise)
@@ -56,7 +61,7 @@ INF = math.inf
 _SITE_ACTIONS = {
     "send": frozenset({"drop", "delay", "dup", "sever"}),
     "recv": frozenset({"drop", "delay", "dup"}),
-    "node": frozenset({"kill_worker", "lease_delay"}),
+    "node": frozenset({"kill_worker", "lease_delay", "preempt"}),
     "gcs": frozenset({"heartbeat_blackhole"}),
     "store": frozenset({"pull_corrupt", "pull_lose"}),
     "chan": frozenset({"read_delay"}),

@@ -31,11 +31,34 @@ from ray_tpu.core.scheduler import (
     any_feasible,
     pick_node,
 )
+from ray_tpu.util.metrics import declare_runtime_metric
 
 ALIVE = "ALIVE"
 PENDING = "PENDING"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
+# Node drain sub-state: the view stays alive (running work finishes) but
+# takes no new placements; on drain completion or deadline expiry the node
+# transitions to DEAD (reference: gcs_service.proto DrainNode + the
+# raylet's graceful-drain deadline).
+DRAINING = "DRAINING"
+
+# Drain telemetry (registered in the runtime catalog; tools/metrics_lint.py
+# imports this module). The objects-migrated counter lives node-side
+# (node._own_metric_snapshot) — the GCS counts drain lifecycle events.
+_GCS_METRIC_META = {
+    "raytpu_node_drains_total": declare_runtime_metric(
+        "raytpu_node_drains_total", "counter",
+        "graceful node drains started (API/CLI/SIGTERM/injected preemption)",
+        layer="core",
+    ),
+    "raytpu_drain_deadline_forced_total": declare_runtime_metric(
+        "raytpu_drain_deadline_forced_total", "counter",
+        "drains that ended in the force mark-dead fallback (grace deadline "
+        "expired, or force=true / zero grace requested)",
+        layer="core",
+    ),
+}
 
 # placement group states
 PG_PENDING = "PENDING"
@@ -117,6 +140,17 @@ class GcsServer:
             lambda addr: self.endpoint.peer_suspect(addr),
         )
         self.subs: dict[str, list[Connection]] = {}
+        # Graceful drain (reference: DrainNode): node_id -> {reason,
+        # grace_s, deadline (monotonic), task (deadline enforcer)}. A
+        # draining node keeps heartbeating but takes no new placements;
+        # drain_complete or the deadline moves it to DEAD.
+        self.draining_nodes: dict[str, dict] = {}
+        self.drain_stats = {"drains": 0, "deadline_forced": 0}
+        # Pre-death object migrations reported by draining nodes:
+        # oid -> node_id now holding a copy. Owners consult this on a
+        # location miss BEFORE falling back to lineage reconstruction.
+        # Bounded: drain is a rare event; entries age out FIFO.
+        self.migrated_objects: "OrderedDict[str, str]" = OrderedDict()
         # Observability: bounded task-event store (reference:
         # GcsTaskManager, gcs_task_manager.h) keyed by task_id — each
         # report merges state timestamps into one record; per-node metric
@@ -301,6 +335,14 @@ class GcsServer:
         meta = self.node_meta.setdefault(p["node_id"], {})
         meta["shm_root"] = p.get("shm_root")
         meta["hostname"] = p.get("hostname", "localhost")
+        # A partition survivor re-registering is alive again: its stale
+        # death verdict must not keep tainting error messages.
+        meta.pop("death_reason", None)
+        # ...nor may a stale drain deadline from a previous incarnation
+        # kill the fresh registration out from under it.
+        ent = self.draining_nodes.pop(p["node_id"], None)
+        if ent is not None and ent.get("task") is not None:
+            ent["task"].cancel()
         # Deliberately NOT resetting meta["log_bid"]: a partition-survivor
         # re-registering under the same node_id is the same process with
         # the same monotonic batch counter, and its restaged heartbeat
@@ -395,6 +437,12 @@ class GcsServer:
             "available": v.available,
             "labels": v.labels,
             "alive": v.alive,
+            # Drain state travels with the view so node-side schedulers
+            # stop spilling leases to a draining peer, and so library
+            # controllers (train / serve) can react to a preemption notice
+            # before the node actually dies.
+            "draining": v.draining,
+            "death_reason": meta.get("death_reason"),
             "shm_root": meta.get("shm_root"),
             "hostname": meta.get("hostname", "localhost"),
         }
@@ -428,8 +476,146 @@ class GcsServer:
         return {"version": self.view_version, "changed": changed}
 
     async def _h_drain_node(self, conn, p):
-        await self._mark_node_dead(p["node_id"], "drained")
+        """Start a graceful drain (reference: gcs_service.proto DrainNode).
+
+        Default: mark the node DRAINING (no new leases/placements; still
+        feasible so demand queues), arm the ``grace_s`` deadline, and ask
+        the node to self-drain — migrate primary objects, restart its
+        restartable actors elsewhere, finish running tasks — unless the
+        node itself initiated (``self_initiated``: it is already draining).
+        On deadline expiry the old immediate mark-dead path fires as the
+        force fallback.
+
+        ``force=true`` (or zero grace) is the compatibility path: kill the
+        node record immediately, exactly the pre-drain behavior — objects
+        then come back via lineage reconstruction.
+        """
+        node_id = p["node_id"]
+        reason = p.get("reason") or "drained"
+        view = self.nodes.get(node_id)
+        if view is None or not view.alive:
+            return {"accepted": False, "state": DEAD}
+        grace = p.get("grace_s")
+        if grace is None:
+            grace = GLOBAL_CONFIG.drain_grace_s
+        if p.get("force") or grace <= 0:
+            # Escalating an in-progress graceful drain counts once: only
+            # a fresh drain bumps the drains counter.
+            if node_id not in self.draining_nodes:
+                self.drain_stats["drains"] += 1
+            self.drain_stats["deadline_forced"] += 1
+            # Tell the node to die for real (best-effort): without this an
+            # in-process node would zombie-heartbeat and re-register right
+            # after the mark-dead below. notify — no reply needed from a
+            # node we are about to declare dead.
+            try:
+                await self.endpoint.anotify(
+                    view.addr, "node.drain",
+                    {"grace_s": 0.0, "reason": reason},
+                )
+            except Exception:
+                pass
+            await self._mark_node_dead(node_id, reason)
+            return {"accepted": True, "state": DEAD, "forced": True}
+        ent = self.draining_nodes.get(node_id)
+        if ent is not None:
+            # Double-drain is idempotent: report the in-progress drain
+            # instead of re-arming the deadline or re-counting.
+            return {
+                "accepted": True,
+                "state": DRAINING,
+                "deadline_in_s": max(0.0, ent["deadline"] - time.monotonic()),
+            }
+        self.drain_stats["drains"] += 1
+        ent = {
+            "reason": reason,
+            "grace_s": float(grace),
+            "deadline": time.monotonic() + float(grace),
+            "task": None,
+        }
+        self.draining_nodes[node_id] = ent
+        view.draining = True
+        self._bump_node_version(node_id)
+        self.events.record(
+            "NODE", "LIFECYCLE", node_id,
+            {"state": DRAINING, "reason": reason, "grace_s": float(grace)},
+        )
+        await self._publish(
+            "nodes",
+            {"node_id": node_id, "state": DRAINING, "reason": reason,
+             "grace_s": float(grace)},
+        )
+        ent["task"] = asyncio.ensure_future(self._drain_deadline(node_id))
+        if not p.get("self_initiated"):
+            try:
+                await self.endpoint.acall(
+                    view.addr, "node.drain",
+                    {"grace_s": float(grace), "reason": reason},
+                )
+            except Exception:
+                pass  # node unreachable: the deadline fallback still fires
+        return {"accepted": True, "state": DRAINING}
+
+    async def _drain_deadline(self, node_id: str) -> None:
+        """Grace-window enforcer: a drain the node never completes falls
+        back to the immediate mark-dead path (today's reconstruction
+        story) instead of wedging DRAINING forever."""
+        ent = self.draining_nodes.get(node_id)
+        if ent is None:
+            return
+        await asyncio.sleep(max(0.0, ent["deadline"] - time.monotonic()))
+        ent = self.draining_nodes.get(node_id)
+        if ent is None:
+            return  # drain completed meanwhile
+        ent["task"] = None  # we ARE the task; don't self-cancel below
+        view = self.nodes.get(node_id)
+        if view is not None and view.alive:
+            self.drain_stats["deadline_forced"] += 1
+            await self._mark_node_dead(node_id, ent["reason"])
+
+    async def _h_drain_complete(self, conn, p):
+        """The draining node finished its migration work: retire it now
+        (with the drain's reason) instead of waiting out the deadline."""
+        ent = self.draining_nodes.get(p["node_id"])
+        reason = ent["reason"] if ent else (p.get("reason") or "drained")
+        await self._mark_node_dead(p["node_id"], reason)
         return True
+
+    async def _h_restart_node_actors(self, conn, p):
+        """A draining node asks for its restartable actors to be restarted
+        on OTHER nodes *before* it dies (pick_node skips the draining
+        view), so the restart-aware submitters resend in order with no
+        post-mortem detection gap. Returns the moved actor ids — the node
+        then retires their local workers so submitters reconnect. Actors
+        out of restart budget stay put and die with the node."""
+        node_id = p["node_id"]
+        reason = p.get("reason") or "drained"
+        moved = []
+        for rec in list(self.actors.values()):
+            if rec.node_id != node_id or rec.state != ALIVE or rec.killed:
+                continue
+            max_restarts = rec.spec.get("max_restarts", 0)
+            if max_restarts == -1 or rec.restarts < max_restarts:
+                await self._on_actor_failure(
+                    rec, f"node {node_id[:8]} draining ({reason})"
+                )
+                moved.append(rec.actor_id)
+        return moved
+
+    async def _h_report_migrations(self, conn, p):
+        """A draining node migrated primary objects to peers: record
+        oid -> new holder so owners resolve the copy instead of paying a
+        lineage reconstruction. Bounded FIFO (drain is rare; a replica
+        outliving its table entry just reconstructs like before)."""
+        for oid, node_id in p["moves"]:
+            self.migrated_objects[oid] = node_id
+            self.migrated_objects.move_to_end(oid)
+        while len(self.migrated_objects) > 50000:
+            self.migrated_objects.popitem(last=False)
+        return True
+
+    async def _h_migrated_location(self, conn, p):
+        return self.migrated_objects.get(p["oid"])
 
     async def _health_loop(self):
         cfg = GLOBAL_CONFIG
@@ -441,7 +627,7 @@ class GcsServer:
                     continue
                 last = self.node_last_seen.get(nid, 0)
                 if now - last > cfg.node_death_timeout_s:
-                    await self._mark_node_dead(nid, "heartbeat timeout")
+                    await self._mark_node_dead(nid, "heartbeat_timeout")
             # Drain work parked by transient failures: pending actors/groups
             # (a failed RPC must not strand them until the next node event)
             # and bundle releases whose return_pg RPC failed.
@@ -463,6 +649,11 @@ class GcsServer:
                 self.pg_release_retries.append((nid, pg_id))
 
     async def _mark_node_dead(self, node_id: str, reason: str):
+        ent = self.draining_nodes.pop(node_id, None)
+        if ent is not None:
+            task = ent.get("task")
+            if task is not None and task is not asyncio.current_task():
+                task.cancel()
         view = self.nodes.get(node_id)
         if view is None or not view.alive:
             return  # unknown/already-dead: no duplicate DEAD event either
@@ -470,7 +661,12 @@ class GcsServer:
             "NODE", "LIFECYCLE", node_id, {"state": DEAD, "reason": reason}
         )
         view.alive = False
+        view.draining = False
         view.available = {}
+        # The reason ("drained"/"preempted"/"heartbeat_timeout") travels
+        # with the dead view entry so owners can tell users WHY a lost
+        # object's node went away (ObjectLostError wording).
+        self.node_meta.setdefault(node_id, {})["death_reason"] = reason
         self.node_metrics.pop(node_id, None)
         self._bump_node_version(node_id)
         await self._publish(
@@ -479,7 +675,9 @@ class GcsServer:
         # Fail or restart actors that lived there.
         for rec in list(self.actors.values()):
             if rec.node_id == node_id and rec.state in (ALIVE, PENDING):
-                await self._on_actor_failure(rec, f"node {node_id} died")
+                await self._on_actor_failure(
+                    rec, f"node {node_id[:8]} died ({reason})"
+                )
         # Reschedule placement-group bundles that were committed there.
         for pg in list(self.pgs.values()):
             if pg.state == PG_REMOVED or node_id not in pg.bundle_nodes:
@@ -613,13 +811,24 @@ class GcsServer:
             await self._publish("actors", self._actor_info(rec))
 
     async def _h_report_worker_death(self, conn, p):
-        """A node reports a worker process exited (possibly hosting actors)."""
+        """A node reports a worker process exited (possibly hosting actors).
+
+        The report only fails an actor whose record still points at the
+        dead worker: a drain (or any restart) may have already moved the
+        actor to a fresh worker, and a late death report for the OLD
+        incarnation must not burn a restart (or kill) the new one."""
+        dead_worker = p.get("worker_id")
         for actor_id in p.get("actor_ids", []):
             rec = self.actors.get(actor_id)
-            if rec is not None and rec.state in (ALIVE, RESTARTING):
-                await self._on_actor_failure(
-                    rec, p.get("reason", "worker died")
-                )
+            if rec is None or rec.state not in (ALIVE, RESTARTING):
+                continue
+            if (
+                dead_worker is not None
+                and rec.worker_id is not None
+                and rec.worker_id != dead_worker
+            ):
+                continue  # stale report: the actor already restarted
+            await self._on_actor_failure(rec, p.get("reason", "worker died"))
         return True
 
     async def _h_get_actor(self, conn, p):
@@ -801,10 +1010,29 @@ class GcsServer:
 
     def _own_metric_snapshot(self) -> dict:
         """The GCS process's own service stats (per-RPC-method latency,
-        in-flight, loop lag, transport counters). The GCS is the metrics
-        sink, so nothing pushes them — they join at scrape time."""
+        in-flight, loop lag, transport counters) plus the drain lifecycle
+        counters. The GCS is the metrics sink, so nothing pushes them —
+        they join at scrape time."""
         meta, points = self.endpoint.service_metric_snapshot(
             {"process": "gcs"}
+        )
+        meta = dict(meta)
+        meta.update(_GCS_METRIC_META)
+        tags = {"process": "gcs"}
+        points = list(points)
+        points.extend(
+            [
+                [
+                    "raytpu_node_drains_total",
+                    tags,
+                    float(self.drain_stats["drains"]),
+                ],
+                [
+                    "raytpu_drain_deadline_forced_total",
+                    tags,
+                    float(self.drain_stats["deadline_forced"]),
+                ],
+            ]
         )
         return {"meta": meta, "points": points}
 

@@ -88,6 +88,13 @@ _NODE_METRIC_META = {
         "riding their own frames",
         layer="core",
     ),
+    "raytpu_drain_objects_migrated_total": declare_runtime_metric(
+        "raytpu_drain_objects_migrated_total", "counter",
+        "sole-copy (primary) objects pushed to healthy peers during a "
+        "graceful drain — each one is a lineage reconstruction the "
+        "cluster did NOT have to pay after the node died",
+        layer="core",
+    ),
 }
 
 IDLE = "idle"
@@ -206,6 +213,13 @@ class NodeManager:
         self._tasks: list = []
         self._stopping = False
         self._resources_freed = False
+        # Graceful drain (SIGTERM / injected preemption / gcs.drain_node):
+        # while draining, no new leases are granted locally (demand spills
+        # or queues) and the self-drain task migrates primary objects +
+        # restartable actors off this node before it dies.
+        self._draining = False
+        self._drain_task: asyncio.Future | None = None
+        self._drain_migrated = 0  # primary objects pushed to peers
         # Observability: worker-pushed metric snapshots + worker log tails
         # (reference: metrics_agent.py per-node aggregation; log_monitor.py)
         self._worker_metric_snaps: dict[str, dict] = {}
@@ -316,6 +330,283 @@ class NodeManager:
                 w.proc.kill()
         self.endpoint.stop()
 
+    # -- graceful drain -------------------------------------------------------
+    # Preemption-aware shutdown (reference: gcs_service.proto DrainNode +
+    # the raylet's graceful-drain deadline). A preemptible TPU VM gets a
+    # SIGTERM + grace window before it dies; instead of wasting the notice
+    # (post-mortem lineage reconstruction, cold actor restarts), the node
+    # self-drains: no new leases, sole-copy primary objects pushed to
+    # healthy peers over the ordinary transfer-chunk path (spilled
+    # primaries restore transparently on the way out — their disk tier
+    # dies with the node too), restartable actors restarted elsewhere
+    # while the submitters' restart-aware resend keeps callers whole, and
+    # running tasks given the remainder of the window to finish.
+
+    def drain(
+        self,
+        grace_s: float | None = None,
+        reason: str = "drained",
+        wait: bool = True,
+    ) -> bool:
+        """Sync entry point (SIGTERM handlers, tests): start a self-
+        initiated drain and optionally block until it retires the node
+        (bounded by the grace window plus margin)."""
+        grace = (
+            GLOBAL_CONFIG.drain_grace_s if grace_s is None else float(grace_s)
+        )
+        started = self.endpoint.submit(
+            self._begin_drain(grace, reason)
+        ).result(timeout=30)
+        if started and wait:
+            deadline = time.monotonic() + grace + 10.0
+            while not self._stopping and time.monotonic() < deadline:
+                time.sleep(0.05)
+        return started
+
+    async def _begin_drain(self, grace_s: float, reason: str) -> bool:
+        """Self-initiated drain (SIGTERM, injected preemption): tell the
+        GCS to mark us DRAINING (it arms the deadline enforcer but does
+        not call back — we are already draining), then run the self-drain.
+        Zero grace means graceful drain is disabled: ask for the immediate
+        force kill, exactly the pre-drain behavior."""
+        if self._draining or self._stopping:
+            return False
+        self._draining = True
+        if grace_s <= 0:
+            try:
+                await self.endpoint.acall(
+                    self.gcs_addr,
+                    "gcs.drain_node",
+                    {"node_id": self.node_id, "reason": reason,
+                     "force": True, "self_initiated": True},
+                )
+            except Exception:
+                pass  # heartbeat-timeout death is the fallback
+            self._retire()
+            return True
+        try:
+            await self.endpoint.acall(
+                self.gcs_addr,
+                "gcs.drain_node",
+                {"node_id": self.node_id, "reason": reason,
+                 "grace_s": grace_s, "self_initiated": True},
+            )
+        except Exception:
+            pass  # still drain best-effort; heartbeat death is the fallback
+        self._drain_task = asyncio.ensure_future(
+            self._self_drain(grace_s, reason)
+        )
+        return True
+
+    async def _h_drain(self, conn, p):
+        """GCS-initiated drain (gcs.drain_node forwards here), or the
+        zero-grace death notice of the force path."""
+        grace = p.get("grace_s")
+        if grace is None:
+            grace = GLOBAL_CONFIG.drain_grace_s
+        reason = p.get("reason") or "drained"
+        if grace <= 0:
+            self._draining = True
+            self._retire()
+            return {"draining": False, "retired": True}
+        if not self._draining:
+            self._draining = True
+            self._drain_task = asyncio.ensure_future(
+                self._self_drain(float(grace), reason)
+            )
+        return {"draining": True}
+
+    async def _chaos_preempt(self) -> None:
+        """Fault-injection hook (node.preempt): a seeded, replayable
+        preemption notice. ``ms`` overrides the grace window; otherwise
+        ``drain_grace_s`` applies (0 = graceful drain disabled, i.e. the
+        instant-kill fallback the acceptance criteria compare against)."""
+        if self._draining or self._stopping:
+            return
+        rule = faults._ACTIVE.decide(
+            "node", self.name, actions=frozenset({"preempt"})
+        )
+        if rule is None:
+            return
+        grace = (
+            rule.delay_s
+            if rule.delay_s > 0
+            else GLOBAL_CONFIG.drain_grace_s
+        )
+        await self._begin_drain(grace, "preempted")
+
+    async def _self_drain(self, grace_s: float, reason: str) -> None:
+        """The node side of the drain protocol, bounded by the grace
+        deadline: migrate primary objects, move restartable actors, let
+        running tasks finish, then report drain_complete and retire. A
+        drain that cannot finish inside the window retires WITHOUT the
+        completion report — the GCS deadline enforcer then fires the
+        mark-dead force fallback (counted in
+        raytpu_drain_deadline_forced_total)."""
+        deadline = time.monotonic() + grace_s
+        clean = False
+        try:
+            await self._migrate_primary_objects(deadline)
+            try:
+                moved = await self.endpoint.acall(
+                    self.gcs_addr,
+                    "gcs.restart_node_actors",
+                    {"node_id": self.node_id, "reason": reason},
+                )
+            except Exception:
+                moved = []
+            self._retire_actor_workers(moved)
+            # Running tasks get whatever remains of the grace window.
+            while time.monotonic() < deadline:
+                if not any(
+                    (w := self.workers.get(lease.worker_id)) is not None
+                    and not w.actor_ids
+                    for lease in self.leases.values()
+                ):
+                    clean = True
+                    break
+                await asyncio.sleep(0.05)
+        except Exception:
+            pass  # retire below either way; the GCS deadline is the backstop
+        if clean:
+            try:
+                await self.endpoint.acall(
+                    self.gcs_addr,
+                    "gcs.drain_complete",
+                    {"node_id": self.node_id, "reason": reason},
+                )
+            except Exception:
+                pass
+        self._retire()
+
+    async def _migrate_primary_objects(self, deadline: float) -> None:
+        """Push every sealed primary blob to a healthy peer via the
+        existing transfer-chunk path (the peer pulls from us), then report
+        the moves so owners resolve the migrated copy instead of paying a
+        lineage reconstruction. No healthy peer = nothing to do: the
+        objects fall back to post-mortem reconstruction like before."""
+        if self.store is None:
+            return
+        await self._refresh_cluster_view(force=True)
+        self._stamp_suspects()
+        targets = [
+            v
+            for nid, v in self.cluster_view.items()
+            if nid != self.node_id
+            and v.alive
+            and not v.draining
+            and not v.suspect
+        ]
+        if not targets:
+            return
+
+        def adopt_stragglers():
+            # Sealed files are ground truth: a worker may have sealed a
+            # blob whose object_created/completions notification has not
+            # reached us yet (a drain can start in that window). Local
+            # seals are primaries by definition — sweep them in before
+            # enumerating, or the freshest objects are exactly the ones
+            # the drain misses.
+            try:
+                names = os.listdir(self.shm_root)
+            except OSError:
+                return
+            for name in names:
+                if name.endswith((".tmp", ".restore")):
+                    continue
+                if not self.store.contains(name):
+                    try:
+                        self.store.adopt(
+                            name,
+                            os.path.getsize(
+                                os.path.join(self.shm_root, name)
+                            ),
+                        )
+                    except OSError:
+                        continue
+
+        await self._store_call(adopt_stragglers)
+        primaries = await self._store_call(self.store.primary_objects)
+        moves: list = []
+        rr = 0
+
+        async def push_one(oid: str, size: int, target) -> None:
+            nonlocal moves
+            try:
+                await self.endpoint.acall(
+                    target.addr,
+                    "node.pull_object",
+                    {
+                        "oid": oid,
+                        "from_addr": tuple(self.endpoint.address),
+                        "size": size,
+                    },
+                )
+            except Exception:
+                return  # this object reconstructs post-mortem
+            moves.append((oid, target.node_id))
+            self._drain_migrated += 1
+
+        # Waves of 4 concurrent pushes: parallel enough to beat the grace
+        # window on real object counts, bounded enough not to stampede one
+        # peer's pull admission control.
+        wave: list = []
+        for oid, size in primaries:
+            if time.monotonic() >= deadline:
+                break
+            wave.append(push_one(oid, size, targets[rr % len(targets)]))
+            rr += 1
+            if len(wave) >= 4:
+                await asyncio.gather(*wave)
+                wave = []
+        if wave:
+            await asyncio.gather(*wave)
+        if moves:
+            try:
+                await self.endpoint.acall(
+                    self.gcs_addr, "gcs.report_migrations", {"moves": moves}
+                )
+            except Exception:
+                pass
+
+    def _retire_actor_workers(self, moved) -> None:
+        """Kill the stale local incarnations of actors the GCS just
+        restarted elsewhere, WITHOUT a worker-death report: the record
+        already points at the new worker, and a report would ask the GCS
+        to fail the fresh restart a second time. Submitters reconnect via
+        wait_actor_alive on the broken connection."""
+        moved = set(moved or [])
+        if not moved:
+            return
+        for wid, w in list(self.workers.items()):
+            if not moved.intersection(w.actor_ids):
+                continue
+            self.workers.pop(wid, None)
+            self._cgroup_retire(wid)
+            self._worker_metric_snaps.pop(wid, None)
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.kill()
+                self._terminated_procs.append(w.proc)
+            for lid, lease in list(self.leases.items()):
+                if lease.worker_id == wid:
+                    add(self.available, lease.resources)
+                    del self.leases[lid]
+
+    def _retire(self) -> None:
+        """Post-drain: stop participating in the cluster. Loops stop (no
+        more heartbeats — re-registering would resurrect a zombie the
+        drain just retired) and workers die, but the endpoint keeps
+        serving: peers may still be reading the last migrated chunks, and
+        in-process harnesses stop() the manager properly later."""
+        if self._stopping:
+            return
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            if w.proc is not None and w.proc.poll() is None:
+                w.proc.kill()
+
     # -- loops ---------------------------------------------------------------
 
     def _piggyback_payload(self) -> dict:
@@ -410,6 +701,13 @@ class NodeManager:
                     },
                 )
                 if ok is False:
+                    if self._draining:
+                        # The GCS declared us dead because we are DRAINING
+                        # toward death (drain complete / deadline expired).
+                        # Re-registering would resurrect a zombie the drain
+                        # protocol just retired — stop heartbeating for
+                        # good instead.
+                        return
                     # The GCS does not know us (it restarted, or declared
                     # us dead across a partition) and dropped the beat's
                     # piggybacked sections unprocessed — re-stage them for
@@ -475,6 +773,7 @@ class NodeManager:
                     available=v["available"],
                     labels=v["labels"],
                     alive=v["alive"],
+                    draining=v.get("draining", False),
                 )
                 self.view_meta[nid] = {"shm_root": v.get("shm_root")}
             if reply["changed"] and self._pending_leases:
@@ -490,6 +789,7 @@ class NodeManager:
             await asyncio.sleep(GLOBAL_CONFIG.worker_poll_interval_s)
             if faults._ACTIVE is not None:
                 self._chaos_kill_worker()
+                await self._chaos_preempt()
             for wid, w in list(self.workers.items()):
                 if w.proc is not None and w.proc.poll() is not None:
                     await self._on_worker_death(wid, f"exit {w.proc.returncode}")
@@ -1047,6 +1347,8 @@ class NodeManager:
         plain = (
             req.policy == "hybrid"
             and not req.soft_label_selector
+            and not self._draining  # draining: no new grants; entries
+            # fall back to individual request_lease, which spills/queues
             and labels_match(self.labels, req.label_selector)
         )
         coros = []
@@ -1118,6 +1420,16 @@ class NodeManager:
 
     async def _lease_or_spill(self, req: SchedulingRequest, deadline: float):
         self._stamp_suspects()
+        if self._draining:
+            # A draining node takes no NEW leases (running work keeps its
+            # grace window): hand the demand to a healthy peer, or have
+            # the caller queue/retry — by the time it gives up, either a
+            # replacement registered or the cluster is really out of
+            # capacity.
+            spill = self._try_spill(req)
+            if spill is not None:
+                return spill
+            return {"retry_after": 0.2}
         local_ok = labels_match(self.labels, req.label_selector)
         soft_target_is_self = False
         if req.policy.startswith(("node_affinity:", "strict_node_affinity:")):
@@ -1452,6 +1764,13 @@ class NodeManager:
             resources=spec.get("resources", {}),
             runtime_env=spec.get("runtime_env") or {},
         )
+        if self._draining:
+            # Capacity-style rejection: the GCS requeues the actor and its
+            # next placement pass skips this DRAINING view.
+            raise SchedulingError(
+                f"node {self.node_id[:8]} is draining; actor must place "
+                f"elsewhere"
+            )
         if not fits(self.available, req.resources):
             raise SchedulingError(
                 f"node {self.node_id[:8]} cannot fit actor {req.resources}"
@@ -1760,6 +2079,11 @@ class NodeManager:
                 tags,
                 float(self._piggyback_saved),
             ],
+            [
+                "raytpu_drain_objects_migrated_total",
+                tags,
+                float(self._drain_migrated),
+            ],
         ]
         if self.store is not None:
             st = self.store.stats()
@@ -1879,13 +2203,15 @@ class NodeManager:
             return []
         out = []
         with self.store._lock:
-            for oid, (size, sealed, last, loc) in self.store.meta.items():
+            for oid, entry in self.store.meta.items():
+                size, sealed, _last, loc = entry[:4]
                 out.append(
                     {
                         "object_id": oid,
                         "size": size,
                         "sealed": bool(sealed),
                         "location": loc,
+                        "primary": bool(entry[4]) if len(entry) > 4 else False,
                         "node_id": self.node_id,
                     }
                 )
@@ -1921,6 +2247,7 @@ class NodeManager:
             "available": self.available,
             "labels": self.labels,
             "shm_root": self.shm_root,
+            "draining": self._draining,
             "num_workers": len(self.workers),
             "workers": [
                 {

@@ -48,7 +48,11 @@ class ShmObjectStore:
         # executor threads (off the node's event loop) while the loop keeps
         # serving RPCs.
         self._lock = threading.RLock()
-        # object hex -> [size, sealed, last_access, location("shm"|"spill")]
+        # object hex ->
+        #   [size, sealed, last_access, location("shm"|"spill"), primary]
+        # primary: this node is where the object was CREATED (a local
+        # worker sealed it) rather than a replica pulled from a peer — the
+        # set a graceful drain must migrate before the node dies.
         self.meta: dict[str, list] = {}
         self._maps: dict[str, tuple[mmap.mmap, memoryview]] = {}
         # Cumulative operation counters (mutated under self._lock, exported
@@ -146,7 +150,9 @@ class ShmObjectStore:
                 mm = mmap.mmap(fd, max(size, 1))
             finally:
                 os.close(fd)
-            self.meta[oid_hex] = [size, False, time.monotonic(), "shm"]
+            # create() is the pull-transfer path: the blob is a replica of
+            # an object whose primary lives elsewhere.
+            self.meta[oid_hex] = [size, False, time.monotonic(), "shm", False]
             self.used += size
             self.op_stats["creates"] += 1
             self._maps[oid_hex] = (mm, memoryview(mm)[:size])
@@ -168,7 +174,9 @@ class ShmObjectStore:
         with self._lock:
             if oid_hex in self.meta:
                 return
-            self.meta[oid_hex] = [size, True, time.monotonic(), "shm"]
+            # Adopted blobs were sealed by a LOCAL worker: this node is the
+            # primary copy (drain migrates these).
+            self.meta[oid_hex] = [size, True, time.monotonic(), "shm", True]
             self.used += size
             self.op_stats["adopts"] += 1
             if self.used > self.capacity:
@@ -208,6 +216,18 @@ class ShmObjectStore:
             if not self.contains(oid_hex):
                 return None
             return self.meta[oid_hex][0]
+
+    def primary_objects(self) -> list:
+        """[(oid, size)] of sealed PRIMARY blobs — ones created on this
+        node rather than pulled as replicas. Spilled primaries are
+        included: their disk tier dies with the node too, and serving the
+        migration pull restores them transparently (get())."""
+        with self._lock:
+            return [
+                (oid, entry[0])
+                for oid, entry in self.meta.items()
+                if entry[1] and len(entry) > 4 and entry[4]
+            ]
 
     def read_range(self, oid_hex: str, offset: int, length: int) -> bytes:
         """Copy a byte range out UNDER the lock: the returned bytes stay

@@ -275,6 +275,18 @@ IDEMPOTENT_RPCS = frozenset(
         "gcs.list_placement_groups",
         "gcs.list_task_events",
         "gcs.get_autoscaler_state",
+        # Drain protocol: all server-side idempotent (drain_complete /
+        # mark-dead dedup on node state; report_migrations is a set
+        # insert; migrated_location is a pure read; restart_node_actors
+        # only moves actors still recorded on the draining node) and a
+        # drain racing a flaky transport MUST retry — the whole point is
+        # beating the preemption deadline.
+        "gcs.drain_node",  # double-drain reports the in-progress drain
+        "gcs.drain_complete",
+        "gcs.report_migrations",
+        "gcs.migrated_location",
+        "gcs.restart_node_actors",
+        "node.drain",
         "node.request_lease",
         "node.fetch_object",
         "node.restore_object",

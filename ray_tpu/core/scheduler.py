@@ -165,6 +165,12 @@ class NodeView:
     # feasible, so demand queues and retries instead of hard-failing while
     # the breaker waits to half-open.
     suspect: bool = False
+    # Graceful-drain state (set by the GCS at drain start and gossiped with
+    # the view): a draining node takes no new leases/placements — exactly
+    # the suspect treatment — but, also like suspect, still counts as
+    # feasible so demand queues until a replacement registers rather than
+    # hard-failing mid-drain.
+    draining: bool = False
 
 
 class SuspectStamper:
@@ -227,6 +233,7 @@ def pick_node(
             view is not None
             and view.alive
             and not view.suspect
+            and not view.draining
             and fits(view.available, req.resources)
             and labels_match(view.labels, req.label_selector)
         ):
@@ -240,6 +247,7 @@ def pick_node(
         for v in views.values()
         if v.alive
         and not v.suspect
+        and not v.draining
         and labels_match(v.labels, req.label_selector)
         and fits(v.available, req.resources)
     ]
@@ -272,9 +280,10 @@ def pick_node(
 
 
 def any_feasible(req: SchedulingRequest, views: Mapping[str, NodeView]) -> bool:
-    # Deliberately IGNORES `suspect`: a breaker-tripped node is still
-    # feasible — demand should queue/retry until the breaker half-opens,
-    # not hard-fail with "no feasible node".
+    # Deliberately IGNORES `suspect` AND `draining`: a breaker-tripped or
+    # gracefully-draining node is still feasible — demand should
+    # queue/retry until the breaker half-opens or a replacement node
+    # registers, not hard-fail with "no feasible node".
     return any(
         v.alive
         and labels_match(v.labels, req.label_selector)

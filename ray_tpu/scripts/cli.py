@@ -103,9 +103,25 @@ def cmd_start(args) -> int:
     print(json.dumps(info), flush=True)
 
     stop_ev = threading.Event()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop_ev.set())
+    term_ev = threading.Event()  # SIGTERM = preemption notice (see below)
+
+    def _on_term(*_):
+        term_ev.set()
+        stop_ev.set()
+
+    # SIGTERM is how preemptible TPU VMs announce impending death: use the
+    # grace window to self-drain (migrate sole-copy objects, move
+    # restartable actors, finish running tasks) instead of wasting it.
+    # SIGINT (ctrl-C) stays an immediate stop. --no-drain or
+    # drain_grace_s=0 restores the old kill-on-SIGTERM behavior.
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, lambda *_: stop_ev.set())
     stop_ev.wait()
+    if term_ev.is_set() and not args.no_drain:
+        try:
+            node.drain(reason="preempted", wait=True)
+        except Exception:
+            pass  # the GCS deadline / heartbeat timeout is the fallback
     try:
         if dashboard is not None:
             dashboard.stop()
@@ -133,6 +149,34 @@ def cmd_status(args) -> int:
         probe.stop()
     print(json.dumps(view, indent=2, default=str))
     return 0
+
+
+def cmd_drain(args) -> int:
+    """Gracefully drain one node: it stops taking leases, migrates its
+    sole-copy objects to healthy peers, has restartable actors restarted
+    elsewhere, and dies when done (or when the grace window expires).
+    ``--force`` is the immediate mark-dead compatibility path."""
+    from ray_tpu.core.api import _parse_address
+    from ray_tpu.core.protocol import Endpoint
+
+    payload = {
+        "node_id": args.node_id,
+        "reason": args.reason,
+        "force": args.force,
+    }
+    if args.grace_s is not None:
+        payload["grace_s"] = args.grace_s
+    probe = Endpoint("cli-drain")
+    probe.start()
+    try:
+        reply = probe.call(
+            _parse_address(args.address), "gcs.drain_node", payload,
+            timeout=30,
+        )
+    finally:
+        probe.stop()
+    print(json.dumps(reply))
+    return 0 if reply.get("accepted") else 1
 
 
 def cmd_stop(args) -> int:
@@ -408,11 +452,38 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="shared secret remote drivers must present",
     )
+    p_start.add_argument(
+        "--no-drain",
+        action="store_true",
+        help="SIGTERM kills immediately instead of gracefully draining "
+        "(the pre-drain behavior)",
+    )
     p_start.set_defaults(fn=cmd_start)
 
     p_status = sub.add_parser("status", help="print the cluster view")
     p_status.add_argument("--address", required=True)
     p_status.set_defaults(fn=cmd_status)
+
+    p_drain = sub.add_parser(
+        "drain",
+        help="gracefully drain one node (migrate state, then retire it)",
+    )
+    p_drain.add_argument("node_id", help="node id (see `raytpu status`)")
+    p_drain.add_argument("--address", required=True, help="GCS address")
+    p_drain.add_argument(
+        "--grace-s",
+        type=float,
+        default=None,
+        help="grace window (default: the drain_grace_s config knob)",
+    )
+    p_drain.add_argument(
+        "--force",
+        action="store_true",
+        help="mark dead immediately (pre-drain behavior: objects come "
+        "back via lineage reconstruction)",
+    )
+    p_drain.add_argument("--reason", default="drained")
+    p_drain.set_defaults(fn=cmd_drain)
 
     p_stop = sub.add_parser(
         "stop", help="kill all raytpu daemons/workers on this host"

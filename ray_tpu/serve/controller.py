@@ -42,6 +42,12 @@ class ServeController:
         self._proxy_port = None
         self._grpc_port = None
         self._proxy_lock = asyncio.Lock()
+        # Draining-node view cache (graceful drain / preemption): replicas
+        # on a DRAINING node are proactively replaced — the replacement
+        # lands on a healthy node (GCS placement skips draining views)
+        # BEFORE the draining one dies, instead of the deployment eating a
+        # replica-down window.
+        self._draining_cache: tuple[float, set] = (0.0, set())
 
     # -- control plane API ----------------------------------------------------
 
@@ -231,6 +237,24 @@ class ServeController:
                 del self._replica_metrics[rid]
             await asyncio.sleep(HEALTH_CHECK_PERIOD_S)
 
+    async def _draining_nodes(self) -> set:
+        """Node ids currently DRAINING, cached for one health-check period
+        (one cluster-view RPC per tick, not one per replica)."""
+        ts, cached = self._draining_cache
+        now = time.monotonic()
+        if now - ts < HEALTH_CHECK_PERIOD_S:
+            return cached
+        worker = core_api._require_worker(auto_init=False)
+        try:
+            view = await worker.gcs.acall("get_cluster_view")
+        except Exception:
+            return cached  # GCS hiccup: keep the last verdicts
+        draining = {
+            nid for nid, v in view.items() if v.get("draining")
+        }
+        self._draining_cache = (now, draining)
+        return draining
+
     async def _ping_all(self, entries: list) -> list:
         """Liveness by GCS actor STATE, not by ping latency: a replica
         whose heavy __init__ (model load, jit compile) outlasts a ping
@@ -240,8 +264,14 @@ class ServeController:
 
         A replica the GCS does not know yet gets a registration grace:
         the controller is an async actor, so create_actor registration is
-        fire-and-forget and may land after the first reconcile tick."""
+        fire-and-forget and may land after the first reconcile tick.
+
+        A replica on a DRAINING node counts as not-ok: the reconciler
+        replaces it NOW (on a node the scheduler still likes) instead of
+        waiting for the drain deadline to kill it — preemption-aware
+        rebalance rather than a replica-down window."""
         worker = core_api._require_worker(auto_init=False)
+        draining = await self._draining_nodes()
         out = []
         now = time.monotonic()
         for r, started_at in entries:
@@ -255,7 +285,10 @@ class ServeController:
             if info is None:
                 out.append(now - started_at < REGISTRATION_GRACE_S)
             else:
-                out.append(info.get("state") != "DEAD")
+                out.append(
+                    info.get("state") != "DEAD"
+                    and info.get("node_id") not in draining
+                )
         return out
 
     async def _autoscale_target(self, dep: dict) -> int:

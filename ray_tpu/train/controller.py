@@ -114,6 +114,14 @@ class TrainController:
                     path=self._storage.experiment_dir,
                     metrics_history=self._metrics_history,
                 )
+            if outcome == "preempted":
+                # A worker node is DRAINING (preemption notice): the gang
+                # was torn down with its latest checkpoint round drained,
+                # and rebuilds on healthy nodes (placement skips draining
+                # views). Expected lifecycle on preemptible TPU VMs — it
+                # does NOT burn the max_failures budget.
+                self._state = RESTARTING
+                continue
             last_error = error
             failures += 1
             if max_failures != -1 and failures > max_failures:
@@ -162,6 +170,7 @@ class TrainController:
             return "failed", f"worker start failed: {e!r}"
         self._state = RUNNING
         done = [False] * len(group)
+        last_drain_check = 0.0
         while True:
             try:
                 statuses = ray_tpu.get(
@@ -183,6 +192,22 @@ class TrainController:
                     failure = st["error"]
                 if st["state"] == "finished":
                     done[i] = True
+            # Preemption-aware: a DRAINING worker node means this gang is
+            # about to lose a rank. Drain the buffered reports (so the
+            # just-persisted checkpoint round finalizes) and rebuild NOW,
+            # while the checkpoint storage is intact — instead of letting
+            # the node's death surface as a mid-collective failure.
+            now = time.monotonic()
+            if now - last_drain_check >= 1.0:
+                last_drain_check = now
+                draining = self._draining_worker_nodes(group)
+                if draining:
+                    self._drain_reports(group, done)
+                    return "preempted", (
+                        f"worker node {draining[0][:8]} is draining "
+                        f"(preemption notice); rebuilding on healthy nodes "
+                        f"from the latest checkpoint"
+                    )
             if failure is not None:
                 # Drain the surviving ranks' buffered reports before the
                 # teardown: a checkpoint round only finalizes once EVERY
@@ -195,6 +220,32 @@ class TrainController:
             if all(done):
                 return "finished", None
             time.sleep(POLL_INTERVAL_S)
+
+    @staticmethod
+    def _draining_worker_nodes(group: WorkerGroup) -> list:
+        """Node ids of gang members whose host node is DRAINING (graceful
+        drain / preemption notice). Rides the CoreWorker's 1s-cached
+        cluster view — no dedicated RPC per poll tick. Best-effort: a GCS
+        hiccup reports nothing and the next check retries."""
+        try:
+            from ray_tpu.core import api as core_api
+
+            worker = core_api._require_worker()
+            view = worker.endpoint.submit(worker._cluster_view()).result(
+                timeout=10
+            )
+        except Exception:
+            return []
+        draining = {nid for nid, v in view.items() if v.get("draining")}
+        if not draining:
+            return []
+        return sorted(
+            {
+                w.metadata["node_id"]
+                for w in group.workers
+                if w.metadata["node_id"] in draining
+            }
+        )
 
     def _drain_reports(
         self, group: WorkerGroup, done: list, timeout_s: float = 3.0

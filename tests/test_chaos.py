@@ -38,6 +38,7 @@ _CFG_FIELDS = (
     "node_death_timeout_s",
     "node_heartbeat_interval_s",
     "verify_transfers",
+    "drain_grace_s",
 )
 
 
@@ -519,6 +520,98 @@ def test_chaos_actor_calls_converge(chaos_cluster):
     c = Counter.remote()
     out = ray_tpu.get([c.bump.remote() for _ in range(15)], timeout=120)
     assert out == list(range(1, 16))
+
+
+def _preempt_workload(runtime, node2):
+    """task + actor + object workload whose state lives on node2: a big
+    task-produced object (sole copy there) and a pinned restartable
+    actor. Returns (object ref, actor handle)."""
+
+    @ray_tpu.remote(resources={"two": 1.0}, num_cpus=1, max_retries=5)
+    def produce():
+        return np.full((1 << 20,), 6, np.uint8)
+
+    @ray_tpu.remote(max_restarts=3, max_task_retries=3, num_cpus=0)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    actor = Counter.options(
+        scheduling_strategy=f"node_affinity:{node2.node_id}"
+    ).remote()
+    assert ray_tpu.get(actor.bump.remote(), timeout=60) == 1
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    return ref, actor
+
+
+def test_chaos_preempt_converges_without_reconstruction(
+    chaos_cluster, wait_for
+):
+    """THE drain acceptance scenario: a seeded node.preempt rule drains a
+    node holding a sole-copy object and an actor. With a grace window the
+    workload converges through pre-death migration + proactive actor
+    restart — ZERO lineage reconstructions, migrated counter > 0."""
+    runtime = chaos_cluster
+    node2 = add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "two": 1.0})
+    ref, actor = _preempt_workload(runtime, node2)
+    GLOBAL_CONFIG.drain_grace_s = 20.0
+    faults.install(
+        faults.parse_spec(17, "node.preempt,match=node*,count=1")
+    )
+    wait_for(lambda: node2._stopping, timeout=40.0)
+    wait_for(
+        lambda: not runtime.gcs.nodes[node2.node_id].alive, timeout=30.0
+    )
+    assert node2._drain_migrated > 0
+    faults.clear()
+    node2.die_silently()  # the preempted VM actually disappears
+    out = ray_tpu.get(ref, timeout=90)
+    assert out.shape == (1 << 20,) and int(out[0]) == 6
+    assert ray_tpu.get(actor.bump.remote(), timeout=60) >= 1
+    from ray_tpu.core import api as core_api
+
+    assert core_api._require_worker().reconstructions == 0
+
+
+def test_chaos_preempt_zero_grace_falls_back_to_reconstruction(
+    chaos_cluster, wait_for
+):
+    """Same seed, drain_grace_s=0: the preemption notice degrades to
+    today's instant-kill path — no migration, and the workload still
+    converges via lineage reconstruction on a replacement node."""
+    runtime = chaos_cluster
+    node2 = add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "two": 1.0})
+
+    @ray_tpu.remote(resources={"two": 1.0}, num_cpus=1, max_retries=5)
+    def produce():
+        return np.full((1 << 20,), 6, np.uint8)
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    GLOBAL_CONFIG.drain_grace_s = 0.0
+    faults.install(
+        faults.parse_spec(17, "node.preempt,match=node*,count=1")
+    )
+    wait_for(lambda: node2._stopping, timeout=40.0)
+    wait_for(
+        lambda: not runtime.gcs.nodes[node2.node_id].alive, timeout=30.0
+    )
+    assert node2._drain_migrated == 0
+    faults.clear()
+    node2.die_silently()
+    # A replacement registers (the preemptible-pool pattern) and lineage
+    # re-runs the producer there.
+    add_node_and_wait(runtime, wait_for, {"CPU": 2.0, "two": 1.0})
+    out = ray_tpu.get(ref, timeout=120)
+    assert out.shape == (1 << 20,) and int(out[0]) == 6
+    from ray_tpu.core import api as core_api
+
+    assert core_api._require_worker().reconstructions > 0
 
 
 @pytest.mark.slow

@@ -126,7 +126,10 @@ class WireStage:
         return x + 1
 
     def consume(self, v):
-        time.sleep(0.03)  # the compute the transfer should hide behind
+        # NOT a synchronization wait (those use conftest.wait_for_condition
+        # everywhere now): this sleep IS the simulated compute the overlap
+        # A/B below measures the transfer hiding behind.
+        time.sleep(0.03)
         return v * 2
 
 

@@ -1,0 +1,246 @@
+"""Graceful node drain: the preemption-aware migration protocol.
+
+Reference parity: `DrainNode` (gcs_service.proto) + the raylet's
+graceful-drain deadline. A draining node stops taking leases, migrates its
+sole-copy (primary) objects to healthy peers over the ordinary
+transfer-chunk path, has its restartable actors restarted elsewhere, and
+retires — so node death costs a GCS lookup instead of lineage
+reconstruction and cold actor detection. The ugly corners live here:
+deadline expiry forcing the kill, a drain racing an in-flight actor
+restart, the sole copy of a borrowed object, and double-drain idempotency.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from conftest import add_node_and_wait
+from ray_tpu.core import api as core_api
+from ray_tpu.core import faults
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import ObjectLostError
+
+_CFG_FIELDS = (
+    "drain_grace_s",
+    "node_death_timeout_s",
+    "node_heartbeat_interval_s",
+)
+
+
+@pytest.fixture
+def drain_cluster(wait_for):
+    saved = {f: getattr(GLOBAL_CONFIG, f) for f in _CFG_FIELDS}
+    runtime = ray_tpu.init(num_cpus=2)
+    node2 = add_node_and_wait(
+        runtime, wait_for, {"CPU": 2.0, "two": 1.0}
+    )
+    yield runtime, node2
+    faults.clear()
+    for f, v in saved.items():
+        setattr(GLOBAL_CONFIG, f, v)
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(resources={"two": 1.0}, num_cpus=1)
+def produce_on_two():
+    return np.full((1 << 20,), 9, np.uint8)
+
+
+def _drain_and_wait(runtime, node, wait_for, **kw):
+    reply = ray_tpu.drain_node(node.node_id, **kw)
+    assert reply["accepted"], reply
+    wait_for(lambda: node._stopping, timeout=30.0)
+    wait_for(
+        lambda: not runtime.gcs.nodes[node.node_id].alive, timeout=30.0
+    )
+    return reply
+
+
+def test_drain_migrates_sole_copy_objects(drain_cluster, wait_for):
+    """The tentpole: draining the only node holding an object's copy moves
+    the copy to a healthy peer BEFORE death — the owner then resolves the
+    migrated replica (gcs.migrated_location) with ZERO lineage
+    reconstructions, even after the node is truly gone."""
+    runtime, node2 = drain_cluster
+    ref = produce_on_two.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    _drain_and_wait(
+        runtime, node2, wait_for, grace_s=20.0, reason="preempted"
+    )
+    assert node2._drain_migrated > 0
+    assert runtime.gcs.node_meta[node2.node_id]["death_reason"] == "preempted"
+    node2.die_silently()  # the VM actually goes away
+    out = ray_tpu.get(ref, timeout=60)
+    assert out.shape == (1 << 20,) and int(out[0]) == 9
+    assert core_api._require_worker().reconstructions == 0
+
+
+def test_drain_restarts_actors_proactively(drain_cluster, wait_for):
+    """Restartable actors on a draining node restart on healthy peers
+    BEFORE the node dies (pick_node skips the DRAINING view), and the
+    restart-aware submitter resends queued calls with no caller-visible
+    failure."""
+    runtime, node2 = drain_cluster
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2, num_cpus=0)
+    class Here:
+        def node(self):
+            return ray_tpu.get_runtime_context().node_id
+
+    a = Here.options(
+        scheduling_strategy=f"node_affinity:{node2.node_id}"
+    ).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == node2.node_id
+    _drain_and_wait(runtime, node2, wait_for, grace_s=20.0)
+    assert (
+        ray_tpu.get(a.node.remote(), timeout=60) == runtime.head.node_id
+    )
+    rec = runtime.gcs.actors[a._actor_id]
+    assert rec.state == "ALIVE" and rec.restarts == 1
+
+
+def test_drain_deadline_expiry_forces_kill(drain_cluster, wait_for):
+    """A drain the node never completes (here: the GCS is told the node
+    self-initiated, so nobody actually drains) must not wedge DRAINING
+    forever: the deadline enforcer fires the mark-dead force fallback and
+    counts it."""
+    runtime, node2 = drain_cluster
+    worker = core_api._require_worker()
+    forced_before = runtime.gcs.drain_stats["deadline_forced"]
+    reply = worker.gcs.call(
+        "drain_node",
+        {"node_id": node2.node_id, "grace_s": 0.7, "self_initiated": True},
+    )
+    assert reply == {"accepted": True, "state": "DRAINING"}
+    view = runtime.gcs.nodes[node2.node_id]
+    assert view.draining and view.alive
+    wait_for(lambda: not runtime.gcs.nodes[node2.node_id].alive, timeout=20.0)
+    assert runtime.gcs.drain_stats["deadline_forced"] == forced_before + 1
+    assert not runtime.gcs.nodes[node2.node_id].draining
+
+
+def test_drain_racing_inflight_actor_restart(drain_cluster, wait_for):
+    """A worker-death report for the OLD incarnation that lands after the
+    drain already restarted the actor elsewhere must not burn a second
+    restart (or kill the fresh one)."""
+    runtime, node2 = drain_cluster
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=2, num_cpus=0)
+    class Pinned:
+        def node(self):
+            return ray_tpu.get_runtime_context().node_id
+
+    a = Pinned.options(
+        scheduling_strategy=f"node_affinity:{node2.node_id}"
+    ).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == node2.node_id
+    rec = runtime.gcs.actors[a._actor_id]
+    old_worker = rec.worker_id
+    _drain_and_wait(runtime, node2, wait_for, grace_s=20.0)
+    wait_for(lambda: rec.state == "ALIVE" and rec.restarts == 1, timeout=30.0)
+    # The race: a stale death report for the pre-drain worker arrives late.
+    worker = core_api._require_worker()
+    worker.gcs.call(
+        "report_worker_death",
+        {
+            "node_id": node2.node_id,
+            "worker_id": old_worker,
+            "actor_ids": [a._actor_id],
+            "reason": "stale exit notice",
+        },
+    )
+    assert rec.state == "ALIVE" and rec.restarts == 1
+    # ...and the actor (max_restarts=1, budget spent) still answers.
+    assert ray_tpu.get(a.node.remote(), timeout=60) == runtime.head.node_id
+
+
+def test_drain_sole_copy_of_borrowed_object(drain_cluster, wait_for):
+    """A borrower whose fetch targets arrive dead resolves the migrated
+    copy through the owner (exclusion corroborated -> migration lookup ->
+    fresh location) instead of forcing a reconstruction."""
+    runtime, node2 = drain_cluster
+    ref = produce_on_two.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    _drain_and_wait(runtime, node2, wait_for, grace_s=20.0)
+    assert node2._drain_migrated > 0
+    node2.die_silently()
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(refs):
+        return int(ray_tpu.get(refs[0])[0])
+
+    assert ray_tpu.get(consume.remote([ref]), timeout=90) == 9
+    assert core_api._require_worker().reconstructions == 0
+
+
+def test_double_drain_is_idempotent(drain_cluster, wait_for):
+    runtime, node2 = drain_cluster
+    r1 = ray_tpu.drain_node(node2.node_id, grace_s=25.0)
+    assert r1["state"] == "DRAINING"
+    r2 = ray_tpu.drain_node(node2.node_id, grace_s=25.0)
+    assert r2["state"] == "DRAINING" and "deadline_in_s" in r2
+    assert runtime.gcs.drain_stats["drains"] == 1
+    wait_for(lambda: not runtime.gcs.nodes[node2.node_id].alive, timeout=30.0)
+    # Draining a dead node is a clean no.
+    r3 = ray_tpu.drain_node(node2.node_id)
+    assert r3 == {"accepted": False, "state": "DEAD"}
+
+
+def test_force_drain_reconstruction_fallback_and_death_reason(
+    drain_cluster, wait_for
+):
+    """force=True is the pre-drain compatibility path: immediate mark-dead,
+    no migration — and the death reason then travels into ObjectLostError
+    so users can tell a drain/preemption from a crash."""
+    runtime, node2 = drain_cluster
+
+    @ray_tpu.remote(max_restarts=0, num_cpus=0)
+    class Producer:
+        def make(self):
+            return np.full((1 << 20,), 4, np.uint8)
+
+    a = Producer.options(
+        scheduling_strategy=f"node_affinity:{node2.node_id}"
+    ).remote()
+    ref = a.make.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    reply = ray_tpu.drain_node(node2.node_id, force=True, reason="preempted")
+    assert reply["state"] == "DEAD" and reply.get("forced")
+    assert not runtime.gcs.nodes[node2.node_id].alive
+    wait_for(lambda: node2._stopping, timeout=20.0)
+    assert node2._drain_migrated == 0
+    node2.die_silently()
+    # Actor-produced object: no lineage — the loss must surface WITH the
+    # node's death reason.
+    with pytest.raises(ObjectLostError, match="preempted"):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_draining_node_takes_no_new_leases(drain_cluster, wait_for):
+    """pick_node treats DRAINING like suspect (skip) while feasibility
+    still counts the node, so demand queues instead of hard-failing."""
+    runtime, node2 = drain_cluster
+    worker = core_api._require_worker()
+    reply = worker.gcs.call(
+        "drain_node",
+        {"node_id": node2.node_id, "grace_s": 30.0, "self_initiated": True},
+    )
+    assert reply["state"] == "DRAINING"
+    wait_for(
+        lambda: (
+            (v := runtime.head.cluster_view.get(node2.node_id)) is not None
+            and v.draining
+        ),
+        timeout=20.0,
+    )
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    # Plenty of head CPU: everything must land there, never on the
+    # draining node.
+    spots = ray_tpu.get([where.remote() for _ in range(6)], timeout=60)
+    assert set(spots) == {runtime.head.node_id}

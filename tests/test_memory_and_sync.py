@@ -9,6 +9,7 @@ import time
 import pytest
 
 import ray_tpu
+from conftest import wait_for_condition
 
 
 @pytest.fixture
@@ -29,10 +30,7 @@ def test_memory_monitor_kills_newest_task_worker_and_task_retries(cluster):
     ref = slow.remote(4)
     # Wait until the task actually holds a lease, then spike the pressure
     # for a single poll.
-    deadline = time.time() + 20
-    while time.time() < deadline and not head.leases:
-        time.sleep(0.05)
-    assert head.leases
+    wait_for_condition(lambda: head.leases, timeout=20.0)
     fired = {"n": 0}
 
     def spiked():
@@ -57,8 +55,17 @@ def test_memory_monitor_spares_actor_workers(cluster):
 
     a = Holder.options(num_cpus=1).remote()
     assert ray_tpu.get(a.ping.remote()) == "ok"
-    head._memory_usage_fn = lambda: 0.99
-    time.sleep(2.5)  # several monitor polls with only the actor leased
+    # Count the monitor's reads instead of sleeping a fixed multiple of
+    # its interval: the negative assertion ("actor survives") only means
+    # something once the monitor has actually looked several times.
+    polls = {"n": 0}
+
+    def pressured():
+        polls["n"] += 1
+        return 0.99
+
+    head._memory_usage_fn = pressured
+    wait_for_condition(lambda: polls["n"] >= 3, timeout=20.0)
     head._memory_usage_fn = lambda: 0.1
     assert ray_tpu.get(a.ping.remote(), timeout=30) == "ok"
     ray_tpu.kill(a)
@@ -66,9 +73,16 @@ def test_memory_monitor_spares_actor_workers(cluster):
 
 def test_view_versions_only_bump_on_change(cluster):
     gcs = cluster.gcs
+    head_id = cluster.head.node_id
     v0 = gcs.view_version
-    time.sleep(1.5)  # several idle heartbeats
-    # Idle heartbeats with unchanged resources must not bump versions.
+    # Observe a couple of REAL heartbeats landing (node_last_seen moves)
+    # rather than sleeping a fixed multiple of the interval; idle beats
+    # with unchanged resources must not bump versions.
+    for _ in range(2):
+        seen = gcs.node_last_seen.get(head_id, 0)
+        wait_for_condition(
+            lambda: gcs.node_last_seen.get(head_id, 0) > seen, timeout=20.0
+        )
     assert gcs.view_version == v0
 
     @ray_tpu.remote(num_cpus=2)
@@ -77,10 +91,8 @@ def test_view_versions_only_bump_on_change(cluster):
         return 1
 
     assert ray_tpu.get(burn.remote()) == 1
-    deadline = time.time() + 10
-    while time.time() < deadline and gcs.view_version == v0:
-        time.sleep(0.1)
-    assert gcs.view_version > v0  # resource change gossiped
+    # resource change gossiped
+    wait_for_condition(lambda: gcs.view_version > v0, timeout=10.0)
 
 
 def test_delta_view_protocol(cluster):

@@ -64,6 +64,22 @@ def test_lint_flags_unbounded_tag_values():
     assert not any("raytpu_good" in p for p in problems)
 
 
+def test_drain_series_registered_and_linted():
+    """The graceful-drain telemetry (GCS lifecycle counters + the
+    node-side migration counter) is declared through the catalog — so the
+    lint covers it and a kind flip or prefix drift fails CI."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    for name in (
+        "raytpu_node_drains_total",
+        "raytpu_drain_deadline_forced_total",
+        "raytpu_drain_objects_migrated_total",
+    ):
+        assert name in catalog, f"{name} missing from the runtime catalog"
+        assert catalog[name]["kind"] == "counter"
+    assert lint_catalog(catalog) == []
+
+
 def test_declare_runtime_metric_enforces_rules():
     with pytest.raises(ValueError, match="prefix"):
         m.declare_runtime_metric("unprefixed_series", "counter")

@@ -2,11 +2,10 @@
 bundles, removal, rescheduling (reference: python/ray/tests/
 test_placement_group*.py families)."""
 
-import time
-
 import pytest
 
 import ray_tpu
+from conftest import wait_for_condition
 from ray_tpu.util import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -21,7 +20,14 @@ def cluster():
     runtime = ray_tpu.init(num_cpus=4, resources={"head_mark": 1.0})
     node2 = runtime.add_node({"CPU": 4.0, "accel": 4.0}, labels={"zone": "b"})
     node3 = runtime.add_node({"CPU": 4.0}, labels={"zone": "c"})
-    time.sleep(1.0)
+    wait_for_condition(
+        lambda: all(
+            (v := runtime.head.cluster_view.get(n.node_id)) is not None
+            and v.alive
+            for n in (node2, node3)
+        ),
+        timeout=30.0,
+    )
     yield runtime, node2, node3
     ray_tpu.shutdown()
 
@@ -152,9 +158,12 @@ def test_remove_pg_frees_resources(cluster):
     pg = placement_group([{"CPU": 2}])
     assert pg.wait(30)
     remove_placement_group(pg)
-    time.sleep(1.0)
-    after = ray_tpu.cluster_resources().get("CPU", 0)
-    assert after == before
+    # The release propagates via node heartbeats; poll instead of hoping
+    # one fixed sleep beats the gossip on a loaded box.
+    wait_for_condition(
+        lambda: ray_tpu.cluster_resources().get("CPU", 0) == before,
+        timeout=20.0,
+    )
 
 
 def test_capture_child_tasks(cluster):

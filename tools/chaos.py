@@ -10,6 +10,10 @@ schedule (see ray_tpu/core/faults.py).
     python tools/chaos.py --seeds 0:5
     python tools/chaos.py --seeds 7 --spec "send.delay,p=0.3,ms=15;recv.dup,p=0.2,match=\\$reply"
     python tools/chaos.py --seeds 0:3 --workloads tasks,actors,kills
+    # preemption sweep: extra nodes join the cluster, and a seeded
+    # node.preempt rule gracefully drains one of them mid-workload (the
+    # glob matches the added nodes, never the head)
+    python tools/chaos.py --seeds 0:3 --extra-nodes 2 --preempt
 
 Exit status: number of failing seeds (0 = all schedules converged).
 """
@@ -103,13 +107,24 @@ WORKLOADS = {
 }
 
 
-def run_seed(seed: int, spec: str, workloads: list, num_cpus: int) -> dict:
+def run_seed(
+    seed: int,
+    spec: str,
+    workloads: list,
+    num_cpus: int,
+    extra_nodes: int = 0,
+) -> dict:
     import ray_tpu
     from ray_tpu.core import faults
 
     result = {"seed": seed, "ok": True, "workloads": {}, "fired": None}
-    ray_tpu.init(num_cpus=num_cpus)
+    runtime = ray_tpu.init(num_cpus=num_cpus)
     try:
+        # Extra nodes (named node1, node2, ...) give node.preempt rules a
+        # drainable victim whose work migrates to surviving peers; the
+        # head (GCS host) keeps the cluster alive.
+        for _ in range(extra_nodes):
+            runtime.add_node({"CPU": float(num_cpus)})
         inj = faults.install(faults.parse_spec(seed, spec))
         for name in workloads:
             t0 = time.perf_counter()
@@ -148,7 +163,22 @@ def main() -> int:
         help=f"comma list from {sorted(WORKLOADS)}",
     )
     ap.add_argument("--num-cpus", type=int, default=4)
+    ap.add_argument(
+        "--extra-nodes",
+        type=int,
+        default=0,
+        help="worker nodes to add beyond the head (preempt targets)",
+    )
+    ap.add_argument(
+        "--preempt",
+        action="store_true",
+        help="append a seeded node.preempt rule matching the added nodes "
+        "(implies --extra-nodes >= 1)",
+    )
     args = ap.parse_args()
+    if args.preempt:
+        args.extra_nodes = max(1, args.extra_nodes)
+        args.spec += ";node.preempt,match=node*,count=1"
 
     if ":" in args.seeds:
         lo, hi = args.seeds.split(":")
@@ -163,13 +193,20 @@ def main() -> int:
     failures = 0
     for seed in seeds:
         print(f"=== seed {seed}: spec {args.spec!r}", flush=True)
-        res = run_seed(seed, args.spec, workloads, args.num_cpus)
+        res = run_seed(
+            seed, args.spec, workloads, args.num_cpus, args.extra_nodes
+        )
         print(json.dumps(res, indent=2), flush=True)
         if not res["ok"]:
             failures += 1
             print(
                 f"REPRO: python tools/chaos.py --seeds {seed} "
-                f"--spec '{args.spec}' --workloads {args.workloads}",
+                f"--spec '{args.spec}' --workloads {args.workloads}"
+                + (
+                    f" --extra-nodes {args.extra_nodes}"
+                    if args.extra_nodes
+                    else ""
+                ),
                 flush=True,
             )
     print(f"{len(seeds) - failures}/{len(seeds)} seeds converged", flush=True)

@@ -15,8 +15,13 @@ prints violations and exits non-zero if any).
 
 from __future__ import annotations
 
+import os
 import re
 import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 HEX_ID_RE = re.compile(r"^[0-9a-f]{16,}$")
 UUID_RE = re.compile(
@@ -30,6 +35,7 @@ _CATALOG_MODULES = [
     "ray_tpu.core.protocol",
     "ray_tpu.core.scheduler",
     "ray_tpu.core.node",
+    "ray_tpu.core.gcs",  # drain lifecycle counters
     "ray_tpu.serve.router",
     "ray_tpu.serve.replica",
     "ray_tpu.data.executor",

@@ -89,7 +89,8 @@ def main() -> int:
         "--faults",
         metavar="SEED:SPEC",
         help="enable the fault-injection plane for the whole run "
-        "(RAY_TPU_FAULTS syntax) — the chaos-overhead arm of the "
+        "(RAY_TPU_FAULTS syntax; includes the node.preempt rule — a "
+        "seeded graceful-drain notice) — the chaos-overhead arm of the "
         "robustness A/B; the default arm (injector off) must stay "
         "within noise of the pre-robustness numbers",
     )
