@@ -188,7 +188,7 @@ def run_bench() -> dict:
     }
 
 
-def _probe_backend() -> str:
+def _probe_backend() -> tuple:
     """Check jax can enumerate devices, in a killable subprocess with a hard
     timeout (a wedged axon tunnel makes jax.devices() hang forever, with no
     error).
@@ -200,9 +200,14 @@ def _probe_backend() -> str:
     RAY_TPU_BENCH_PROBE_SPACING_S apart (default 300 s), and only writes
     the skip record after the whole ~30-minute window comes up dry.
 
-    Returns "ok", "wedged" (every round hung — environmental, skip cleanly)
-    or "broken" (fast nonzero exits — a jax/plugin/install regression that
-    must fail the gate, not silently skip)."""
+    Returns ``(outcome, probe_record)``. Outcome is "ok", "wedged" (every
+    round hung — environmental, skip cleanly) or "broken" (fast nonzero
+    exits — a jax/plugin/install regression that must fail the gate, not
+    silently skip). The probe record carries per-attempt telemetry
+    (return code or "timeout", stderr tail) and is persisted into the
+    emitted BENCH record EVEN on skip rounds, so a wedged round is
+    diagnosable from the BENCH_r* file afterwards instead of lost with the
+    CI logs."""
     code = (
         "import os, jax\n"
         "if os.environ.get('JAX_PLATFORMS'):\n"
@@ -212,6 +217,8 @@ def _probe_backend() -> str:
     rounds = max(1, int(os.environ.get("RAY_TPU_BENCH_PROBE_ROUNDS", "6")))
     spacing = float(os.environ.get("RAY_TPU_BENCH_PROBE_SPACING_S", "300"))
     last_outcome = "broken"
+    attempts = []  # per-attempt telemetry, persisted into the BENCH record
+    t_start = time.monotonic()
     for attempt in range(1, rounds + 1):
         try:
             r = subprocess.run(
@@ -220,10 +227,12 @@ def _probe_backend() -> str:
                 capture_output=True,
                 text=True,
             )
+            tail = "\n".join(r.stderr.strip().splitlines()[-3:])[-400:]
+            attempts.append({"rc": r.returncode, "stderr_tail": tail})
             if r.returncode == 0:
                 _log(f"backend probe ok: {r.stdout.strip()}")
-                return "ok"
-            tail = "\n".join(r.stderr.strip().splitlines()[-3:])
+                last_outcome = "ok"
+                break
             _log(f"backend probe attempt {attempt} rc={r.returncode}: {tail}")
             # A fast nonzero exit looks like deterministic breakage, but a
             # dropping tunnel can also fail fast (connection refused): keep
@@ -234,17 +243,33 @@ def _probe_backend() -> str:
             # on "broken" and goes red rather than green-skipping.
             last_outcome = "broken"
             delay = min(15.0, spacing)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            tail = ""
+            if e.stderr:
+                err = e.stderr
+                if isinstance(err, bytes):
+                    err = err.decode(errors="replace")
+                tail = "\n".join(err.strip().splitlines()[-3:])[-400:]
+            attempts.append(
+                {"rc": "timeout", "stderr_tail": tail,
+                 "timeout_s": PROBE_TIMEOUT_S}
+            )
             last_outcome = "wedged"
             delay = spacing
             _log(
                 f"backend probe attempt {attempt}/{rounds} timed out after "
                 f"{PROBE_TIMEOUT_S}s (tunnel wedged?)"
             )
-        if attempt < rounds:
+        if last_outcome != "ok" and attempt < rounds:
             _log(f"waiting {delay:.0f}s before probe attempt {attempt + 1}")
             time.sleep(delay)
-    return last_outcome
+    probe_record = {
+        "outcome": last_outcome,
+        "attempts": len(attempts),
+        "window_s": round(time.monotonic() - t_start, 1),
+        "results": attempts,
+    }
+    return last_outcome, probe_record
 
 
 def _skip(reason: str) -> dict:
@@ -293,9 +318,13 @@ def _data_plane_rows() -> dict:
     return {}
 
 
-def _emit(record: dict, data_plane: dict) -> None:
+def _emit(record: dict, data_plane: dict, probe: dict | None = None) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
+    if probe:
+        # Probe telemetry rides every record — skip rounds included — so a
+        # wedged round stays diagnosable from the BENCH_r* file.
+        record = {**record, "probe": probe}
     print(json.dumps(record), flush=True)
 
 
@@ -309,14 +338,14 @@ def main() -> None:
     # tunnel is wedged (BENCH_r* keeps tracking the object plane).
     data_plane = _data_plane_rows()
 
-    probe = _probe_backend()
+    probe, probe_record = _probe_backend()
     if probe == "wedged":
-        _emit(_skip("tpu-unavailable"), data_plane)
+        _emit(_skip("tpu-unavailable"), data_plane, probe_record)
         return
     if probe == "broken":
         # Fast nonzero exits mean jax/the plugin is broken, not that the
         # tunnel is down — a real regression must go red, not skip.
-        _emit(_skip("backend-probe-failed"), data_plane)
+        _emit(_skip("backend-probe-failed"), data_plane, probe_record)
         sys.exit(1)
 
     try:
@@ -330,24 +359,24 @@ def main() -> None:
         )
     except subprocess.TimeoutExpired:
         _log(f"bench subprocess exceeded {BENCH_TIMEOUT_S}s; tunnel wedge?")
-        _emit(_skip("tpu-unavailable"), data_plane)
+        _emit(_skip("tpu-unavailable"), data_plane, probe_record)
         return
     if r.returncode != 0:
         # The backend was alive (probe passed), so a failing measurement is a
         # real bug: emit the marker for machine readability but FAIL the gate.
         _log(f"bench subprocess failed rc={r.returncode}")
-        _emit(_skip(f"bench-failed-rc{r.returncode}"), data_plane)
+        _emit(_skip(f"bench-failed-rc{r.returncode}"), data_plane, probe_record)
         sys.exit(1)
     # Forward the subprocess's final JSON line as our one-line contract.
     for line in reversed(r.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                _emit(json.loads(line), data_plane)
+                _emit(json.loads(line), data_plane, probe_record)
             except json.JSONDecodeError:
                 print(line, flush=True)
             return
-    _emit(_skip("no-output"), data_plane)
+    _emit(_skip("no-output"), data_plane, probe_record)
 
 
 if __name__ == "__main__":

@@ -128,6 +128,25 @@ class Config:
     # serialization.dumps_oob AND in the frame encoder; smaller ones are
     # pickled in-band (framing overhead beats the copy win).
     oob_min_buffer_bytes: int = 4096
+    # Hierarchical topology-aware collectives (ROADMAP multi-pod scale-out
+    # item). hierarchical_collectives is the kill switch
+    # (RAY_TPU_HIERARCHICAL_COLLECTIVES=0): off, every collective group
+    # takes today's flat one-ring path bit-for-bit, whatever strategy the
+    # caller asked for. collective_quantize_dcn applies the EQuARX-style
+    # block-int8 codec to the cross-slice (DCN) leg of SUM-allreduces over
+    # float tensors (~4x fewer bytes on the slow hop; per-block error bound
+    # documented in README "Hierarchical collectives");
+    # collective_quant_block is the codec's block size (one fp32 scale per
+    # block). collective_dcn_deadline_s bounds one DCN hop: a blackholed
+    # inter-slice link fails the gang with DeadlineExceededError (round-9
+    # semantics) instead of hanging the collective — an injected blackhole
+    # (faults site ``dcn``) fails exactly at the deadline; a real one is
+    # bounded by a small multiple (the leader subgroup's call timeout is
+    # clamped to this value, and its data plane allows 2x for the reply).
+    hierarchical_collectives: bool = True
+    collective_quantize_dcn: bool = True
+    collective_quant_block: int = 256
+    collective_dcn_deadline_s: float = 30.0
     # Graceful node drain (reference: gcs_service.proto DrainNode + the
     # raylet's graceful-drain deadline). A draining node stops taking new
     # leases, migrates its sole-copy (primary) objects to healthy peers,

@@ -19,6 +19,15 @@ site       actions                injected where
 ``store``  pull_corrupt           ``node._h_fetch_object`` (flip served bytes)
 ``store``  pull_lose              ``node._h_fetch_object`` (raise)
 ``chan``   read_delay             dag channel ``read()`` (simulated transfer)
+``dcn``    sever delay            hierarchical-collective DCN leg
+                                  (``util/collective/hierarchical.py``):
+                                  ``sever`` = inter-slice link down →
+                                  PeerUnavailableError fails the gang fast;
+                                  ``delay`` past ``collective_dcn_deadline_s``
+                                  (``ms=inf`` = blackhole) →
+                                  DeadlineExceededError, never a hang.
+                                  ``match`` globs the group name, ``peer``
+                                  globs the affected slice name.
 =========  =====================  ==============================================
 
 Determinism: every rule owns a ``random.Random`` seeded from
@@ -65,6 +74,7 @@ _SITE_ACTIONS = {
     "gcs": frozenset({"heartbeat_blackhole"}),
     "store": frozenset({"pull_corrupt", "pull_lose"}),
     "chan": frozenset({"read_delay"}),
+    "dcn": frozenset({"sever", "delay"}),
 }
 
 

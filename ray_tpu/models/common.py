@@ -126,8 +126,10 @@ def pipelined_blocks(blocks, x, block_fn, mesh, *, n_micro):
 
         # Carries become device-varying over pp after the first ppermute;
         # mark the (replicated-zero) initial values accordingly.
+        from ray_tpu.util.jax_compat import pcast_varying
+
         init = jax.tree.map(
-            lambda z: jax.lax.pcast(z, ("pp",), to="varying"),
+            lambda z: pcast_varying(z, ("pp",)),
             (
                 jnp.zeros_like(xs[0]),
                 jnp.zeros_like(xs),
@@ -147,8 +149,10 @@ def pipelined_blocks(blocks, x, block_fn, mesh, *, n_micro):
         aux = jax.lax.psum(aux, "pp") / n_micro
         return outs.reshape(B, *x_full.shape[1:]), aux
 
+    from ray_tpu.util.jax_compat import shard_map
+
     layer_specs = jax.tree.map(lambda _: P("pp"), blocks)
-    return jax.shard_map(
+    return shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(layer_specs, P()),

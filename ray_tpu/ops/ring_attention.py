@@ -77,8 +77,10 @@ def ring_attention(
         shape = q_l.shape[:3]
         # Fresh zero/neg-inf constants are device-invariant; the scan carry
         # becomes sp-varying after the first step — mark them up front.
+        from ray_tpu.util.jax_compat import pcast_varying
+
         acc0, m0, l0 = jax.tree.map(
-            lambda z: jax.lax.pcast(z, (axis,), to="varying"),
+            lambda z: pcast_varying(z, (axis,)),
             (
                 jnp.zeros(q_l.shape, jnp.float32),
                 jnp.full(shape, _NEG_INF, jnp.float32),
@@ -91,8 +93,10 @@ def ring_attention(
         )
         return (acc / l[..., None]).astype(q_l.dtype)
 
+    from ray_tpu.util.jax_compat import shard_map
+
     seq_spec = P(None, None, axis, None)
-    return jax.shard_map(
+    return shard_map(
         local,
         mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),

@@ -43,6 +43,12 @@ class TrainContext:
     local_rank: int
     local_world_size: int
     node_rank: int
+    # Slice identity (hierarchical collective tier): which TPU slice this
+    # rank sits on, its slice's index in rank order, and the slice count —
+    # what init_collective_group(strategy="hierarchical") decomposes over.
+    slice_name: str = ""
+    slice_rank: int = 0
+    num_slices: int = 1
     storage: Optional[StorageContext] = None
     latest_checkpoint: Optional[Checkpoint] = None
     # reports buffered here; the controller polls them off the worker
@@ -70,6 +76,15 @@ class TrainContext:
 
     def get_node_rank(self) -> int:
         return self.node_rank
+
+    def get_slice_name(self) -> str:
+        return self.slice_name
+
+    def get_slice_rank(self) -> int:
+        return self.slice_rank
+
+    def get_num_slices(self) -> int:
+        return self.num_slices
 
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.latest_checkpoint

@@ -48,7 +48,9 @@ def _jax_init_worker(
 
     if platform:
         jax.config.update("jax_platforms", platform)
-    if coordinator is not None and not jax.distributed.is_initialized():
+    from ray_tpu.util.tpu import jax_distributed_initialized
+
+    if coordinator is not None and not jax_distributed_initialized():
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,

@@ -109,6 +109,9 @@ class TrainWorker:
             local_rank=context_spec["local_rank"],
             local_world_size=context_spec["local_world_size"],
             node_rank=context_spec["node_rank"],
+            slice_name=context_spec.get("slice_name", ""),
+            slice_rank=context_spec.get("slice_rank", 0),
+            num_slices=context_spec.get("num_slices", 1),
             storage=storage,
             latest_checkpoint=(
                 Checkpoint(latest_checkpoint_path)
@@ -289,11 +292,24 @@ class WorkerGroup:
     def actors(self) -> list:
         return [w.actor for w in self.workers]
 
+    def collective_topology(self):
+        """Two-level (slice → host) topology of this gang, derived from the
+        slice identities the ranks were sorted by — the structure the
+        hierarchical collective tier (util/collective/hierarchical.py)
+        decomposes over. Ranks are slice-contiguous by construction, so
+        this never raises the contiguity error."""
+        from ray_tpu.util.collective import topology as _topology
+
+        return _topology.derive(
+            [w.metadata.get("slice_name") or None for w in self.workers]
+        )
+
     def context_specs(self, experiment_name, storage_path, num_to_keep=None):
         """Per-worker context dicts: local/node ranks derived from node_id
         grouping in rank order."""
         node_order: list[str] = []
         local_counts: dict[str, int] = {}
+        topo = self.collective_topology()
         specs = []
         for w in self.workers:
             nid = w.metadata["node_id"]
@@ -310,6 +326,13 @@ class WorkerGroup:
                     "world_rank": w.world_rank,
                     "local_rank": local_rank,
                     "node_rank": node_order.index(nid),
+                    # Slice identity for the hierarchical collective tier:
+                    # train loops can init_collective_group(...,
+                    # slice_name=ctx.get_slice_name()) without re-deriving
+                    # labels, and the ranks stay slice-contiguous.
+                    "slice_name": w.metadata.get("slice_name", ""),
+                    "slice_rank": topo.slice_index(w.world_rank),
+                    "num_slices": topo.num_slices,
                 }
             )
         for i, spec in enumerate(specs):

@@ -2,7 +2,13 @@
 
 Reference parity: python/ray/util/collective/. Backends: "xla" (device
 collectives over ICI/DCN via a jax mesh) and "cpu" (coordinator-actor data
-plane for tests and host arrays).
+plane for tests and host arrays). Groups that span more than one TPU slice
+auto-select the hierarchical strategy (``strategy="hierarchical"``):
+reduce-scatter over ICI within each slice, an EQuARX-style block-int8
+quantized allreduce across the DCN hop, and an all-gather back — see
+``hierarchical.py`` / ``topology.py`` / ``quantization.py``.
+``RAY_TPU_HIERARCHICAL_COLLECTIVES=0`` kills the tier back to the flat
+path.
 """
 
 from ray_tpu.util.collective.collective import (
@@ -22,12 +28,14 @@ from ray_tpu.util.collective.collective import (
     send,
 )
 from ray_tpu.util.collective.communicator import Communicator
+from ray_tpu.util.collective.topology import TwoLevelTopology
 from ray_tpu.util.collective.types import Backend, ReduceOp
 
 __all__ = [
     "Backend",
     "Communicator",
     "ReduceOp",
+    "TwoLevelTopology",
     "allgather",
     "allreduce",
     "barrier",

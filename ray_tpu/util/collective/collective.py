@@ -89,6 +89,8 @@ class GroupManager:
             backend=decl["backend"],
             group_name=group_name,
             timeout_s=decl.get("timeout_s", DEFAULT_TIMEOUT_S),
+            strategy=decl.get("strategy", "auto"),
+            quantize_dcn=decl.get("quantize_dcn"),
         )
 
 
@@ -110,10 +112,14 @@ def _coordinator_handle(
     world_size: int,
     rank: int,
     timeout_s: float,
+    info: Optional[dict] = None,
 ):
     """Rank 0 creates the named coordinator actor; other ranks poll for it
     (the NCCLUniqueIDStore rendezvous pattern,
-    reference nccl_collective_group.py Rendezvous.meet :55).
+    reference nccl_collective_group.py Rendezvous.meet :55). Returns
+    ``(coordinator, join_infos)``: the all-ranks join barrier carries each
+    rank's ``info`` dict (slice identity) and hands every rank the complete
+    ``{rank: info}`` map — the topology exchange rides the rendezvous.
 
     The coordinator's identity is versioned per *generation*: its actor name
     carries a fresh token that rank 0 publishes to the GCS KV only after the
@@ -163,8 +169,8 @@ def _coordinator_handle(
         worker.gcs.kv_put(
             _gen_key(group_name), token.encode(), ns=_KV_NS, overwrite=True
         )
-        ray_tpu.get(coord.join.remote(rank))
-        return coord
+        infos = ray_tpu.get(coord.join.remote(rank, info))
+        return coord, infos
     deadline = time.monotonic() + timeout_s
     while True:
         if time.monotonic() > deadline:
@@ -180,8 +186,8 @@ def _coordinator_handle(
             coord = ray_tpu.get_actor(_coord_name(group_name, raw.decode()))
             # All-ranks barrier pins this rank to a generation rank 0 is
             # also in; a stale generation dies under us and we re-poll.
-            ray_tpu.get(coord.join.remote(rank))
-            return coord
+            infos = ray_tpu.get(coord.join.remote(rank, info))
+            return coord, infos
         except (
             ValueError,  # not registered yet / already deregistered
             ActorDiedError,  # stale generation killed under us
@@ -192,6 +198,28 @@ def _coordinator_handle(
             time.sleep(0.05)
 
 
+_STRATEGIES = ("auto", "flat", "hierarchical")
+
+
+def _hierarchical_enabled() -> bool:
+    """The kill switch (RAY_TPU_HIERARCHICAL_COLLECTIVES=0 / config
+    ``hierarchical_collectives``): off forces every group onto today's
+    flat path bit-for-bit, whatever the caller asked for."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    return bool(GLOBAL_CONFIG.hierarchical_collectives)
+
+
+def _flat_group(backend, group_name, world_size, rank, coord, timeout_s):
+    if backend == Backend.CPU:
+        from ray_tpu.util.collective.cpu_group import CpuGroup
+
+        return CpuGroup(group_name, world_size, rank, coord, timeout_s)
+    from ray_tpu.util.collective.xla_group import XlaGroup
+
+    return XlaGroup(group_name, world_size, rank, coord, timeout_s)
+
+
 def init_collective_group(
     world_size: int,
     rank: int,
@@ -199,6 +227,10 @@ def init_collective_group(
     group_name: str = DEFAULT_GROUP_NAME,
     *,
     timeout_s: float = DEFAULT_TIMEOUT_S,
+    strategy: str = "auto",
+    slice_name: Optional[str] = None,
+    quantize_dcn: Optional[bool] = None,
+    quant_block: Optional[int] = None,
 ) -> Communicator:
     """Join collective group ``group_name`` as ``rank`` of ``world_size``.
 
@@ -206,24 +238,103 @@ def init_collective_group(
     collective call, unless the group was declared with
     create_collective_group (then the first collective auto-joins).
 
+    ``strategy`` selects the data-plane structure: ``"flat"`` is today's
+    one-ring path; ``"hierarchical"`` composes per-slice (ICI) subgroups
+    with a quantized cross-slice (DCN) leg (``hierarchical.py``);
+    ``"auto"`` (default) picks hierarchical only when the group's derived
+    topology spans more than one slice — single-slice groups stay flat
+    bit-for-bit. Slice identity comes from ``slice_name`` when given, else
+    from the TPU env / node labels (``topology.current_slice_name``).
+    ``quantize_dcn``/``quant_block`` override the config defaults for the
+    EQuARX-style int8 DCN leg (SUM over float tensors only; other ops ride
+    full precision). ``RAY_TPU_HIERARCHICAL_COLLECTIVES=0`` is the global
+    kill switch back to flat.
+
     Failure semantics match communicator libraries (NCCL included): a group
     is one generation of processes. If any member dies mid-run, the whole
     gang must re-init the group (rank 0's re-init retires the old
     coordinator) — a lone restarted member cannot rejoin an in-flight
     generation, because its op sequence numbers restart from zero.
     """
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
     backend = Backend.parse(backend)
-    coord = _coordinator_handle(group_name, world_size, rank, timeout_s)
-    if backend == Backend.CPU:
-        from ray_tpu.util.collective.cpu_group import CpuGroup
-
-        comm: Communicator = CpuGroup(
-            group_name, world_size, rank, coord, timeout_s
+    if strategy not in _STRATEGIES:
+        raise ValueError(
+            f"unknown collective strategy {strategy!r}; "
+            f"available: {_STRATEGIES}"
         )
-    else:
-        from ray_tpu.util.collective.xla_group import XlaGroup
+    if not _hierarchical_enabled():
+        strategy = "flat"
+    if strategy != "flat" and slice_name is None:
+        from ray_tpu.util.collective import topology as _topology
 
-        comm = XlaGroup(group_name, world_size, rank, coord, timeout_s)
+        slice_name = _topology.current_slice_name()
+    coord, infos = _coordinator_handle(
+        group_name,
+        world_size,
+        rank,
+        timeout_s,
+        info={"slice": slice_name or ""},
+    )
+    comm: Optional[Communicator] = None
+    if strategy != "flat":
+        from ray_tpu.util.collective import topology as _topology
+        from ray_tpu.util.collective.hierarchical import (
+            HierarchicalGroup,
+            xla_hierarchical_group,
+        )
+
+        try:
+            topo = _topology.derive(
+                [
+                    (infos.get(r) or {}).get("slice") or None
+                    for r in range(world_size)
+                ]
+            )
+        except ValueError:
+            # Non-contiguous slice ranks (a user-chosen rank permutation
+            # that interleaves slices). An explicit hierarchical request
+            # must surface the problem; auto keeps such groups on the flat
+            # path they always had.
+            if strategy == "hierarchical":
+                raise
+            topo = None
+        if quantize_dcn is None:
+            quantize_dcn = GLOBAL_CONFIG.collective_quantize_dcn
+        if quant_block is None:
+            quant_block = GLOBAL_CONFIG.collective_quant_block
+        if topo is None or not topo.spans_dcn:
+            comm = None  # one ICI domain (or underivable): flat path
+        elif backend == Backend.XLA:
+            if topo.uniform:
+                comm = xla_hierarchical_group(
+                    group_name, world_size, rank, coord, timeout_s,
+                    topology=topo, quantize_dcn=quantize_dcn,
+                    quant_block=quant_block,
+                )
+            elif strategy == "hierarchical":
+                # An explicit request must not silently degrade to
+                # full-precision flat traffic; auto may.
+                raise ValueError(
+                    f"strategy='hierarchical' on the xla backend needs "
+                    f"equal ranks per slice to form the (dcn, ici) mesh; "
+                    f"got {[len(topo.ranks_in_slice(s)) for s in range(topo.num_slices)]} "
+                    f"ranks across slices {topo.slices}"
+                )
+            # Non-uniform slices can't form the 2-D mesh; auto falls flat.
+        else:
+            from ray_tpu.util.collective.cpu_group import CpuGroup
+
+            comm = HierarchicalGroup(
+                group_name, world_size, rank, coord, timeout_s,
+                topology=topo, backend_factory=CpuGroup,
+                quantize_dcn=quantize_dcn, quant_block=quant_block,
+            )
+    if comm is None:
+        comm = _flat_group(
+            backend, group_name, world_size, rank, coord, timeout_s
+        )
     _group_mgr.add(comm)
     return comm
 
@@ -236,10 +347,14 @@ def create_collective_group(
     group_name: str = DEFAULT_GROUP_NAME,
     *,
     timeout_s: float = DEFAULT_TIMEOUT_S,
+    strategy: str = "auto",
+    quantize_dcn: Optional[bool] = None,
 ) -> None:
     """Declare a collective group over ``actors`` (reference
     collective.py:211): stores {actor_id: rank} in the GCS KV; each actor
-    auto-joins on its first collective call."""
+    auto-joins on its first collective call. ``strategy``/``quantize_dcn``
+    ride the declaration so auto-joining actors agree on the data-plane
+    structure (see init_collective_group)."""
     from ray_tpu.core import api as core_api
 
     backend = Backend.parse(backend)
@@ -249,11 +364,18 @@ def create_collective_group(
         raise ValueError(
             f"ranks must be a permutation of range({world_size}), got {ranks}"
         )
+    if strategy not in _STRATEGIES:
+        raise ValueError(
+            f"unknown collective strategy {strategy!r}; "
+            f"available: {_STRATEGIES}"
+        )
     worker = core_api._require_worker()
     decl = {
         "world_size": world_size,
         "backend": backend.value,
         "timeout_s": timeout_s,
+        "strategy": strategy,
+        "quantize_dcn": quantize_dcn,
         "actor_ranks": {
             a._actor_id: r for a, r in zip(actors, ranks)
         },
@@ -282,20 +404,13 @@ def get_collective_group_size(group_name: str = DEFAULT_GROUP_NAME) -> int:
     return comm.world_size if comm is not None else -1
 
 
-def destroy_collective_group(group_name: str = DEFAULT_GROUP_NAME) -> None:
-    """Leave the group locally; rank 0 (or a non-member, e.g. the driver that
-    declared the group) also tears down the shared state (coordinator actor,
-    KV declaration). Non-zero ranks only leave — the coordinator doubles as
-    the P2P mailbox, so killing it from any rank could drop in-flight
-    messages other ranks have yet to recv. Drain P2P before destroying."""
+def _teardown_group_state(group_name: str) -> None:
+    """Tear down one group's shared state: KV declaration, generation key,
+    and the coordinator actor. Used by rank 0 of the top-level group and by
+    rank 0 of each hierarchical subgroup (``hierarchical.py``)."""
     import ray_tpu
     from ray_tpu.core import api as core_api
 
-    comm = _group_mgr.remove(group_name)
-    if comm is not None:
-        comm.destroy()
-    if comm is not None and comm.rank != 0:
-        return
     try:
         worker = core_api._require_worker(auto_init=False)
         worker.gcs.kv_del(f"decl::{group_name}", ns=_KV_NS)
@@ -306,6 +421,20 @@ def destroy_collective_group(group_name: str = DEFAULT_GROUP_NAME) -> None:
             ray_tpu.kill(coord)
     except Exception:
         pass
+
+
+def destroy_collective_group(group_name: str = DEFAULT_GROUP_NAME) -> None:
+    """Leave the group locally; rank 0 (or a non-member, e.g. the driver that
+    declared the group) also tears down the shared state (coordinator actor,
+    KV declaration). Non-zero ranks only leave — the coordinator doubles as
+    the P2P mailbox, so killing it from any rank could drop in-flight
+    messages other ranks have yet to recv. Drain P2P before destroying."""
+    comm = _group_mgr.remove(group_name)
+    if comm is not None:
+        comm.destroy()
+    if comm is not None and comm.rank != 0:
+        return
+    _teardown_group_state(group_name)
 
 
 # ---------------------------------------------------------------------------

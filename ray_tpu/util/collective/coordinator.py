@@ -35,6 +35,10 @@ class CollectiveCoordinator:
         self._mail: dict[tuple, list] = {}
         # ranks that completed the init-time join barrier (idempotent)
         self._joined: set[int] = set()
+        # per-rank join-time metadata (slice identity etc.); the complete
+        # map is every rank's join() return value, so topology derivation
+        # needs no extra KV round trips
+        self._join_info: dict[int, dict] = {}
         # small KV for backend-specific rendezvous (e.g. XLA coordinator addr)
         self._meta: dict[str, bytes] = {}
 
@@ -46,11 +50,13 @@ class CollectiveCoordinator:
     def ping(self) -> bool:
         return True
 
-    def join(self, rank: int) -> bool:
+    def join(self, rank: int, info: dict | None = None) -> dict:
         """All-ranks barrier that binds a rank to THIS coordinator generation
         at init time (see collective._coordinator_handle): a rank that bound
         a stale generation blocks here forever instead of leaking collective
-        contributions into an actor about to be killed.
+        contributions into an actor about to be killed. Returns the
+        complete ``{rank: info}`` map once every rank has arrived — the
+        rendezvous doubles as the topology exchange.
 
         Idempotent per rank (set-based): a rank whose join RPC was delivered
         but whose reply was lost may safely retry, and a re-join after the
@@ -59,13 +65,15 @@ class CollectiveCoordinator:
         deadline = self._deadline()
         with self._cv:
             self._joined.add(int(rank))
+            if info is not None:
+                self._join_info[int(rank)] = info
             self._cv.notify_all()
             while len(self._joined) < self._world:
                 self._wait(
                     deadline,
                     f"join ({len(self._joined)}/{self._world} ranks)",
                 )
-            return True
+            return dict(self._join_info)
 
     # -- rendezvous metadata -------------------------------------------------
 

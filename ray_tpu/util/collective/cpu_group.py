@@ -12,7 +12,12 @@ from __future__ import annotations
 from typing import Any, List
 
 from ray_tpu.util.collective.communicator import Communicator
-from ray_tpu.util.collective.types import ReduceOp, like_input, to_numpy
+from ray_tpu.util.collective.types import (
+    ReduceOp,
+    like_input,
+    to_numpy,
+    validate_reducescatter_input,
+)
 
 
 class CpuGroup(Communicator):
@@ -72,7 +77,12 @@ class CpuGroup(Communicator):
         return [like_input(tensor, o) for o in outs]
 
     def reducescatter(self, tensor, op: ReduceOp = ReduceOp.SUM):
-        out = self._call("reducescatter", to_numpy(tensor), {"op": ReduceOp(op)})
+        arr = to_numpy(tensor)
+        # Validate before shipping: a misshaped input must fail HERE with a
+        # clear ValueError, not poison the whole gang's op at the
+        # coordinator (the server-side check remains as defense).
+        validate_reducescatter_input(arr, self._world_size)
+        out = self._call("reducescatter", arr, {"op": ReduceOp(op)})
         return like_input(tensor, out)
 
     def send(self, tensor, dst_rank: int) -> None:

@@ -1,0 +1,143 @@
+"""EQuARX-style block-wise int8 quantization for the DCN collective leg.
+
+The codec (PAPERS.md: "EQuARX: Efficient Quantized AllReduce in XLA"):
+split the flattened tensor into fixed-size blocks, carry one fp32 scale per
+block (symmetric, ``scale = max|x| / 127``), round each element to int8,
+and accumulate in fp32 at the reducer — quantized payloads are NEVER summed
+in the integer domain. Applied only to the bandwidth-bound DCN hop between
+slices; the ICI leg stays full precision.
+
+Error contract (documented in README "Hierarchical collectives" and
+asserted by tests/test_collective_hierarchical.py): one quantize step
+introduces at most ``scale / 2 = max|x_block| / 254`` absolute error per
+element. A hierarchical allreduce over ``S`` slices quantizes each slice's
+partial sum exactly once, so
+
+    |result - exact| <= sum_s max|partial_s block| / 254
+                     <= S * max_s max|partial_s block| / 254
+
+per element, block-wise. Integer and bool tensors are not quantized
+(``should_quantize`` gates the leg); non-SUM reductions fall back to full
+precision — min/max under rounding would be biased, not just noisy.
+Non-finite partials (mixed-precision gradient overflow) also ride full
+precision on the host engine — a nan/inf abs-max would poison its whole
+block's scale, where the flat path propagates the inf intact for the AMP
+scaler to catch. (On the single-program XLA engine the blast radius of a
+non-finite element is its own block.)
+
+Wire format (``pack``/``unpack``): a uint8 vector, so any Communicator
+backend can move it as an ordinary equal-shape array over its data plane —
+    [u32 ndim][u32 dims...][u32 block][u32 nelems][f32 scales][i8 payload]
+little-endian, scales one per block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+DEFAULT_BLOCK = 256
+
+# int8 symmetric range: round() targets [-127, 127]; /254 = scale/2 error.
+_QMAX = 127.0
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedTensor:
+    """One block-quantized tensor: int8 payload + per-block fp32 scales."""
+
+    data: np.ndarray  # int8, flat, zero-padded to a block multiple
+    scales: np.ndarray  # fp32, one per block
+    shape: tuple
+    block: int
+    nelems: int
+
+    @property
+    def nbytes(self) -> int:
+        """Bytes this tensor occupies on the wire (payload + scales)."""
+        return self.data.nbytes + self.scales.nbytes
+
+
+def should_quantize(arr: np.ndarray) -> bool:
+    """Only inexact (float) dtypes quantize; ints/bools ride full fidelity."""
+    return np.issubdtype(np.asarray(arr).dtype, np.floating)
+
+
+def quantize_blockwise(
+    arr: np.ndarray, block: int = DEFAULT_BLOCK
+) -> QuantizedTensor:
+    if block < 1:
+        raise ValueError(f"quantization block must be >= 1, got {block}")
+    arr = np.asarray(arr)
+    flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1)
+    nelems = flat.size
+    pad = (-nelems) % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    blocks = flat.reshape(-1, block)
+    absmax = np.abs(blocks).max(axis=1, keepdims=True)
+    scales = absmax / _QMAX
+    # All-zero blocks get scale 0; divide by 1 there to keep the math clean
+    # (the payload is exactly 0 either way).
+    safe = np.where(scales > 0, scales, 1.0)
+    q = np.rint(blocks / safe).astype(np.int8)
+    return QuantizedTensor(
+        data=q.reshape(-1),
+        scales=scales.reshape(-1).astype(np.float32),
+        shape=tuple(arr.shape),
+        block=block,
+        nelems=nelems,
+    )
+
+
+def dequantize_blockwise(q: QuantizedTensor) -> np.ndarray:
+    """fp32 reconstruction — the accumulation dtype at the reducer."""
+    blocks = q.data.astype(np.float32).reshape(-1, q.block)
+    out = blocks * q.scales.reshape(-1, 1)
+    return out.reshape(-1)[: q.nelems].reshape(q.shape)
+
+
+def error_bound(q: QuantizedTensor) -> np.ndarray:
+    """Per-element absolute error bound of THIS quantization step, shaped
+    like the original tensor: half the owning block's scale."""
+    per_block = q.scales / 2.0
+    full = np.repeat(per_block, q.block)
+    return full[: q.nelems].reshape(q.shape)
+
+
+# -- wire format --------------------------------------------------------------
+
+
+def pack(q: QuantizedTensor) -> np.ndarray:
+    """Serialize to a uint8 vector (for backends that move equal-shape
+    arrays, e.g. an XLA all-gather over the DCN axis or the coordinator
+    data plane)."""
+    header = np.array(
+        [len(q.shape), *q.shape, q.block, q.nelems], dtype="<u4"
+    )
+    return np.concatenate(
+        [
+            header.view(np.uint8),
+            q.scales.astype("<f4").view(np.uint8),
+            q.data.view(np.uint8),
+        ]
+    )
+
+
+def unpack(buf: np.ndarray) -> QuantizedTensor:
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    ndim = int(buf[:4].view("<u4")[0])
+    header_words = 1 + ndim + 2
+    header = buf[: 4 * header_words].view("<u4")
+    shape = tuple(int(d) for d in header[1 : 1 + ndim])
+    block = int(header[1 + ndim])
+    nelems = int(header[2 + ndim])
+    nblocks = (nelems + block - 1) // block
+    off = 4 * header_words
+    scales = buf[off : off + 4 * nblocks].view("<f4").astype(np.float32)
+    off += 4 * nblocks
+    data = buf[off : off + nblocks * block].view(np.int8)
+    return QuantizedTensor(
+        data=data, scales=scales, shape=shape, block=block, nelems=nelems
+    )

@@ -62,6 +62,25 @@ def numpy_reduce(arrays: list, op: "ReduceOp | str") -> np.ndarray:
     return _NUMPY_REDUCERS[ReduceOp(op)](np.stack(arrays, axis=0))
 
 
+def validate_reducescatter_input(arr: Any, world_size: int) -> None:
+    """Up-front reducescatter shape check, shared by every backend: dim0
+    must split evenly across the group, and the error must be the same
+    clear ValueError whether the data plane is the coordinator actor, an
+    XLA mesh, or the hierarchical composition — not a backend-dependent
+    misshape deep inside the op."""
+    shape = np.shape(arr)
+    if len(shape) == 0:
+        raise ValueError(
+            f"reducescatter input must have at least 1 dimension to "
+            f"scatter across world size {world_size}, got a scalar"
+        )
+    if shape[0] % world_size != 0:
+        raise ValueError(
+            f"reducescatter dim0 {shape[0]} not divisible by world size "
+            f"{world_size}"
+        )
+
+
 def to_numpy(tensor: Any) -> np.ndarray:
     """Host copy of a tensor (numpy / jax array / python scalar / list)."""
     if isinstance(tensor, np.ndarray):

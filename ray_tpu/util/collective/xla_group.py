@@ -19,7 +19,11 @@ from __future__ import annotations
 from typing import Any, List
 
 from ray_tpu.util.collective.communicator import Communicator
-from ray_tpu.util.collective.types import ReduceOp, to_numpy
+from ray_tpu.util.collective.types import (
+    ReduceOp,
+    to_numpy,
+    validate_reducescatter_input,
+)
 
 _REDUCE_LAX = {
     ReduceOp.SUM: "psum",
@@ -62,9 +66,11 @@ class XlaGroup(Communicator):
 
         if self._world_size == 1:
             return
+        from ray_tpu.util.tpu import jax_distributed_initialized
+
         # NB: don't probe jax.process_count() here — it would initialize the
         # XLA backend, after which jax.distributed.initialize() refuses to run.
-        if jax.distributed.is_initialized():
+        if jax_distributed_initialized():
             # Multi-controller runtime already up (e.g. the train tier ran
             # jax.distributed.initialize); reuse it.
             if jax.process_count() != self._world_size:
@@ -94,6 +100,7 @@ class XlaGroup(Communicator):
 
         if self._world_size == 1:
             self._my_device = jax.local_devices()[0]
+            self._devices = [self._my_device]
             self._mesh = Mesh([self._my_device], ("ranks",))
             return
         by_proc: dict[int, Any] = {}
@@ -106,6 +113,9 @@ class XlaGroup(Communicator):
             )
         devices = [by_proc[p] for p in sorted(by_proc)]
         self._my_device = by_proc[jax.process_index()]
+        # Rank-ordered device list: XlaHierarchicalGroup reshapes it into
+        # the 2-D (dcn, ici) mesh.
+        self._devices = devices
         self._mesh = Mesh(devices, ("ranks",))
 
     # -- device data plane ---------------------------------------------------
@@ -130,7 +140,8 @@ class XlaGroup(Communicator):
         import jax
         import numpy as np
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+
+        from ray_tpu.util.jax_compat import shard_map
 
         garr = self._global_array(tensor)
         cache_key = (kind, tuple(sorted(static.items())))
@@ -272,6 +283,11 @@ class XlaGroup(Communicator):
         import jax.numpy as jnp
 
         op = ReduceOp(op)
+        # Validate before tracing: psum_scatter on an indivisible dim0
+        # would otherwise surface as a backend-dependent shape error from
+        # inside XLA; the cpu backend raises the same ValueError. The
+        # check only reads .shape — no device-to-host copy.
+        validate_reducescatter_input(tensor, self._world_size)
         return jnp.asarray(
             self._run("reducescatter", tensor, op=_REDUCE_LAX[op])
         )

@@ -1,0 +1,81 @@
+"""Version-tolerant wrappers over jax APIs that moved between releases.
+
+The tree targets the modern surface (``jax.shard_map`` with ``check_vma``
+/ ``axis_names``); the pinned toolchain in some environments still ships
+the ``jax.experimental.shard_map`` spelling (``check_rep`` / ``auto``).
+One adapter keeps every call site on the modern vocabulary instead of
+sprinkling try/except at each shard_map construction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    axis_names: Optional[set] = None,
+    check_vma: bool = True,
+):
+    """``jax.shard_map`` if available, else the experimental spelling.
+
+    ``axis_names`` is the modern parameter: the mesh axes the body handles
+    manually (all of them when None). On old jax that maps to ``auto`` =
+    the complement, and ``check_vma`` maps to ``check_rep``.
+    """
+    import jax
+
+    modern = getattr(jax, "shard_map", None)
+    if modern is not None:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return modern(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    # Two deliberate downgrades on the legacy path:
+    #
+    # * check_rep is always off: bodies in this tree state replication
+    #   invariants in the modern VMA vocabulary (lax.pcast/pvary), which
+    #   legacy jax lacks — its rep checker then mis-reports scan carries
+    #   that become device-varying (ppermute rings, collective
+    #   accumulators). The checker is purely static; disabling it does not
+    #   change lowering.
+    # * axis_names does NOT become `auto`: partial-auto shard_map on the
+    #   legacy SPMD partitioner lowers axis_index to a PartitionId
+    #   instruction it then rejects as UNIMPLEMENTED. Full-manual is
+    #   correct for every call site in this tree (their in/out_specs only
+    #   shard over the named axes, so the formerly-auto axes see
+    #   replicated data and produce replicated results) at the cost of
+    #   redundant per-device compute — a legacy-environment-only tax.
+    return _legacy(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=False,
+        auto=frozenset(),
+    )
+
+
+def pcast_varying(x, axes):
+    """Mark ``x`` as varying over manual ``axes`` (modern
+    ``jax.lax.pcast(..., to="varying")``). Older jax has ``pvary``; oldest
+    has neither — there the VMA system doesn't exist, replication isn't
+    tracked (we run shard_map with check_rep=False), and identity is the
+    correct lowering."""
+    import jax
+
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axes), to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, tuple(axes))
+    return x

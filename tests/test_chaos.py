@@ -39,6 +39,7 @@ _CFG_FIELDS = (
     "node_heartbeat_interval_s",
     "verify_transfers",
     "drain_grace_s",
+    "collective_dcn_deadline_s",
 )
 
 
@@ -612,6 +613,142 @@ def test_chaos_preempt_zero_grace_falls_back_to_reconstruction(
     from ray_tpu.core import api as core_api
 
     assert core_api._require_worker().reconstructions > 0
+
+
+# -- collectives under DCN faults ---------------------------------------------
+# Round-11 acceptance: a severed or blackholed inter-slice link mid-allreduce
+# must fail the WHOLE gang fast with round-9 error semantics
+# (PeerUnavailableError for a severed link, DeadlineExceededError for a
+# blackhole) — never hang. The fault fires in the slice leaders' processes
+# (RAY_TPU_FAULTS rides the env into spawned workers); leaders propagate the
+# typed error to their slice members over the group mailbox.
+
+
+@ray_tpu.remote(num_cpus=0)
+class _DcnMember:
+    def __init__(self, world, rank, group, slice_name):
+        from ray_tpu.util import collective as col
+
+        self._col = col
+        self._group = group
+        col.init_collective_group(
+            world, rank, backend="cpu", group_name=group, timeout_s=30.0,
+            slice_name=slice_name,
+        )
+
+    def allreduce_capture(self, value):
+        """Run one allreduce; report the outcome instead of raising so the
+        test can assert the exact error type on every rank."""
+        import numpy as np
+
+        try:
+            out = self._col.allreduce(
+                np.full((64,), value, np.float32), group_name=self._group
+            )
+            return ("ok", float(np.asarray(out)[0]))
+        except Exception as e:  # noqa: BLE001 — the type IS the assertion
+            return ("err", type(e).__name__)
+
+
+def _dcn_chaos_run(fault_spec, group):
+    """Init a cluster with RAY_TPU_FAULTS exported (so member worker
+    processes inherit the injector), run one 2-slice allreduce, and return
+    each rank's outcome plus the wall time."""
+    import os
+
+    GLOBAL_CONFIG.collective_dcn_deadline_s = 1.0
+    os.environ["RAY_TPU_FAULTS"] = fault_spec
+    runtime = ray_tpu.init(num_cpus=8)
+    try:
+        slices = ["sa", "sa", "sb", "sb"]
+        members = [
+            _DcnMember.remote(4, r, group, slices[r]) for r in range(4)
+        ]
+        t0 = time.monotonic()
+        outs = ray_tpu.get(
+            [m.allreduce_capture.remote(1.0) for m in members], timeout=90
+        )
+        elapsed = time.monotonic() - t0
+        for m in members:
+            ray_tpu.kill(m)
+        return outs, elapsed
+    finally:
+        del os.environ["RAY_TPU_FAULTS"]
+        faults.clear()
+        ray_tpu.shutdown()
+
+
+def test_dcn_sever_fails_whole_gang_fast():
+    """A severed inter-slice link: every rank — leaders that hit the fault
+    AND members waiting on their leader — fails with PeerUnavailableError,
+    well inside the group timeout (fail fast, never hang)."""
+    outs, elapsed = _dcn_chaos_run(
+        "13:dcn.sever,match=g_dcn_sever", "g_dcn_sever"
+    )
+    assert outs == [("err", "PeerUnavailableError")] * 4, outs
+    assert elapsed < 30.0, f"sever took {elapsed:.1f}s — not fail-fast"
+
+
+def test_dcn_blackhole_deadlines_not_hangs():
+    """An infinite DCN delay (ms=inf blackhole) converts to
+    DeadlineExceededError after collective_dcn_deadline_s on every rank —
+    the round-9 deadline discipline applied to the collective tier."""
+    outs, elapsed = _dcn_chaos_run(
+        "13:dcn.delay,ms=inf,match=g_dcn_bh", "g_dcn_bh"
+    )
+    assert outs == [("err", "DeadlineExceededError")] * 4, outs
+    assert elapsed < 30.0, f"blackhole took {elapsed:.1f}s — not fail-fast"
+
+
+def test_dcn_short_delay_converges():
+    """A bounded DCN delay under the deadline only slows the hop: the
+    allreduce still converges to the exact result (seeded, replayable)."""
+    outs, _ = _dcn_chaos_run(
+        "13:dcn.delay,ms=50,match=g_dcn_slow", "g_dcn_slow"
+    )
+    # Quantization is ON by default, so the sum is within the codec's
+    # bound of 4.0 rather than bitwise (the exactness contract is covered
+    # by test_collective_hierarchical.py).
+    assert all(
+        o[0] == "ok" and abs(o[1] - 4.0) < 0.05 for o in outs
+    ), outs
+
+
+def test_dcn_real_hang_converts_to_deadline_error():
+    """No fault injection at all: a peer slice that simply never shows up
+    on the DCN hop (real blackhole) still fails the waiting slice with
+    DeadlineExceededError on the collective_dcn_deadline_s clock — the
+    deadline bounds the real exchange, not just the simulated one."""
+    GLOBAL_CONFIG.collective_dcn_deadline_s = 1.0
+    runtime = ray_tpu.init(num_cpus=8)
+    try:
+        slices = ["sa", "sa", "sb", "sb"]
+        members = [
+            _DcnMember.remote(4, r, "g_dcn_real", slices[r])
+            for r in range(4)
+        ]
+        # Groups form (all four join), but slice-b never enters the op.
+        t0 = time.monotonic()
+        outs = ray_tpu.get(
+            [m.allreduce_capture.remote(1.0) for m in members[:2]],
+            timeout=90,
+        )
+        elapsed = time.monotonic() - t0
+        assert outs == [("err", "DeadlineExceededError")] * 2, outs
+        assert elapsed < 30.0, f"real hang took {elapsed:.1f}s"
+        for m in members:
+            ray_tpu.kill(m)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_dcn_site_parses_and_is_seeded():
+    inj = faults.parse_env("3:dcn.sever,match=train*;dcn.delay,ms=inf,peer=s1")
+    assert [r.site for r in inj.rules] == ["dcn", "dcn"]
+    assert inj.rules[1].delay_s == faults.INF
+    assert inj.decide("dcn", name="train_group", peer="s0") is not None
+    with pytest.raises(ValueError):
+        faults.parse_rule("dcn.kill_worker")  # action/site mismatch
 
 
 @pytest.mark.slow

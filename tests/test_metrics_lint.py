@@ -80,6 +80,25 @@ def test_drain_series_registered_and_linted():
     assert lint_catalog(catalog) == []
 
 
+def test_collective_series_registered_and_linted():
+    """The hierarchical-collective telemetry (per-tier hop-time histogram,
+    DCN bytes pre/post quantization, op counter) is declared through the
+    catalog so the lint covers it."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    assert "raytpu_collective_hop_seconds" in catalog
+    assert catalog["raytpu_collective_hop_seconds"]["kind"] == "histogram"
+    assert catalog["raytpu_collective_hop_seconds"]["tag_keys"] == ("tier",)
+    for name in (
+        "raytpu_collective_dcn_bytes_pre_total",
+        "raytpu_collective_dcn_bytes_post_total",
+        "raytpu_collective_ops_total",
+    ):
+        assert name in catalog, f"{name} missing from the runtime catalog"
+        assert catalog[name]["kind"] == "counter"
+    assert lint_catalog(catalog) == []
+
+
 def test_declare_runtime_metric_enforces_rules():
     with pytest.raises(ValueError, match="prefix"):
         m.declare_runtime_metric("unprefixed_series", "counter")

@@ -1,0 +1,55 @@
+"""Same-session A/B of the hierarchical collective tier (PERF.md round-11).
+
+Runs tools/ray_perf.py alternately with the hierarchical + quantized
+collectives ON (HEAD defaults) and OFF on the SAME commit, interleaved so
+ambient box load hits both arms equally (the round-3 lesson). Two arms:
+
+    --arm hierarchical   ON vs --no-hierarchical (flat one-ring baseline —
+                         the strategy A/B; the 1-slice row must stay at
+                         parity, it never takes the hierarchical path)
+    --arm quantized      ON vs --no-quantized (hierarchical both sides,
+                         fp32 DCN leg as baseline — isolates the codec;
+                         read collective_dcn_bytes_ratio for the ~4x
+                         wire-byte reduction)
+
+    python tools/ab_collective.py [--arm hierarchical|quantized]
+                                  [--rounds 3] [--full]
+
+The interleaved-median machinery is shared with tools/ab_coalesce.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import interleaved_ab  # noqa: E402 — shared harness
+
+_ARMS = {
+    "hierarchical": "--no-hierarchical",
+    "quantized": "--no-quantized",
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--arm", choices=sorted(_ARMS), default="hierarchical",
+        help="which kill switch the OFF arm uses",
+    )
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument(
+        "--full", action="store_true", help="full (not --quick) perf runs"
+    )
+    args = ap.parse_args()
+    interleaved_ab(
+        _ARMS[args.arm], f"collective-{args.arm}", args.rounds, args.full
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

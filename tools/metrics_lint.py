@@ -41,6 +41,7 @@ _CATALOG_MODULES = [
     "ray_tpu.data.executor",
     "ray_tpu.train.context",
     "ray_tpu.train.worker_group",
+    "ray_tpu.util.collective.hierarchical",  # collective hop/byte series
 ]
 _OPTIONAL_MODULES = ["ray_tpu.llm.engine", "ray_tpu.llm.serve_llm"]
 

@@ -86,6 +86,20 @@ def main() -> int:
         "the BENCH_r* data-plane record)",
     )
     ap.add_argument(
+        "--no-hierarchical",
+        action="store_true",
+        help="kill switch: flat one-ring collectives (equivalent to "
+        "RAY_TPU_HIERARCHICAL_COLLECTIVES=0) — the A/B baseline for the "
+        "PERF.md round-11 hierarchical-collective tier",
+    )
+    ap.add_argument(
+        "--no-quantized",
+        action="store_true",
+        help="keep the hierarchical structure but ship the DCN leg at "
+        "full precision (no block-int8 codec) — isolates the "
+        "quantization arm of the round-11 A/B",
+    )
+    ap.add_argument(
         "--faults",
         metavar="SEED:SPEC",
         help="enable the fault-injection plane for the whole run "
@@ -105,7 +119,13 @@ def main() -> int:
     batch = 20 if args.quick else 100
     min_s = 0.5 if args.quick else 2.0
 
-    if args.no_coalesce or args.no_metrics or args.no_scatter_gather:
+    if (
+        args.no_coalesce
+        or args.no_metrics
+        or args.no_scatter_gather
+        or args.no_hierarchical
+        or args.no_quantized
+    ):
         from ray_tpu.core.config import GLOBAL_CONFIG
 
         # Before init: the head ships this config to every node/worker.
@@ -115,6 +135,10 @@ def main() -> int:
             GLOBAL_CONFIG.metrics_enabled = False
         if args.no_scatter_gather:
             GLOBAL_CONFIG.rpc_scatter_gather_enabled = False
+        if args.no_hierarchical:
+            GLOBAL_CONFIG.hierarchical_collectives = False
+        if args.no_quantized:
+            GLOBAL_CONFIG.collective_quantize_dcn = False
 
     ray_tpu.init(num_cpus=16)
     results = {}
@@ -268,6 +292,99 @@ def main() -> int:
         ray_tpu.get(refs)
 
     record("n_n_actor_calls_async", n_n_async, batch * 2 * len(sinks))
+
+    # -- collectives (round-11 hierarchical + quantized DCN) -----------------
+    # Two allreduce rows over real member-actor gangs on the coordinator
+    # data plane: a 2-slice group (slice identities passed explicitly, so
+    # auto strategy picks hierarchical unless --no-hierarchical) and a
+    # 1-slice group (always flat — the parity row: hierarchical selection
+    # must not touch it). Bytes ride MB/s like the data-plane rows; the
+    # dcn byte counters from rank 0's process give the quantization ratio.
+
+    @ray_tpu.remote(num_cpus=0)
+    class _CollMember:
+        def __init__(self, world, rank, group, slice_name):
+            from ray_tpu.util import collective as col
+
+            self._col = col
+            self._group = group
+            self._comm = col.init_collective_group(
+                world, rank, backend="cpu", group_name=group,
+                timeout_s=120.0, slice_name=slice_name,
+            )
+
+        def strategy(self):
+            return self._comm.backend
+
+        def allreduce(self, n):
+            t = np.ones(n, np.float32)
+            out = self._col.allreduce(t, group_name=self._group)
+            return float(np.asarray(out)[0])
+
+        def dcn_bytes(self):
+            from ray_tpu.util.metrics import registry
+
+            out = {"pre": 0.0, "post": 0.0}
+            for name, _tags, value in registry().snapshot()["points"]:
+                if name == "raytpu_collective_dcn_bytes_pre_total":
+                    out["pre"] = float(value)
+                elif name == "raytpu_collective_dcn_bytes_post_total":
+                    out["post"] = float(value)
+            return out
+
+        def destroy(self):
+            from ray_tpu.util import collective as col
+
+            col.destroy_collective_group(self._group)
+            return True
+
+    n_elems = 256 * 1024  # 1 MiB fp32 per rank per op
+    coll_mb = n_elems * 4 / 1e6
+    world = 4
+    for row, slices in (
+        ("collective_allreduce_2slice", ["s0", "s0", "s1", "s1"]),
+        ("collective_allreduce_1slice", ["s0", "s0", "s0", "s0"]),
+    ):
+        members = [
+            _CollMember.remote(world, r, row, slices[r])
+            for r in range(world)
+        ]
+        strat = ray_tpu.get(
+            [m.strategy.remote() for m in members], timeout=120
+        )[0]
+
+        def coll_op(ms=members):
+            ray_tpu.get(
+                [m.allreduce.remote(n_elems) for m in ms], timeout=120
+            )
+
+        n, rate = timeit(row, coll_op, 1, min_s=min_s, max_iters=30)
+        results[n] = round(rate * coll_mb, 2)
+        print(f"  -> {results[n]:.1f} MB/s ({strat})", flush=True)
+        if row == "collective_allreduce_2slice":
+            b = ray_tpu.get(members[0].dcn_bytes.remote(), timeout=60)
+            if b["post"]:
+                results["collective_dcn_bytes_ratio"] = round(
+                    b["pre"] / b["post"], 3
+                )
+                print(
+                    f"  dcn bytes: {b['pre']:.0f} pre / {b['post']:.0f} "
+                    f"post = {results['collective_dcn_bytes_ratio']}x",
+                    flush=True,
+                )
+        # Members destroy first (each tears down the hierarchical subgroup
+        # coordinators it owns — killing them outright would leak those
+        # actors into the rest of the timed run), then the driver reaps
+        # any parent state left behind.
+        try:
+            ray_tpu.get([m.destroy.remote() for m in members], timeout=60)
+        except Exception:
+            pass
+        from ray_tpu.util import collective as _col
+
+        _col.destroy_collective_group(row)
+        for m in members:
+            ray_tpu.kill(m)
 
     # Transport counters: the strace-free syscall-reduction view
     # (PERF.md round-6 A/B rides these).
