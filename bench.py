@@ -318,9 +318,66 @@ def _data_plane_rows() -> dict:
     return {}
 
 
-def _emit(record: dict, data_plane: dict, probe: dict | None = None) -> None:
+def _serve_llm_rows() -> dict:
+    """LLM-serving A/B record (round-12): aggregate tok/s + p99 TTFT with
+    prefix-affinity routing ON vs OFF (``--no-prefix-routing``), via
+    ``tools/ray_perf.py --quick --serve-llm-only``. CPU-only (tiny model,
+    a wedged TPU tunnel can't block it) and best-effort: any failure
+    returns {} so the headline one-JSON-line contract stands."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = {}
+    for arm, flags in (("on", ()), ("off", ("--no-prefix-routing",))):
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(repo, "tools", "ray_perf.py"),
+                    "--quick",
+                    "--serve-llm-only",
+                    *flags,
+                ],
+                timeout=600,
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=repo,
+            )
+            if r.returncode != 0:
+                _log(f"serve_llm arm {arm} failed rc={r.returncode}; skipping")
+                return {}
+            for line in reversed(r.stdout.strip().splitlines()):
+                try:
+                    out[arm] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        except Exception as e:  # noqa: BLE001 — never fail the headline
+            _log(f"serve_llm rows skipped: {type(e).__name__}: {e}")
+            return {}
+    if "on" in out and "off" in out:
+        on_t = out["on"].get("serve_llm_shared_prefix", 0)
+        off_t = out["off"].get("serve_llm_shared_prefix", 0)
+        if off_t:
+            out["shared_prefix_tok_s_ratio"] = round(on_t / off_t, 3)
+    return out
+
+
+def _emit(
+    record: dict,
+    data_plane: dict,
+    probe: dict | None = None,
+    serve_llm: dict | None = None,
+) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
+    if serve_llm:
+        # Serving A/B rides every record too: the BENCH trajectory tracks
+        # the serving number (tok/s + p99 TTFT, routing ON vs OFF) from
+        # round 12 on, TPU availability notwithstanding.
+        record = {**record, "serve_llm": serve_llm}
     if probe:
         # Probe telemetry rides every record — skip rounds included — so a
         # wedged round stays diagnosable from the BENCH_r* file.
@@ -334,18 +391,21 @@ def main() -> None:
         print(json.dumps(run_bench()), flush=True)
         return
 
-    # Data-plane rows first: CPU-only, so they report even when the TPU
-    # tunnel is wedged (BENCH_r* keeps tracking the object plane).
+    # Data-plane + serving rows first: CPU-only, so they report even when
+    # the TPU tunnel is wedged (BENCH_r* keeps tracking both planes).
     data_plane = _data_plane_rows()
+    serve_llm = _serve_llm_rows()
 
     probe, probe_record = _probe_backend()
     if probe == "wedged":
-        _emit(_skip("tpu-unavailable"), data_plane, probe_record)
+        _emit(_skip("tpu-unavailable"), data_plane, probe_record, serve_llm)
         return
     if probe == "broken":
         # Fast nonzero exits mean jax/the plugin is broken, not that the
         # tunnel is down — a real regression must go red, not skip.
-        _emit(_skip("backend-probe-failed"), data_plane, probe_record)
+        _emit(
+            _skip("backend-probe-failed"), data_plane, probe_record, serve_llm
+        )
         sys.exit(1)
 
     try:
@@ -359,24 +419,29 @@ def main() -> None:
         )
     except subprocess.TimeoutExpired:
         _log(f"bench subprocess exceeded {BENCH_TIMEOUT_S}s; tunnel wedge?")
-        _emit(_skip("tpu-unavailable"), data_plane, probe_record)
+        _emit(_skip("tpu-unavailable"), data_plane, probe_record, serve_llm)
         return
     if r.returncode != 0:
         # The backend was alive (probe passed), so a failing measurement is a
         # real bug: emit the marker for machine readability but FAIL the gate.
         _log(f"bench subprocess failed rc={r.returncode}")
-        _emit(_skip(f"bench-failed-rc{r.returncode}"), data_plane, probe_record)
+        _emit(
+            _skip(f"bench-failed-rc{r.returncode}"),
+            data_plane,
+            probe_record,
+            serve_llm,
+        )
         sys.exit(1)
     # Forward the subprocess's final JSON line as our one-line contract.
     for line in reversed(r.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                _emit(json.loads(line), data_plane, probe_record)
+                _emit(json.loads(line), data_plane, probe_record, serve_llm)
             except json.JSONDecodeError:
                 print(line, flush=True)
             return
-    _emit(_skip("no-output"), data_plane, probe_record)
+    _emit(_skip("no-output"), data_plane, probe_record, serve_llm)
 
 
 if __name__ == "__main__":

@@ -147,6 +147,19 @@ class Config:
     collective_quantize_dcn: bool = True
     collective_quant_block: int = 256
     collective_dcn_deadline_s: float = 30.0
+    # Prefix-affinity serve routing (ROADMAP "LLM serving for millions of
+    # users"). prefix_routing is the kill switch (RAY_TPU_PREFIX_ROUTING=0):
+    # off, routers never consult replica prefix-pool digests or fetch
+    # replica state — the pre-round-12 path (pow-2 + the router-local
+    # prompt-prefix affinity table) runs untouched, modulo the px: key's
+    # chat-prompt derivation now matching what the replica tokenizes.
+    # prefix_route_staleness_s bounds how old
+    # a router's replica-digest table may get before a background refresh
+    # fires — routing NEVER blocks on the control plane; within the window
+    # it uses whatever it has (a stale digest costs at most one avoidable
+    # re-prefill, the pre-routing behavior).
+    prefix_routing: bool = True
+    prefix_route_staleness_s: float = 2.0
     # Graceful node drain (reference: gcs_service.proto DrainNode + the
     # raylet's graceful-drain deadline). A draining node stops taking new
     # leases, migrates its sole-copy (primary) objects to healthy peers,

@@ -34,6 +34,7 @@ from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models import gpt2
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util.prefix_digest import BYTE_BOS_SCHEME, chain_digests
 
 # Serving SLO series (recorded per step, not per frame: a decode step is
 # milliseconds-scale, so registry locking is negligible here). TTFT =
@@ -56,6 +57,12 @@ _PROMPT_TOKENS = _metrics.Counter(
 _GEN_TOKENS = _metrics.Counter(
     "raytpu_llm_generated_tokens_total",
     "tokens sampled by the decode loop",
+)
+_PREFILL_CHUNKS = _metrics.Counter(
+    "raytpu_llm_prefill_chunks_total",
+    "prefill chunks executed on the chunked-prefill path "
+    "(prefill_chunk_tokens > 0; one long prompt = several chunks "
+    "interleaved with decode steps)",
 )
 _REQUESTS = _metrics.Counter(
     "raytpu_llm_requests_total", "requests admitted to the engine"
@@ -93,6 +100,18 @@ def _replica_tags() -> dict:
     return _replica_tags_cache
 
 
+def _validate_block_multiple(name: str, value: int, block_size: int) -> None:
+    """Shared config check for every token-granularity knob that must
+    align with the paged-KV block size (pooled prefixes are shared, and
+    prefill chunks written, at block granularity)."""
+    if value % block_size:
+        raise ValueError(
+            f"{name} ({value}) must be a multiple of kv_block_size "
+            f"({block_size}): pooled prefixes are shared and prefill "
+            f"chunks written at block granularity"
+        )
+
+
 def _model_ops(cfg):
     """(model_module, decode_module) for a model-family config — the ONE
     dispatch point; everything else in the engine is family-agnostic
@@ -119,6 +138,12 @@ class _Request:
     slot: int = -1
     finished: bool = False
     blocks: list = dataclasses.field(default_factory=list)  # paged mode
+    # Chunked prefill: the request holds a slot but is still prefilling
+    # its prompt one chunk per step; pf_next is the next absolute prompt
+    # position to prefill. No token samples until pf_next reaches the
+    # prompt length.
+    prefilling: bool = False
+    pf_next: int = 0
     # Admission failure surfaced via pop_finished (an impossible
     # reservation must fail the REQUEST, not wedge the engine loop).
     error: Optional[str] = None
@@ -190,10 +215,11 @@ class LLMEngine:
             bs = config.kv_block_size
             if S % bs:
                 raise ValueError("max_seq must be a multiple of kv_block_size")
-            if config.enable_prefix_caching and config.prefix_chunk % bs:
-                raise ValueError(
-                    "prefix_chunk must be a multiple of kv_block_size "
-                    "(pooled prefixes are shared at block granularity)"
+            if config.enable_prefix_caching:
+                _validate_block_multiple("prefix_chunk", config.prefix_chunk, bs)
+            if config.prefill_chunk_tokens:
+                _validate_block_multiple(
+                    "prefill_chunk_tokens", config.prefill_chunk_tokens, bs
                 )
             self._block_size = bs
             self._table_width = S // bs
@@ -232,8 +258,15 @@ class LLMEngine:
         self._prefix_pool: dict = {}
         self._prefix_tokens_cached = 0
         self._prefix_clock = 0
+        # Routing advertisement: a stable (cross-process) digest of every
+        # chunk-multiple prefix the pool currently holds, rebuilt on pool
+        # mutation and swapped in atomically — replica report loops read
+        # it from another thread while the pump thread mutates the pool.
+        self._digest_snapshot: tuple = ()
+        self._digest_version = 0
         self.stats = {
             "prefill_tokens": 0,  # tokens that PAID prefill compute
+            "prefill_chunks": 0,  # chunked-prefill pieces executed
             "prefix_hits": 0,
             "prefix_lookups": 0,
             "prefix_tokens_reused": 0,
@@ -246,6 +279,7 @@ class LLMEngine:
         self.requests: dict[str, _Request] = {}
         self._slot_req: list = [None] * B
         self._rng = np.random.default_rng(config.seed)
+        self._pf_rr = 0  # round-robin cursor over prefilling slots
         self._steps = 0
         self._published_tokens = 0  # tokens already inc'd into the counter
 
@@ -415,6 +449,7 @@ class LLMEngine:
             entry["k"], entry["v"] = k, v
         self._prefix_pool[key] = entry
         self._prefix_tokens_cached += p
+        self._refresh_digest_snapshot()
 
     def _admit_waiting(self) -> list:
         """Admit waiting requests into free slots; returns requests that
@@ -442,6 +477,10 @@ class LLMEngine:
                 # finished with an error; the wave continues — an
                 # impossible request must not starve admittable ones.
                 admit_finished.append(req)
+                continue
+            if req.prefilling:
+                # Chunked prefill took the slot but defers its first
+                # sample to _advance_prefills; keep admitting.
                 continue
             if logits is None:
                 return admit_finished
@@ -532,6 +571,12 @@ class LLMEngine:
         row = np.zeros(self._table_width, np.int32)
         row[: len(table)] = table
         self.block_tables[slot] = row
+        if entry is not None:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_reused"] += P
+        if self._chunks_feasible(P, T):
+            self._begin_chunked_prefill(req, slot, P)
+            return None
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :rem] = req.prompt[P:]
         self.pool, logits = self._pg_prefill(
@@ -543,9 +588,6 @@ class LLMEngine:
             self.pool,
         )
         self.stats["prefill_tokens"] += rem
-        if entry is not None:
-            self.stats["prefix_hits"] += 1
-            self.stats["prefix_tokens_reused"] += P
         self._insert_prefix(req.prompt, slot, blocks=table)
         return logits
 
@@ -562,6 +604,10 @@ class LLMEngine:
         self._prefix_tokens_cached -= evicted["len"]
         if "blocks" in evicted:
             self.block_mgr.decref(evicted["blocks"])
+        # Digest refresh is the CALLERS' duty, once per eviction wave —
+        # a per-eviction rebuild would rehash the whole surviving pool
+        # N times in an eviction storm (insert budget loop,
+        # _evict_prefixes_until).
         return True
 
     def _evict_prefixes_until(self, need: int, keep=None) -> None:
@@ -569,9 +615,13 @@ class LLMEngine:
         allocatable or nothing evictable remains. Entries whose blocks are
         still shared by running requests free nothing when dropped — the
         loop keeps going past them."""
+        evicted = False
         while not self.block_mgr.can_alloc(need):
             if not self._evict_one_prefix(keep=keep):
-                return
+                break
+            evicted = True
+        if evicted:
+            self._refresh_digest_snapshot()
 
     def _admit_dense(self, req: _Request, slot: int):
         """Legacy dense per-slot cache admission (kv_block_size=0)."""
@@ -599,11 +649,16 @@ class LLMEngine:
             # only the suffix (the whole point: a shared system prompt
             # pays prefill FLOPs once per pool lifetime, not per
             # request).
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :rem] = req.prompt[P:]
             self.cache = self._copy_prefix_in(
                 self.cache, entry["k"], entry["v"], slot
             )
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_tokens_reused"] += P
+            if self._chunks_feasible(P, T):
+                self._begin_chunked_prefill(req, slot, P)
+                return None
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :rem] = req.prompt[P:]
             self.cache, logits = self._prefill_cont(
                 self.params,
                 jnp.asarray(toks),
@@ -613,9 +668,10 @@ class LLMEngine:
                 slot,
             )
             self.stats["prefill_tokens"] += rem
-            self.stats["prefix_hits"] += 1
-            self.stats["prefix_tokens_reused"] += P
         else:
+            if self._chunks_feasible(0, T):
+                self._begin_chunked_prefill(req, slot, 0)
+                return None
             bucket = next(
                 (b for b in self.config.prefill_buckets if b >= T),
                 self.config.prefill_buckets[-1],
@@ -632,6 +688,136 @@ class LLMEngine:
             self.stats["prefill_tokens"] += T
         self._insert_prefix(req.prompt, slot)
         return logits
+
+    # -- chunked prefill -----------------------------------------------------
+    # A long prompt's suffix prefills in prefill_chunk_tokens-sized pieces,
+    # one chunk per engine step, interleaved with decode steps for the
+    # slots already generating — so one long prompt bounds in-flight
+    # streams' ITL instead of stalling a whole slot-batch for its full
+    # prefill. Invariant while a slot is prefilling: positions[slot] ==
+    # pf_next (the next chunk's start), so the fixed-shape decode
+    # program's garbage write for that slot lands exactly where the next
+    # chunk (or, after the final chunk, the first real decode) overwrites
+    # it — in the request's OWN rows/blocks, never in shared prefix
+    # blocks (pf_next > P always).
+
+    def _chunk_bucket(self, start: int, clen: int):
+        """Smallest prefill bucket that holds a ``clen``-token chunk at
+        ``start`` WITHOUT reaching past max_seq; None when none fits.
+        The bound protects both modes: dense, a padded write past
+        max_seq is start-clamped by XLA into silent cache corruption;
+        paged, a position past max_seq clamps to the LAST block-table
+        entry — which, for a full-width table (T + max_tokens >=
+        max_seq), is the request's own last REAL block, not the scratch
+        block, and the padded garbage rows would overwrite real prompt
+        KV."""
+        for b in self.config.prefill_buckets:
+            if b >= clen and start + b <= self.config.max_seq:
+                return b
+        return None
+
+    def _chunks_feasible(self, start: int, T: int) -> bool:
+        """True when the [start, T) suffix should prefill chunked: the
+        knob is on, the suffix is longer than one chunk, and EVERY chunk
+        has a fitting bucket (checked up front — a mid-prefill fallback
+        would strand a half-filled slot)."""
+        chunk = self.config.prefill_chunk_tokens
+        if chunk <= 0 or T - start <= chunk:
+            return False
+        s = start
+        while s < T:
+            clen = min(chunk, T - s)
+            if self._chunk_bucket(s, clen) is None:
+                return False
+            s += clen
+        return True
+
+    def _begin_chunked_prefill(self, req: _Request, slot: int, start: int):
+        """Take the slot (blocks/table already reserved); ALL chunk work
+        happens in _advance_prefills under its per-step budget — an
+        admission wave of long prompts must not burst N first-chunks
+        into one step."""
+        req.slot = slot
+        req.prefilling = True
+        req.pf_next = start
+        self.slot_free[slot] = False
+        self._slot_req[slot] = req
+        self.positions[slot] = start
+        self.last_tokens[slot] = 0
+
+    def _prefill_one_chunk(self, req: _Request):
+        """Prefill the next chunk of ``req``'s prompt; returns the chunk's
+        last-logits (only the final chunk's are ever sampled)."""
+        T = len(req.prompt)
+        start = req.pf_next
+        clen = min(self.config.prefill_chunk_tokens, T - start)
+        bucket = self._chunk_bucket(start, clen)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :clen] = req.prompt[start : start + clen]
+        if self.paged:
+            self.pool, logits = self._pg_prefill(
+                self.params,
+                jnp.asarray(toks),
+                jnp.asarray(clen, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(self.block_tables[req.slot]),
+                self.pool,
+            )
+        else:
+            self.cache, logits = self._prefill_cont(
+                self.params,
+                jnp.asarray(toks),
+                jnp.asarray(clen, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                self.cache,
+                req.slot,
+            )
+        self.stats["prefill_tokens"] += clen
+        self.stats["prefill_chunks"] += 1
+        if _metrics.metrics_enabled():
+            _PREFILL_CHUNKS.inc(1.0)
+        req.pf_next = start + clen
+        self.positions[req.slot] = req.pf_next
+        return logits
+
+    def _advance_prefills(self) -> list:
+        """ONE chunk, for ONE prefilling slot (round-robin), per step:
+        the per-step prefill budget is prefill_chunk_tokens TOTAL, so a
+        wave of long prompts serializes its prefill across steps instead
+        of collectively stalling the decode batch (the token-budget rule
+        of Sarathi-style chunked prefill). A slot whose final chunk lands
+        samples its first token and joins the decode batch. Returns
+        requests that finished here (max_tokens=1 / stop at prefill)."""
+        B = len(self._slot_req)
+        req = None
+        for off in range(B):
+            slot = (self._pf_rr + off) % B
+            cand = self._slot_req[slot]
+            if cand is not None and cand.prefilling:
+                req = cand
+                self._pf_rr = (slot + 1) % B
+                break
+        if req is None:
+            return []
+        logits = self._prefill_one_chunk(req)
+        T = len(req.prompt)
+        if req.pf_next < T:
+            return []
+        req.prefilling = False
+        tok = self._sample(np.asarray(logits), req)
+        req.generated.append(tok)
+        self.stats["tokens_generated"] += 1
+        req.t_last_token = _time.perf_counter()
+        if _metrics.metrics_enabled():
+            _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
+        self.positions[req.slot] = T
+        self.last_tokens[req.slot] = tok
+        self._insert_prefix(
+            req.prompt, req.slot,
+            blocks=req.blocks if self.paged else None,
+        )
+        self._maybe_finish(req)
+        return [req] if req.finished else []
 
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
         if req.temperature <= 0.0:
@@ -670,8 +856,14 @@ class LLMEngine:
         """Admit + one decode step for all active slots. Returns the
         requests that finished this step."""
         instrument = _metrics.metrics_enabled()
-        finished = self._admit_waiting()
-        active = [r for r in self._slot_req if r is not None]
+        # Prefill chunks of already-admitted long prompts advance BEFORE
+        # this step's admissions, so a request admitted this step runs
+        # exactly its first chunk — one chunk per request per step.
+        finished = self._advance_prefills()
+        finished += self._admit_waiting()
+        active = [
+            r for r in self._slot_req if r is not None and not r.prefilling
+        ]
         if active:
             if self.paged:
                 self.pool, logits = self._pg_decode(
@@ -726,6 +918,65 @@ class LLMEngine:
             _PREFIX_HIT_RATE.set(
                 self.stats["prefix_hits"] / lookups, tags
             )
+
+    # Advertisement cap: the pool's token budget already bounds the digest
+    # count (budget / prefix_chunk), but a tiny chunk against a big budget
+    # must not grow the per-heartbeat report unboundedly.
+    MAX_ADVERTISED_DIGESTS = 512
+
+    def _refresh_digest_snapshot(self) -> None:
+        """Rebuild the routing advertisement from the pool and swap it in
+        atomically (readers — the replica report loop — run on another
+        thread; attribute assignment is their consistency boundary).
+        Every chunk-multiple prefix of every pooled entry is advertised,
+        so a router can match a PARTIAL share of a longer pooled prefix."""
+        chunk = self.config.prefix_chunk
+        out: set = set()
+        for e in self._prefix_pool.values():
+            out.update(chain_digests(e["tokens"], chunk, strict=False))
+            if len(out) >= self.MAX_ADVERTISED_DIGESTS:
+                break
+        # Snapshot FIRST, version LAST: a report-thread read between the
+        # two assignments must never pair the new version with the old
+        # snapshot — that push would suppress the fresh digests until
+        # the 5 s heartbeat (version is the report loop's push-now
+        # signal). The benign race direction (old version + new
+        # snapshot) just pushes one tick later.
+        self._digest_snapshot = tuple(out)
+        self._digest_version += 1
+
+    def prefix_digest(self) -> dict:
+        """Compact routing advertisement: what the prefix pool holds
+        (stable cross-process digests at prefix_chunk granularity) plus
+        the cache-pressure signals the router biases on. Thread-safe
+        against the pump thread (snapshot tuple + scalar reads only)."""
+        # Version BEFORE snapshot: paired with the writer's snapshot-then-
+        # version order, a torn read can only pair an OLD version with a
+        # NEW snapshot (pushes one tick late), never a new version with
+        # stale digests (which would suppress the push until the 5 s
+        # heartbeat).
+        version = self._digest_version
+        digests = list(self._digest_snapshot)
+        lookups = self.stats["prefix_lookups"]
+        kv_util = 0.0
+        if self.paged:
+            total = self.block_mgr.num_blocks - 1
+            if total > 0:
+                kv_util = self.block_mgr.used_blocks / total
+        return {
+            "scheme": (
+                BYTE_BOS_SCHEME
+                if isinstance(self.tokenizer, ByteTokenizer)
+                else "custom"
+            ),
+            "chunk": self.config.prefix_chunk,
+            "digests": digests,
+            "version": version,
+            "hit_rate": (self.stats["prefix_hits"] / lookups) if lookups else 0.0,
+            "kv_util": kv_util,
+            "prefill_tokens": self.stats["prefill_tokens"],
+            "prefix_tokens_reused": self.stats["prefix_tokens_reused"],
+        }
 
     def has_unfinished(self) -> bool:
         return any(not r.finished for r in self.requests.values())

@@ -173,6 +173,14 @@ class LLMServer:
             self._finished.pop(rid, None)
             self._events.pop(rid, None)
 
+    def router_state(self) -> dict:
+        """Routing advertisement, pushed by the hosting ReplicaActor's
+        report loop: which prefix blocks this replica's KV pool already
+        holds (stable digests), plus hit-rate/KV-util — the signals the
+        prefix-affinity router biases pow-2 on. Reads only atomic engine
+        snapshots, so it is safe against the pump's executor thread."""
+        return self.engine.prefix_digest()
+
     @staticmethod
     def _sampling(body: dict) -> SamplingParams:
         return SamplingParams(
@@ -240,11 +248,10 @@ class LLMServer:
             return {"error": "JSON body required"}
         created = int(time.time())
         if path.endswith("/v1/chat/completions"):
+            from ray_tpu.util.prefix_digest import chat_prompt
+
             msgs = body.get("messages", [])
-            prompt = "\n".join(
-                f"{m.get('role', 'user')}: {m.get('content', '')}"
-                for m in msgs
-            )
+            prompt = chat_prompt(msgs if isinstance(msgs, list) else [])
             if body.get("stream"):
                 return self._stream_chunks(prompt, body, created, chat=True)
             out = await self._generate(prompt, self._sampling(body))
@@ -291,6 +298,8 @@ def build_openai_app(
 ):
     """An Application serving OpenAI-style routes under /{name}/v1/...
     (reference: ray.serve.llm build_openai_app)."""
+    from ray_tpu.util.prefix_digest import BYTE_BOS_SCHEME
+
     dep = serve_api.deployment(
         LLMServer,
         name=name,
@@ -300,6 +309,16 @@ def build_openai_app(
         # pooled that prefix's KV (no re-prefill of shared system prompts).
         request_affinity=(
             "prompt_prefix" if config.enable_prefix_caching else None
+        ),
+        # Digest contract for prefix-affinity routing: the engine's
+        # default ByteTokenizer is byte-level, so routers can hash a
+        # prompt's leading blocks from TEXT and match the replica-pooled
+        # digests exactly (a custom tokenizer would advertise "custom"
+        # and routers fall back to load-only).
+        request_affinity_config=(
+            {"scheme": BYTE_BOS_SCHEME, "chunk": config.prefix_chunk}
+            if config.enable_prefix_caching
+            else None
         ),
     )
     return dep.bind(config)

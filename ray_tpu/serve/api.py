@@ -38,6 +38,13 @@ class DeploymentConfig:
     # engine's prefix-KV pool is warm (reference:
     # serve/_private/request_router/prefix_aware/prefix_aware_router.py).
     request_affinity: Optional[str] = None
+    # Prefix-digest routing contract for "prompt_prefix" deployments:
+    # {"scheme": <token hashing scheme>, "chunk": <tokens per block>}.
+    # Routers hash a prompt's leading blocks under this contract and
+    # bias pow-2 toward replicas whose ADVERTISED prefix pool already
+    # holds them (see util/prefix_digest.py). None = router-local
+    # affinity only.
+    request_affinity_config: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -150,6 +150,7 @@ class ServeController:
             "replicas": [r for r, _ in dep["replicas"]],
             "max_concurrent": dep["config"].get("max_concurrent_queries", 8),
             "affinity": dep["config"].get("request_affinity"),
+            "affinity_config": dep["config"].get("request_affinity_config"),
         }
 
     async def poll_routing(
@@ -177,15 +178,44 @@ class ServeController:
             except asyncio.TimeoutError:
                 return {"version": version}
 
-    async def push_metrics(self, replica_id: str, queue_len: int) -> None:
+    async def push_metrics(
+        self, replica_id: str, queue_len: int, router_state=None
+    ) -> None:
         """Replica-pushed autoscaling metric (replaces per-tick queue_len
         fan-out; reference: replicas push autoscaling metrics to the
-        controller via the long-poll/metrics channel)."""
-        self._replica_metrics[replica_id] = (int(queue_len), time.monotonic())
+        controller via the long-poll/metrics channel). ``router_state``
+        rides the same push: the replica callable's routing advertisement
+        (prefix-pool digests + hit-rate/KV-util for LLM replicas) that
+        routers read back through get_router_state."""
+        self._replica_metrics[replica_id] = (
+            int(queue_len), time.monotonic(), router_state,
+        )
 
     async def get_replica_metrics(self) -> dict:
         """Pushed queue-length table (replica_id -> len); observability."""
         return {rid: m[0] for rid, m in self._replica_metrics.items()}
+
+    async def get_router_state(self, name: str) -> dict:
+        """Per-replica routing advertisement for one deployment:
+        replica_id -> {queue_len, age_s, state} where ``state`` is what
+        the replica's callable last pushed (None for callables that don't
+        advertise). Routers poll this on a staleness window — it is a
+        read of the pushed table, never a fan-out to replicas."""
+        dep = self._deployments.get(name)
+        if dep is None:
+            return {}
+        now = time.monotonic()
+        out = {}
+        for r, _ in dep["replicas"]:
+            m = self._replica_metrics.get(r._actor_id)
+            if m is None:
+                continue
+            out[r._actor_id] = {
+                "queue_len": m[0],
+                "age_s": round(now - m[1], 3),
+                "state": m[2] if len(m) > 2 else None,
+            }
+        return out
 
     async def status(self) -> dict:
         return {

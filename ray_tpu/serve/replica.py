@@ -72,7 +72,12 @@ class ReplicaActor:
     async def _report_loop(self) -> None:
         """Push queue_len to the controller when it changes (5 s heartbeat
         otherwise) so autoscaling reads a table instead of fanning out
-        per-tick RPCs (reference: replicas push autoscaling metrics)."""
+        per-tick RPCs (reference: replicas push autoscaling metrics).
+
+        Callables exposing ``router_state()`` (LLM replicas: prefix-pool
+        digests + hit-rate/KV-util) ride the same push; a state-version
+        change forces a push within one loop tick so routers see a newly
+        pooled prefix inside their staleness window."""
         from ray_tpu.core import api as core_api
         from ray_tpu.serve.controller import CONTROLLER_NAME
 
@@ -82,22 +87,33 @@ class ReplicaActor:
             rid = core_api.get_runtime_context().actor_id
         except Exception:
             return  # not running as an actor (unit tests)
+        state_fn = getattr(self._callable, "router_state", None)
         controller = None
-        last, last_t = None, 0.0
+        last, last_t, last_sv = None, 0.0, None
         while True:
             try:
                 now = time.monotonic()
                 cur = self._inflight  # capture: it can move during the push
-                if cur != last or now - last_t >= 5.0:
+                state, sv = None, None
+                if state_fn is not None:
+                    try:
+                        state = state_fn()
+                        if isinstance(state, dict):
+                            sv = state.get("version")
+                        else:
+                            state = None
+                    except Exception:
+                        state = None  # advertisement is best-effort
+                if cur != last or sv != last_sv or now - last_t >= 5.0:
                     if controller is None:
                         controller = await core_api.get_actor_async(
                             CONTROLLER_NAME
                         )
                     await core_api.get_async(
-                        controller.push_metrics.remote(rid, cur),
+                        controller.push_metrics.remote(rid, cur, state),
                         timeout=5,
                     )
-                    last, last_t = cur, now
+                    last, last_t, last_sv = cur, now, sv
             except Exception:
                 controller = None  # re-resolve next round
             await asyncio.sleep(1.0)

@@ -15,8 +15,10 @@ import time as _time
 
 from ray_tpu.core import api as core_api
 from ray_tpu.core import serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.errors import ActorDiedError, ActorUnavailableError
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util.prefix_digest import chat_prompt, prompt_digests
 
 # Serve request SLO series, recorded in the routing process (driver or
 # proxy) and shipped through the standard push path. Request latency
@@ -37,6 +39,23 @@ _REQUESTS = _metrics.Counter(
 _ERRORS = _metrics.Counter(
     "raytpu_serve_errors_total",
     "requests that failed after all routing retries, per deployment",
+    tag_keys=("deployment",),
+)
+# Prefix-affinity routing outcome, recorded per routed request on
+# prompt_prefix deployments with digest routing enabled: a hit landed on
+# a replica whose ADVERTISED prefix pool already held the prompt's
+# leading blocks; a miss fell back to load-only pow-2 (nothing
+# advertised/matched, or the hot replica was saturated).
+_PREFIX_ROUTE_HITS = _metrics.Counter(
+    "raytpu_serve_prefix_route_hits_total",
+    "requests routed to a replica whose advertised prefix pool already "
+    "held the prompt's leading blocks",
+    tag_keys=("deployment",),
+)
+_PREFIX_ROUTE_MISSES = _metrics.Counter(
+    "raytpu_serve_prefix_route_misses_total",
+    "prefix-routable requests that fell back to load-only pow-2 "
+    "(digest miss or saturated hot replica)",
     tag_keys=("deployment",),
 )
 
@@ -75,14 +94,25 @@ class Router:
         # with a shared prompt prefix stick to replicas whose prefix-KV
         # pool is warm (reference: prefix_aware_router.py).
         self._affinity: str | None = None
+        # Digest contract for prefix routing ({"scheme", "chunk"}, from
+        # the deployment config) and the last replica-state table fetched
+        # from the controller: replica_id -> {queue_len, age_s, state}.
+        # The table refreshes in the BACKGROUND on a staleness window —
+        # routing never awaits the control plane.
+        self._affinity_cfg: dict | None = None
+        self._replica_state: dict = {}
+        self._state_fetched = 0.0
+        self._state_task: asyncio.Task | None = None
+        self._max_concurrent = 8
 
     def close(self) -> None:
-        task = self._listen_task
-        self._listen_task = None
-        if task is not None:
-            # close() is called from the driver thread; the task lives on
-            # the endpoint loop — cancel must hop threads.
-            task.get_loop().call_soon_threadsafe(task.cancel)
+        for attr in ("_listen_task", "_state_task"):
+            task = getattr(self, attr)
+            setattr(self, attr, None)
+            if task is not None:
+                # close() is called from the driver thread; the task lives
+                # on the endpoint loop — cancel must hop threads.
+                task.get_loop().call_soon_threadsafe(task.cancel)
 
     def _ensure_listener(self) -> None:
         if self._listen_task is None or self._listen_task.done():
@@ -133,33 +163,61 @@ class Router:
                 await asyncio.sleep(1.0)
         return False
 
+    @staticmethod
+    def _extract_prompt(args: tuple, kwargs: dict) -> str:
+        """The prompt text the LLM replica will tokenize, reconstructed
+        from the request envelope by the SAME rules serve_llm applies
+        (chat path -> the shared chat_prompt join; everything else ->
+        body['prompt']) — digest routing hashes this text, and a
+        divergence would silently turn requests into digest misses."""
+        req = args[0] if args else kwargs.get("request")
+        if not isinstance(req, dict):
+            return ""
+        body = req.get("body")
+        body = body if isinstance(body, dict) else req
+        if str(req.get("path", "")).endswith("/v1/chat/completions"):
+            msgs = body.get("messages")
+            return chat_prompt(msgs) if isinstance(msgs, list) else ""
+        prompt = body.get("prompt") or ""
+        if not prompt:
+            # Envelope without a path (plain handle calls): fall back to
+            # messages so chat-shaped bodies still get an affinity key.
+            msgs = body.get("messages")
+            if isinstance(msgs, list):
+                return chat_prompt(msgs)
+        return str(prompt)
+
     def _affinity_key(self, args: tuple, kwargs: dict) -> str:
         """Derive the routing-affinity key for prompt-prefix deployments:
         a hash of the request's first 256 prompt characters. Rides the
         same affinity table model-multiplexing uses."""
         if self._affinity != "prompt_prefix":
             return ""
-        req = args[0] if args else kwargs.get("request")
-        if not isinstance(req, dict):
-            return ""
-        body = req.get("body")
-        body = body if isinstance(body, dict) else req
-        prompt = body.get("prompt") or ""
-        if not prompt:
-            msgs = body.get("messages")
-            if isinstance(msgs, list) and msgs and isinstance(msgs[0], dict):
-                prompt = str(msgs[0].get("content", ""))
-        prefix = str(prompt)[:256]
+        prefix = self._extract_prompt(args, kwargs)[:256]
         if not prefix:
             return ""
         import hashlib
 
         return "px:" + hashlib.sha1(prefix.encode()).hexdigest()[:16]
 
+    def _prompt_digests(self, args: tuple, kwargs: dict) -> list:
+        """Block digests of the request's prompt under the deployment's
+        advertised hashing contract ([] when the contract/scheme is
+        unknown — the router then routes on load alone)."""
+        cfg = self._affinity_cfg or {}
+        text = self._extract_prompt(args, kwargs)
+        if not text:
+            return []
+        return prompt_digests(
+            text, int(cfg.get("chunk") or 0), cfg.get("scheme") or ""
+        )
+
     def _apply(self, table: dict) -> None:
         if table.get("replicas") is None:
             return
         self._affinity = table.get("affinity")
+        self._affinity_cfg = table.get("affinity_config")
+        self._max_concurrent = table.get("max_concurrent") or 8
         import time
 
         now = time.monotonic()
@@ -178,6 +236,28 @@ class Router:
             r._actor_id: self._inflight.get(r._actor_id, 0)
             for r in self._replicas
         }
+        # Affinity lists must track membership: a replaced replica's id
+        # would otherwise sit in every list it ever joined, for the
+        # router's whole lifetime (the lists are bounded per key, but a
+        # long-lived router sees unbounded replica churn).
+        alive = set(self._inflight)
+        for key in list(self._model_replicas):
+            kept = [rid for rid in self._model_replicas[key] if rid in alive]
+            if kept:
+                self._model_replicas[key] = kept
+            else:
+                del self._model_replicas[key]
+
+    def _forget_replica(self, rid: str) -> None:
+        """Drop a dead replica from every affinity list NOW (the next
+        table refresh would prune it too, but the router keeps routing —
+        and must not keep preferring — in between)."""
+        for key in list(self._model_replicas):
+            reps = self._model_replicas[key]
+            if rid in reps:
+                reps.remove(rid)
+                if not reps:
+                    del self._model_replicas[key]
 
     async def _refresh(self, force: bool = False) -> None:
         try:
@@ -208,13 +288,112 @@ class Router:
         self._apply(table)
         self._ensure_listener()
 
-    def _pick(self, model_id: str = ""):
+    def _prefix_routing_on(self) -> bool:
+        """Digest-based prefix routing applies: the deployment declared
+        prompt_prefix affinity WITH a digest contract, and the kill
+        switch (RAY_TPU_PREFIX_ROUTING=0) is not thrown. Off, the
+        pre-round-12 pow-2 + local-affinity-table path runs untouched
+        (no digest lookups, no state fetches; the only carried-over
+        change is the px: key's chat-prompt derivation, which now
+        hashes the same text the replica tokenizes)."""
+        return (
+            GLOBAL_CONFIG.prefix_routing
+            and self._affinity == "prompt_prefix"
+            and bool(self._affinity_cfg)
+        )
+
+    def _maybe_refresh_state(self) -> None:
+        """Keep the replica digest table within the staleness window via
+        a background fetch; routing itself never awaits the controller
+        (a stale digest costs at most one avoidable re-prefill)."""
+        import time
+
+        now = time.monotonic()
+        if now - self._state_fetched < GLOBAL_CONFIG.prefix_route_staleness_s:
+            return
+        if self._state_task is not None and not self._state_task.done():
+            return
+        self._state_fetched = now  # claim the window before the fetch lands
+        self._state_task = asyncio.ensure_future(self._fetch_state())
+
+    async def _fetch_state(self) -> None:
+        try:
+            state = await core_api.get_async(
+                self._controller.get_router_state.remote(self._deployment),
+                timeout=10,
+            )
+            if isinstance(state, dict):
+                self._replica_state = state
+        except Exception:
+            pass  # keep the stale table; the next window retries
+
+    # Saturation floor for the digest-preferred replica. Unlike the
+    # multiplex margin (+2 — a replica running one model at a time), an
+    # LLM replica CONTINUOUS-BATCHES: it absorbs up to its concurrency
+    # budget of streams at little marginal cost, so prefix warmth is
+    # worth riding out a burst of half that budget before spilling to a
+    # load-picked replica (which prefills once, pools the prefix,
+    # advertises it, and joins the hot set — capacity follows demand).
+    PREFIX_SPILL_MARGIN = 2
+
+    def _pick_prefix(self, digests: list, count: bool = True):
+        """The replica whose ADVERTISED prefix pool holds the longest
+        leading-block match for this prompt, or None to fall back to
+        load-only routing (no match anywhere, or the matched replica is
+        saturated). ``digests`` are shortest-first consecutive chain
+        hashes, so the match length is the highest matching index + 1.
+        ``count=False`` suppresses the outcome counters (dead-replica
+        RETRIES of one request must not double-count it, and an
+        attempt-1 'hit' that then died avoided no re-prefill)."""
+        alive = {r._actor_id: r for r in self._replicas}
+        best, best_score = None, 0
+        for rid, info in self._replica_state.items():
+            r = alive.get(rid)
+            adv = ((info or {}).get("state") or {}).get("digests")
+            if r is None or not adv:
+                continue
+            aset = set(adv)
+            score = 0
+            for i, d in enumerate(digests):
+                if d in aset:
+                    score = i + 1
+            if score > best_score:
+                best, best_score = r, score
+        tags = {"deployment": self._deployment}
+        instrument = count and _metrics.metrics_enabled()
+        if best is None:
+            if instrument:
+                _PREFIX_ROUTE_MISSES.inc(1.0, tags)
+            return None
+        load = lambda r: self._inflight.get(r._actor_id, 0)  # noqa: E731
+        others = [r for r in self._replicas if r is not best]
+        margin = max(self.PREFIX_SPILL_MARGIN, self._max_concurrent // 2)
+        if others and load(best) > min(map(load, others)) + margin:
+            if instrument:
+                _PREFIX_ROUTE_MISSES.inc(1.0, tags)
+            return None
+        if instrument:
+            _PREFIX_ROUTE_HITS.inc(1.0, tags)
+        return best
+
+    def _pick(
+        self,
+        model_id: str = "",
+        digests: list | None = None,
+        count_prefix: bool = True,
+    ):
         """Power of two choices on the local in-flight estimates; with a
         model id, prefer replicas that model was recently routed to (its
         weights are probably still resident — reference: multiplexed
-        routing in python/ray/serve/_private/replica_scheduler)."""
+        routing in python/ray/serve/_private/replica_scheduler). With
+        prompt digests, first prefer the replica whose advertised prefix
+        pool already holds them (prefix-affinity routing)."""
         if len(self._replicas) == 1:
             return self._replicas[0]
+        if digests:
+            best = self._pick_prefix(digests, count=count_prefix)
+            if best is not None:
+                return best
         if model_id:
             alive = {r._actor_id: r for r in self._replicas}
             known = [
@@ -296,7 +475,11 @@ class Router:
                     await asyncio.sleep(0.2)
                     continue
             pick_key = model_id or self._affinity_key(args, kwargs)
-            replica = self._pick(pick_key)
+            digests = None
+            if not model_id and self._prefix_routing_on():
+                self._maybe_refresh_state()
+                digests = self._prompt_digests(args, kwargs)
+            replica = self._pick(pick_key, digests, count_prefix=attempt == 0)
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             if instrument:
@@ -320,6 +503,7 @@ class Router:
                 self._replicas = [
                     r for r in self._replicas if r._actor_id != rid
                 ]
+                self._forget_replica(rid)
                 self._version = -2
                 await asyncio.sleep(min(0.1 * (attempt + 1), 1.0))
             finally:
@@ -350,7 +534,11 @@ class Router:
                     await asyncio.sleep(0.2)
                     continue
             pick_key = model_id or self._affinity_key(args, kwargs)
-            replica = self._pick(pick_key)
+            digests = None
+            if not model_id and self._prefix_routing_on():
+                self._maybe_refresh_state()
+                digests = self._prompt_digests(args, kwargs)
+            replica = self._pick(pick_key, digests, count_prefix=attempt == 0)
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             if instrument:
@@ -380,6 +568,7 @@ class Router:
                 self._replicas = [
                     r for r in self._replicas if r._actor_id != rid
                 ]
+                self._forget_replica(rid)
                 self._version = -2
                 await asyncio.sleep(min(0.1 * (attempt + 1), 1.0))
             finally:

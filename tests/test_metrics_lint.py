@@ -109,3 +109,20 @@ def test_declare_runtime_metric_enforces_rules():
     m.declare_runtime_metric("raytpu_test_lint_series", "counter")
     with pytest.raises(ValueError, match="already declared"):
         m.declare_runtime_metric("raytpu_test_lint_series", "gauge")
+
+
+def test_prefix_routing_series_registered_and_linted():
+    """Round-12 cache-aware serving series: the router's prefix-routing
+    outcome counters are declared through the catalog (the engine's
+    raytpu_llm_prefill_chunks_total rides the optional llm module and is
+    asserted in tests/test_serve_llm_routing.py)."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    for name in (
+        "raytpu_serve_prefix_route_hits_total",
+        "raytpu_serve_prefix_route_misses_total",
+    ):
+        assert name in catalog, f"{name} missing from the runtime catalog"
+        assert catalog[name]["kind"] == "counter"
+        assert catalog[name]["tag_keys"] == ("deployment",)
+    assert lint_catalog(catalog) == []

@@ -50,18 +50,20 @@ def run_once(quick: bool, extra_flags: tuple = ()) -> dict:
 
 
 def interleaved_ab(
-    off_flag: str, label: str, rounds: int, full: bool
+    off_flag: str, label: str, rounds: int, full: bool,
+    base_flags: tuple = (),
 ) -> dict:
     """Alternate ON (HEAD defaults) vs OFF (``off_flag``) runs, starting
     arm swapped each round so slow box drift hits both arms equally, and
-    print/return per-metric medians + the on/off ratio."""
+    print/return per-metric medians + the on/off ratio. ``base_flags``
+    ride BOTH arms (row-subset selectors like --serve-llm-only)."""
     on_runs, off_runs = [], []
     for i in range(rounds):
-        order = [((), on_runs), ((off_flag,), off_runs)]
+        order = [(base_flags, on_runs), (base_flags + (off_flag,), off_runs)]
         if i % 2:
             order.reverse()
         for flags, sink in order:
-            arm = "off" if flags else "on "
+            arm = "off" if off_flag in flags else "on "
             print(f"[round {i}] {label} {arm} ...", flush=True)
             sink.append(run_once(quick=not full, extra_flags=flags))
 

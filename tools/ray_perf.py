@@ -57,6 +57,316 @@ class Sink:
         return b"ok"
 
 
+def _p99_ms(samples: list) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return round(s[min(len(s) - 1, int(0.99 * len(s)))] * 1e3, 2)
+
+
+def _hist_snapshot(name: str) -> dict:
+    """Cumulative bucket counts (le -> count, summed across processes)
+    of one merged-cluster histogram, from the Prometheus exposition.
+    Engine-side phase deltas come from diffing two of these — client
+    timings on a contended box carry scheduler noise the engine's own
+    step clock does not."""
+    from ray_tpu.util.state.api import cluster_metrics_text
+
+    out: dict = {}
+    for line in cluster_metrics_text().splitlines():
+        if not line.startswith(name + "_bucket"):
+            continue
+        try:
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            out[le] = out.get(le, 0.0) + float(line.rsplit(None, 1)[1])
+        except (IndexError, ValueError):
+            continue
+    return out
+
+
+def _hist_frac_above(before: dict, after: dict, boundary: str) -> float:
+    """Fraction of NEW samples (between two snapshots) above ``boundary``
+    seconds; -1 when the window saw no samples."""
+    d = {le: after.get(le, 0.0) - before.get(le, 0.0) for le in after}
+    total = d.get("+Inf", 0.0)
+    if total <= 0:
+        return -1.0
+    return round((total - d.get(boundary, 0.0)) / total, 4)
+
+
+def _serve_llm_rows(results: dict, no_chunked_prefill: bool, quick: bool):
+    """Cache-aware LLM serving rows (PERF.md round-12): two tiny-model
+    replicas behind the serve router, streaming clients from driver
+    threads. Two traffic mixes:
+
+      serve_llm_shared_prefix — 3 long shared system prompts x unique
+        suffixes at high concurrency: prefix-affinity routing converges
+        each prompt family onto the replica that pooled it (tok/s + p99
+        TTFT vs --no-prefix-routing).
+      serve_llm_mixed_len — long prompts interleaved with short in-flight
+        decoders: chunked prefill bounds the decoders' p99 ITL (vs
+        --no-chunked-prefill).
+    """
+    import concurrent.futures
+
+    from ray_tpu import serve
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.llm.config import LLMConfig
+    from ray_tpu.llm.serve_llm import build_openai_app
+    from ray_tpu.models.gpt2 import GPT2Config
+
+    # Sized so prefill is a real cost on CPU (the TPU-serving regime the
+    # A/B models): a cold ~900-token prompt costs several decode steps,
+    # so a missed cache reuse / an unchunked prefill stall is visible.
+    # The prompt families share a 260-char boilerplate header then
+    # DIVERGE — the pre-round-12 px: affinity (first 256 chars) cannot
+    # tell them apart, block digests can — and the pool budget holds
+    # only 2 of the 3 families per replica, so the families must
+    # PARTITION across replicas to all stay warm.
+    # Faster digest repair for the benchmark: one ~900-token request on
+    # this box takes ~0.5 s, so the default 2 s staleness window lets a
+    # single pool-churn event misroute several follow-ups; 0.75 s keeps
+    # the table within ~1-2 requests of reality (documented knob — a
+    # real deployment with ms-scale requests would RAISE it instead).
+    GLOBAL_CONFIG.prefix_route_staleness_s = min(
+        GLOBAL_CONFIG.prefix_route_staleness_s, 0.75
+    )
+    model = GPT2Config.tiny(n_layer=3, d_model=256, n_head=4, max_seq=1024)
+    cfg = LLMConfig(
+        model_config=model,
+        max_slots=4,
+        max_seq=1024,
+        prefill_buckets=(32, 128, 1024),
+        num_kv_blocks=420,
+        prefix_chunk=32,
+        max_prefix_cache_tokens=2048,
+        prefill_chunk_tokens=0 if no_chunked_prefill else 128,
+    )
+    handle = serve.run(build_openai_app(cfg, name="perfllm", num_replicas=2))
+    stream_handle = handle.options(stream=True)
+    common = (
+        "SYSTEM BOILERPLATE: you are a careful, terse assistant; follow "
+        "the contract; cite sources; refuse what you must refuse; " * 2
+    )[:260]
+    # THREE families over two replicas whose pools hold TWO ~900-token
+    # entries each: a stable {2 families, 1 family} partition exists and
+    # digest routing maintains it (a correctly routed request refreshes
+    # its own entry, evicting nothing); cache-blind routing bounces the
+    # shared-header traffic and thrashes the 2-entry pools.
+    systems = [
+        common
+        + f" FAMILY {i}: "
+        + f"domain-{i} instructions and few-shot examples; " * 14
+        for i in range(3)
+    ]  # ~900 chars each: a full 1024-token prefill bucket when cold
+
+    def one_request(prompt: str, max_tokens: int) -> dict:
+        t0 = time.perf_counter()
+        ttft, gaps, last, tokens = None, [], None, 0
+        for _chunk in stream_handle.remote(
+            {
+                "path": "/perfllm/v1/completions",
+                "body": {
+                    "prompt": prompt,
+                    "max_tokens": max_tokens,
+                    "stream": True,
+                },
+            }
+        ):
+            now = time.perf_counter()
+            if ttft is None:
+                ttft = now - t0
+            elif last is not None:
+                gaps.append(now - last)
+            last = now
+            tokens += 1
+        return {"ttft": ttft or 0.0, "gaps": gaps, "tokens": tokens}
+
+    def run_mix(requests: list, workers: int) -> list:
+        out = [None] * len(requests)
+        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+            futs = {
+                pool.submit(one_request, p, mt): i
+                for i, (p, mt) in enumerate(requests)
+            }
+            for f in concurrent.futures.as_completed(futs):
+                out[futs[f]] = f.result()
+        return out
+
+    n_shared = 24 if quick else 60
+    n_long = 4 if quick else 10
+    n_short = 12 if quick else 30
+
+    # Warm each prompt family twice (pass 1 pools by pow-2 wherever it
+    # lands; pass 2, past the staleness window, routes on the advertised
+    # digests and repairs any churn), so both arms measure steady-state
+    # serving, not cold-start discovery.
+    for _pass in range(2):
+        for s in systems:
+            one_request(s + " warmup", 2)
+        time.sleep(GLOBAL_CONFIG.prefix_route_staleness_s + 1.5)
+
+    shared_reqs = [
+        (systems[i % len(systems)] + f" q{i}", 8) for i in range(n_shared)
+    ]
+    pre_hist = _hist_snapshot("raytpu_llm_ttft_seconds")
+    t0 = time.perf_counter()
+    res = run_mix(shared_reqs, workers=6)
+    dt = time.perf_counter() - t0
+    toks = sum(r["tokens"] for r in res)
+    results["serve_llm_shared_prefix"] = round(toks / dt, 1)
+    results["serve_llm_shared_prefix_p99_ttft_ms"] = _p99_ms(
+        [r["ttft"] for r in res]
+    )
+    time.sleep(3.0)  # metric push interval: let replica snapshots land
+    results["serve_llm_shared_ttft_gt250ms_pct"] = _hist_frac_above(
+        pre_hist, _hist_snapshot("raytpu_llm_ttft_seconds"), "0.25"
+    )
+    print(
+        f"serve_llm_shared_prefix: {results['serve_llm_shared_prefix']:,} "
+        f"tok/s, p99 TTFT "
+        f"{results['serve_llm_shared_prefix_p99_ttft_ms']} ms, engine "
+        f"TTFT>250ms {results['serve_llm_shared_ttft_gt250ms_pct']:.1%}",
+        flush=True,
+    )
+
+    # Mixed lengths: short decoders in flight while long COLD prompts
+    # prefill (each long prompt is distinct — no cache help; unchunked,
+    # its full-bucket prefill stalls every decoder sharing the replica).
+    mixed = [
+        (f"COLD DOCUMENT {i}: " + f"paragraph {i} " * 120, 8)
+        for i in range(n_long)
+    ] + [(f"quick question {i}?", 24) for i in range(n_short)]
+    pre_hist = _hist_snapshot("raytpu_llm_itl_seconds")
+    t0 = time.perf_counter()
+    res = run_mix(mixed, workers=6)
+    dt = time.perf_counter() - t0
+    toks = sum(r["tokens"] for r in res)
+    short_gaps = [g for r in res[n_long:] for g in r["gaps"]]
+    results["serve_llm_mixed_len"] = round(toks / dt, 1)
+    results["serve_llm_mixed_len_p99_ttft_ms"] = _p99_ms(
+        [r["ttft"] for r in res]
+    )
+    results["serve_llm_mixed_len_p99_itl_ms"] = _p99_ms(short_gaps)
+    time.sleep(3.0)
+    # The stall criterion, on the engine's own clock: the share of
+    # decode-loop inter-token gaps above 100 ms — an unchunked ~1024-token
+    # prefill (~120 ms on this box) parks every in-flight decoder in the
+    # >100 ms buckets; chunked prefill must empty them.
+    results["serve_llm_mixed_itl_gt100ms_pct"] = _hist_frac_above(
+        pre_hist, _hist_snapshot("raytpu_llm_itl_seconds"), "0.1"
+    )
+    print(
+        f"serve_llm_mixed_len: {results['serve_llm_mixed_len']:,} tok/s, "
+        f"p99 TTFT {results['serve_llm_mixed_len_p99_ttft_ms']} ms, "
+        f"short-stream p99 ITL "
+        f"{results['serve_llm_mixed_len_p99_itl_ms']} ms, engine "
+        f"ITL>100ms {results['serve_llm_mixed_itl_gt100ms_pct']:.1%}",
+        flush=True,
+    )
+
+    # Engine-side aggregates via the advertisement table: prefill_tokens
+    # is the compute actually paid, prefix_tokens_reused the compute
+    # routing+caching avoided — the mechanism behind the client metrics.
+    time.sleep(2.0)  # let the last report-loop push land
+    ctrl = ray_tpu.get_actor("serve::controller")
+    st = ray_tpu.get(ctrl.get_router_state.remote("perfllm"), timeout=30)
+    results["serve_llm_prefill_tokens"] = float(
+        sum(
+            ((i.get("state") or {}).get("prefill_tokens", 0))
+            for i in st.values()
+        )
+    )
+    results["serve_llm_prefix_tokens_reused"] = float(
+        sum(
+            ((i.get("state") or {}).get("prefix_tokens_reused", 0))
+            for i in st.values()
+        )
+    )
+    print(
+        f"  engines: {results['serve_llm_prefill_tokens']:.0f} prefill "
+        f"tokens paid, {results['serve_llm_prefix_tokens_reused']:.0f} "
+        f"reused",
+        flush=True,
+    )
+
+    # Routing outcome counters from THIS process (the router runs here).
+    from ray_tpu.util.metrics import registry
+
+    for name, key in (
+        ("raytpu_serve_prefix_route_hits_total", "serve_llm_route_hits"),
+        ("raytpu_serve_prefix_route_misses_total", "serve_llm_route_misses"),
+    ):
+        total = 0.0
+        for n, _tags, v in registry().snapshot()["points"]:
+            if n == name:
+                total += v
+        results[key] = total
+    print(
+        f"  routing: {results['serve_llm_route_hits']:.0f} hits / "
+        f"{results['serve_llm_route_misses']:.0f} misses",
+        flush=True,
+    )
+    serve.shutdown()
+
+    # Controlled single-engine stall probe (no serve/driver noise, both
+    # cores to one process): the worst inter-token gap three in-flight
+    # decoders see while a cold ~950-token prompt is admitted — THE
+    # number chunked prefill exists to bound. Unchunked, the gap is one
+    # full-bucket prefill + a step; chunked, one chunk + a step.
+    import statistics
+
+    from ray_tpu.llm.config import SamplingParams
+    from ray_tpu.llm.engine import LLMEngine
+
+    eng = LLMEngine(
+        LLMConfig(
+            model_config=model,
+            max_slots=4,
+            max_seq=1024,
+            prefill_buckets=(32, 128, 1024),
+            num_kv_blocks=420,
+            enable_prefix_caching=False,  # every long prompt stays cold
+            prefill_chunk_tokens=0 if no_chunked_prefill else 128,
+        )
+    )
+    eng.add_request("warm", "w" * 950, SamplingParams(max_tokens=2))
+    while eng.has_unfinished():
+        eng.step()  # compile both prefill paths + decode
+    eng.pop_finished()
+    for i in range(3):
+        eng.add_request(f"d{i}", f"short {i}", SamplingParams(max_tokens=250))
+    eng.step()
+    eng.step()
+    stalls = []
+    for trial in range(3):
+        eng.add_request(
+            f"long{trial}", "y" * (930 + trial), SamplingParams(max_tokens=2)
+        )
+        gaps, t_last = [], time.perf_counter()
+        for _ in range(64):
+            eng.step()
+            now = time.perf_counter()
+            gaps.append(now - t_last)
+            t_last = now
+            if not any(
+                r.request_id == f"long{trial}" and not r.finished
+                for r in eng.requests.values()
+            ):
+                break
+        eng.pop_finished()
+        stalls.append(max(gaps))
+    results["serve_llm_decode_stall_ms"] = round(
+        statistics.median(stalls) * 1e3, 2
+    )
+    print(
+        f"serve_llm_decode_stall_ms: "
+        f"{results['serve_llm_decode_stall_ms']} ms (worst decoder gap "
+        f"while a cold long prompt lands; median of 3)",
+        flush=True,
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -100,6 +410,28 @@ def main() -> int:
         "quantization arm of the round-11 A/B",
     )
     ap.add_argument(
+        "--serve-llm-only",
+        action="store_true",
+        help="run only the LLM-serving rows (2 tiny-model replicas on "
+        "CPU jax, streaming clients): serve_llm_shared_prefix / "
+        "serve_llm_mixed_len tok/s + p99 TTFT/ITL — the round-12 "
+        "cache-aware-serving A/B rides this via tools/ab_prefix_routing.py",
+    )
+    ap.add_argument(
+        "--no-prefix-routing",
+        action="store_true",
+        help="kill switch: cache-blind router (equivalent to "
+        "RAY_TPU_PREFIX_ROUTING=0) — the A/B baseline for prefix-affinity "
+        "routing (PERF.md round-12)",
+    )
+    ap.add_argument(
+        "--no-chunked-prefill",
+        action="store_true",
+        help="serve-llm rows only: engines admit with whole-suffix "
+        "prefill (prefill_chunk_tokens=0) — the A/B baseline for chunked "
+        "prefill (PERF.md round-12)",
+    )
+    ap.add_argument(
         "--faults",
         metavar="SEED:SPEC",
         help="enable the fault-injection plane for the whole run "
@@ -125,6 +457,7 @@ def main() -> int:
         or args.no_scatter_gather
         or args.no_hierarchical
         or args.no_quantized
+        or args.no_prefix_routing
     ):
         from ray_tpu.core.config import GLOBAL_CONFIG
 
@@ -139,9 +472,26 @@ def main() -> int:
             GLOBAL_CONFIG.hierarchical_collectives = False
         if args.no_quantized:
             GLOBAL_CONFIG.collective_quantize_dcn = False
+        if args.no_prefix_routing:
+            GLOBAL_CONFIG.prefix_routing = False
+
+    if args.serve_llm_only:
+        # Replica actors must run CPU jax even where a TPU plugin is
+        # installed: workers inherit the driver env.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     ray_tpu.init(num_cpus=16)
     results = {}
+
+    if args.serve_llm_only:
+        _serve_llm_rows(
+            results,
+            no_chunked_prefill=args.no_chunked_prefill,
+            quick=args.quick,
+        )
+        print(json.dumps(results), flush=True)
+        ray_tpu.shutdown()
+        return 0
 
     def record(name, fn, multiplier=1):
         n, rate = timeit(name, fn, multiplier, min_s=min_s)
