@@ -365,11 +365,43 @@ def _serve_llm_rows() -> dict:
     return out
 
 
+def _raylint_rows() -> dict:
+    """Static-analysis debt counts via ``tools/raylint.py --json`` (total /
+    suppressed / unsuppressed + per-rule) so lint debt is tracked per round
+    like perf. Best-effort: any failure returns {} so the headline
+    one-JSON-line contract stands."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "tools", "raylint.py"),
+                "--json",
+            ],
+            timeout=120,
+            capture_output=True,
+            text=True,
+            cwd=repo,
+        )
+        # rc 1 = unsuppressed findings: still a valid, very interesting row.
+        payload = json.loads(r.stdout.strip().splitlines()[-1])
+        return {
+            "total": payload["total"],
+            "suppressed": payload["suppressed"],
+            "unsuppressed": payload["unsuppressed"],
+            "by_rule": payload["by_rule"],
+        }
+    except Exception as e:  # noqa: BLE001 — never fail the headline bench
+        _log(f"raylint rows skipped: {type(e).__name__}: {e}")
+    return {}
+
+
 def _emit(
     record: dict,
     data_plane: dict,
     probe: dict | None = None,
     serve_llm: dict | None = None,
+    raylint: dict | None = None,
 ) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
@@ -378,6 +410,11 @@ def _emit(
         # the serving number (tok/s + p99 TTFT, routing ON vs OFF) from
         # round 12 on, TPU availability notwithstanding.
         record = {**record, "serve_llm": serve_llm}
+    if raylint:
+        # Lint-debt counts ride every record (tracked like perf: the
+        # suppressed count is the justified-debt baseline; unsuppressed
+        # must stay 0 — tests/test_raylint.py enforces it in tier-1).
+        record = {**record, "raylint": raylint}
     if probe:
         # Probe telemetry rides every record — skip rounds included — so a
         # wedged round stays diagnosable from the BENCH_r* file.
@@ -395,16 +432,21 @@ def main() -> None:
     # the TPU tunnel is wedged (BENCH_r* keeps tracking both planes).
     data_plane = _data_plane_rows()
     serve_llm = _serve_llm_rows()
+    raylint = _raylint_rows()
 
     probe, probe_record = _probe_backend()
     if probe == "wedged":
-        _emit(_skip("tpu-unavailable"), data_plane, probe_record, serve_llm)
+        _emit(
+            _skip("tpu-unavailable"), data_plane, probe_record, serve_llm,
+            raylint,
+        )
         return
     if probe == "broken":
         # Fast nonzero exits mean jax/the plugin is broken, not that the
         # tunnel is down — a real regression must go red, not skip.
         _emit(
-            _skip("backend-probe-failed"), data_plane, probe_record, serve_llm
+            _skip("backend-probe-failed"), data_plane, probe_record, serve_llm,
+            raylint,
         )
         sys.exit(1)
 
@@ -419,7 +461,10 @@ def main() -> None:
         )
     except subprocess.TimeoutExpired:
         _log(f"bench subprocess exceeded {BENCH_TIMEOUT_S}s; tunnel wedge?")
-        _emit(_skip("tpu-unavailable"), data_plane, probe_record, serve_llm)
+        _emit(
+            _skip("tpu-unavailable"), data_plane, probe_record, serve_llm,
+            raylint,
+        )
         return
     if r.returncode != 0:
         # The backend was alive (probe passed), so a failing measurement is a
@@ -430,6 +475,7 @@ def main() -> None:
             data_plane,
             probe_record,
             serve_llm,
+            raylint,
         )
         sys.exit(1)
     # Forward the subprocess's final JSON line as our one-line contract.
@@ -437,11 +483,14 @@ def main() -> None:
         line = line.strip()
         if line.startswith("{"):
             try:
-                _emit(json.loads(line), data_plane, probe_record, serve_llm)
+                _emit(
+                    json.loads(line), data_plane, probe_record, serve_llm,
+                    raylint,
+                )
             except json.JSONDecodeError:
                 print(line, flush=True)
             return
-    _emit(_skip("no-output"), data_plane, probe_record, serve_llm)
+    _emit(_skip("no-output"), data_plane, probe_record, serve_llm, raylint)
 
 
 if __name__ == "__main__":

@@ -151,7 +151,7 @@ def _metadata(key: str) -> Optional[str]:
     if _metadata_lookup is not None:
         try:
             return _metadata_lookup(key)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- GCE metadata server absent off-cloud; None routes callers to env/defaults
             return None
     return None
 
@@ -180,7 +180,7 @@ class TPUAcceleratorManager(AcceleratorManager):
             if vfio:
                 return len(vfio)
             return len(glob.glob("/dev/accel*"))
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- accelerator device-file probe; unreadable /dev means 0 local chips
             return 0
 
     @staticmethod

@@ -10,6 +10,7 @@ through the provider, and terminates instances idle past the timeout
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from typing import Optional
@@ -77,7 +78,10 @@ class Autoscaler:
             try:
                 self.reconcile_once()
             except Exception:
-                pass
+                logging.getLogger("ray_tpu.autoscaler").exception(
+                    "autoscaler reconcile tick failed; retrying next "
+                    "interval"
+                )
             self._stop.wait(self.config.interval_s)
 
     # -- one reconcile tick ---------------------------------------------------
@@ -148,7 +152,7 @@ class Autoscaler:
                          "reason": "idle_terminated"},
                         timeout=10,
                     )
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- best-effort pre-termination drain; terminate_node below proceeds either way
                     pass
                 self.provider.terminate_node(pid)
                 counts[info["node_type"]] -= 1
@@ -169,11 +173,11 @@ class Autoscaler:
                 {"ns": _REQUEST_KV_NS, "key": _REQUEST_KEY},
                 timeout=10,
             )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- provider CLI listing failed; empty view skips this reconcile round
             return []
         if not raw:
             return []
         try:
             return json.loads(raw)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- malformed provider CLI output treated as empty node list
             return []

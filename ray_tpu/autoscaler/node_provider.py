@@ -86,5 +86,5 @@ class FakeMultiNodeProvider(NodeProvider):
         for info in nodes:
             try:
                 info["node"].stop()
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- best-effort stop of an in-process test node during terminate
                 pass

@@ -143,7 +143,7 @@ def cluster_up(
                     provider.terminate(iid)
                     del state["instances"][iid]
                     _save_state(config, state_dir, state)
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- state-file prune is cosmetic; a stale instance entry is retried by `down`
                     pass
         head_type = config.node_types[config.head_node_type]
         head_id = provider.create(
@@ -187,7 +187,7 @@ def cluster_up(
                     provider.terminate(wid)
                     del state["instances"][wid]
                     _save_state(config, state_dir, state)
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- stays tracked; `down` retries
                     pass  # stays tracked; `down` retries
         have = sum(
             1

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import atexit
+import logging
 import functools
 import os
 import threading
@@ -98,7 +99,7 @@ class Runtime:
         for node in self.nodes:
             try:
                 node.stop()
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- shutdown teardown; node already stopping or gone
                 pass
         self.gcs.stop()
 
@@ -118,7 +119,7 @@ def _default_resources(num_cpus: float | None) -> dict:
         from ray_tpu.accelerators import tpu as tpu_accel
 
         resources.update(tpu_accel.detect_resources())
-    except Exception:
+    except Exception:  # raylint: disable=RL006 -- TPU detection on non-TPU hosts; resources fall back to CPU-only
         pass
     return resources
 
@@ -128,7 +129,7 @@ def _default_labels() -> dict:
         from ray_tpu.accelerators import tpu as tpu_accel
 
         return tpu_accel.detect_labels()
-    except Exception:
+    except Exception:  # raylint: disable=RL006 -- TPU label detection on non-TPU hosts; no labels to add
         return {}
 
 
@@ -291,8 +292,12 @@ def init(
         if GLOBAL_CONFIG.log_to_driver:
             try:
                 worker.enable_log_subscription()
-            except Exception:
-                pass
+            except Exception as e:
+                logging.getLogger("ray_tpu").warning(
+                    "log-to-driver subscription failed (worker logs stay "
+                    "on their nodes): %s",
+                    e,
+                )
         _runtime = runtime
         _worker = worker
         atexit.register(shutdown)
@@ -331,7 +336,7 @@ def shutdown() -> None:
             _runtime = None
         try:
             atexit.unregister(shutdown)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- atexit.unregister after interpreter-shutdown races is best-effort
             pass
 
 
@@ -691,11 +696,9 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     if worker.on_endpoint_loop():
         # From an async actor method (endpoint loop): blocking would
         # deadlock the loop; kill is fire-and-forget there.
-        from ray_tpu.core.core_worker import _logged
+        from ray_tpu.util.tasks import spawn
 
-        asyncio.ensure_future(
-            _logged(worker.gcs.acall("kill_actor", payload), "kill_actor")
-        )
+        spawn(worker.gcs.acall("kill_actor", payload), name="kill_actor")
     else:
         worker.gcs.call("kill_actor", payload)
 

@@ -218,6 +218,23 @@ class Config:
     push_batch_min_queue: int = 8
     log_monitor_interval_s: float = 0.3
     log_to_driver: bool = True
+    # Deterministic fault injection (RAY_TPU_FAULTS="<seed>:<rule>[;...]"):
+    # parsed by core/faults.py at import into the process-global injector.
+    # Empty = chaos off (production). Spawned workers inherit the env var,
+    # so a head-exported spec reaches every member process.
+    faults: str = ""
+    # Distributed tracing (RAY_TPU_TRACING_ENABLED=1): spans ride the
+    # task-event pipeline; tracing.enable()/disable() override at runtime.
+    tracing_enabled: bool = False
+    # GCS event-log JSON-lines export sink (RAY_TPU_EVENT_EXPORT_PATH):
+    # empty = no export. Written by a background thread, drop-on-overflow.
+    event_export_path: str = ""
+    # Transfer-fabric armed-array cap (RAY_TPU_XFER_ARMED_CAP): staged
+    # device arrays kept alive awaiting a pull before LRU eviction.
+    xfer_armed_cap: int = 16
+    # Default train/tune results root (RAY_TPU_STORAGE_PATH): used when
+    # RunConfig.storage_path is not given. Empty = ~/ray_tpu_results.
+    storage_path: str = ""
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -232,6 +249,55 @@ class Config:
         every module holds a reference to GLOBAL_CONFIG."""
         for k, v in json.loads(s).items():
             setattr(self, k, v)
+
+    def reapply_env(self) -> None:
+        """Re-apply this process's RAY_TPU_<FIELD> env overrides on top of
+        shipped cluster config. Per-process env wins (the contract in this
+        module's docstring): a worker spawned with
+        runtime_env={"env_vars": {"RAY_TPU_TRACING_ENABLED": "1"}} must
+        keep that override after apply_json() lands the head's values.
+        Callers: worker_main, immediately after applying
+        RAY_TPU_INTERNAL_CONFIG."""
+        for f in dataclasses.fields(Config):
+            if os.environ.get(f"RAY_TPU_{f.name.upper()}") is not None:
+                setattr(self, f.name, _env(f.name, getattr(self, f.name)))
+
+
+# Per-process bootstrap interface: RAY_TPU_* env vars that are read
+# directly from the environment OUTSIDE this module, on purpose. These
+# cannot ride the Config knob table because they are per-process identity
+# or bootstrap values (set by the parent for a child it spawns, or
+# consulted before/independently of config load), not cluster-synced
+# configuration. tools/raylint.py (RL004) enforces that every RAY_TPU_*
+# read outside this file is either a registered knob read via
+# GLOBAL_CONFIG or a member of this registry, and that each is documented
+# in README.md.
+BOOTSTRAP_ENV_VARS = frozenset(
+    {
+        # Cluster address for auto-connecting drivers/jobs (set by the job
+        # manager for driver subprocesses; read at ray_tpu.init()).
+        "RAY_TPU_ADDRESS",
+        # Endpoint bind/advertise interface selection: consulted at
+        # Endpoint.start() time, including before any cluster config
+        # exists, and mutated at runtime by `raytpu start`/api.init.
+        "RAY_TPU_BIND_HOST",
+        "RAY_TPU_ADVERTISE_HOST",
+        "RAY_TPU_HOST_IP",
+        # Spawned-worker identity/bootstrap (set by the node per child).
+        "RAY_TPU_WORKER_ID",
+        "RAY_TPU_INTERNAL_CONFIG",
+        "RAY_TPU_RUNTIME_ENV",
+        # Worker stdio routing kill switches (consulted at spawn time).
+        "RAY_TPU_WORKER_LOG_INHERIT",
+        "RAY_TPU_SILENCE_WORKERS",
+        # Accelerator visibility: opt-out of TPU_VISIBLE_CHIPS pinning
+        # (mirrors the reference's RAY_EXPERIMENTAL_NOSET_* contract).
+        "RAY_TPU_NOSET_TPU_VISIBLE_CHIPS",
+        # Device-object fabric kill switch: read per device_get() call so
+        # it can be flipped at runtime (tests and live mitigation).
+        "RAY_TPU_RDT_FABRIC",
+    }
+)
 
 
 def load_config() -> Config:

@@ -54,6 +54,7 @@ from ray_tpu.core.object_store import (
     ShmReader,
     ShmWriter,
 )
+from ray_tpu.util.tasks import spawn
 from ray_tpu.core.protocol import (
     ConnectionLost,
     Endpoint,
@@ -123,7 +124,6 @@ class CoreWorker:
         self.node_addr = tuple(node_addr)
         self.gcs = GcsClient(self.endpoint, gcs_addr)
         self.max_pending_leases = max_pending_leases
-        self._bg_tasks: set = set()  # strong refs for fire-and-forget tasks
 
         self.owner_store: OwnerStore | None = None  # created on loop start
         self.node_id: str | None = None
@@ -265,7 +265,7 @@ class CoreWorker:
                     {"worker_id": self.worker_id},
                     timeout=5,
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- shutdown unregister; node already gone means nothing to unregister
                 pass
         if self._executor is not None:
             self._executor.shutdown(wait=False, cancel_futures=True)
@@ -309,7 +309,7 @@ class CoreWorker:
                 await self.gcs.acall(
                     "report_task_events", {"events": batch}
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- failure requeues the batch for the next flush tick (assignment below)
                 self._task_events_buf = batch + self._task_events_buf
 
     async def _metrics_push_loop(self) -> None:
@@ -338,7 +338,7 @@ class CoreWorker:
                     "node.report_metrics",
                     {"worker_id": self.worker_id, "snapshot": snap},
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- best-effort telemetry push; next interval retries with a fresh snapshot
                 pass
 
     def enable_log_subscription(self) -> None:
@@ -393,7 +393,7 @@ class CoreWorker:
                         ref.owner_addr, "owner.add_borrow", {"oid": ref.hex()}
                     )
                 )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- ref-count notify on a closing owner connection; owner GC reconciles
             pass
 
     def _on_ref_deleted(self, ref: ObjectRef) -> None:
@@ -408,7 +408,7 @@ class CoreWorker:
                         ref.owner_addr, "owner.remove_borrow", {"oid": ref.hex()}
                     )
                 )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- ref-count notify on a closing owner connection; owner GC reconciles
             pass
 
     async def _release_local_ref(self, oid: str) -> None:
@@ -439,7 +439,7 @@ class CoreWorker:
                         await self.endpoint.anotify(
                             addr, "node.free_object", {"oid": oid}
                         )
-                    except Exception:
+                    except Exception:  # raylint: disable=RL006 -- best-effort remote free; node death frees the blob with the node
                         pass
 
     # -- owner RPCs ----------------------------------------------------------
@@ -493,7 +493,7 @@ class CoreWorker:
                 try:
                     await self._reconstruct(oid)
                     reconstructed = True
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001 # raylint: disable=RL006 -- reconstruction failure is propagated to the caller in the reply envelope
                     return {"error": e}
                 continue
             info = await self._node_info_for(node_id) or {}
@@ -734,7 +734,7 @@ class CoreWorker:
                             "size": loc["size"],
                         },
                     )
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- best-effort borrower registration; owner death surfaces on get()
                     pass
             return data
 
@@ -753,7 +753,7 @@ class CoreWorker:
             return ""
         try:
             info = await self._node_info_for(node_id)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- death-reason lookup is advisory; generic ObjectLostError still raised
             info = None
         reason = (info or {}).get("death_reason")
         if reason:
@@ -767,7 +767,7 @@ class CoreWorker:
         to lineage reconstruction like before."""
         try:
             node_id = await self.gcs.acall("migrated_location", {"oid": oid})
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- migrated-location probe; miss falls through to lineage reconstruction
             return None
         if not node_id:
             return None
@@ -1015,7 +1015,7 @@ class CoreWorker:
         (get/wait/cancel) runs after the drain callback, so it observes the
         owner-store entries already registered."""
         if self.on_endpoint_loop():
-            asyncio.ensure_future(_logged(coro, "task enqueue"))
+            spawn(coro, name="task enqueue")
             return
         if not GLOBAL_CONFIG.rpc_coalesce_enabled:
             self.endpoint.submit(coro).result(timeout=30)
@@ -1045,7 +1045,7 @@ class CoreWorker:
                     self._submit_wake_pending = False
                     return
             for coro in coros:
-                asyncio.ensure_future(_logged(coro, "task enqueue"))
+                spawn(coro, name="task enqueue")
 
     def _encode_arg(self, value: Any, ref_bag: "set | None" = None):
         if isinstance(value, ObjectRef):
@@ -1110,11 +1110,14 @@ class CoreWorker:
             # A deep queue's whole lease wave rides ONE RPC (PERF.md
             # round-5: the driver->node leg was still one frame per lease).
             qs.inflight += want
-            asyncio.ensure_future(self._acquire_batch_and_run(key, qs, want))
+            spawn(
+                self._acquire_batch_and_run(key, qs, want),
+                name="lease batch acquire",
+            )
             return
         for _ in range(want):
             qs.inflight += 1
-            asyncio.ensure_future(self._acquire_and_run(key, qs))
+            spawn(self._acquire_and_run(key, qs), name="lease acquire")
 
     async def _acquire_batch_and_run(
         self, key, qs: _QueueState, want: int
@@ -1147,8 +1150,9 @@ class CoreWorker:
         # the inflight slots hand off 1:1.
         for reply in replies:
             first = None if reply.get("fallback") else reply
-            asyncio.ensure_future(
-                self._acquire_and_run(key, qs, first_reply=first)
+            spawn(
+                self._acquire_and_run(key, qs, first_reply=first),
+                name="lease acquire",
             )
 
     async def _acquire_and_run(
@@ -1166,7 +1170,7 @@ class CoreWorker:
                         "node.return_lease",
                         {"lease_id": first_reply["lease_id"]},
                     )
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- lease return on an unreachable node; lease dies with the node
                     pass
             return
         try:
@@ -1202,7 +1206,7 @@ class CoreWorker:
                 await self.endpoint.acall(
                     node_addr, "node.return_lease", {"lease_id": lease_id}
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- lease return on an unreachable node; lease dies with the node
                 pass
             return
         addr = tuple(node_addr)
@@ -1219,11 +1223,11 @@ class CoreWorker:
                 await self.endpoint.acall(
                     addr, "node.return_lease_batch", {"lease_ids": ids}
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- batch lease return on an unreachable node; leases die with the node
                 pass
 
         asyncio.get_running_loop().call_soon(
-            lambda: asyncio.ensure_future(flush())
+            lambda: spawn(flush(), name="lease batch return")
         )
 
     async def _drain_lease(self, qs: "_QueueState", grant: dict) -> None:
@@ -1420,12 +1424,10 @@ class CoreWorker:
                     ),
                     GLOBAL_CONFIG.rpc_connect_timeout_s,
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- peer truly gone: nothing granted, nothing to leak
                 pass  # peer truly gone: nothing granted, nothing to leak
 
-        t = asyncio.get_running_loop().create_task(_fire())
-        self._bg_tasks.add(t)
-        t.add_done_callback(self._bg_tasks.discard)
+        spawn(_fire(), name="lease cancel notify")
 
     async def _request_lease(
         self, spec: TaskSpec, first_reply: dict | None = None
@@ -1500,7 +1502,7 @@ class CoreWorker:
                             "node.peer_suspect",
                             {"addr": tuple(node_addr)},
                         )
-                    except Exception:
+                    except Exception:  # raylint: disable=RL006 -- suspect-report notify; scheduler breaker state converges on its own
                         pass
                     if time.monotonic() > deadline:
                         raise asyncio.TimeoutError(
@@ -1547,7 +1549,7 @@ class CoreWorker:
                 "node.worker_unreachable",
                 {"worker_id": grant["worker_id"]},
             )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- kill of a worker on an unreachable node; node death reaps it
             pass
 
     async def _retry_or_fail_after_conn_loss(self, spec: TaskSpec) -> None:
@@ -1585,7 +1587,10 @@ class CoreWorker:
                 # already dropped: don't resurrect the owner entry, and free
                 # the orphan blob the rerun just sealed on its node.
                 if kind == "location":
-                    asyncio.ensure_future(self._free_remote_blob(res[1], oid))
+                    spawn(
+                        self._free_remote_blob(res[1], oid),
+                        name="orphan blob free",
+                    )
                 continue
             if kind == "inline":
                 self.owner_store.put_inline(oid, res[1])
@@ -1609,9 +1614,7 @@ class CoreWorker:
         )
         # Fire-and-forget pattern: refs dropped while the task was PENDING
         # couldn't free then — re-check now that results exist.
-        asyncio.ensure_future(
-            _logged(self._free_completed_outputs(spec), "output free")
-        )
+        spawn(self._free_completed_outputs(spec), name="output free")
 
     async def _free_completed_outputs(self, spec: TaskSpec) -> None:
         for oid in spec.return_ids:
@@ -1624,7 +1627,7 @@ class CoreWorker:
                 await self.endpoint.anotify(
                     addr, "node.free_object", {"oid": oid}
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- best-effort orphan blob free; node death frees it
                 pass
 
     async def _fail_task(self, spec: TaskSpec, error: Exception) -> None:
@@ -1747,7 +1750,7 @@ class CoreWorker:
             return
         try:
             self.endpoint.submit(self._drop_stream_async(task_id))
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- stream drop riding a stopping endpoint loop; server ttl reaps it
             pass
 
     async def _drop_stream_async(self, task_id: str) -> None:
@@ -1862,11 +1865,9 @@ class CoreWorker:
             # loop (the submitter retries name resolution until the GCS
             # finishes scheduling it; a registration error is logged here
             # and surfaces to callers as the actor never becoming alive).
-            asyncio.ensure_future(
-                _logged(
-                    self.gcs.acall("create_actor", {"spec": spec}),
-                    f"actor registration ({spec['class_name']})",
-                )
+            spawn(
+                self.gcs.acall("create_actor", {"spec": spec}),
+                name=f"actor registration ({spec['class_name']})",
             )
             return {"actor_id": actor_id}
         info = self.gcs.call("create_actor", {"spec": spec}, timeout=120)
@@ -2015,7 +2016,7 @@ class CoreWorker:
                             "reason": str(self._actor_init_error),
                         },
                     )
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- actor-death report on a dying GCS link; heartbeat loss reports it too
                     pass
             finally:
                 self._actor_ready.set()
@@ -2771,7 +2772,7 @@ class _ActorSubmitter:
         if self._sender_active or self._reconnecting:
             return
         self._sender_active = True
-        asyncio.ensure_future(self._send_loop())
+        spawn(self._send_loop(), name="actor send loop")
 
     async def _send_loop(self) -> None:
         try:
@@ -2795,8 +2796,8 @@ class _ActorSubmitter:
                     await self._on_disconnect()
                     continue
                 fut.add_done_callback(
-                    lambda f, s=spec: asyncio.ensure_future(
-                        self._on_reply(s, f)
+                    lambda f, s=spec: spawn(
+                        self._on_reply(s, f), name="actor reply apply"
                     )
                 )
         finally:
@@ -2827,6 +2828,7 @@ class _ActorSubmitter:
         if exc is None:
             if spec.task_id in self.unacked:
                 del self.unacked[spec.task_id]
+                # raylint: disable=RL001 -- done-callback context: fut completed (exception() above returned None), so result() cannot block
                 self.worker._apply_task_reply(spec, fut.result())
             return
         if isinstance(exc, (ConnectionLost, ConnectionError, OSError)):
@@ -2901,17 +2903,6 @@ class _ActorSubmitter:
         self.incarnation += 1
         self.seq = 0
         return True
-
-
-async def _logged(coro, what: str):
-    """Await a fire-and-forget coroutine, logging instead of silently
-    dropping its failure."""
-    try:
-        return await coro
-    except Exception:  # noqa: BLE001
-        import logging
-
-        logging.getLogger("ray_tpu").exception("background %s failed", what)
 
 
 def _safe_exc(exc: Exception) -> Exception:

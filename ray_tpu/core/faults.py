@@ -60,10 +60,11 @@ from __future__ import annotations
 import dataclasses
 import fnmatch
 import math
-import os
 import random
 import threading
 from typing import Optional, Sequence
+
+from ray_tpu.core.config import GLOBAL_CONFIG
 
 INF = math.inf
 
@@ -230,7 +231,7 @@ def parse_env(value: str) -> FaultInjector:
 # gate on this single attribute check and pay nothing else.
 _ACTIVE: Optional[FaultInjector] = None
 
-_env_spec = os.environ.get("RAY_TPU_FAULTS")
+_env_spec = GLOBAL_CONFIG.faults
 if _env_spec:
     _ACTIVE = parse_env(_env_spec)
 

@@ -11,6 +11,7 @@ groups.
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -32,6 +33,7 @@ from ray_tpu.core.scheduler import (
     pick_node,
 )
 from ray_tpu.util.metrics import declare_runtime_metric
+from ray_tpu.util.tasks import spawn
 
 ALIVE = "ALIVE"
 PENDING = "PENDING"
@@ -266,8 +268,12 @@ class GcsServer:
                 continue
             try:
                 await conn.notify("pub", {"channel": channel, "data": data})
-            except Exception:
-                pass
+            except Exception as e:
+                # Subscriber misses one event; its next poll/resync catches
+                # up. Logged so a flapping subscriber link is visible.
+                logging.getLogger("ray_tpu.gcs").debug(
+                    "pub to subscriber dropped (channel %s): %s", channel, e
+                )
 
     async def _h_subscribe(self, conn: Connection, p: dict):
         for ch in p["channels"]:
@@ -513,7 +519,7 @@ class GcsServer:
                     view.addr, "node.drain",
                     {"grace_s": 0.0, "reason": reason},
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- force-kill notice to an unreachable node; mark_node_dead below is authoritative
                 pass
             await self._mark_node_dead(node_id, reason)
             return {"accepted": True, "state": DEAD, "forced": True}
@@ -545,14 +551,16 @@ class GcsServer:
             {"node_id": node_id, "state": DRAINING, "reason": reason,
              "grace_s": float(grace)},
         )
-        ent["task"] = asyncio.ensure_future(self._drain_deadline(node_id))
+        ent["task"] = spawn(
+            self._drain_deadline(node_id), name="drain deadline"
+        )
         if not p.get("self_initiated"):
             try:
                 await self.endpoint.acall(
                     view.addr, "node.drain",
                     {"grace_s": float(grace), "reason": reason},
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- node unreachable: the deadline fallback still fires
                 pass  # node unreachable: the deadline fallback still fires
         return {"accepted": True, "state": DRAINING}
 
@@ -870,7 +878,7 @@ class GcsServer:
                         "node.kill_worker",
                         {"worker_id": rec.worker_id, "force": True},
                     )
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- force-kill of a worker on an unreachable node; node death reaps it
                     pass
         if rec.killed:
             rec.state = DEAD
@@ -1243,7 +1251,7 @@ class GcsServer:
                         ],
                     },
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- restart-ack failure recorded via r=False and retried by the caller loop
                 r = False
             if not r:
                 ok = False
@@ -1261,7 +1269,7 @@ class GcsServer:
                         "node.cancel_bundles",
                         {"pg_id": rec.pg_id},
                     )
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- pg release on an unreachable node; node death frees its bundles
                     pass
             if rec.state != PG_REMOVED and rec.pg_id not in self.pending_pgs:
                 self.pending_pgs.append(rec.pg_id)
@@ -1287,7 +1295,7 @@ class GcsServer:
                             "node.return_pg",
                             {"pg_id": rec.pg_id},
                         )
-                    except Exception:
+                    except Exception:  # raylint: disable=RL006 -- pg prepare rollback on an unreachable node; reschedule loop retries
                         pass
                 continue
             view = self.nodes.get(nid)

@@ -110,7 +110,7 @@ class SqliteStoreClient(StoreClient):
         with self._lock:
             try:
                 self._db.close()
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- sqlite close during process teardown; data already flushed per-write
                 pass
 
 

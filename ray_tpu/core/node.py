@@ -41,6 +41,7 @@ from ray_tpu.core.scheduler import (
     subtract,
 )
 from ray_tpu.util.metrics import declare_runtime_metric
+from ray_tpu.util.tasks import spawn
 
 # Node-level series (beyond the worker/cpu gauges of earlier rounds):
 # object-plane occupancy and churn, plus the heartbeat-piggyback saving.
@@ -177,7 +178,6 @@ class NodeManager:
             lambda: bool(self._suspect_until or self.endpoint._breakers),
             self._addr_suspect,
         )
-        self._bg_tasks: set = set()  # strong refs for fire-and-forget tasks
         # request_lease idempotency dedup: req_id -> (ts, reply future).
         # A transport retry of an in-flight lease request attaches to the
         # original grant instead of double-granting (see _h_request_lease).
@@ -309,7 +309,7 @@ class NodeManager:
                 if w.proc is not None:
                     try:
                         w.proc.wait(timeout=5)
-                    except Exception:
+                    except Exception:  # raylint: disable=RL006 -- worker proc wait during stop; SIGKILL path already ran
                         pass
         self.endpoint.stop()
         if self._cgroups is not None:
@@ -380,7 +380,7 @@ class NodeManager:
                     {"node_id": self.node_id, "reason": reason,
                      "force": True, "self_initiated": True},
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- heartbeat-timeout death is the fallback
                 pass  # heartbeat-timeout death is the fallback
             self._retire()
             return True
@@ -391,10 +391,10 @@ class NodeManager:
                 {"node_id": self.node_id, "reason": reason,
                  "grace_s": grace_s, "self_initiated": True},
             )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- still drain best-effort; heartbeat death is the fallback
             pass  # still drain best-effort; heartbeat death is the fallback
-        self._drain_task = asyncio.ensure_future(
-            self._self_drain(grace_s, reason)
+        self._drain_task = spawn(
+            self._self_drain(grace_s, reason), name="self drain"
         )
         return True
 
@@ -411,8 +411,8 @@ class NodeManager:
             return {"draining": False, "retired": True}
         if not self._draining:
             self._draining = True
-            self._drain_task = asyncio.ensure_future(
-                self._self_drain(float(grace), reason)
+            self._drain_task = spawn(
+                self._self_drain(float(grace), reason), name="self drain"
             )
         return {"draining": True}
 
@@ -453,7 +453,7 @@ class NodeManager:
                     "gcs.restart_node_actors",
                     {"node_id": self.node_id, "reason": reason},
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- GCS unreachable mid-drain: actors restart post-mortem instead
                 moved = []
             self._retire_actor_workers(moved)
             # Running tasks get whatever remains of the grace window.
@@ -466,7 +466,7 @@ class NodeManager:
                     clean = True
                     break
                 await asyncio.sleep(0.05)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- retire below either way; the GCS deadline is the backstop
             pass  # retire below either way; the GCS deadline is the backstop
         if clean:
             try:
@@ -475,7 +475,7 @@ class NodeManager:
                     "gcs.drain_complete",
                     {"node_id": self.node_id, "reason": reason},
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- drain_complete notify best-effort; the GCS deadline closes the drain
                 pass
         self._retire()
 
@@ -542,7 +542,7 @@ class NodeManager:
                         "size": size,
                     },
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- this object reconstructs post-mortem
                 return  # this object reconstructs post-mortem
             moves.append((oid, target.node_id))
             self._drain_migrated += 1
@@ -566,7 +566,7 @@ class NodeManager:
                 await self.endpoint.acall(
                     self.gcs_addr, "gcs.report_migrations", {"moves": moves}
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- migration report lost with the link; owners fall back to reconstruction
                 pass
 
     def _retire_actor_workers(self, moved) -> None:
@@ -781,7 +781,7 @@ class NodeManager:
                 # requests that were infeasible everywhere — re-evaluate
                 # now instead of letting them sit out their deadline.
                 await self._drain_pending()
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- lease-queue drain after worker death; next scheduling tick re-drains
             pass
 
     async def _worker_monitor_loop(self):
@@ -899,7 +899,7 @@ class NodeManager:
                         "reason": reason,
                     },
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- worker-death report on a dying GCS link; heartbeat divergence covers it
                 pass
         await self._drain_pending()
 
@@ -1288,13 +1288,7 @@ class NodeManager:
                 if isinstance(r, dict) and "lease_id" in r:
                     freed |= self._return_one_lease(r["lease_id"])
             if freed:
-                # Strong ref until done: a bare create_task can be
-                # collected mid-flight.
-                t = asyncio.get_running_loop().create_task(
-                    self._drain_pending()
-                )
-                self._bg_tasks.add(t)
-                t.add_done_callback(self._bg_tasks.discard)
+                spawn(self._drain_pending(), name="orphan lease drain")
 
         fut.add_done_callback(_return_orphan)  # fires now if already done
         return True
@@ -1566,7 +1560,7 @@ class NodeManager:
             info = await self.endpoint.acall(
                 self.gcs_addr, "gcs.get_placement_group", {"pg_id": pg_id}
             )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- pg liveness probe; cache keeps the last verdict until the GCS answers
             info = None
         verdict = info is not None and info["state"] != "REMOVED"
         self._pg_state_cache[pg_id] = (now, verdict)
@@ -2168,6 +2162,7 @@ class NodeManager:
                 if size <= off:
                     continue
                 try:
+                    # raylint: disable=RL001 -- local log tail on tmpfs/disk page cache, bounded 1 MiB read per poll tick; an executor hop per tick would cost more than the read
                     with open(path, "rb") as f:
                         f.seek(off)
                         chunk = f.read(min(size - off, 1 << 20))

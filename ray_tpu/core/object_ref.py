@@ -63,7 +63,18 @@ class ObjectRef:
             try:
                 cb(self)
             except Exception:
-                pass
+                _log_ref_hook_failure(self)
+
+
+def _log_ref_hook_failure(ref) -> None:
+    try:
+        import logging
+
+        logging.getLogger("ray_tpu").exception(
+            "ref-deleted hook failed for %s", ref.id.hex()[:12]
+        )
+    except Exception:  # raylint: disable=RL006 -- __del__ can run at interpreter shutdown where logging is already torn down
+        pass
 
 
 def _deserialize_ref(id_hex: str, owner_addr, task_name: str) -> ObjectRef:

@@ -27,6 +27,7 @@ one-write-plus-drain-per-frame path.
 from __future__ import annotations
 
 import asyncio
+import logging
 import pickle
 import random
 import struct
@@ -38,6 +39,7 @@ from typing import Any, Awaitable, Callable, Optional
 from ray_tpu.core import faults
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.errors import DeadlineExceededError, PeerUnavailableError
+from ray_tpu.util.tasks import spawn
 from ray_tpu.util.metrics import (
     LATENCY_BOUNDARIES_S,
     LocalHistogram,
@@ -615,7 +617,7 @@ class Connection:
             transport = self.writer.transport
             size = transport.get_write_buffer_size()
             high = transport.get_write_buffer_limits()[1]
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- transport introspection varies by loop impl; defaults skip the drain wait
             size, high = 0, 1
         if size <= high:
             self.stats["drains_skipped"] += 1
@@ -797,11 +799,13 @@ class Connection:
                     # layer never mistakes a remote OSError/TimeoutError
                     # for a transport failure of THIS hop.
                     exc._raytpu_remote = True
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- exc may be immutable (e.g. tuple-backed); marking is best-effort
                     pass
                 fut.set_exception(exc)
         else:
-            asyncio.ensure_future(self._dispatch(msg_type, msg_id, payload))
+            spawn(
+                self._dispatch(msg_type, msg_id, payload), name="rpc dispatch"
+            )
 
     async def _dispatch(self, msg_type: str, msg_id, payload) -> None:
         try:
@@ -816,8 +820,16 @@ class Connection:
                     tb = traceback.format_exc()
                     try:
                         await self._send(_ERROR, None, msg_id, tb)
-                    except Exception:
-                        pass
+                    except Exception as e2:
+                        # Peer unreachable: its pending call surfaces as
+                        # ConnectionLost; the original error is only lost
+                        # from the WIRE, so keep a local trace of it.
+                        logging.getLogger("ray_tpu.rpc").debug(
+                            "error reply for %s dropped (%s); original: %s",
+                            msg_type,
+                            e2,
+                            tb,
+                        )
 
     def _teardown(self) -> None:
         if self._closed:
@@ -831,7 +843,7 @@ class Connection:
         self._pending.clear()
         try:
             self.writer.close()
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- writer close on an already-broken transport
             pass
         if self.on_close is not None:
             self.on_close(self)
@@ -941,7 +953,7 @@ class Endpoint:
                 GLOBAL_CONFIG.metrics_enabled
                 and GLOBAL_CONFIG.loop_lag_probe_interval_s > 0
             ):
-                asyncio.ensure_future(self._lag_probe_loop())
+                spawn(self._lag_probe_loop(), name="loop lag probe")
             self._started.set()
 
         self._loop.run_until_complete(boot())
@@ -982,7 +994,7 @@ class Endpoint:
             asyncio.run_coroutine_threadsafe(shutdown(), self._loop).result(
                 timeout=5
             )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- best-effort goodbye to the peer; socket teardown follows regardless
             pass
         try:
             self._loop.call_soon_threadsafe(self._loop.stop)

@@ -41,7 +41,7 @@ def main() -> None:
             import jax
 
             jax.config.update("jax_platforms", plat)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- jax platform re-pin is advisory; absent/old jax keeps its default
             pass
 
     from ray_tpu.core.config import GLOBAL_CONFIG
@@ -49,6 +49,13 @@ def main() -> None:
 
     if os.environ.get("RAY_TPU_INTERNAL_CONFIG"):
         GLOBAL_CONFIG.apply_json(os.environ["RAY_TPU_INTERNAL_CONFIG"])
+        # Per-process env overrides (runtime_env env_vars, operator
+        # exports) beat the head's shipped values. NB modules that read a
+        # knob at import time (core/faults.py) already saw the env-loaded
+        # value: the CoreWorker import above precedes apply_json, and this
+        # re-apply keeps the config consistent with what they captured
+        # even if that import order ever changes.
+        GLOBAL_CONFIG.reapply_env()
 
     def parse(a: str) -> tuple:
         host, _, port = a.rpartition(":")
@@ -115,7 +122,7 @@ def main() -> None:
                 worker.endpoint.call(
                     worker.node_addr, "node.get_info", {}, timeout=10
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- orphan watchdog: any error reaching the node means it is gone; exit
                 break
     worker.stop()
     sys.exit(0)

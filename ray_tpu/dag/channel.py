@@ -150,7 +150,7 @@ class ShmChannel:
         try:
             self._mm.close()
             self._f.close()
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- mmap/file close during channel teardown; already closed is fine
             pass
         if unlink:
             try:
@@ -339,7 +339,7 @@ def _is_device_array(value) -> bool:
         import jax
 
         return isinstance(value, jax.Array)
-    except Exception:
+    except Exception:  # raylint: disable=RL006 -- jax import/isinstance probe; non-array values take the pickle path
         return False
 
 
@@ -380,7 +380,7 @@ class DeviceChannel:
         fab = xfer.fabric()
         try:
             partitions = xfer.decomposition_of(value.sharding, value.shape)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- sharding decomposition probe; fallback ships the whole array
             partitions = (1,) * value.ndim
         desc = fab.arm(None, value, partitions)
         self._armed.append(desc["uuid"])

@@ -111,7 +111,7 @@ class DashboardHead:
         finally:
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- HTTP connection close; client already went away
                 pass
 
     async def _route(self, method: str, target: str, body: bytes):

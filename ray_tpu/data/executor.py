@@ -58,7 +58,7 @@ def _record_block_output(block) -> None:
     try:
         _TASK_ROWS.inc(float(block.num_rows))
         _TASK_BYTES.inc(float(block.nbytes))
-    except Exception:
+    except Exception:  # raylint: disable=RL006 -- never fail a data task over telemetry
         pass  # never fail a data task over telemetry
 from ray_tpu.data.plan import (
     DataPlan,
@@ -566,7 +566,7 @@ class StreamingExecutor:
             for actor in pool:
                 try:
                     ray_tpu.kill(actor)
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- actor-pool teardown kill; actor already dead
                     pass
 
     # -- barriers ------------------------------------------------------------

@@ -230,7 +230,7 @@ def apply_chain_op(op, block: Block) -> Block:
                 batch = acc.to_batch(op.batch_format)
                 result = op.fn(batch, **op.fn_kwargs)
                 return BlockAccessor.batch_to_block(result)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- empty-batch schema probe only: fns assuming non-empty arrays are skipped, not crashed (the reference drops zero-row bundles); non-empty batches below propagate errors
                 return block
         out_blocks = []
         size = op.batch_size or n

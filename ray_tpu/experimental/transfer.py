@@ -55,7 +55,7 @@ def _repin_platform() -> None:
 
         try:
             jax.config.update("jax_platforms", plat)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- jax platform re-pin is advisory; absent/old jax keeps its default
             pass
 
 
@@ -75,7 +75,6 @@ class _Fabric:
 
     def __init__(self):
         import collections
-        import os
 
         self._lock = threading.Lock()
         self._server = None
@@ -85,9 +84,9 @@ class _Fabric:
         self._armed: "collections.OrderedDict[int, tuple]" = (
             collections.OrderedDict()
         )
-        self._armed_cap = int(
-            os.environ.get("RAY_TPU_XFER_ARMED_CAP", str(self.ARMED_CAP))
-        )
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        self._armed_cap = int(GLOBAL_CONFIG.xfer_armed_cap)
         self._stats = {"arms": 0, "pulls": 0, "fallbacks": 0}
 
     # -- server ----------------------------------------------------------------

@@ -94,7 +94,7 @@ def _replica_tags() -> dict:
             from ray_tpu.core import api as core_api
 
             rid = core_api.get_runtime_context().actor_id or ""
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- runtime-context probe outside an actor; replica tag falls back to 'local'
             rid = ""
         _replica_tags_cache = {"replica": rid[:12] or "local"}
     return _replica_tags_cache
@@ -163,7 +163,7 @@ class LLMEngine:
         if plat:
             try:
                 jax.config.update("jax_platforms", plat)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- jax platform re-pin is advisory; absent/old jax keeps its default
                 pass
         self.config = config
         self.tokenizer = tokenizer or ByteTokenizer()

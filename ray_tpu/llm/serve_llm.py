@@ -22,6 +22,7 @@ from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.engine import LLMEngine
 from ray_tpu.serve import api as serve_api
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util.tasks import spawn
 
 # Replica-level serving view on top of the engine's own series (TTFT/ITL/
 # token counters/KV gauges live in llm/engine.py): how long each
@@ -62,7 +63,7 @@ class LLMServer:
 
     def _ensure_pump(self) -> None:
         if self._pump_task is None or self._pump_task.done():
-            self._pump_task = asyncio.ensure_future(self._pump())
+            self._pump_task = spawn(self._pump(), name="llm engine pump")
 
     def _step_with_admissions(self) -> list:
         with self._pending_lock:

@@ -447,5 +447,5 @@ class DQN(Algorithm):
         super().stop()
         try:
             ray_tpu.kill(self.replay)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- teardown kill; replay actor already dead
             pass

@@ -120,7 +120,7 @@ def cmd_start(args) -> int:
     if term_ev.is_set() and not args.no_drain:
         try:
             node.drain(reason="preempted", wait=True)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- the GCS deadline / heartbeat timeout is the fallback
             pass  # the GCS deadline / heartbeat timeout is the fallback
     try:
         if dashboard is not None:

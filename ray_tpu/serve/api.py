@@ -189,7 +189,7 @@ def shutdown() -> None:
     for router in _handle_mod._routers.values():
         try:
             router.close()
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- router close during serve shutdown; endpoint already stopping
             pass
     _handle_mod._routers.clear()
     try:

@@ -133,5 +133,5 @@ class ASGIAppWrapper:
                         # whose cleanup wedges) must not hang the replica's
                         # close path forever.
                         await asyncio.wait_for(asyncio.shield(task), 1.0)
-                    except BaseException:
+                    except BaseException:  # raylint: disable=RL006 -- bounded 1s grace for the app task; the sentinel below force-closes
                         pass

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import functools
 from typing import Any, Callable, Optional
+from ray_tpu.util.tasks import spawn
 
 
 class _BatchQueue:
@@ -70,7 +71,7 @@ class _BatchQueue:
             # Overflow: restart the timer against the leftover items' own
             # arrival times (fires immediately if they are already due).
             self._flusher = asyncio.ensure_future(self._flush_after_wait())
-        asyncio.ensure_future(self._run_batch(batch))
+        spawn(self._run_batch(batch), name="serve batch run")
 
     async def _run_batch(self, batch: list) -> None:
         items = [item for item, _, _ in batch]

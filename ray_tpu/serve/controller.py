@@ -14,6 +14,7 @@ import time
 
 import ray_tpu
 from ray_tpu.core import api as core_api
+from ray_tpu.util.tasks import spawn
 
 CONTROLLER_NAME = "serve::controller"
 HEALTH_CHECK_PERIOD_S = 1.0
@@ -78,7 +79,7 @@ class ServeController:
             for r, _ in dep["replicas"]:
                 try:
                     ray_tpu.kill(r)
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- redeploy kill of an old-version replica; already dead is success
                     pass
             dep["replicas"] = []
         dep["version"] = self._bump()
@@ -93,7 +94,7 @@ class ServeController:
         for r, _ in dep["replicas"]:
             try:
                 ray_tpu.kill(r)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- deployment delete kill; replica already dead
                 pass
         return True
 
@@ -133,7 +134,7 @@ class ServeController:
         try:
             await core_api.get_async(replica.ping.remote(), timeout=5.0)
             return True
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- ping probe: any failure IS the un-healthy verdict
             return False
 
     async def get_routing(self, name: str, version: int = -1) -> dict:
@@ -237,7 +238,7 @@ class ServeController:
         it."""
         if not self._loop_running:
             self._loop_running = True
-            asyncio.ensure_future(self._control_loop())
+            spawn(self._control_loop(), name="serve control loop")
 
     async def _control_loop(self) -> None:
         """Run forever: converge replicas toward target state and replace
@@ -277,7 +278,7 @@ class ServeController:
         worker = core_api._require_worker(auto_init=False)
         try:
             view = await worker.gcs.acall("get_cluster_view")
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- GCS hiccup: keep the last verdicts
             return cached  # GCS hiccup: keep the last verdicts
         draining = {
             nid for nid, v in view.items() if v.get("draining")
@@ -349,7 +350,7 @@ class ServeController:
                 return await core_api.get_async(
                     r.queue_len.remote(), timeout=2.0
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- starting/dead replica contributes no queue demand
                 return 0  # starting/dead: contributes no demand
 
         lens = await asyncio.gather(
@@ -381,7 +382,7 @@ class ServeController:
                     if not ok:
                         try:  # release its worker even if half-alive
                             ray_tpu.kill(r)
-                        except Exception:
+                        except Exception:  # raylint: disable=RL006 -- release its worker even if half-alive
                             pass
                 dep["replicas"] = [
                     entry for entry, ok in zip(dep["replicas"], alive) if ok
@@ -405,7 +406,7 @@ class ServeController:
             started = True
             try:
                 ray_tpu.kill(victim)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- downscale kill; victim already dead
                 pass
         if started:
             dep["version"] = self._bump()
@@ -487,7 +488,7 @@ class ServeController:
         if self._proxy is not None:
             try:
                 ray_tpu.kill(self._proxy)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- proxy kill during shutdown; already dead
                 pass
             self._proxy = None
             self._proxy_port = None

@@ -112,7 +112,7 @@ class HTTPProxyActor:
         finally:
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- HTTP connection close; client already went away
                 pass
 
     @staticmethod
@@ -298,7 +298,7 @@ class HTTPProxyActor:
                     chunk = str(chunk).encode()
                 writer.write(bytes(chunk))
                 await writer.drain()
-        except Exception:  # noqa: BLE001 — mid-stream: connection close
+        except Exception:  # noqa: BLE001 — mid-stream: connection close  # raylint: disable=RL006 -- mid-stream client disconnect; nothing to send the rest to
             pass
 
     async def _respond(self, writer, status: int, payload, keep=False):

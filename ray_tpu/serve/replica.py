@@ -85,7 +85,7 @@ class ReplicaActor:
 
         try:
             rid = core_api.get_runtime_context().actor_id
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- not running as an actor (unit tests): no report loop to run
             return  # not running as an actor (unit tests)
         state_fn = getattr(self._callable, "router_state", None)
         controller = None
@@ -102,7 +102,7 @@ class ReplicaActor:
                             sv = state.get("version")
                         else:
                             state = None
-                    except Exception:
+                    except Exception:  # raylint: disable=RL006 -- advertisement is best-effort
                         state = None  # advertisement is best-effort
                 if cur != last or sv != last_sv or now - last_t >= 5.0:
                     if controller is None:
@@ -114,7 +114,7 @@ class ReplicaActor:
                         timeout=5,
                     )
                     last, last_t, last_sv = cur, now, sv
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- controller lost; re-resolve next round (assignment below)
                 controller = None  # re-resolve next round
             await asyncio.sleep(1.0)
 
@@ -126,7 +126,7 @@ class ReplicaActor:
                 from ray_tpu.core import api as core_api
 
                 rid = core_api.get_runtime_context().actor_id or ""
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- runtime-context probe outside an actor; metric tags fall back
                 rid = ""
             self._metric_tags = {
                 "deployment": self._deployment,

@@ -324,7 +324,7 @@ class Router:
             )
             if isinstance(state, dict):
                 self._replica_state = state
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- keep the stale table; the next window retries
             pass  # keep the stale table; the next window retries
 
     # Saturation floor for the digest-preferred replica. Unlike the

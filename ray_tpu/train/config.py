@@ -67,7 +67,8 @@ class RunConfig:
 
     def __post_init__(self):
         if self.storage_path is None:
-            self.storage_path = os.environ.get(
-                "RAY_TPU_STORAGE_PATH",
-                os.path.expanduser("~/ray_tpu_results"),
+            from ray_tpu.core.config import GLOBAL_CONFIG
+
+            self.storage_path = GLOBAL_CONFIG.storage_path or (
+                os.path.expanduser("~/ray_tpu_results")
             )

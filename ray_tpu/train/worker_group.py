@@ -258,7 +258,7 @@ class WorkerGroup:
             for a in actors:
                 try:
                     ray_tpu.kill(a)
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- slice rollback kill; worker already dead
                     pass
             if slice_pg is not None:
                 slice_pg.shutdown()
@@ -345,18 +345,18 @@ class WorkerGroup:
         for w in self.workers:
             try:
                 ray_tpu.kill(w.actor)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- group shutdown kill; worker already dead
                 pass
         if self._slice_pg is not None:
             try:
                 self._slice_pg.shutdown()
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- slice pg teardown; bundles freed with their nodes
                 pass
         elif self._pg is not None:
             from ray_tpu.util.placement_group import remove_placement_group
 
             try:
                 remove_placement_group(self._pg)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- pg remove during shutdown; GCS may already have dropped it
                 pass
         self.workers = []

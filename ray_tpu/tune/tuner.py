@@ -191,18 +191,18 @@ class _ExperimentStore:
         for key, value in (("scheduler", scheduler), ("searcher", searcher)):
             try:
                 blob[key] = cloudpickle.dumps(value)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- unpicklable scheduler field checkpointed as None; resume defaults it
                 blob[key] = None
         if searcher is not None:
             try:
                 blob["searcher_state"] = cloudpickle.dumps(
                     searcher.save_state()
                 )
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- searcher state save failed; resume restarts the searcher fresh
                 blob["searcher_state"] = None
         try:
             self._atomic_write("scheduler.pkl", pickle.dumps(blob))
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- checkpoint write is best-effort; next report re-writes it
             pass
 
     def load(self) -> dict:
@@ -223,7 +223,7 @@ class _ExperimentStore:
                     if raw is not None:
                         try:
                             out[key] = pickle.loads(raw)
-                        except Exception:
+                        except Exception:  # raylint: disable=RL006 -- corrupt checkpoint field skipped; resume proceeds with the rest
                             pass
             else:  # pre-searcher checkpoint layout
                 out["scheduler"] = dyn
@@ -407,7 +407,7 @@ class Tuner:
                 trial = entry["trial"]
                 try:
                     reports = ray_tpu.get(drain_refs[tid], timeout=30)
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- drain-report fetch from a preempted trial; empty reports resume from ckpt
                     reports = []
                 for rec in reports:
                     dirty = True
@@ -458,7 +458,7 @@ class Tuner:
                     ):
                         trial.metrics_history.append(rec)
                         trial.metrics = rec
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- final metrics fetch from a finished trial actor; history keeps prior rows
                     pass
                 ray_tpu.kill(entry["actor"])
                 del running[tid]

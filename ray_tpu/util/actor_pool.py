@@ -65,7 +65,7 @@ class ActorPool:
         self._return_actor(actor)
         try:
             self._done[idx] = ("ok", self._ray.get(ref))
-        except Exception as e:  # noqa: BLE001 — rethrown at retrieval
+        except Exception as e:  # noqa: BLE001 — rethrown at retrieval  # raylint: disable=RL006 -- rethrown at retrieval
             self._done[idx] = ("err", e)
 
     # -- retrieval -----------------------------------------------------------

@@ -150,6 +150,6 @@ def current_slice_name() -> Optional[str]:
         for n in ray_tpu.nodes():
             if n["NodeID"] == node_id:
                 return n.get("Labels", {}).get(TPU_SLICE_NAME_LABEL) or None
-    except Exception:
+    except Exception:  # raylint: disable=RL006 -- cluster-view probe; no label means single-slice topology
         return None
     return None

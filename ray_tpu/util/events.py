@@ -18,13 +18,14 @@ wire protocol.
 from __future__ import annotations
 
 import json
-import os
 import queue
 import threading
 import time
 import uuid
 from collections import deque
 from typing import Any, Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG
 
 DEFINITION = "DEFINITION"
 LIFECYCLE = "LIFECYCLE"
@@ -44,8 +45,8 @@ class EventRecorder:
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._io_lock = threading.Lock()
-        self._export_path = export_path or os.environ.get(
-            "RAY_TPU_EVENT_EXPORT_PATH"
+        self._export_path = (
+            export_path or GLOBAL_CONFIG.event_export_path or None
         )
         self._export_file = None
         self._dropped = 0
@@ -118,7 +119,7 @@ class EventRecorder:
             try:
                 if self._export_file is not None:
                     self._export_file.close()
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- export-file close after a write error; sink already broken
                 pass
             self._export_file = None
 
@@ -160,6 +161,6 @@ class EventRecorder:
             if self._export_file is not None:
                 try:
                     self._export_file.close()
-                except Exception:
+                except Exception:  # raylint: disable=RL006 -- export-file close during shutdown; sink already broken
                     pass
                 self._export_file = None

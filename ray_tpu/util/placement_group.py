@@ -115,7 +115,7 @@ class PlacementGroup:
                 timeout=float(timeout_seconds) + 10.0,
             )
             return True
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- wait() contract: timeout/GCS error is the False verdict
             return False
 
     def __eq__(self, other):

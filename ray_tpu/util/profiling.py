@@ -134,7 +134,7 @@ def _worker_addr(worker_id: str) -> tuple:
             info = w.endpoint.call(
                 tuple(node["Address"]), "node.get_info", {}, timeout=5
             )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- per-node info probe; unreachable nodes are skipped
             continue
         for rec in info.get("workers", []):
             if rec.get("worker_id") == worker_id and rec.get("addr"):

@@ -107,5 +107,5 @@ class Queue:
     def shutdown(self) -> None:
         try:
             self._ray.kill(self._actor)
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- queue shutdown kill; actor already dead
             pass

@@ -74,7 +74,7 @@ def list_workers(limit: int = 1000) -> list[dict]:
             info = w.endpoint.call(
                 tuple(node["Address"]), "node.get_info", {}, timeout=10
             )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- per-node info probe; unreachable nodes are skipped
             continue
         for rec in info.get("workers", []):
             out.append({"node_id": node["NodeID"], **rec})
@@ -102,7 +102,7 @@ def list_objects(limit: int = 10000) -> list[dict]:
                     tuple(node["Address"]), "node.list_objects", {}, timeout=10
                 )
             )
-        except Exception:
+        except Exception:  # raylint: disable=RL006 -- per-node log probe; unreachable nodes are skipped
             continue
         if len(out) >= limit:
             break

@@ -63,7 +63,7 @@ def jax_distributed_initialized() -> bool:
         from jax._src import distributed as _dist
 
         return _dist.global_state.client is not None
-    except Exception:
+    except Exception:  # raylint: disable=RL006 -- jax.distributed state probe; unqueryable means uninitialized
         return False
 
 
@@ -160,7 +160,7 @@ def get_tpu_num_slices_for_workers(
         if per_slice == 0:
             return 1
         return max(1, math.ceil(num_workers / per_slice))
-    except Exception:
+    except Exception:  # raylint: disable=RL006 -- host-count math over partial metadata; 1 is the safe minimum
         return 1
 
 
@@ -341,13 +341,13 @@ class SlicePlacementGroup:
         if self._pg is not None:
             try:
                 remove_placement_group(self._pg)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- pg remove during shutdown; GCS may already have dropped it
                 pass
             self._pg = None
         for pg in self._head_pgs:
             try:
                 remove_placement_group(pg)
-            except Exception:
+            except Exception:  # raylint: disable=RL006 -- pg remove during shutdown; GCS may already have dropped it
                 pass
         self._head_pgs = []
 

@@ -23,10 +23,11 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-import os
 import time
 import uuid
 from typing import Any, Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG
 
 _ctx: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_trace", default=None
@@ -53,9 +54,7 @@ def enabled() -> bool:
         return True
     if _enabled_override is not None:
         return _enabled_override
-    return os.environ.get("RAY_TPU_TRACING_ENABLED", "").lower() in (
-        "1", "true", "yes",
-    )
+    return GLOBAL_CONFIG.tracing_enabled
 
 
 def current_context() -> Optional[tuple]:
@@ -108,7 +107,7 @@ def _record_span_event(ev: dict) -> None:
 
         worker = core_api._require_worker(auto_init=False)
         worker._task_events_buf.append(ev)
-    except Exception:
+    except Exception:  # raylint: disable=RL006 -- span record without a live worker (driver exit); trace rows are advisory
         pass
 
 

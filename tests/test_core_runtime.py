@@ -437,3 +437,22 @@ def test_actor_concurrency_groups(rt):
     # compute group limit 1 -> never two crunches at once.
     assert ray_tpu.get(w.peak.remote(), timeout=10) == 1
     ray_tpu.kill(w)
+
+
+def test_config_reapply_env_beats_shipped_config(monkeypatch):
+    """Worker bootstrap contract: the head's INTERNAL_CONFIG lands first,
+    then this process's own RAY_TPU_* env overrides are re-applied on top
+    (runtime_env env_vars / operator exports win per-process)."""
+    from ray_tpu.core.config import Config
+
+    cfg = Config()
+    head = Config()
+    head.tracing_enabled = False
+    head.push_batch_size = 99
+    monkeypatch.setenv("RAY_TPU_TRACING_ENABLED", "1")
+    cfg.apply_json(head.to_json())
+    assert cfg.push_batch_size == 99  # shipped value applied
+    assert cfg.tracing_enabled is False  # ...including over the env for now
+    cfg.reapply_env()
+    assert cfg.tracing_enabled is True  # env override restored
+    assert cfg.push_batch_size == 99  # non-overridden fields keep shipped

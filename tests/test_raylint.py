@@ -1,0 +1,515 @@
+"""raylint (tools/raylint.py): the rule engine catches each violation
+class, the pragma/suppression contract holds, and the tree itself is at
+ZERO unsuppressed findings — the burn-down stays burned down."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from tools.raylint import (
+    REPO_ROOT,
+    RULE_IDS,
+    Finding,
+    lint_text,
+    lint_tree,
+    summarize,
+)
+
+
+def _ids(findings, suppressed=None):
+    out = []
+    for f in findings:
+        if suppressed is not None and f.suppressed is not suppressed:
+            continue
+        out.append(f.rule)
+    return out
+
+
+def _lint(src, **kw):
+    return lint_text(textwrap.dedent(src), **kw)
+
+
+# -- RL001: blocking calls inside async def -----------------------------------
+
+
+def test_rl001_violating():
+    findings = _lint(
+        """
+        import time, subprocess, socket
+
+        async def bad(lock, fut):
+            time.sleep(1)
+            subprocess.run(["ls"])
+            socket.create_connection(("h", 1))
+            open("/tmp/x")
+            fut.result()
+            lock.acquire()
+        """
+    )
+    assert _ids(findings).count("RL001") == 6
+
+
+def test_rl001_clean():
+    findings = _lint(
+        """
+        import asyncio, time
+
+        async def good(lock, fut):
+            await asyncio.sleep(1)
+            await fut
+            lock.acquire(timeout=5)
+            await alock.acquire()
+
+        def sync_helper():
+            time.sleep(1)       # sync context: fine
+            open("/tmp/x")
+
+        async def outer():
+            def inner():
+                time.sleep(1)   # nested sync def: runs off-loop
+            return inner
+        """
+    )
+    assert "RL001" not in _ids(findings)
+
+
+def test_rl001_pragma_suppressed():
+    findings = _lint(
+        """
+        import time
+
+        async def justified():
+            time.sleep(0.0001)  # raylint: disable=RL001 -- sub-ms calibration spin, measured harmless
+        """
+    )
+    rl1 = [f for f in findings if f.rule == "RL001"]
+    assert len(rl1) == 1 and rl1[0].suppressed
+    assert "calibration" in rl1[0].reason
+
+
+# -- RL002: threading lock held across await ----------------------------------
+
+
+def test_rl002_violating():
+    findings = _lint(
+        """
+        async def bad(self):
+            with self._lock:
+                await self.flush()
+        """
+    )
+    assert _ids(findings) == ["RL002"]
+
+
+def test_rl002_clean():
+    findings = _lint(
+        """
+        async def good(self):
+            with self._lock:
+                batch = list(self._buf)
+            await self.flush(batch)
+
+        async def also_good(self):
+            async with self._alock:
+                await self.flush()
+
+        def sync_ok(self):
+            with self._lock:
+                self.buf.append(1)
+        """
+    )
+    assert "RL002" not in _ids(findings)
+
+
+def test_rl002_pragma_suppressed():
+    findings = _lint(
+        """
+        async def justified(self):
+            with self._lock:  # raylint: disable=RL002 -- the awaited coro never touches lock-guarded state; split tracked in #42
+                await self.flush()
+        """
+    )
+    rl2 = [f for f in findings if f.rule == "RL002"]
+    assert len(rl2) == 1 and rl2[0].suppressed
+
+
+# -- RL003: fire-and-forget tasks ---------------------------------------------
+
+
+def test_rl003_violating():
+    findings = _lint(
+        """
+        import asyncio
+
+        def bad(self, loop):
+            asyncio.ensure_future(self._loop())
+            loop.create_task(self._other())
+            loop.call_soon(lambda: asyncio.ensure_future(self._third()))
+            fut.add_done_callback(lambda f: loop.create_task(self._cb(f)))
+        """
+    )
+    assert _ids(findings).count("RL003") == 4
+
+
+def test_rl003_clean():
+    findings = _lint(
+        """
+        import asyncio
+        from ray_tpu.util.tasks import spawn
+
+        def good(self):
+            spawn(self._loop(), name="loop")
+            self._task = asyncio.ensure_future(self._other())
+            t = asyncio.get_running_loop().create_task(self._third())
+            return t
+        """
+    )
+    assert "RL003" not in _ids(findings)
+
+
+def test_rl003_pragma_suppressed():
+    findings = _lint(
+        """
+        import asyncio
+
+        def justified(self):
+            asyncio.ensure_future(self._noop())  # raylint: disable=RL003 -- coroutine is await-free and cannot raise
+        """
+    )
+    rl3 = [f for f in findings if f.rule == "RL003"]
+    assert len(rl3) == 1 and rl3[0].suppressed
+
+
+# -- RL004: env-var hygiene ----------------------------------------------------
+
+
+def test_rl004_violating_fixture():
+    # Fixture mode resolves against an empty registry: any RAY_TPU_* read
+    # is unregistered.
+    findings = _lint(
+        """
+        import os
+
+        def bad():
+            a = os.environ.get("RAY_TPU_SECRET_KNOB")
+            b = os.environ["RAY_TPU_OTHER"]
+            c = os.getenv("RAY_TPU_THIRD")
+            return a, b, c
+        """
+    )
+    assert _ids(findings).count("RL004") == 3
+
+
+def test_rl004_clean_fixture():
+    findings = _lint(
+        """
+        import os
+
+        def good():
+            os.environ["RAY_TPU_WORKER_ID"] = "w1"   # write: bootstrap interface
+            return os.environ.get("PATH")            # non-RAY_TPU read
+        """
+    )
+    assert "RL004" not in _ids(findings)
+
+
+def test_rl004_pragma_suppressed():
+    findings = _lint(
+        """
+        import os
+
+        def justified():
+            return os.environ.get("RAY_TPU_LEGACY")  # raylint: disable=RL004 -- legacy migration shim, removed next round
+        """
+    )
+    rl4 = [f for f in findings if f.rule == "RL004"]
+    assert len(rl4) == 1 and rl4[0].suppressed
+
+
+def _mini_tree(tmp_path, protocol_src=None, config_src=None, readme=""):
+    pkg = tmp_path / "ray_tpu"
+    core = pkg / "core"
+    core.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (core / "__init__.py").write_text("")
+    (core / "config.py").write_text(
+        config_src
+        if config_src is not None
+        else textwrap.dedent(
+            """
+            class Config:
+                my_knob: int = 3
+
+            BOOTSTRAP_ENV_VARS = frozenset({"RAY_TPU_BOOT_VAR"})
+            """
+        )
+    )
+    (core / "protocol.py").write_text(
+        protocol_src
+        if protocol_src is not None
+        else "IDEMPOTENT_RPCS = frozenset()\n"
+    )
+    (tmp_path / "README.md").write_text(readme)
+    return tmp_path
+
+
+def test_rl004_cross_file_resolution(tmp_path):
+    root = _mini_tree(
+        tmp_path,
+        readme="`RAY_TPU_MY_KNOB` and `RAY_TPU_BOOT_VAR` documented.",
+    )
+    (root / "ray_tpu" / "user.py").write_text(
+        textwrap.dedent(
+            """
+            import os
+
+            knob = os.environ.get("RAY_TPU_MY_KNOB")     # must use config
+            boot = os.environ.get("RAY_TPU_BOOT_VAR")    # registered: ok
+            other = os.environ.get("RAY_TPU_MYSTERY")    # unregistered
+            """
+        )
+    )
+    findings = [f for f in lint_tree(str(root)) if f.rule == "RL004"]
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("GLOBAL_CONFIG.my_knob" in m for m in msgs)
+    assert any("RAY_TPU_MYSTERY" in m and "unregistered" in m for m in msgs)
+
+
+def test_rl004_readme_completeness(tmp_path):
+    root = _mini_tree(tmp_path, readme="only `RAY_TPU_BOOT_VAR` here")
+    findings = [f for f in lint_tree(str(root)) if f.rule == "RL004"]
+    assert len(findings) == 1
+    assert "RAY_TPU_MY_KNOB" in findings[0].message
+    assert "README" in findings[0].message
+
+
+# -- RL005: RPC-contract consistency ------------------------------------------
+
+
+def test_rl005_stale_entry_flagged(tmp_path):
+    root = _mini_tree(
+        tmp_path,
+        protocol_src=textwrap.dedent(
+            """
+            IDEMPOTENT_RPCS = frozenset({"gcs.ping", "gcs.gone_rpc"})
+            RPC_DEADLINE_EXEMPT = frozenset({"worker.push_task"})
+
+            async def _h_ping(self, conn, p):
+                return True
+            """
+        ),
+    )
+    (root / "ray_tpu" / "core" / "worker.py").write_text(
+        "async def _h_worker_push_task(self, conn, p):\n    return 1\n"
+    )
+    findings = [f for f in lint_tree(str(root)) if f.rule == "RL005"]
+    assert len(findings) == 1
+    assert "gcs.gone_rpc" in findings[0].message
+    assert "IDEMPOTENT_RPCS" in findings[0].message
+
+
+def test_rl005_clean_tree(tmp_path):
+    root = _mini_tree(
+        tmp_path,
+        protocol_src=textwrap.dedent(
+            """
+            IDEMPOTENT_RPCS = frozenset({"gcs.ping"})
+
+            async def _h_ping(self, conn, p):
+                return True
+            """
+        ),
+    )
+    assert [f for f in lint_tree(str(root)) if f.rule == "RL005"] == []
+
+
+# -- RL006: silent exception swallowing ---------------------------------------
+
+
+def test_rl006_violating():
+    findings = _lint(
+        """
+        def bad():
+            try:
+                work()
+            except Exception:
+                pass
+            try:
+                work()
+            except:
+                x = 1
+            try:
+                work()
+            except (ValueError, Exception):
+                return None
+        """
+    )
+    assert _ids(findings).count("RL006") == 3
+
+
+def test_rl006_clean():
+    findings = _lint(
+        """
+        import logging
+
+        def good():
+            try:
+                work()
+            except Exception:
+                logging.getLogger("x").exception("work failed")
+            try:
+                work()
+            except ValueError:
+                pass            # narrow: not a broad swallow
+            try:
+                work()
+            except Exception as e:
+                raise RuntimeError("wrapped") from e
+        """
+    )
+    assert "RL006" not in _ids(findings)
+
+
+def test_rl006_pragma_suppressed():
+    findings = _lint(
+        """
+        def justified():
+            try:
+                sock.close()
+            except Exception:  # raylint: disable=RL006 -- teardown: peer already gone
+                pass
+        """
+    )
+    rl6 = [f for f in findings if f.rule == "RL006"]
+    assert len(rl6) == 1 and rl6[0].suppressed
+    assert rl6[0].reason == "teardown: peer already gone"
+
+
+# -- pragma contract -----------------------------------------------------------
+
+
+def test_pragma_without_reason_is_rl000():
+    findings = _lint(
+        """
+        def bad():
+            try:
+                work()
+            except Exception:  # raylint: disable=RL006
+                pass
+        """
+    )
+    ids = _ids(findings)
+    assert "RL000" in ids
+    # The malformed pragma does NOT suppress the underlying finding.
+    rl6 = [f for f in findings if f.rule == "RL006"]
+    assert rl6 and not rl6[0].suppressed
+
+
+def test_pragma_unknown_rule_is_rl000():
+    findings = _lint(
+        """
+        x = 1  # raylint: disable=RL999 -- no such rule
+        """
+    )
+    assert _ids(findings) == ["RL000"]
+
+
+def test_pragma_on_comment_line_above():
+    findings = _lint(
+        """
+        def justified():
+            try:
+                work()
+            # raylint: disable=RL006 -- cleanup path, error is unactionable
+            except Exception:
+                pass
+        """
+    )
+    rl6 = [f for f in findings if f.rule == "RL006"]
+    assert len(rl6) == 1 and rl6[0].suppressed
+
+
+def test_pragma_multiple_ids():
+    findings = _lint(
+        """
+        import time
+
+        async def justified(self):
+            with self._lock: await noop(time.sleep(0))  # raylint: disable=RL001,RL002 -- measured sub-us critical section with a bounded sleep probe
+        """
+    )
+    assert all(f.suppressed for f in findings if f.rule != "RL000")
+    assert "RL000" not in _ids(findings)
+
+
+# -- whole-tree gate (the burn-down stays burned down) ------------------------
+
+
+def test_tree_has_zero_unsuppressed_findings():
+    findings = lint_tree(REPO_ROOT)
+    bad = [f for f in findings if not f.suppressed]
+    assert bad == [], "unsuppressed raylint findings:\n" + "\n".join(
+        f.format() for f in bad
+    )
+
+
+def test_tree_suppressions_all_carry_reasons():
+    findings = lint_tree(REPO_ROOT)
+    assert findings, "tree run produced no findings at all (rules broken?)"
+    for f in findings:
+        if f.suppressed:
+            assert f.reason.strip(), f"{f.path}:{f.line} reasonless pragma"
+
+
+def test_cli_json_contract():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "raylint.py"),
+         "--json"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["unsuppressed"] == 0
+    assert payload["total"] == payload["suppressed"]
+    assert {"rule", "path", "line", "message", "suppressed", "reason"} <= set(
+        payload["findings"][0]
+    )
+
+
+def test_cli_only_filter():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "raylint.py"),
+         "--json", "--only", "RL003"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert r.returncode == 0
+    payload = json.loads(r.stdout)
+    assert set(payload["by_rule"]) <= {"RL003", "RL000"}
+
+
+def test_summarize_counts():
+    fs = [
+        Finding("RL006", "a.py", 1, "x", suppressed=True, reason="r"),
+        Finding("RL003", "a.py", 2, "y"),
+    ]
+    s = summarize(fs)
+    assert s == {
+        "total": 2,
+        "suppressed": 1,
+        "unsuppressed": 1,
+        "by_rule": {"RL003": 1, "RL006": 1},
+    }
+
+
+def test_rule_ids_registered():
+    assert {"RL001", "RL002", "RL003", "RL004", "RL005", "RL006",
+            "RL000"} == set(RULE_IDS)

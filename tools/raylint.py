@@ -1,0 +1,859 @@
+"""raylint — AST-level concurrency & invariant lint for the ray_tpu runtime.
+
+The runtime carries load-bearing invariants that exist only by convention:
+a hybrid asyncio + ``threading.Lock`` concurrency model, RPC allowlists in
+``core/protocol.py``, env-var kill switches, and a long tail of broad
+``except Exception`` blocks. This tool machine-checks those properties the
+way ``tools/metrics_lint.py`` checks the series catalog — CI-enforced via
+``tests/test_raylint.py``, so every future PR holds them by construction.
+
+Rule families
+-------------
+RL001  blocking call inside ``async def`` (``time.sleep``, blocking
+       socket/subprocess/file I/O, zero-arg ``Future.result()``,
+       ``Lock.acquire()`` without a timeout) — one blocked event loop
+       stalls every collective behind it.
+RL002  ``threading.Lock``/``RLock`` held across an ``await`` (a sync
+       ``with ...lock:`` whose body awaits) — deadlock/race class in the
+       hybrid concurrency model.
+RL003  fire-and-forget task: ``asyncio.ensure_future``/``create_task``
+       whose result is discarded (bare expression statement). Use
+       ``ray_tpu.util.tasks.spawn`` — it strong-refs the task and logs
+       non-cancelled exceptions instead of dropping them at GC time.
+RL004  env-var hygiene: every ``RAY_TPU_*`` read outside
+       ``core/config.py`` must be a registered bootstrap var
+       (``config.BOOTSTRAP_ENV_VARS``); reads of config-knob env vars
+       must go through ``GLOBAL_CONFIG``; every knob and bootstrap var
+       must be documented in README.md.
+RL005  RPC-contract consistency: every method name in the
+       ``core/protocol.py`` allowlists (``IDEMPOTENT_RPCS``,
+       ``RPC_DEADLINE_EXEMPT`` and the deadline-class sets) must resolve
+       to a handler actually registered on an Endpoint (``_h_<meth>`` /
+       ``_h_<topic>_<meth>`` convention).
+RL006  silent exception swallowing: a bare/broad except whose body
+       neither raises nor calls anything (no logging, no cleanup call)
+       can eat exactly the typed errors the robustness tier surfaces.
+RL000  malformed suppression pragma (unknown rule id or missing reason).
+
+Suppression
+-----------
+``# raylint: disable=RL006 -- <reason>`` on the finding's line (or on a
+comment-only line directly above it). The reason string is REQUIRED —
+a pragma without one is itself a finding (RL000) and fails CI.
+
+Run::
+
+    python tools/raylint.py              # lint ray_tpu/, exit 1 on findings
+    python tools/raylint.py --json       # machine-readable findings + counts
+    python tools/raylint.py --only RL003,RL006
+    python tools/raylint.py --only metrics   # the metrics-catalog lint
+                                             # (tools/metrics_lint.py)
+
+Adding a rule: subclass ``Rule``, set ``ID``/``TITLE``, implement
+``check(ctx)`` (per-file) and/or ``finalize(tree_ctx)`` (whole-tree), and
+append it to ``ALL_RULES``. Add the three fixtures (violating / clean /
+pragma-suppressed) in tests/test_raylint.py and a row to the README table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Iterable, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRAGMA_RE = re.compile(
+    r"#\s*raylint:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s+--\s*(?P<reason>.*\S))?\s*$"
+)
+ENV_PREFIX = "RAY_TPU_"
+
+# Socket-module calls that actually block on the network. gethostname()
+# and friends are local libc lookups and deliberately NOT listed.
+_BLOCKING_SOCKET = {
+    "create_connection",
+    "getaddrinfo",
+    "gethostbyname",
+    "gethostbyname_ex",
+    "gethostbyaddr",
+    "getfqdn",
+}
+_BLOCKING_SUBPROCESS = {
+    "run",
+    "call",
+    "check_call",
+    "check_output",
+    "getoutput",
+    "getstatusoutput",
+    "Popen",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileCtx:
+    """One parsed source file: tree, parent links, pragma table."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._raylint_parent = node  # type: ignore[attr-defined]
+        # line -> (frozenset of rule ids, reason); malformed pragmas land
+        # in pragma_errors as RL000 findings.
+        self.pragmas: dict[int, tuple[frozenset, str]] = {}
+        self.pragma_errors: list[Finding] = []
+        self._collect_pragmas()
+
+    def _collect_pragmas(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            if "raylint" not in line:
+                continue
+            m = PRAGMA_RE.search(line)
+            if m is None:
+                if re.search(r"#\s*raylint\b", line):
+                    self.pragma_errors.append(
+                        Finding(
+                            "RL000",
+                            self.relpath,
+                            i,
+                            "unparseable raylint pragma (expected "
+                            "'# raylint: disable=RLxxx -- reason')",
+                        )
+                    )
+                continue
+            ids = frozenset(
+                t.strip() for t in m.group(1).split(",") if t.strip()
+            )
+            reason = (m.group("reason") or "").strip()
+            bad = [r for r in ids if r not in RULE_IDS]
+            if bad:
+                self.pragma_errors.append(
+                    Finding(
+                        "RL000",
+                        self.relpath,
+                        i,
+                        f"pragma names unknown rule id(s) {sorted(bad)}",
+                    )
+                )
+                continue
+            if not reason:
+                self.pragma_errors.append(
+                    Finding(
+                        "RL000",
+                        self.relpath,
+                        i,
+                        "pragma is missing the required reason string "
+                        "('# raylint: disable=RLxxx -- why this is safe')",
+                    )
+                )
+                continue
+            self.pragmas[i] = (ids, reason)
+
+    def suppression_for(self, rule: str, line: int) -> Optional[str]:
+        """Reason string if ``rule`` is suppressed at ``line``.
+
+        A pragma applies to findings on its own line, or — when it sits on
+        a comment-only line — to the first following non-comment line.
+        """
+        ent = self.pragmas.get(line)
+        if ent and rule in ent[0]:
+            return ent[1]
+        prev = line - 1
+        if prev >= 1 and prev in self.pragmas:
+            ids, reason = self.pragmas[prev]
+            if rule in ids and self.lines[prev - 1].lstrip().startswith("#"):
+                return reason
+        return None
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_raylint_parent", None)
+
+
+# -- rule engine --------------------------------------------------------------
+
+
+class Rule:
+    ID = "RL000"
+    TITLE = "base rule"
+
+    def check(self, ctx: FileCtx) -> list[Finding]:  # per-file
+        return []
+
+    def finalize(self, tree: "TreeCtx") -> list[Finding]:  # whole-tree
+        return []
+
+
+def _call_name(node: ast.Call) -> tuple[Optional[str], Optional[str]]:
+    """(base, attr) for ``base.attr(...)`` calls, (None, name) for bare."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        base = f.value.id if isinstance(f.value, ast.Name) else None
+        return base, f.attr
+    if isinstance(f, ast.Name):
+        return None, f.id
+    return None, None
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Walk a module, tracking whether the nearest enclosing function scope
+    is async. Nested sync defs/lambdas shadow the async scope (their bodies
+    run wherever they are called, not necessarily on the loop)."""
+
+    def __init__(self):
+        self.async_depth: list[bool] = []
+
+    @property
+    def in_async(self) -> bool:
+        return bool(self.async_depth) and self.async_depth[-1]
+
+    def visit_AsyncFunctionDef(self, node):
+        self.async_depth.append(True)
+        self.generic_visit(node)
+        self.async_depth.pop()
+
+    def visit_FunctionDef(self, node):
+        self.async_depth.append(False)
+        self.generic_visit(node)
+        self.async_depth.pop()
+
+    def visit_Lambda(self, node):
+        self.async_depth.append(False)
+        self.generic_visit(node)
+        self.async_depth.pop()
+
+
+class BlockingInAsync(Rule):
+    ID = "RL001"
+    TITLE = "blocking call inside async def"
+
+    def check(self, ctx: FileCtx) -> list[Finding]:
+        findings: list[Finding] = []
+        rule_id = self.ID
+        relpath = ctx.relpath
+
+        class V(_AsyncBodyVisitor):
+            def visit_Call(self, node):
+                if self.in_async:
+                    msg = self._blocking(node)
+                    if msg:
+                        findings.append(
+                            Finding(rule_id, relpath, node.lineno, msg)
+                        )
+                self.generic_visit(node)
+
+            @staticmethod
+            def _blocking(node: ast.Call) -> Optional[str]:
+                base, attr = _call_name(node)
+                if base == "time" and attr == "sleep":
+                    return (
+                        "time.sleep() blocks the event loop; "
+                        "use `await asyncio.sleep()`"
+                    )
+                if base == "subprocess" and attr in _BLOCKING_SUBPROCESS:
+                    return (
+                        f"subprocess.{attr}() blocks the event loop; use "
+                        "asyncio.create_subprocess_* or run_in_executor"
+                    )
+                if base == "os" and attr in ("system", "popen", "waitpid"):
+                    return f"os.{attr}() blocks the event loop"
+                if base == "socket" and attr in _BLOCKING_SOCKET:
+                    return (
+                        f"socket.{attr}() does blocking network I/O on "
+                        "the event loop"
+                    )
+                if base is None and attr == "open" and isinstance(
+                    node.func, ast.Name
+                ):
+                    return (
+                        "open() does blocking file I/O on the event loop; "
+                        "use run_in_executor for anything non-trivial"
+                    )
+                if (
+                    attr == "result"
+                    and isinstance(node.func, ast.Attribute)
+                    and not node.args
+                    and not node.keywords
+                ):
+                    if isinstance(parent(node), ast.Await):
+                        return None
+                    return (
+                        "zero-arg .result() can block the loop on an "
+                        "unfinished future; await it (or pragma if the "
+                        "future is provably done here)"
+                    )
+                if (
+                    attr == "acquire"
+                    and isinstance(node.func, ast.Attribute)
+                    and not node.args
+                    and not any(
+                        k.arg in ("timeout", "blocking")
+                        for k in node.keywords
+                    )
+                ):
+                    if isinstance(parent(node), ast.Await):
+                        return None  # asyncio.Lock.acquire()
+                    return (
+                        ".acquire() without a timeout can block the event "
+                        "loop indefinitely"
+                    )
+                return None
+
+        V().visit(ctx.tree)
+        return findings
+
+
+class LockAcrossAwait(Rule):
+    ID = "RL002"
+    TITLE = "threading lock held across await"
+
+    def check(self, ctx: FileCtx) -> list[Finding]:
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(
+                "lock" in _expr_tail(item.context_expr).lower()
+                for item in node.items
+            ):
+                continue
+            if _contains_await(node.body):
+                findings.append(
+                    Finding(
+                        self.ID,
+                        ctx.relpath,
+                        node.lineno,
+                        "sync `with ...lock:` body contains `await` — the "
+                        "thread lock is held across a suspension point "
+                        "(deadlock/race in the hybrid concurrency model); "
+                        "release before awaiting or use asyncio.Lock with "
+                        "`async with`",
+                    )
+                )
+        return findings
+
+
+def _expr_tail(e: ast.AST) -> str:
+    """Trailing name segment of a context expression (``self._lock`` ->
+    '_lock', ``lock.gen_rlock()`` -> 'gen_rlock')."""
+    if isinstance(e, ast.Call):
+        e = e.func
+    if isinstance(e, ast.Attribute):
+        return e.attr
+    if isinstance(e, ast.Name):
+        return e.id
+    return ""
+
+
+def _contains_await(body: list) -> bool:
+    """Await anywhere in the statements, not crossing into nested defs."""
+    stack = list(body)
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        if isinstance(n, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+class FireAndForgetTask(Rule):
+    ID = "RL003"
+    TITLE = "fire-and-forget task"
+
+    def check(self, ctx: FileCtx) -> list[Finding]:
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            _base, attr = _call_name(node)
+            if attr not in ("ensure_future", "create_task"):
+                continue
+            # Discarded as a bare statement, OR as a lambda body — a
+            # `call_soon(lambda: ensure_future(...))` / done-callback
+            # lambda returns the task to a caller that drops it.
+            if isinstance(parent(node), (ast.Expr, ast.Lambda)):
+                findings.append(
+                    Finding(
+                        self.ID,
+                        ctx.relpath,
+                        node.lineno,
+                        f"{attr}() result discarded — the task can be "
+                        "GC'd mid-flight and its exception is silently "
+                        "dropped; use ray_tpu.util.tasks.spawn (strong "
+                        "ref + logged done-callback)",
+                    )
+                )
+        return findings
+
+
+class EnvVarHygiene(Rule):
+    ID = "RL004"
+    TITLE = "RAY_TPU_* env-var hygiene"
+
+    CONFIG_RELPATH = os.path.join("ray_tpu", "core", "config.py")
+
+    def check(self, ctx: FileCtx) -> list[Finding]:
+        if ctx.relpath.replace(os.sep, "/").endswith("core/config.py"):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            key, line = _env_read(node)
+            if key is None or not key.startswith(ENV_PREFIX):
+                continue
+            findings.append(
+                Finding(self.ID, ctx.relpath, line, key)
+            )  # resolved in finalize against the config registry
+        return findings
+
+    def finalize(self, tree: "TreeCtx") -> list[Finding]:
+        knobs, bootstrap, knob_lines = tree.config_registry()
+        out = []
+        for f in tree.pending.pop(self.ID, []):
+            key = f.message
+            field = key[len(ENV_PREFIX):].lower()
+            if field in knobs:
+                f.message = (
+                    f"direct read of config-knob env var {key}; use "
+                    f"GLOBAL_CONFIG.{field} (env reads outside "
+                    "core/config.py bypass the cluster-synced config)"
+                )
+                out.append(f)
+            elif key in bootstrap:
+                continue
+            else:
+                f.message = (
+                    f"read of unregistered env var {key}: add it to "
+                    "core/config.py (a Config knob, or "
+                    "BOOTSTRAP_ENV_VARS for per-process bootstrap "
+                    "interfaces) and document it in README.md"
+                )
+                out.append(f)
+        # README completeness: every knob and bootstrap var is external
+        # interface and must be documented.
+        readme = tree.readme_text()
+        for field in sorted(knobs):
+            env = ENV_PREFIX + field.upper()
+            if env not in readme:
+                out.append(
+                    Finding(
+                        self.ID,
+                        self.CONFIG_RELPATH,
+                        knob_lines.get(field, 1),
+                        f"config knob {field} ({env}) is not documented "
+                        "in README.md",
+                    )
+                )
+        for env in sorted(bootstrap):
+            if env not in readme:
+                out.append(
+                    Finding(
+                        self.ID,
+                        self.CONFIG_RELPATH,
+                        knob_lines.get("__bootstrap__", 1),
+                        f"bootstrap env var {env} is not documented in "
+                        "README.md",
+                    )
+                )
+        return out
+
+
+def _env_read(node: ast.AST) -> tuple[Optional[str], int]:
+    """(key, line) when ``node`` reads an environment variable with a
+    constant key: os.environ.get/os.getenv/os.environ[...]."""
+    if isinstance(node, ast.Call):
+        base, attr = _call_name(node)
+        is_environ_get = (
+            attr == "get"
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "environ"
+        ) or (
+            attr == "get"
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "environ"
+        )
+        is_getenv = attr == "getenv" and (base in ("os", None))
+        if (is_environ_get or is_getenv) and node.args:
+            k = node.args[0]
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                return k.value, node.lineno
+    if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        v = node.value
+        if (
+            isinstance(v, ast.Attribute)
+            and v.attr == "environ"
+            or isinstance(v, ast.Name)
+            and v.id == "environ"
+        ):
+            k = node.slice
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                return k.value, node.lineno
+    return None, 0
+
+
+class RpcContract(Rule):
+    ID = "RL005"
+    TITLE = "RPC allowlist entries resolve to registered handlers"
+
+    ALLOWLISTS = (
+        "IDEMPOTENT_RPCS",
+        "RPC_DEADLINE_EXEMPT",
+        "_HEARTBEAT_RPCS",
+        "_DATA_PLANE_RPCS",
+        "_SLOW_RPCS",
+    )
+
+    def finalize(self, tree: "TreeCtx") -> list[Finding]:
+        protocol = tree.file("ray_tpu/core/protocol.py")
+        if protocol is None:
+            return []
+        handlers = tree.handler_names()
+        findings = []
+        for node in ast.walk(protocol.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in self.ALLOWLISTS
+            ):
+                continue
+            listname = node.targets[0].id
+            for c in ast.walk(node.value):
+                if not (
+                    isinstance(c, ast.Constant) and isinstance(c.value, str)
+                ):
+                    continue
+                entry = c.value
+                topic, dot, meth = entry.partition(".")
+                resolved = dot and (
+                    f"_h_{meth}" in handlers
+                    or f"_h_{topic}_{meth}" in handlers
+                )
+                if not resolved:
+                    findings.append(
+                        Finding(
+                            self.ID,
+                            protocol.relpath,
+                            c.lineno,
+                            f"{listname} entry {entry!r} does not resolve "
+                            "to any registered handler (_h_"
+                            f"{meth or entry} / _h_{topic}_{meth}): stale "
+                            "entry or renamed handler",
+                        )
+                    )
+        return findings
+
+
+class SilentExcept(Rule):
+    ID = "RL006"
+    TITLE = "silently swallowed broad exception"
+
+    def check(self, ctx: FileCtx) -> list[Finding]:
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            if _handler_acts(node.body):
+                continue
+            what = (
+                "bare `except:`" if node.type is None
+                else f"`except {ast.unparse(node.type)}`"
+            )
+            findings.append(
+                Finding(
+                    self.ID,
+                    ctx.relpath,
+                    node.lineno,
+                    f"{what} swallows the error with no logging, "
+                    "re-raise, or handling call — this can eat the typed "
+                    "errors the robustness tier works to surface; log it, "
+                    "narrow it, or pragma-justify it",
+                )
+            )
+        return findings
+
+
+def _is_broad(t: Optional[ast.AST]) -> bool:
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _handler_acts(body: list) -> bool:
+    """True when the handler body raises or calls anything — logging, a
+    metrics bump, cleanup. A body of pass/continue/assignments is silent."""
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Raise, ast.Call)):
+                return True
+    return False
+
+
+ALL_RULES: list[Rule] = [
+    BlockingInAsync(),
+    LockAcrossAwait(),
+    FireAndForgetTask(),
+    EnvVarHygiene(),
+    RpcContract(),
+    SilentExcept(),
+]
+RULE_IDS = frozenset(r.ID for r in ALL_RULES) | {"RL000"}
+
+
+# -- tree driver --------------------------------------------------------------
+
+
+class TreeCtx:
+    """Whole-tree context: parsed files + cross-file registries."""
+
+    def __init__(self, repo_root: str, scan_root: Optional[str] = None):
+        self.repo_root = repo_root
+        self.scan_root = scan_root or os.path.join(repo_root, "ray_tpu")
+        self.files: dict[str, FileCtx] = {}
+        # rule id -> findings parked by check() for finalize() resolution
+        self.pending: dict[str, list[Finding]] = {}
+        self._load()
+
+    def _load(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.scan_root):
+            dirnames[:] = [
+                d for d in dirnames if d != "__pycache__"
+            ]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.repo_root).replace(
+                    os.sep, "/"
+                )
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                self.files[rel] = FileCtx(path, rel, src)
+
+    def file(self, relpath: str) -> Optional[FileCtx]:
+        return self.files.get(relpath)
+
+    def handler_names(self) -> frozenset:
+        out = set()
+        for ctx in self.files.values():
+            for n in ast.walk(ctx.tree):
+                if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and n.name.startswith("_h_"):
+                    out.add(n.name)
+        return frozenset(out)
+
+    def config_registry(self) -> tuple[set, set, dict]:
+        """(knob field names, bootstrap env var names, field->line) parsed
+        statically from core/config.py — raylint never imports the tree."""
+        knobs: set[str] = set()
+        bootstrap: set[str] = set()
+        lines: dict[str, int] = {}
+        cfg = self.file("ray_tpu/core/config.py")
+        if cfg is None:
+            return knobs, bootstrap, lines
+        for node in ast.walk(cfg.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Config":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name
+                    ):
+                        knobs.add(stmt.target.id)
+                        lines[stmt.target.id] = stmt.lineno
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "BOOTSTRAP_ENV_VARS"
+            ):
+                lines["__bootstrap__"] = node.lineno
+                for c in ast.walk(node.value):
+                    if isinstance(c, ast.Constant) and isinstance(
+                        c.value, str
+                    ):
+                        bootstrap.add(c.value)
+        return knobs, bootstrap, lines
+
+    def readme_text(self) -> str:
+        path = os.path.join(self.repo_root, "README.md")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+
+def _apply_suppressions(
+    findings: list[Finding], files: dict[str, FileCtx]
+) -> None:
+    for f in findings:
+        ctx = files.get(f.path)
+        if ctx is None:
+            continue
+        reason = ctx.suppression_for(f.rule, f.line)
+        if reason is not None:
+            f.suppressed = True
+            f.reason = reason
+
+
+def lint_tree(
+    repo_root: str = REPO_ROOT,
+    scan_root: Optional[str] = None,
+    only: Optional[set] = None,
+) -> list[Finding]:
+    """Run the rule engine over the tree; returns ALL findings (callers
+    filter on ``.suppressed``)."""
+    tree = TreeCtx(repo_root, scan_root)
+    rules = [r for r in ALL_RULES if only is None or r.ID in only]
+    findings: list[Finding] = []
+    for ctx in tree.files.values():
+        findings.extend(ctx.pragma_errors)
+        for rule in rules:
+            got = rule.check(ctx)
+            if isinstance(rule, EnvVarHygiene):
+                tree.pending.setdefault(rule.ID, []).extend(got)
+            else:
+                findings.extend(got)
+    for rule in rules:
+        findings.extend(rule.finalize(tree))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    _apply_suppressions(findings, tree.files)
+    return findings
+
+
+def lint_text(
+    source: str, relpath: str = "fixture.py", only: Optional[set] = None
+) -> list[Finding]:
+    """Lint a source snippet with the per-file rules (fixture test hook).
+    Cross-file resolution (RL004 registry, RL005 handlers) needs
+    ``lint_tree`` over a real tree."""
+    ctx = FileCtx("<fixture>", relpath, source)
+    rules = [r for r in ALL_RULES if only is None or r.ID in only]
+    findings = list(ctx.pragma_errors)
+    for rule in rules:
+        got = rule.check(ctx)
+        if isinstance(rule, EnvVarHygiene):
+            # Fixture mode: resolve against an empty registry — every
+            # RAY_TPU_* read is "unregistered".
+            for f in got:
+                f.message = f"read of unregistered env var {f.message}"
+            findings.extend(got)
+        else:
+            findings.extend(got)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    _apply_suppressions(findings, {relpath: ctx})
+    return findings
+
+
+def summarize(findings: Iterable[Finding]) -> dict:
+    fs = list(findings)
+    return {
+        "total": len(fs),
+        "suppressed": sum(1 for f in fs if f.suppressed),
+        "unsuppressed": sum(1 for f in fs if not f.suppressed),
+        "by_rule": {
+            rid: sum(1 for f in fs if f.rule == rid)
+            for rid in sorted({f.rule for f in fs})
+        },
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="raylint", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    ap.add_argument(
+        "--only",
+        default=None,
+        help="comma-separated rule ids (e.g. RL003,RL006), or 'metrics' "
+        "to run the metrics-catalog lint (tools/metrics_lint.py)",
+    )
+    ap.add_argument(
+        "--root",
+        default=REPO_ROOT,
+        help="repository root (default: the checkout containing this file)",
+    )
+    ap.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print suppressed findings",
+    )
+    args = ap.parse_args(argv)
+
+    if args.only and args.only.strip().lower() == "metrics":
+        # One lint entry point: delegate to the metrics-catalog lint
+        # (imports the instrumented layers, so it runs only on demand).
+        sys.path.insert(0, args.root)
+        from tools import metrics_lint
+
+        return metrics_lint.main()
+
+    only = None
+    if args.only:
+        only = {t.strip() for t in args.only.split(",") if t.strip()}
+        unknown = only - RULE_IDS
+        if unknown:
+            ap.error(f"unknown rule id(s): {sorted(unknown)}")
+
+    findings = lint_tree(repo_root=args.root, only=only)
+    counts = summarize(findings)
+    if args.json:
+        print(
+            json.dumps(
+                {**counts, "findings": [f.to_json() for f in findings]}
+            )
+        )
+    else:
+        for f in findings:
+            if f.suppressed and not args.show_suppressed:
+                continue
+            print(f.format())
+        print(
+            f"raylint: {counts['unsuppressed']} unsuppressed, "
+            f"{counts['suppressed']} suppressed finding(s)"
+        )
+    return 1 if counts["unsuppressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
