@@ -33,7 +33,14 @@ import time
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 100_000.0
 METRIC = "gpt2_125m_train_tokens_per_sec_per_chip"
 PROBE_TIMEOUT_S = 75
-BENCH_TIMEOUT_S = 1500
+# Hard cap on TOTAL probe wall-clock (attempts + spacing sleeps). The
+# pre-round-13 loop could burn 6x(75s timeout + 300s spacing) ≈ 37 min on a
+# fully wedged tunnel — past the whole round's timeout, so the round died
+# rc=124 with NO record (BENCH_r02-r05). The budget must stay well inside
+# the round timeout; on exhaustion the partial probe telemetry is emitted
+# in a persisted skip record.
+PROBE_BUDGET_S = float(os.environ.get("RAY_TPU_BENCH_PROBE_BUDGET_S", "480"))
+BENCH_TIMEOUT_S = float(os.environ.get("RAY_TPU_BENCH_TIMEOUT_S", "1500"))
 
 
 def _log(msg: str) -> None:
@@ -65,6 +72,7 @@ def run_bench() -> dict:
         shardings_from_logical,
     )
     from ray_tpu.train.spmd import (
+        compile_train_step,
         default_optimizer,
         make_train_state,
         make_train_step,
@@ -124,9 +132,20 @@ def run_bench() -> dict:
             jax.random.key(1), (B, seq), 0, cfg.vocab_size
         )
         batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+        # AOT: trace + XLA-compile during setup so neither ever lands in
+        # the measured window (warmup still absorbs autotuning/transfer),
+        # and the executable's own cost model gives a device-verified
+        # flops/step to cross-check tok/s against.
+        t0 = time.perf_counter()
+        compiled, step_flops = compile_train_step(step, state, batch)
+        _log(
+            f"AOT compile (B={B}, chunk={cfg.loss_chunk}) in "
+            f"{time.perf_counter() - t0:.1f}s"
+            + (f", {step_flops:.3e} flops/step" if step_flops else "")
+        )
         t0 = time.perf_counter()
         for _ in range(warmup):
-            state, metrics = step(state, batch)
+            state, metrics = compiled(state, batch)
         # float() forces a device->host transfer: the only reliable sync
         # on tunneled backends (block_until_ready can return early).
         loss_val = float(metrics["loss"])
@@ -134,9 +153,11 @@ def run_bench() -> dict:
             f"warmup done (B={B}, chunk={cfg.loss_chunk}) in "
             f"{time.perf_counter() - t0:.1f}s, loss={loss_val:.4f}"
         )
+        # The timed loop is host-free by construction: N async dispatches,
+        # one sync at the end — the host never sits between steps.
         t0 = time.perf_counter()
         for _ in range(iters):
-            state, metrics = step(state, batch)
+            state, metrics = compiled(state, batch)
         float(metrics["loss"])
         dt = time.perf_counter() - t0
         per_chip = B * seq * iters / dt / n_dev
@@ -144,20 +165,32 @@ def run_bench() -> dict:
             f"B={B} seq={seq} chunk={cfg.loss_chunk}: "
             f"{per_chip:,.0f} tok/s/chip ({dt / iters * 1e3:.1f} ms/step)"
         )
-        return per_chip
+        if step_flops:
+            # Device-verified cross-check: achieved FLOP/s from the
+            # executable's own cost model vs the token-count arithmetic.
+            tflops = step_flops * iters / dt / 1e12 / n_dev
+            _log(
+                f"  cost-model cross-check: {step_flops / (B * seq):,.0f} "
+                f"flops/token -> {tflops:.2f} TFLOP/s/chip at the measured "
+                f"step time"
+            )
+        return per_chip, step_flops
 
     # Measure the first TWO viable candidates and report the better one
     # (the preference order is from the sweep, but tunnels/toolchain drift;
     # one extra ~60 s measurement buys a verified choice). OOM backs off
     # to the next candidate; other errors surface immediately.
     best = 0.0
+    best_flops = None
     measured = 0
     last_err = None
     for per_chip_batch, cfg in candidates:
         if measured >= 2:
             break
         try:
-            best = max(best, measure_one(per_chip_batch, cfg))
+            per_chip, step_flops = measure_one(per_chip_batch, cfg)
+            if per_chip > best:
+                best, best_flops = per_chip, step_flops
             measured += 1
         except Exception as e:
             msg = f"{type(e).__name__}: {e}"
@@ -180,12 +213,15 @@ def run_bench() -> dict:
             _log(f"candidate B={per_chip_batch} OOM; backing off")
     if best == 0.0:
         raise RuntimeError(f"all candidates failed; last error: {last_err}")
-    return {
+    record = {
         "metric": METRIC,
         "value": round(best, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(best / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4),
     }
+    if best_flops:
+        record["step_flops"] = best_flops
+    return record
 
 
 def _probe_backend() -> tuple:
@@ -194,20 +230,23 @@ def _probe_backend() -> tuple:
     error).
 
     The tunnel wedges in windows: one dead probe does not mean a dead round.
-    So the probe runs up to RAY_TPU_BENCH_PROBE_ROUNDS rounds (default 6 —
-    rounds 2-4 skipped on a 15-minute window that kept coming up dry, so
-    round 5 doubled it per the verdict), spaced
-    RAY_TPU_BENCH_PROBE_SPACING_S apart (default 300 s), and only writes
-    the skip record after the whole ~30-minute window comes up dry.
+    So the probe runs up to RAY_TPU_BENCH_PROBE_ROUNDS rounds (default 6),
+    spaced RAY_TPU_BENCH_PROBE_SPACING_S apart (default 300 s) — but the
+    TOTAL wall-clock (attempts AND sleeps) is hard-capped by
+    RAY_TPU_BENCH_PROBE_BUDGET_S (default 480 s): per-attempt timeouts are
+    clamped to the remaining budget, a sleep never outlives it, and on
+    exhaustion the loop exits with whatever telemetry it gathered. A fully
+    wedged tunnel therefore costs ~the budget, never the whole round
+    (BENCH_r02-r05 died rc=124 to the old uncapped 6x(75+300)s window).
 
     Returns ``(outcome, probe_record)``. Outcome is "ok", "wedged" (every
     round hung — environmental, skip cleanly) or "broken" (fast nonzero
     exits — a jax/plugin/install regression that must fail the gate, not
     silently skip). The probe record carries per-attempt telemetry
-    (return code or "timeout", stderr tail) and is persisted into the
-    emitted BENCH record EVEN on skip rounds, so a wedged round is
-    diagnosable from the BENCH_r* file afterwards instead of lost with the
-    CI logs."""
+    (return code or "timeout", stderr tail, the budget verdict) and is
+    persisted into the emitted BENCH record EVEN on skip rounds, so a
+    wedged round is diagnosable from the BENCH_r* file afterwards instead
+    of lost with the CI logs."""
     code = (
         "import os, jax\n"
         "if os.environ.get('JAX_PLATFORMS'):\n"
@@ -216,14 +255,24 @@ def _probe_backend() -> tuple:
     )
     rounds = max(1, int(os.environ.get("RAY_TPU_BENCH_PROBE_ROUNDS", "6")))
     spacing = float(os.environ.get("RAY_TPU_BENCH_PROBE_SPACING_S", "300"))
+    budget = PROBE_BUDGET_S
     last_outcome = "broken"
+    budget_exhausted = False
     attempts = []  # per-attempt telemetry, persisted into the BENCH record
     t_start = time.monotonic()
     for attempt in range(1, rounds + 1):
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining <= 1.0:
+            budget_exhausted = True
+            _log(
+                f"probe budget ({budget:.0f}s) exhausted before attempt "
+                f"{attempt}; emitting partial probe record"
+            )
+            break
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
-                timeout=PROBE_TIMEOUT_S,
+                timeout=max(5.0, min(PROBE_TIMEOUT_S, remaining)),
                 capture_output=True,
                 text=True,
             )
@@ -252,21 +301,35 @@ def _probe_backend() -> tuple:
                 tail = "\n".join(err.strip().splitlines()[-3:])[-400:]
             attempts.append(
                 {"rc": "timeout", "stderr_tail": tail,
-                 "timeout_s": PROBE_TIMEOUT_S}
+                 "timeout_s": round(float(e.timeout), 1)}
             )
             last_outcome = "wedged"
             delay = spacing
             _log(
                 f"backend probe attempt {attempt}/{rounds} timed out after "
-                f"{PROBE_TIMEOUT_S}s (tunnel wedged?)"
+                f"{e.timeout:.0f}s (tunnel wedged?)"
             )
         if last_outcome != "ok" and attempt < rounds:
+            remaining = budget - (time.monotonic() - t_start)
+            # Sleeping only pays if another attempt can still fit after
+            # it; otherwise break NOW — sleeping out the tail of the
+            # budget would burn minutes of CI wall-clock for nothing.
+            if remaining <= delay + 5.0:
+                budget_exhausted = True
+                _log(
+                    f"probe budget ({budget:.0f}s) leaves no room for "
+                    f"another attempt after #{attempt}; emitting partial "
+                    f"probe record"
+                )
+                break
             _log(f"waiting {delay:.0f}s before probe attempt {attempt + 1}")
             time.sleep(delay)
     probe_record = {
         "outcome": last_outcome,
         "attempts": len(attempts),
         "window_s": round(time.monotonic() - t_start, 1),
+        "budget_s": budget,
+        "budget_exhausted": budget_exhausted,
         "results": attempts,
     }
     return last_outcome, probe_record
@@ -365,6 +428,66 @@ def _serve_llm_rows() -> dict:
     return out
 
 
+def _train_overlap_rows() -> dict:
+    """Host-free train-step A/B (round-13): steps/s + host-blocked ms per
+    step with async dispatch + device prefetch ON vs the kill-switch arm
+    (``--no-async-dispatch``), via ``tools/ray_perf.py --quick
+    --train-only``. CPU-only (pure-jax single-process loop — a wedged TPU
+    tunnel can't block it) and best-effort: any failure returns {} so the
+    headline one-JSON-line contract stands."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    out = {}
+    for arm, flags in (("on", ()), ("off", ("--no-async-dispatch",))):
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(repo, "tools", "ray_perf.py"),
+                    "--quick",
+                    "--train-only",
+                    *flags,
+                ],
+                timeout=420,
+                capture_output=True,
+                text=True,
+                env=env,
+                cwd=repo,
+            )
+            if r.returncode != 0:
+                _log(
+                    f"train_overlap arm {arm} failed rc={r.returncode}; "
+                    f"skipping"
+                )
+                return {}
+            for line in reversed(r.stdout.strip().splitlines()):
+                try:
+                    out[arm] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            if arm not in out:
+                # rc=0 but no parsable summary line: all-or-nothing — a
+                # one-armed record would break round-over-round diffs.
+                _log(f"train_overlap arm {arm} produced no JSON; skipping")
+                return {}
+        except Exception as e:  # noqa: BLE001 — never fail the headline
+            _log(f"train_overlap rows skipped: {type(e).__name__}: {e}")
+            return {}
+    if "on" in out and "off" in out:
+        on_b = out["on"].get("train_step_host_blocked_ms", 0)
+        off_b = out["off"].get("train_step_host_blocked_ms", 0)
+        if on_b:
+            out["host_blocked_off_on_ratio"] = round(off_b / on_b, 3)
+        on_s = out["on"].get("train_step_overlap", 0)
+        off_s = out["off"].get("train_step_overlap", 0)
+        if off_s:
+            out["steps_per_s_ratio"] = round(on_s / off_s, 3)
+    return out
+
+
 def _raylint_rows() -> dict:
     """Static-analysis debt counts via ``tools/raylint.py --json`` (total /
     suppressed / unsuppressed + per-rule) so lint debt is tracked per round
@@ -402,6 +525,7 @@ def _emit(
     probe: dict | None = None,
     serve_llm: dict | None = None,
     raylint: dict | None = None,
+    train_overlap: dict | None = None,
 ) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
@@ -410,6 +534,10 @@ def _emit(
         # the serving number (tok/s + p99 TTFT, routing ON vs OFF) from
         # round 12 on, TPU availability notwithstanding.
         record = {**record, "serve_llm": serve_llm}
+    if train_overlap:
+        # Train-overlap A/B (async dispatch + prefetch ON vs kill switch)
+        # rides every record like data_plane/serve_llm from round 13 on.
+        record = {**record, "train_overlap": train_overlap}
     if raylint:
         # Lint-debt counts ride every record (tracked like perf: the
         # suppressed count is the justified-debt baseline; unsuppressed
@@ -428,26 +556,35 @@ def main() -> None:
         print(json.dumps(run_bench()), flush=True)
         return
 
-    # Data-plane + serving rows first: CPU-only, so they report even when
-    # the TPU tunnel is wedged (BENCH_r* keeps tracking both planes).
+    # Data-plane + serving + train-overlap rows first: CPU-only, so they
+    # report even when the TPU tunnel is wedged (BENCH_r* keeps tracking
+    # every plane).
     data_plane = _data_plane_rows()
     serve_llm = _serve_llm_rows()
+    train_overlap = _train_overlap_rows()
     raylint = _raylint_rows()
 
-    probe, probe_record = _probe_backend()
-    if probe == "wedged":
+    probe_record: dict | None = None
+
+    def emit(record: dict) -> None:
         _emit(
-            _skip("tpu-unavailable"), data_plane, probe_record, serve_llm,
-            raylint,
+            record, data_plane, probe_record, serve_llm, raylint,
+            train_overlap,
         )
+
+    try:
+        probe, probe_record = _probe_backend()
+    except Exception as e:  # noqa: BLE001 — a record must persist regardless
+        _log(f"backend probe crashed: {type(e).__name__}: {e}")
+        emit(_skip("probe-crashed"))
+        sys.exit(1)
+    if probe == "wedged":
+        emit(_skip("tpu-unavailable"))
         return
     if probe == "broken":
         # Fast nonzero exits mean jax/the plugin is broken, not that the
         # tunnel is down — a real regression must go red, not skip.
-        _emit(
-            _skip("backend-probe-failed"), data_plane, probe_record, serve_llm,
-            raylint,
-        )
+        emit(_skip("backend-probe-failed"))
         sys.exit(1)
 
     try:
@@ -461,36 +598,24 @@ def main() -> None:
         )
     except subprocess.TimeoutExpired:
         _log(f"bench subprocess exceeded {BENCH_TIMEOUT_S}s; tunnel wedge?")
-        _emit(
-            _skip("tpu-unavailable"), data_plane, probe_record, serve_llm,
-            raylint,
-        )
+        emit(_skip("tpu-unavailable"))
         return
     if r.returncode != 0:
         # The backend was alive (probe passed), so a failing measurement is a
         # real bug: emit the marker for machine readability but FAIL the gate.
         _log(f"bench subprocess failed rc={r.returncode}")
-        _emit(
-            _skip(f"bench-failed-rc{r.returncode}"),
-            data_plane,
-            probe_record,
-            serve_llm,
-            raylint,
-        )
+        emit(_skip(f"bench-failed-rc{r.returncode}"))
         sys.exit(1)
     # Forward the subprocess's final JSON line as our one-line contract.
     for line in reversed(r.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                _emit(
-                    json.loads(line), data_plane, probe_record, serve_llm,
-                    raylint,
-                )
+                emit(json.loads(line))
             except json.JSONDecodeError:
                 print(line, flush=True)
             return
-    _emit(_skip("no-output"), data_plane, probe_record, serve_llm, raylint)
+    emit(_skip("no-output"))
 
 
 if __name__ == "__main__":

@@ -235,6 +235,25 @@ class Config:
     # Default train/tune results root (RAY_TPU_STORAGE_PATH): used when
     # RunConfig.storage_path is not given. Empty = ~/ray_tpu_results.
     storage_path: str = ""
+    # Host-free train steps (the BENCH 0.677x->1.0x tier). With async
+    # dispatch on, TrainContext.report() of a DEVICE-RESIDENT metrics
+    # pytree enqueues it into a bounded ring instead of forcing a
+    # device->host readback: up to train_async_dispatch_depth steps of
+    # dispatch stay in flight ahead of execution, and the host only blocks
+    # when a ring slot is evicted or at checkpoint/flush boundaries — so
+    # raytpu_train_step_seconds measures device time, not host stalls.
+    # RAY_TPU_TRAIN_ASYNC_DISPATCH=0 is the kill switch back to the
+    # synchronous loop (readback inside every report(); the A/B arm of
+    # tools/ray_perf.py --no-async-dispatch). Metrics surface at most
+    # `depth` steps late; checkpoints flush the ring first, so restore
+    # points never race in-flight steps.
+    train_async_dispatch: bool = True
+    train_async_dispatch_depth: int = 4
+    # Double-buffered train input: dataset/iterator batches are staged on
+    # device with jax.device_put (under the step's sharding) this many
+    # batches ahead of the consuming step, off the timed path. 0 = hand
+    # host batches straight through (no staging thread).
+    train_prefetch_depth: int = 2
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

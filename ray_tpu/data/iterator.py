@@ -45,13 +45,40 @@ def iter_batches_from_blocks(
 
 
 class DataIterator:
-    """One consumer's view of a (sharded) dataset."""
+    """One consumer's view of a (sharded) dataset.
 
-    def __init__(self, dataset):
+    ``prefetch_depth`` (set by the trainer from ``DataConfig``) is the
+    default device-staging depth for :meth:`iter_device_batches`."""
+
+    def __init__(self, dataset, prefetch_depth: Optional[int] = None):
         self._dataset = dataset
+        self._prefetch_depth = prefetch_depth
 
     def iter_batches(self, **kwargs) -> Iterator:
         return self._dataset.iter_batches(**kwargs)
+
+    def iter_device_batches(
+        self,
+        *,
+        sharding=None,
+        prefetch_depth: Optional[int] = None,
+        **kwargs,
+    ) -> Iterator:
+        """iter_batches, but each batch is staged on device (``jax.
+        device_put`` under ``sharding`` — pass the step's NamedSharding)
+        ahead of consumption, so ``data → train`` feeds a jitted step with
+        no host staging in the timed region. ``prefetch_depth`` overrides
+        the trainer's ``DataConfig`` value (else the
+        ``train_prefetch_depth`` config default); 0 = host passthrough."""
+        from ray_tpu.train.input import DevicePrefetchIterator
+
+        if prefetch_depth is None:
+            prefetch_depth = self._prefetch_depth
+        return DevicePrefetchIterator(
+            self.iter_batches(**kwargs),
+            sharding=sharding,
+            depth=prefetch_depth,
+        )
 
     def iter_rows(self) -> Iterator[dict]:
         return self._dataset.iter_rows()

@@ -130,12 +130,25 @@ class Learner:
 
     # -- update -------------------------------------------------------------
     def _allreduce_grads(self, grads):
-        """Mean the gradient across the learner group as ONE flat vector."""
+        """Mean the gradient across the learner group as ONE flat vector.
+
+        XLA (and hierarchical-over-XLA) groups take the device path: the
+        flat gradient goes into the collective as the jax array it already
+        is and comes back device-resident, straight into the jitted
+        apply — no device->np.asarray->device bounce per SGD step. Only
+        CPU groups (whose data plane is the coordinator actor, host
+        arrays by construction) stage through numpy."""
         from ray_tpu.util import collective as col
 
         flat, unravel = jax.flatten_util.ravel_pytree(grads)
-        reduced = col.allreduce(np.asarray(flat), self._group_name)
-        return unravel(jnp.asarray(reduced) / self._world_size)
+        comm = col.get_group(self._group_name)
+        if comm is not None and comm.backend.startswith("xla"):
+            reduced = comm.allreduce(flat)
+        else:
+            reduced = jnp.asarray(
+                col.allreduce(np.asarray(flat), self._group_name)
+            )
+        return unravel(reduced / self._world_size)
 
     def update(self, batch: SampleBatch) -> dict:
         """SGD epochs over shuffled equal-size minibatches. Returns the

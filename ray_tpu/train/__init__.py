@@ -12,6 +12,7 @@ DataParallelTrainer, JaxTrainer, Result).
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import (
     CheckpointConfig,
+    DataConfig,
     FailureConfig,
     RunConfig,
     ScalingConfig,
@@ -22,6 +23,7 @@ from ray_tpu.train.context import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.input import DevicePrefetchIterator
 from ray_tpu.train.sharded_checkpoint import (
     load_sharded_state,
     restore_sharded,
@@ -30,6 +32,7 @@ from ray_tpu.train.sharded_checkpoint import (
 )
 from ray_tpu.train.spmd import (
     TrainState,
+    compile_train_step,
     make_train_state,
     make_train_step,
     state_shardings,
@@ -38,10 +41,13 @@ from ray_tpu.train.spmd import (
 __all__ = [
     "Checkpoint",
     "CheckpointConfig",
+    "DataConfig",
+    "DevicePrefetchIterator",
     "FailureConfig",
     "RunConfig",
     "ScalingConfig",
     "TrainState",
+    "compile_train_step",
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",

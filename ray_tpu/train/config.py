@@ -39,6 +39,18 @@ class ScalingConfig:
 
 
 @dataclasses.dataclass
+class DataConfig:
+    """How ``datasets=`` shards feed the train loop.
+
+    prefetch_depth: batches staged on device ahead of the consuming step
+    (``DataIterator.iter_device_batches`` / ``DevicePrefetchIterator``).
+    None = the ``train_prefetch_depth`` config knob; 0 = host handoff
+    (no staging thread)."""
+
+    prefetch_depth: Optional[int] = None
+
+
+@dataclasses.dataclass
 class FailureConfig:
     """max_failures: worker-group rebuilds before giving up (-1 = unlimited).
     Reference: train/v2/_internal/execution/failure_handling/."""

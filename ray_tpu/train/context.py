@@ -8,6 +8,7 @@ TrainWorker before the user's train loop runs on its thread.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time as _time
 from dataclasses import dataclass, field
@@ -20,6 +21,8 @@ from ray_tpu.util import metrics as _metrics
 # Step-time telemetry: train loops call report() once per step (reference
 # convention), so the gap between consecutive report() calls on one worker
 # IS the step time — data loading, compute, and collectives included.
+# With async dispatch (device-resident metrics + the pipelined ring) the
+# gap is dispatch-bounded, i.e. device time, not host readback stalls.
 # Counters/histograms sum across ranks at merge time.
 _STEP_SECONDS = _metrics.Histogram(
     "raytpu_train_step_seconds",
@@ -31,8 +34,57 @@ _REPORTS = _metrics.Counter(
     "raytpu_train_reports_total",
     "train.report() calls (steps) across all workers",
 )
+# Host-overlap telemetry (the BENCH train tier): how long report() spends
+# BLOCKED on device->host metric readback per materialization — the number
+# async dispatch exists to take off the step path — and how many
+# device-resident reports are in flight in the ring right now.
+_HOST_BLOCKED = _metrics.Histogram(
+    "raytpu_train_host_blocked_seconds",
+    "time train.report() blocks on device->host metric readback",
+    boundaries=[0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
+                5.0, 30.0],
+)
+_DISPATCH_DEPTH = _metrics.Gauge(
+    "raytpu_train_dispatch_depth",
+    "device-resident metric reports currently in flight (async ring)",
+)
 
 _ctx_local = threading.local()
+
+
+def _has_device_leaves(metrics: Any) -> bool:
+    """True when any metrics leaf is a jax array (device-resident).
+
+    Consults sys.modules instead of importing jax: a host-metrics train
+    loop (plain floats) must not pay a jax import inside report()."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        return any(
+            isinstance(leaf, jax.Array) for leaf in jax.tree.leaves(metrics)
+        )
+    except TypeError:
+        return False
+
+
+def _materialize_metrics(metrics: Any) -> Any:
+    """Force device->host readback of a metrics pytree (blocks until the
+    producing step finished on device) and unwrap 0-d arrays to python
+    scalars so reports stay plain dicts on the controller wire."""
+    import jax
+    import numpy as np
+
+    t0 = _time.perf_counter()
+    host = jax.device_get(metrics)
+    if _metrics.metrics_enabled():
+        _HOST_BLOCKED.observe(_time.perf_counter() - t0)
+    return jax.tree.map(
+        lambda x: x.item()
+        if isinstance(x, np.ndarray) and x.ndim == 0
+        else x,
+        host,
+    )
 
 
 @dataclass
@@ -56,6 +108,10 @@ class TrainContext:
     _report_index: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock)
     _last_report_t: float = 0.0  # step-time anchor (perf_counter)
+    # Async-dispatch ring: device-resident metric reports not yet read
+    # back to host, oldest first. Bounded by train_async_dispatch_depth;
+    # eviction/flush materializes entries (in index order) into _reports.
+    _pending: list = field(default_factory=list)
 
     # -- user API ------------------------------------------------------------
 
@@ -101,7 +157,17 @@ class TrainContext:
         a pytree of distributed jax arrays written IN PLACE into the run
         dir with per-shard parallel IO (orbax) — every rank must pass its
         (identical pytree-structure) state, and no bytes are staged or
-        copied. Restore with load_sharded_state(ctx.get_checkpoint())."""
+        copied. Restore with load_sharded_state(ctx.get_checkpoint()).
+
+        Pipelined mode (host-free steady state): when ``metrics`` is a
+        DEVICE-RESIDENT pytree (jax array leaves) and async dispatch is on
+        (``train_async_dispatch``), the pytree is enqueued into a bounded
+        ring instead of read back — report() returns without waiting for
+        the step to execute, so up to ``train_async_dispatch_depth`` steps
+        of dispatch run ahead of the device. Host readback happens only on
+        ring eviction, at checkpoint boundaries (which flush the ring
+        first), or at :meth:`flush` — each step's metrics surface at most
+        ``depth`` reports late, bit-identical to the synchronous loop."""
         if checkpoint is not None and sharded_state is not None:
             raise ValueError(
                 "pass either checkpoint= or sharded_state=, not both"
@@ -115,6 +181,24 @@ class TrainContext:
             if self._last_report_t:
                 _STEP_SECONDS.observe(now - self._last_report_t)
             self._last_report_t = now
+        device_resident = _has_device_leaves(metrics)
+        if checkpoint is None and sharded_state is None and device_resident:
+            depth = self._async_depth()
+            if depth > 0:
+                self._enqueue_async(index, metrics, depth)
+                return
+            # Kill-switch arm: synchronous readback on the step path (the
+            # host-blocked time lands in raytpu_train_host_blocked_seconds
+            # either way, so the A/B measures exactly the stall removed).
+            metrics = _materialize_metrics(metrics)
+        else:
+            # Checkpoint boundary (or a host-metrics report): in-flight
+            # reports materialize FIRST so the restore point never precedes
+            # its own metrics and _reports stays index-ordered.
+            if self._pending:
+                self.flush()
+            if device_resident:
+                metrics = _materialize_metrics(metrics)
         # Persist OUTSIDE the lock: a multi-GB copytree must not block the
         # controller's status() polls (it would read as a dead worker).
         persisted = None
@@ -159,6 +243,55 @@ class TrainContext:
         ):
             pass
         return Checkpoint(final)
+
+    # -- async dispatch (host-free steady state) ----------------------------
+
+    @staticmethod
+    def _async_depth() -> int:
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        if not GLOBAL_CONFIG.train_async_dispatch:
+            return 0
+        return max(0, int(GLOBAL_CONFIG.train_async_dispatch_depth))
+
+    def _enqueue_async(self, index: int, metrics: Any, depth: int) -> None:
+        """Enqueue a device-resident report; evict (materialize) the oldest
+        entries past ``depth`` — the only host blocking on the steady-state
+        step path, and it waits on a step dispatched ``depth`` steps ago,
+        which has almost certainly already executed."""
+        evicted = []
+        with self._lock:
+            self._pending.append({"index": index, "metrics": metrics})
+            while len(self._pending) > depth:
+                evicted.append(self._pending.pop(0))
+            occupancy = len(self._pending)
+        if _metrics.metrics_enabled():
+            _DISPATCH_DEPTH.set(float(occupancy))
+        for entry in evicted:
+            self._materialize_entry(entry)
+
+    def _materialize_entry(self, entry: dict) -> None:
+        report = {
+            "index": entry["index"],
+            "metrics": dict(_materialize_metrics(entry["metrics"])),
+            "checkpoint_path": None,
+            "world_rank": self.world_rank,
+        }
+        with self._lock:
+            self._reports.append(report)
+
+    def flush(self) -> None:
+        """Force host readback of every in-flight async report, in index
+        order. Called at checkpoint boundaries (report(checkpoint=...) /
+        report(sharded_state=...)) and when the train fn returns, so no
+        metrics are lost to the ring; user loops may also call it to bound
+        staleness explicitly."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for entry in pending:
+            self._materialize_entry(entry)
+        if pending and _metrics.metrics_enabled():
+            _DISPATCH_DEPTH.set(0.0)
 
     def drain_reports(self) -> list:
         with self._lock:

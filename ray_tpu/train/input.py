@@ -1,0 +1,167 @@
+"""Double-buffered device input for the train loop.
+
+The other half of the host-free steady state (async dispatch being the
+first): the NEXT batch must already be on device — placed with the step's
+``NamedSharding`` — when the current step's dispatch returns, so the timed
+region never contains host staging (batch slicing, host->device copy).
+``jax.device_put`` itself is asynchronous, but the host-side work feeding
+it (iterating blocks, building the numpy batch) is not; a staging thread
+keeps a bounded queue of device-resident batches ahead of the consumer.
+
+Used directly (wrap any host-batch iterator) or through
+``DataIterator.iter_device_batches`` so ``datasets=`` shards feed a jitted
+step without host staging in the timed region. Pair with
+``make_train_step(donate_batch=True)``: each staged batch is consumed
+exactly once, so XLA may reuse its buffers for the step's outputs.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+from ray_tpu.util import metrics as _metrics
+
+# A miss = the consumer reached next() before the staging thread had the
+# next batch on device — the host data path is slower than the step, and
+# the stall it causes is exactly what this iterator exists to hide.
+_PREFETCH_MISSES = _metrics.Counter(
+    "raytpu_train_prefetch_misses_total",
+    "train input batches the consumer had to wait on (prefetch underrun)",
+)
+
+_SENTINEL = object()
+
+
+class DevicePrefetchIterator:
+    """Stage host batches on device ahead of the consuming train step.
+
+    ``depth`` batches (default: config ``train_prefetch_depth``) are held
+    on device at a time; ``depth=0`` hands host batches straight through
+    (no thread, no staging — the passthrough arm of the A/B). ``sharding``
+    is applied to every leaf via ``jax.device_put`` (a pytree of shardings
+    matching the batch structure also works, as device_put allows).
+    Exceptions from the source iterator surface at the consumer's next()
+    call, after all successfully staged batches have been consumed.
+
+    A consumer that stops early (break / exception) should call
+    :meth:`close` (or drop the iterator — ``__del__`` closes too) so the
+    staging thread releases its staged device batches instead of parking
+    on a full queue for the life of the process.
+    """
+
+    def __init__(
+        self,
+        batches: Iterable,
+        *,
+        sharding: Any = None,
+        depth: Optional[int] = None,
+    ):
+        if depth is None:
+            from ray_tpu.core.config import GLOBAL_CONFIG
+
+            # One kill switch restores the whole synchronous loop:
+            # RAY_TPU_TRAIN_ASYNC_DISPATCH=0 also turns default-depth
+            # prefetch into host passthrough (an explicit depth= wins).
+            depth = (
+                GLOBAL_CONFIG.train_prefetch_depth
+                if GLOBAL_CONFIG.train_async_dispatch
+                else 0
+            )
+        self._depth = max(0, int(depth))
+        self._sharding = sharding
+        self._it = iter(batches)
+        self._error: Optional[BaseException] = None
+        self._done = False
+        self._first = True  # warm-up get: not an underrun by definition
+        self._stop = threading.Event()
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        if self._depth > 0:
+            self._queue = queue.Queue(maxsize=self._depth)
+            self._thread = threading.Thread(
+                target=self._fill, name="train-input-prefetch", daemon=True
+            )
+            self._thread.start()
+
+    def _stage(self, batch: Any) -> Any:
+        import jax
+
+        if self._sharding is None:
+            return jax.device_put(batch)
+        return jax.device_put(batch, self._sharding)
+
+    def _put(self, item: Any) -> bool:
+        """Bounded put that gives up when close() fired, so an abandoned
+        iterator never parks the staging thread on a full queue."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self) -> None:
+        try:
+            for batch in self._it:
+                if not self._put(self._stage(batch)):
+                    return
+        except BaseException as e:  # noqa: BLE001  # raylint: disable=RL006 -- stored and re-raised at the consumer's next() call
+            self._error = e
+        finally:
+            self._put(_SENTINEL)
+
+    def close(self) -> None:
+        """Release the staging thread and every staged batch. Idempotent;
+        called automatically at exhaustion and on __del__ — call it
+        explicitly when breaking out of the loop early."""
+        self._done = True
+        if self._queue is None:
+            return
+        self._stop.set()
+        # Drain so a put-blocked thread wakes, sees the stop flag, exits.
+        for _ in range(2):
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            if self._thread is not None:
+                self._thread.join(timeout=0.5)
+                if not self._thread.is_alive():
+                    break
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # raylint: disable=RL006 -- interpreter-teardown __del__; nothing to report to
+            pass
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self) -> Any:
+        if self._done:
+            raise StopIteration
+        if self._queue is None:
+            # depth=0 passthrough: the host batch, untouched and unstaged.
+            try:
+                return next(self._it)
+            except StopIteration:
+                self._done = True
+                raise
+        # The warm-up get races thread startup and is not a signal; from
+        # then on, an empty queue means the host data path fell behind.
+        underrun = not self._first and self._queue.empty()
+        item = self._queue.get()
+        self._first = False
+        if item is _SENTINEL:
+            self._done = True
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        if underrun and _metrics.metrics_enabled():
+            _PREFETCH_MISSES.inc()
+        return item

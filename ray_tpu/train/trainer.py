@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from ray_tpu.train.backend import Backend, BackendConfig
-from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.config import DataConfig, RunConfig, ScalingConfig
 from ray_tpu.train.controller import Result, TrainController, TrainingFailedError
 from ray_tpu.train.jax_backend import JaxConfig
 
@@ -28,6 +28,7 @@ class DataParallelTrainer:
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         datasets: Optional[dict] = None,
+        data_config: Optional[DataConfig] = None,
     ):
         self._train_fn = train_loop_per_worker
         self._train_loop_config = train_loop_config
@@ -35,6 +36,7 @@ class DataParallelTrainer:
         self._scaling_config = scaling_config or ScalingConfig(num_workers=1)
         self._run_config = run_config or RunConfig()
         self._datasets = datasets or {}
+        self._data_config = data_config or DataConfig()
 
     def fit(self) -> Result:
         """Run to completion; raises TrainingFailedError on unrecovered
@@ -62,18 +64,22 @@ class DataParallelTrainer:
         datasets = {
             name: ds.materialize() for name, ds in self._datasets.items()
         }
+        prefetch_depth = self._data_config.prefetch_depth
 
         from ray_tpu.train.context import get_context
 
         def with_datasets(*maybe_config):
             # Per-worker dataset shards land in the context before the loop
-            # (reference: streaming_split feeding RayTrainWorkers).
+            # (reference: streaming_split feeding RayTrainWorkers). The
+            # DataConfig prefetch depth rides along so iter_device_batches
+            # stages batches on device without per-loop plumbing.
             from ray_tpu.data.iterator import DataIterator
 
             ctx = get_context()
             ctx.dataset_shards = {
                 name: DataIterator(
-                    ds.shard(ctx.get_world_size(), ctx.get_world_rank())
+                    ds.shard(ctx.get_world_size(), ctx.get_world_rank()),
+                    prefetch_depth=prefetch_depth,
                 )
                 for name, ds in datasets.items()
             }

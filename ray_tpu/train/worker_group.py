@@ -139,11 +139,25 @@ class TrainWorker:
                     fn(config)
                 else:
                     fn()
+                # Async-dispatch reports still in the ring materialize now,
+                # inside the try: a readback failure is a real train
+                # failure, and the controller's next status() poll must see
+                # every step's metrics before "finished".
+                self._ctx.flush()
                 self._state = "finished"
             except BaseException as e:  # noqa: BLE001
                 self._error = (
                     f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
                 )
+                # Best-effort ring flush: the steps just before a crash
+                # (the loss spike that explains it) are the most
+                # diagnostic reports, and the synchronous loop would have
+                # kept them. Readback may itself fail on a dead device —
+                # the run is already failed either way.
+                try:
+                    self._ctx.flush()
+                except BaseException:  # noqa: BLE001  # raylint: disable=RL006 -- the train fn's error is already captured; a failing readback must not mask it
+                    pass
                 self._state = "failed"
             finally:
                 set_context(None)

@@ -19,6 +19,7 @@ from ray_tpu.util.collective.collective import (
     create_collective_group,
     destroy_collective_group,
     get_collective_group_size,
+    get_group,
     get_rank,
     init_collective_group,
     is_group_initialized,
@@ -43,6 +44,7 @@ __all__ = [
     "create_collective_group",
     "destroy_collective_group",
     "get_collective_group_size",
+    "get_group",
     "get_rank",
     "init_collective_group",
     "is_group_initialized",

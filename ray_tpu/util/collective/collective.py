@@ -394,6 +394,15 @@ def is_group_initialized(group_name: str = DEFAULT_GROUP_NAME) -> bool:
     return _group_mgr.get(group_name) is not None
 
 
+def get_group(group_name: str = DEFAULT_GROUP_NAME) -> Optional[Communicator]:
+    """This process's Communicator for ``group_name`` (auto-joining a
+    declared group, like any collective call), or None. Callers that care
+    about the data plane — e.g. the rllib learner keeping gradients on
+    device for XLA groups but staging host arrays for CPU groups — branch
+    on ``comm.backend`` instead of round-tripping unconditionally."""
+    return _group_mgr.get(group_name)
+
+
 def get_rank(group_name: str = DEFAULT_GROUP_NAME) -> int:
     comm = _group_mgr.get(group_name)
     return comm.rank if comm is not None else -1

@@ -758,9 +758,15 @@ def _build_xla_hierarchical():
             import jax.numpy as jnp
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            local = jax.device_put(
-                jnp.asarray(to_numpy(tensor)), self._my_device
-            )
+            # Device path for jax arrays, like XlaGroup._global_array: a
+            # device-resident gradient enters the program without a host
+            # round trip.
+            if isinstance(tensor, jax.Array):
+                local = jax.device_put(tensor, self._my_device)
+            else:
+                local = jax.device_put(
+                    jnp.asarray(to_numpy(tensor)), self._my_device
+                )
             local = local[None]
             sharding = NamedSharding(self._hmesh, P(("dcn", "ici")))
             return jax.make_array_from_single_device_arrays(
@@ -768,7 +774,7 @@ def _build_xla_hierarchical():
             )
 
         def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
-            import jax.numpy as jnp
+            import jax
 
             op = ReduceOp(op)
             if op != ReduceOp.SUM:
@@ -777,7 +783,10 @@ def _build_xla_hierarchical():
                 # per-slice SUMS, not the requested op. Non-SUM allreduces
                 # are control-plane-rare: ride the flat 1-D path.
                 return super().allreduce(tensor, op)
-            arr = to_numpy(tensor)
+            # Only shape/dtype metadata is needed host-side; jax arrays
+            # stay on device (should_quantize and .dtype.itemsize read
+            # the dtype object, not the buffer).
+            arr = tensor if isinstance(tensor, jax.Array) else to_numpy(tensor)
             quantized = self._quantize and quant.should_quantize(arr)
             _count_op("allreduce")
             # NB: on the single-program engine the gate can only stop THIS
@@ -815,7 +824,10 @@ def _build_xla_hierarchical():
                 if s.device == self._my_device
             ][0]
             _observe_hop("dcn", t0)
-            return jnp.asarray(np.asarray(shard))
+            # Device-resident result (jax array), matching XlaGroup._run:
+            # a gradient goes back into the jitted apply with no
+            # device->host->device bounce.
+            return shard
 
         def _hier_fn(self, op, quantized, shape, n, k, shard_len):
             key = ("h_allreduce", op, quantized, shape)

@@ -59,9 +59,16 @@ class QuantizedTensor:
         return self.data.nbytes + self.scales.nbytes
 
 
-def should_quantize(arr: np.ndarray) -> bool:
-    """Only inexact (float) dtypes quantize; ints/bools ride full fidelity."""
-    return np.issubdtype(np.asarray(arr).dtype, np.floating)
+def should_quantize(arr) -> bool:
+    """Only inexact (float) dtypes quantize; ints/bools ride full fidelity.
+
+    Reads only ``.dtype`` when the array exposes one, so device-resident
+    jax arrays are classified without a host copy (the hierarchical XLA
+    allreduce calls this on the device path)."""
+    dtype = getattr(arr, "dtype", None)
+    if dtype is None:
+        dtype = np.asarray(arr).dtype
+    return np.issubdtype(dtype, np.floating)
 
 
 def quantize_blockwise(

@@ -122,12 +122,22 @@ class XlaGroup(Communicator):
 
     def _global_array(self, tensor):
         """Stack local tensors into a global (world, *shape) array sharded
-        one-rank-per-device along axis 0."""
+        one-rank-per-device along axis 0.
+
+        jax arrays take the device path: device_put moves (or no-ops) the
+        existing buffer without a host round-trip, so a device-resident
+        gradient never touches host memory on its way into the collective
+        (the rllib learner's flat-gradient allreduce rides this)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        local = jax.device_put(jnp.asarray(to_numpy(tensor)), self._my_device)
+        if isinstance(tensor, jax.Array):
+            local = jax.device_put(tensor, self._my_device)
+        else:
+            local = jax.device_put(
+                jnp.asarray(to_numpy(tensor)), self._my_device
+            )
         local = local[None]
         sharding = NamedSharding(self._mesh, P("ranks"))
         return jax.make_array_from_single_device_arrays(
@@ -136,9 +146,8 @@ class XlaGroup(Communicator):
 
     def _run(self, kind: str, tensor, **static):
         """jit(shard_map(op)) over the ranks mesh; returns this process's
-        local shard of the result."""
+        local shard of the result (device-resident)."""
         import jax
-        import numpy as np
         from jax.sharding import PartitionSpec as P
 
         from ray_tpu.util.jax_compat import shard_map
@@ -235,12 +244,15 @@ class XlaGroup(Communicator):
             )
             self._jitted[cache_key] = fn
         out = fn(garr)
-        # My share: the addressable shard this process holds.
-        shard = [
+        # My share: the addressable shard this process holds — returned
+        # DEVICE-RESIDENT (a jax array). Callers that want host values
+        # wrap with np.asarray; keeping the buffer on device lets
+        # allreduce feed straight back into a jitted update with no
+        # device->host->device bounce.
+        return [
             s.data for s in out.addressable_shards
             if s.device == self._my_device
         ][0]
-        return np.asarray(shard)
 
     # -- Communicator API ----------------------------------------------------
 

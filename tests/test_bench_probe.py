@@ -1,0 +1,108 @@
+"""bench.py probe hardening: a fully wedged backend probe must exit
+within its own wall-clock budget and still persist a skip record with the
+partial probe telemetry — never time the whole round out (the rc=124
+regression of BENCH_r02-r05)."""
+
+import json
+import subprocess
+import time
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def fast_probe_env(monkeypatch):
+    """Probe knobs shrunk so a simulated wedge resolves in ~seconds."""
+    monkeypatch.setenv("RAY_TPU_BENCH_PROBE_ROUNDS", "6")
+    monkeypatch.setenv("RAY_TPU_BENCH_PROBE_SPACING_S", "300")
+    monkeypatch.setattr(bench, "PROBE_BUDGET_S", 2.0)
+    return monkeypatch
+
+
+def test_wedged_probe_bounded_by_budget(fast_probe_env, monkeypatch):
+    """Every attempt hangs (TimeoutExpired): the old loop slept out
+    6x(75+300)s; the budget must cap the WHOLE window — sleeps included —
+    and the record must carry the partial telemetry."""
+
+    def fake_run(cmd, timeout=None, **kw):
+        raise subprocess.TimeoutExpired(cmd=cmd, timeout=timeout)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    t0 = time.perf_counter()
+    outcome, record = bench._probe_backend()
+    elapsed = time.perf_counter() - t0
+    assert outcome == "wedged"
+    assert elapsed < 10.0  # 2s budget + slack, not 37 minutes
+    assert record["budget_exhausted"] is True
+    assert record["attempts"] >= 1
+    assert record["results"][0]["rc"] == "timeout"
+    # The per-attempt timeout was clamped to the remaining budget.
+    assert record["results"][0]["timeout_s"] <= bench.PROBE_TIMEOUT_S
+
+
+def test_fast_failures_still_report_broken(fast_probe_env, monkeypatch):
+    """Deterministic nonzero exits (plugin regression) stay 'broken' —
+    the budget cap must not convert a red signal into a green skip."""
+    monkeypatch.setenv("RAY_TPU_BENCH_PROBE_ROUNDS", "2")
+    monkeypatch.setenv("RAY_TPU_BENCH_PROBE_SPACING_S", "0.01")
+    # Attempts are instant here; leave budget headroom so both rounds run
+    # (the wedge-budget path has its own test above).
+    monkeypatch.setattr(bench, "PROBE_BUDGET_S", 30.0)
+
+    def fake_run(cmd, timeout=None, **kw):
+        return subprocess.CompletedProcess(
+            cmd, returncode=1, stdout="", stderr="ImportError: no plugin"
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    outcome, record = bench._probe_backend()
+    assert outcome == "broken"
+    assert record["attempts"] == 2
+    assert all(r["rc"] == 1 for r in record["results"])
+
+
+def test_probe_ok_short_circuits(fast_probe_env, monkeypatch):
+    calls = []
+
+    def fake_run(cmd, timeout=None, **kw):
+        calls.append(timeout)
+        return subprocess.CompletedProcess(
+            cmd, returncode=0, stdout="8 cpu", stderr=""
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    outcome, record = bench._probe_backend()
+    assert outcome == "ok"
+    assert len(calls) == 1
+    assert record["budget_exhausted"] is False
+
+
+def test_wedged_round_persists_skip_record(monkeypatch, capsys):
+    """End-to-end main() with a wedged probe: exits cleanly (rc 0 path)
+    and PRINTS one JSON record carrying the skip marker + probe
+    telemetry — the persisted artifact a wedged round must leave."""
+    probe_record = {
+        "outcome": "wedged",
+        "attempts": 2,
+        "window_s": 2.0,
+        "budget_s": 2.0,
+        "budget_exhausted": True,
+        "results": [{"rc": "timeout"}],
+    }
+    monkeypatch.setattr(bench, "_data_plane_rows", lambda: {})
+    monkeypatch.setattr(bench, "_serve_llm_rows", lambda: {})
+    monkeypatch.setattr(bench, "_train_overlap_rows", lambda: {})
+    monkeypatch.setattr(bench, "_raylint_rows", lambda: {})
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda: ("wedged", probe_record)
+    )
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    bench.main()  # must NOT raise / sys.exit nonzero
+    out = capsys.readouterr().out.strip().splitlines()
+    record = json.loads(out[-1])
+    assert record["skipped"] == "tpu-unavailable"
+    assert record["value"] == 0.0
+    assert record["probe"]["budget_exhausted"] is True
+    assert record["probe"]["results"] == [{"rc": "timeout"}]

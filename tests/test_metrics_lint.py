@@ -99,6 +99,21 @@ def test_collective_series_registered_and_linted():
     assert lint_catalog(catalog) == []
 
 
+def test_train_overlap_series_registered_and_linted():
+    """Round-13 host-free-train telemetry: the host-blocked readback
+    histogram, the async-ring occupancy gauge, and the input prefetch-miss
+    counter are declared through the catalog so the lint covers them."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    assert "raytpu_train_host_blocked_seconds" in catalog
+    assert catalog["raytpu_train_host_blocked_seconds"]["kind"] == "histogram"
+    assert "raytpu_train_dispatch_depth" in catalog
+    assert catalog["raytpu_train_dispatch_depth"]["kind"] == "gauge"
+    assert "raytpu_train_prefetch_misses_total" in catalog
+    assert catalog["raytpu_train_prefetch_misses_total"]["kind"] == "counter"
+    assert lint_catalog(catalog) == []
+
+
 def test_declare_runtime_metric_enforces_rules():
     with pytest.raises(ValueError, match="prefix"):
         m.declare_runtime_metric("unprefixed_series", "counter")

@@ -85,14 +85,16 @@ def interleaved_ab(
     return summary
 
 
-def ab_main(off_flag: str, label: str) -> int:
+def ab_main(off_flag: str, label: str, base_flags: tuple = ()) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument(
         "--full", action="store_true", help="full (not --quick) perf runs"
     )
     args = ap.parse_args()
-    interleaved_ab(off_flag, label, args.rounds, args.full)
+    interleaved_ab(
+        off_flag, label, args.rounds, args.full, base_flags=base_flags
+    )
     return 0
 
 

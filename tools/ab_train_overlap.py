@@ -1,0 +1,50 @@
+"""Same-session A/B of the host-free train-step tier (PERF.md round-13).
+
+Runs ``tools/ray_perf.py --quick --train-only`` alternately with the
+overlap tier ON (HEAD defaults: device-resident metrics in the pipelined
+ring + device-prefetched input) and OFF (``--no-async-dispatch`` — the
+WHOLE synchronous loop: device->host readback inside every report() AND
+host-passthrough input, since default-depth prefetch follows the same
+kill switch) on the SAME commit, interleaved so ambient box load hits
+both arms equally (the round-3 lesson). The delta is the combined
+readback+staging overlap, not readback alone. Watch:
+
+    train_step_overlap          steps/s — the headline
+    train_step_host_blocked_ms  consumer-thread stalls per step (metric
+                                readback + obtaining the next batch); the
+                                OFF arm syncs on the step it just
+                                dispatched and then runs the loader with
+                                the device idle, the ON arm waits only on
+                                ring eviction (a step ~depth back) with
+                                the loader hidden inside that wait
+    train_prefetch_misses       input-staging underruns, ON arm only (the
+                                OFF arm has no staging thread); nonzero
+                                means the host data path is the
+                                bottleneck, not the step
+
+    python tools/ab_train_overlap.py [--rounds 3] [--full]
+
+The interleaved-median machinery is shared with tools/ab_coalesce.py.
+bench.py records the same pair per round as the ``train_overlap`` BENCH
+record (like ``data_plane`` / ``serve_llm``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import ab_main  # noqa: E402 — shared harness
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return ab_main(
+        "--no-async-dispatch", "train-overlap", base_flags=("--train-only",)
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,7 @@ _CATALOG_MODULES = [
     "ray_tpu.serve.replica",
     "ray_tpu.data.executor",
     "ray_tpu.train.context",
+    "ray_tpu.train.input",  # prefetch-miss counter (host-free train tier)
     "ray_tpu.train.worker_group",
     "ray_tpu.util.collective.hierarchical",  # collective hop/byte series
 ]

@@ -367,6 +367,171 @@ def _serve_llm_rows(results: dict, no_chunked_prefill: bool, quick: bool):
     )
 
 
+def _hist_sum_count(name: str) -> tuple:
+    """(sum, count) of one histogram across this process's registry."""
+    from ray_tpu.util.metrics import registry
+
+    total, count = 0.0, 0.0
+    for n, _tags, v in registry().snapshot()["points"]:
+        if n == name and isinstance(v, dict):
+            total += v["sum"]
+            count += v["count"]
+    return total, count
+
+
+def _counter_total(name: str) -> float:
+    from ray_tpu.util.metrics import registry
+
+    total = 0.0
+    for n, _tags, v in registry().snapshot()["points"]:
+        if n == name:
+            total += float(v)
+    return total
+
+
+def _train_rows(results: dict, no_async_dispatch: bool, quick: bool):
+    """Host-free train-step rows (PERF.md round-13): a pure-jax
+    single-process loop — tiny GPT-2, AOT-compiled donated step — feeding
+    DEVICE-RESIDENT metrics through TrainContext.report() with batches
+    staged by DevicePrefetchIterator. No cluster runtime: the A/B isolates
+    exactly the host work on the step path.
+
+      train_step_overlap          steps/s of the full loop (input + step +
+                                  report)
+      train_step_host_blocked_ms  host-blocked readback per step
+                                  (raytpu_train_host_blocked_seconds
+                                  delta / steps). In the OFF arm every
+                                  report() waits for the step it just
+                                  dispatched AND the loader then runs with
+                                  the device idle; in the ON arm the ring
+                                  eviction waits on a step dispatched
+                                  ``depth`` steps ago while the loader's
+                                  cost hides inside that wait
+      train_prefetch_misses       staging underruns (consumer beat the
+                                  input thread)
+
+    ``--no-async-dispatch`` (= RAY_TPU_TRAIN_ASYNC_DISPATCH=0) is the OFF
+    arm and restores the whole synchronous loop: sync readback inside
+    every report() AND host-passthrough input (default-depth prefetch
+    follows the same kill switch)."""
+    import numpy as np
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    if no_async_dispatch:
+        GLOBAL_CONFIG.train_async_dispatch = False
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # The TPU plugin stomps the env var at import time; repin.
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.train.context import TrainContext
+    from ray_tpu.train.input import DevicePrefetchIterator
+    from ray_tpu.train.spmd import (
+        compile_train_step,
+        default_optimizer,
+        make_train_state,
+        make_train_step,
+    )
+
+    cfg = gpt2.GPT2Config.tiny(n_layer=2, d_model=128, max_seq=128)
+    steps = 40 if quick else 120
+    B = 8
+    opt = default_optimizer(total_steps=steps)
+    state = make_train_state(
+        lambda k: gpt2.init_params(k, cfg), opt, jax.random.key(0)
+    )
+    # donate_batch stays off: int32 token buffers have no dtype-matching
+    # outputs to reuse, so donation would only emit XLA's unusable-donation
+    # warning. donate_state off too: the CPU runtime blocks the dispatch
+    # call until a donated input is defined (~the full step time), which
+    # would hide the readback stall this A/B exists to measure (TPU
+    # resolves aliasing asynchronously — bench.py keeps donation on).
+    step = make_train_step(
+        lambda p, b: gpt2.loss_fn(p, b, cfg), opt, donate_state=False
+    )
+    rng = np.random.default_rng(0)
+
+    def host_batches():
+        # Synthetic loader with REAL host cost per batch (~20-25 ms on
+        # this box vs a ~55 ms step): an oversampled byte "corpus" folded
+        # into vocab ids, standing in for tokenize+pack. This is the work
+        # the overlap tier takes off the step path — the prefetch thread
+        # absorbs it in the ON arm; the OFF arm (passthrough) pays it
+        # inline between steps while the device sits idle.
+        for _ in range(steps):
+            raw = rng.integers(
+                0, 256, size=(B * cfg.max_seq, 2048), dtype=np.int64
+            )
+            tokens = (
+                (raw.cumsum(axis=1).sum(axis=1) % cfg.vocab_size)
+                .astype(np.int32)
+                .reshape(B, cfg.max_seq)
+            )
+            yield {"tokens": tokens, "targets": np.roll(tokens, -1, axis=1)}
+
+    # AOT-compile against a staged example OUTSIDE the timed loop. lower()
+    # only traces — donation happens when the executable runs — so the
+    # example batch stays valid.
+    example = jax.device_put(next(iter(host_batches())))
+    compiled, _flops = compile_train_step(step, state, example)
+
+    ctx = TrainContext(
+        experiment_name="ray_perf",
+        world_size=1,
+        world_rank=0,
+        local_rank=0,
+        local_world_size=1,
+        node_rank=0,
+    )
+    blocked0, _ = _hist_sum_count("raytpu_train_host_blocked_seconds")
+    misses0 = _counter_total("raytpu_train_prefetch_misses_total")
+    it = DevicePrefetchIterator(host_batches())
+    input_wait = 0.0  # consumer-thread time spent obtaining the next batch
+    t0 = time.perf_counter()
+    while True:
+        t_in = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        input_wait += time.perf_counter() - t_in
+        state, metrics = compiled(state, batch)
+        ctx.report(metrics)
+    ctx.flush()
+    jax.block_until_ready(state["step"])
+    dt = time.perf_counter() - t0
+    blocked1, _ = _hist_sum_count("raytpu_train_host_blocked_seconds")
+    reports = ctx.drain_reports()
+    assert len(reports) == steps, (len(reports), steps)
+
+    results["train_step_overlap"] = round(steps / dt, 2)
+    # Host-blocked = everything the consumer thread did per step that was
+    # NOT dispatching: metric readback stalls (the histogram) + obtaining
+    # the next batch (inline loader+h2d in the OFF arm; a queue pop —
+    # usually instant — in the ON arm). The tier's whole point is driving
+    # this toward pure device-wait while steps/s rises.
+    results["train_step_host_blocked_ms"] = round(
+        ((blocked1 - blocked0) + input_wait) * 1e3 / steps, 4
+    )
+    results["train_prefetch_misses"] = (
+        _counter_total("raytpu_train_prefetch_misses_total") - misses0
+    )
+    arm = "off (sync readback)" if no_async_dispatch else (
+        f"on (depth {GLOBAL_CONFIG.train_async_dispatch_depth})"
+    )
+    print(
+        f"train_step_overlap: {results['train_step_overlap']:,.1f} steps/s, "
+        f"host-blocked {results['train_step_host_blocked_ms']:.3f} ms/step, "
+        f"{results['train_prefetch_misses']:.0f} prefetch misses "
+        f"[async dispatch {arm}]",
+        flush=True,
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -432,6 +597,22 @@ def main() -> int:
         "prefill (PERF.md round-12)",
     )
     ap.add_argument(
+        "--train-only",
+        action="store_true",
+        help="run only the host-free train-step rows (pure-jax CPU loop, "
+        "no cluster): train_step_overlap steps/s + host-blocked ms/step — "
+        "the round-13 async-dispatch A/B rides this via "
+        "tools/ab_train_overlap.py and bench.py's train_overlap record",
+    )
+    ap.add_argument(
+        "--no-async-dispatch",
+        action="store_true",
+        help="kill switch: synchronous train loop — device->host metric "
+        "readback inside every report() (equivalent to "
+        "RAY_TPU_TRAIN_ASYNC_DISPATCH=0) — the A/B baseline for the "
+        "round-13 host-free train steps",
+    )
+    ap.add_argument(
         "--faults",
         metavar="SEED:SPEC",
         help="enable the fault-injection plane for the whole run "
@@ -450,6 +631,19 @@ def main() -> int:
         _faults.install(_faults.parse_env(args.faults))
     batch = 20 if args.quick else 100
     min_s = 0.5 if args.quick else 2.0
+
+    if args.train_only:
+        # Pure-jax in-process rows: no cluster runtime, both cores to the
+        # jitted step. CPU jax even where a TPU plugin is installed.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        results = {}
+        _train_rows(
+            results,
+            no_async_dispatch=args.no_async_dispatch,
+            quick=args.quick,
+        )
+        print(json.dumps(results), flush=True)
+        return 0
 
     if (
         args.no_coalesce
