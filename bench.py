@@ -512,7 +512,14 @@ def _raylint_rows() -> dict:
             "total": payload["total"],
             "suppressed": payload["suppressed"],
             "unsuppressed": payload["unsuppressed"],
+            "advisory": payload.get("advisory", 0),
             "by_rule": payload["by_rule"],
+            # Lock-graph summary (RL105): nodes/edges of the cross-file
+            # lock-acquisition graph; cycles must stay 0 — tracked per
+            # round like the finding counts.
+            "lock_graph": payload.get(
+                "lock_graph", {"nodes": 0, "edges": 0, "cycles": 0}
+            ),
         }
     except Exception as e:  # noqa: BLE001 — never fail the headline bench
         _log(f"raylint rows skipped: {type(e).__name__}: {e}")

@@ -1986,9 +1986,10 @@ class NodeManager:
         from ray_tpu import _native
 
         def compute():
-            with self.store._lock:
-                mv = self.store.get(p["oid"])
-                return _native.fingerprint(mv)
+            # Owner-side pin: the store holds its own lock around the view
+            # + fingerprint so a concurrent spill can't unmap mid-hash
+            # (reaching into store._lock from here was an RL105 finding).
+            return self.store.apply(p["oid"], _native.fingerprint)
 
         return await self._store_call(compute)
 
@@ -2196,21 +2197,17 @@ class NodeManager:
         for sealed blobs)."""
         if self.store is None:
             return []
-        out = []
-        with self.store._lock:
-            for oid, entry in self.store.meta.items():
-                size, sealed, _last, loc = entry[:4]
-                out.append(
-                    {
-                        "object_id": oid,
-                        "size": size,
-                        "sealed": bool(sealed),
-                        "location": loc,
-                        "primary": bool(entry[4]) if len(entry) > 4 else False,
-                        "node_id": self.node_id,
-                    }
-                )
-        return out
+        return [
+            {
+                "object_id": oid,
+                "size": size,
+                "sealed": sealed,
+                "location": loc,
+                "primary": primary,
+                "node_id": self.node_id,
+            }
+            for oid, size, sealed, loc, primary in self.store.list_entries()
+        ]
 
     async def _h_read_worker_log(self, conn, p):
         """Tail of one worker's captured stdout/stderr file (dashboard log

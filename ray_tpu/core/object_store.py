@@ -236,6 +236,31 @@ class ShmObjectStore:
             view = self.get(oid_hex)
             return bytes(view[offset : offset + length])
 
+    def apply(self, oid_hex: str, fn):
+        """Run ``fn(view)`` on a sealed blob's memoryview UNDER the store
+        lock — the mapping is pinned against a concurrent spill/delete for
+        the duration. THE way for other components to compute over a blob
+        in place (fingerprinting, checksums) without reaching into
+        ``_lock`` themselves: lock ordering stays owned by the store."""
+        with self._lock:
+            return fn(self.get(oid_hex))
+
+    def list_entries(self) -> list:
+        """Snapshot of ``(oid, size, sealed, location, primary)`` rows,
+        taken under the store lock so callers never iterate live metadata
+        (or hold our private lock) themselves."""
+        with self._lock:
+            return [
+                (
+                    oid,
+                    entry[0],
+                    bool(entry[1]),
+                    entry[3],
+                    bool(entry[4]) if len(entry) > 4 else False,
+                )
+                for oid, entry in self.meta.items()
+            ]
+
     def stats(self) -> dict:
         """Occupancy + cumulative operation counters for the node's metric
         snapshot (one lock hold per report interval, not per operation)."""

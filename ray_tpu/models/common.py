@@ -152,7 +152,7 @@ def pipelined_blocks(blocks, x, block_fn, mesh, *, n_micro):
     from ray_tpu.util.jax_compat import shard_map
 
     layer_specs = jax.tree.map(lambda _: P("pp"), blocks)
-    return shard_map(
+    return shard_map(  # raylint: disable=RL102 -- constructed under the enclosing jit trace of the model fwd; rebuilt once per outer trace, not per step
         pipelined,
         mesh=mesh,
         in_specs=(layer_specs, P()),

@@ -96,7 +96,7 @@ def ring_attention(
     from ray_tpu.util.jax_compat import shard_map
 
     seq_spec = P(None, None, axis, None)
-    return shard_map(
+    return shard_map(  # raylint: disable=RL102 -- constructed under the enclosing jit trace of the attention caller; rebuilt once per outer trace, not per step
         local,
         mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),

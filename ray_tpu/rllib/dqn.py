@@ -236,7 +236,7 @@ class DQNLearner(Learner):
         if not self._built:
             self.build()
         batch = SampleBatch(dict(batch))
-        batch[TD_TARGETS] = np.asarray(
+        batch[TD_TARGETS] = np.asarray(  # raylint: disable=RL101 -- TD targets re-enter the numpy SampleBatch replay path; minibatch slicing is host-side by design
             self._td_targets(
                 self.params,
                 self.target_params,

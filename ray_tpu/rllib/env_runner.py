@@ -236,24 +236,24 @@ class EnvRunner(RolloutBase):
             self._key, k = jax.random.split(self._key)
             # env-to-module connectors transform raw observations into the
             # module's input space; the TRANSFORMED obs is what trains.
-            obs_in = np.asarray(
+            obs_in = np.asarray(  # raylint: disable=RL101 -- env-to-module connector output is numpy by contract (rollout buffers + env.step)
                 self._env_to_module(self._obs), np.float32
             )
             if obs_buf is None:
                 obs_buf = np.empty((T,) + obs_in.shape, np.float32)
             actions, logp, vf = self._policy_step(self._params, obs_in, k)
-            actions_np = np.asarray(actions)
+            actions_np = np.asarray(actions)  # raylint: disable=RL101 -- policy actions cross the env boundary as numpy
             obs_buf[t] = obs_in
             act_list.append(actions_np)
-            logp_buf[t] = np.asarray(logp)
-            vf_buf[t] = np.asarray(vf)
+            logp_buf[t] = np.asarray(logp)  # raylint: disable=RL101 -- logp lands in the numpy rollout buffer; trainer re-uploads per minibatch
+            vf_buf[t] = np.asarray(vf)  # raylint: disable=RL101 -- vf lands in the numpy rollout buffer
             # Envs in autoreset perform their reset this step: the recorded
             # transition is fabricated (action ignored, reward 0) and is
             # masked out of the loss and the episode accounting.
             live = ~self._autoreset
             mask_buf[t] = live
             env_actions = (
-                np.asarray(self._module_to_env(actions_np))
+                np.asarray(self._module_to_env(actions_np))  # raylint: disable=RL101 -- module-to-env connector output feeds env.step (host)
                 if len(self._module_to_env)
                 else actions_np
             )
@@ -265,10 +265,10 @@ class EnvRunner(RolloutBase):
             self._obs = next_obs
         self._total_steps += int(mask_buf.sum())
 
-        last_vf = np.asarray(
+        last_vf = np.asarray(  # raylint: disable=RL101 -- bootstrap value joins the numpy GAE path
             self._vf(
                 self._params,
-                np.asarray(
+                np.asarray(  # raylint: disable=RL101 -- frozen obs transform is the numpy vf input at the fragment boundary
                     # frozen: this same obs transforms AGAIN at the next
                     # fragment's first step — updating twice would bias
                     # stats toward fragment-boundary states.

@@ -126,7 +126,7 @@ class MultiAgentEnvRunner:
 
     def _stack(self, obs_dict: dict) -> np.ndarray:
         return np.stack(
-            [np.asarray(obs_dict[a], np.float32) for a in self.agents]
+            [np.asarray(obs_dict[a], np.float32) for a in self.agents]  # raylint: disable=RL101 -- per-agent obs stacking is numpy: the env speaks per-agent dicts (host)
         )
 
     def set_weights(self, params, version: int = 0) -> bool:
@@ -163,17 +163,17 @@ class MultiAgentEnvRunner:
 
         for t in range(T):
             self._key, k = jax.random.split(self._key)
-            obs_in = np.asarray(self._env_to_module(self._obs), np.float32)
+            obs_in = np.asarray(self._env_to_module(self._obs), np.float32)  # raylint: disable=RL101 -- env-to-module connector output is numpy by contract (rollout buffers + env.step)
             if obs_buf is None:
                 obs_buf = np.empty((T,) + obs_in.shape, np.float32)
             actions, logp, vf = self._policy_step(self._params, obs_in, k)
-            actions_np = np.asarray(actions)
+            actions_np = np.asarray(actions)  # raylint: disable=RL101 -- policy actions cross the env boundary as numpy
             obs_buf[t] = obs_in
             act_list.append(actions_np)
-            logp_buf[t] = np.asarray(logp)
-            vf_buf[t] = np.asarray(vf)
+            logp_buf[t] = np.asarray(logp)  # raylint: disable=RL101 -- logp lands in the numpy rollout buffer
+            vf_buf[t] = np.asarray(vf)  # raylint: disable=RL101 -- vf lands in the numpy rollout buffer
             env_actions = (
-                np.asarray(self._module_to_env(actions_np))
+                np.asarray(self._module_to_env(actions_np))  # raylint: disable=RL101 -- module-to-env connector output feeds env.step (host)
                 if len(self._module_to_env)
                 else actions_np
             )
@@ -201,13 +201,13 @@ class MultiAgentEnvRunner:
                     # yields identical targets while keeping self._obs as
                     # the NEXT episode's start (GAE must not read the new
                     # episode's value for the old one's last step).
-                    final_in = np.asarray(
+                    final_in = np.asarray(  # raylint: disable=RL101 -- truncation bootstrap input is the numpy obs transform (host GAE path)
                         self._env_to_module(
                             self._stack(obs), update=False
                         ),
                         np.float32,
                     )
-                    final_vf = np.asarray(
+                    final_vf = np.asarray(  # raylint: disable=RL101 -- truncation bootstrap value folds into the numpy reward buffer
                         self._vf(self._params, final_in)
                     )
                     rew_buf[t] += self.gamma * final_vf
@@ -217,10 +217,10 @@ class MultiAgentEnvRunner:
             self._obs = self._stack(obs)
         self._total_steps += T * N
 
-        last_vf = np.asarray(
+        last_vf = np.asarray(  # raylint: disable=RL101 -- bootstrap value joins the numpy GAE path
             self._vf(
                 self._params,
-                np.asarray(
+                np.asarray(  # raylint: disable=RL101 -- frozen obs transform is the numpy vf input at the fragment boundary
                     self._env_to_module(self._obs, update=False), np.float32
                 ),
             )

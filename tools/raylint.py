@@ -1,39 +1,69 @@
-"""raylint — AST-level concurrency & invariant lint for the ray_tpu runtime.
+"""raylint — AST-level concurrency, invariant & TPU/JAX lint for ray_tpu.
 
 The runtime carries load-bearing invariants that exist only by convention:
 a hybrid asyncio + ``threading.Lock`` concurrency model, RPC allowlists in
-``core/protocol.py``, env-var kill switches, and a long tail of broad
-``except Exception`` blocks. This tool machine-checks those properties the
-way ``tools/metrics_lint.py`` checks the series catalog — CI-enforced via
-``tests/test_raylint.py``, so every future PR holds them by construction.
+``core/protocol.py``, env-var kill switches, a long tail of broad
+``except Exception`` blocks — and, since the host-free train loop and the
+cache-aware serving tier, a *device plane* whose throughput depends on no
+host synchronization inside hot paths. This tool machine-checks those
+properties the way ``tools/metrics_lint.py`` checks the series catalog —
+CI-enforced via ``tests/test_raylint.py``.
 
 Rule families
 -------------
+Concurrency / invariants (RL0xx):
+
 RL001  blocking call inside ``async def`` (``time.sleep``, blocking
        socket/subprocess/file I/O, zero-arg ``Future.result()``,
-       ``Lock.acquire()`` without a timeout) — one blocked event loop
-       stalls every collective behind it.
-RL002  ``threading.Lock``/``RLock`` held across an ``await`` (a sync
-       ``with ...lock:`` whose body awaits) — deadlock/race class in the
-       hybrid concurrency model.
+       ``Lock.acquire()`` without a timeout).
+RL002  ``threading.Lock``/``RLock`` held across an ``await``.
 RL003  fire-and-forget task: ``asyncio.ensure_future``/``create_task``
-       whose result is discarded (bare expression statement). Use
-       ``ray_tpu.util.tasks.spawn`` — it strong-refs the task and logs
-       non-cancelled exceptions instead of dropping them at GC time.
+       whose result is discarded. Use ``ray_tpu.util.tasks.spawn``.
 RL004  env-var hygiene: every ``RAY_TPU_*`` read outside
-       ``core/config.py`` must be a registered bootstrap var
-       (``config.BOOTSTRAP_ENV_VARS``); reads of config-knob env vars
-       must go through ``GLOBAL_CONFIG``; every knob and bootstrap var
-       must be documented in README.md.
-RL005  RPC-contract consistency: every method name in the
-       ``core/protocol.py`` allowlists (``IDEMPOTENT_RPCS``,
-       ``RPC_DEADLINE_EXEMPT`` and the deadline-class sets) must resolve
-       to a handler actually registered on an Endpoint (``_h_<meth>`` /
-       ``_h_<topic>_<meth>`` convention).
-RL006  silent exception swallowing: a bare/broad except whose body
-       neither raises nor calls anything (no logging, no cleanup call)
-       can eat exactly the typed errors the robustness tier surfaces.
+       ``core/config.py`` must be a registered bootstrap var; knob
+       reads go through ``GLOBAL_CONFIG``; README stays complete.
+RL005  RPC-contract consistency: allowlist entries resolve to
+       registered ``_h_<meth>`` / ``_h_<topic>_<meth>`` handlers.
+RL006  silent exception swallowing (broad except, body acts on nothing).
+
+TPU/JAX device plane (RL1xx, "jaxlint"):
+
+RL101  host–device sync in device-hot code: ``jax.device_get``,
+       ``np.asarray``, ``.item()``, ``.block_until_ready()`` inside a
+       function reachable from a jit/shard_map dispatch site or a
+       device-hot entrypoint (``LLMEngine.step``, ``TrainContext.report``,
+       ``Learner.update``) via the static call graph; plus
+       ``float()/int()/bool()`` concretization inside *traced* functions.
+RL102  recompilation hazards: ``jax.jit``/``shard_map`` constructed
+       inside a loop, jit-wrapped-and-immediately-called (retraces every
+       invocation), and data-dependent ``static_argnums``/``argnames``.
+RL103  donation hygiene: a donated argument read after the jitted call
+       (its buffer is invalidated); step-shaped jits with no donation
+       are reported as ADVISORY findings (flagged, never fail the exit
+       code — but the tree convention is to pragma-justify them).
+RL104  collective-order divergence: a collective op under a rank-/slice-
+       conditional branch in ``util/collective/``, ``rllib/learner.py``
+       or ``train/`` — divergent collective ordering across ranks hangs
+       the group.
+RL105  lock-order deadlock: the cross-file lock-acquisition graph over
+       every ``threading.Lock``/``RLock`` holder (edges = lock B acquired
+       — directly or through the call graph — while lock A is held);
+       any AB/BA cycle is a finding carrying both witness paths. A
+       non-reentrant ``Lock`` re-acquired while held is a self-deadlock
+       finding.
+
 RL000  malformed suppression pragma (unknown rule id or missing reason).
+
+Device-hot reachability (RL101)
+-------------------------------
+A function is *device-hot* when it (a) calls a callable bound from
+``jax.jit(...)``/``shard_map(...)`` (a dispatch site), (b) is one of the
+registered entrypoints in ``DEVICE_HOT_ENTRYPOINTS``, or (c) is reachable
+from either through the static call graph (bare names, ``self.meth``,
+``module.func``, ``self.attr.meth`` via instance typing, nested defs).
+A function is *traced* when it is passed into ``jax.jit``/``shard_map``/
+``jax.grad``/``jax.value_and_grad`` (or decorated with one), or reachable
+from such a function.
 
 Suppression
 -----------
@@ -41,18 +71,32 @@ Suppression
 comment-only line directly above it). The reason string is REQUIRED —
 a pragma without one is itself a finding (RL000) and fails CI.
 
+Caching & incrementality
+------------------------
+Per-file analysis facts (findings + call-graph/lock facts) are cached
+under ``.raylint_cache/`` keyed by a content hash (file source + the
+raylint source itself), so unchanged files never re-parse. Cross-file
+analyses (RL004/RL005/RL101/RL105) always re-run over the cached facts —
+they are cheap without the parse. ``--changed-only`` reports only
+findings in files changed vs git HEAD (cross-file analysis still sees
+the whole tree, so reachability and the lock graph stay sound).
+
 Run::
 
     python tools/raylint.py              # lint ray_tpu/, exit 1 on findings
     python tools/raylint.py --json       # machine-readable findings + counts
     python tools/raylint.py --only RL003,RL006
+    python tools/raylint.py --only jax       # the RL101-RL104 family
+    python tools/raylint.py --only locks     # RL105 lock-order analysis
     python tools/raylint.py --only metrics   # the metrics-catalog lint
-                                             # (tools/metrics_lint.py)
+    python tools/raylint.py --changed-only   # findings in git-changed files
+    python tools/raylint.py --no-cache       # bypass .raylint_cache/
 
 Adding a rule: subclass ``Rule``, set ``ID``/``TITLE``, implement
-``check(ctx)`` (per-file) and/or ``finalize(tree_ctx)`` (whole-tree), and
-append it to ``ALL_RULES``. Add the three fixtures (violating / clean /
-pragma-suppressed) in tests/test_raylint.py and a row to the README table.
+``check(ctx)`` (per-file) and/or ``finalize(tree_ctx)`` (whole-tree, over
+the facts layer), and append it to ``ALL_RULES``. Add the three fixtures
+(violating / clean / pragma-suppressed) in tests/test_raylint.py and a
+row to the README table.
 """
 
 from __future__ import annotations
@@ -60,13 +104,20 @@ from __future__ import annotations
 import argparse
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
+import subprocess
 import sys
 from typing import Iterable, Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Bumping this (or editing this file at all — the source is part of the
+# cache key) invalidates every .raylint_cache entry.
+SCHEMA_VERSION = "3"
+CACHE_DIRNAME = ".raylint_cache"
 
 PRAGMA_RE = re.compile(
     r"#\s*raylint:\s*disable=([A-Za-z0-9_,\s]+?)"
@@ -94,6 +145,52 @@ _BLOCKING_SUBPROCESS = {
     "Popen",
 }
 
+# RL101: host-side functions that anchor device-hot reachability even
+# though they do not themselves dispatch a jitted callable (they sit
+# BETWEEN dispatches on the steady-state step path). Dotted module +
+# qualname, matched against the scanned tree.
+DEVICE_HOT_ENTRYPOINTS = frozenset(
+    {
+        "ray_tpu.llm.engine.LLMEngine.step",
+        "ray_tpu.llm.engine.LLMEngine.generate",
+        "ray_tpu.train.context.TrainContext.report",
+        "ray_tpu.rllib.learner.Learner.update",
+    }
+)
+
+# RL104: collective operations whose call ORDER must be rank-uniform.
+# send/recv are excluded: P2P is rank-conditional by definition.
+_COLLECTIVE_OPS = frozenset(
+    {
+        "allreduce",
+        "all_reduce",
+        "allgather",
+        "all_gather",
+        "reducescatter",
+        "reduce_scatter",
+        "psum",
+        "psum_scatter",
+        "broadcast",
+        "barrier",
+        "pmean",
+        "pmax",
+        "pmin",
+        "ppermute",
+    }
+)
+_RANKISH = ("rank", "slice", "leader")
+_RL104_PATHS = ("ray_tpu/util/collective/", "ray_tpu/train/")
+_RL104_FILES = ("ray_tpu/rllib/learner.py",)
+
+_STEP_SHAPED = re.compile(r"(^|_)(step|train|update|apply)(_|$)|step$")
+
+# --only group filters (satellite of the jaxlint round): named families
+# that expand to rule-id sets, mirroring the `--only metrics` delegation.
+RULE_GROUPS = {
+    "jax": frozenset({"RL101", "RL102", "RL103", "RL104"}),
+    "locks": frozenset({"RL105"}),
+}
+
 
 @dataclasses.dataclass
 class Finding:
@@ -103,13 +200,22 @@ class Finding:
     message: str
     suppressed: bool = False
     reason: str = ""
+    # Advisory findings are surfaced (and must still be pragma-justified
+    # to keep the tree at zero unsuppressed) but never flip the exit code:
+    # the RL103 missing-donation tier is a judgement call per jit.
+    advisory: bool = False
 
     def format(self) -> str:
         tag = f" [suppressed: {self.reason}]" if self.suppressed else ""
-        return f"{self.path}:{self.line}: {self.rule} {self.message}{tag}"
+        adv = " [advisory]" if self.advisory else ""
+        return f"{self.path}:{self.line}: {self.rule}{adv} {self.message}{tag}"
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Finding":
+        return cls(**d)
 
 
 class FileCtx:
@@ -124,9 +230,9 @@ class FileCtx:
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
                 child._raylint_parent = node  # type: ignore[attr-defined]
-        # line -> (frozenset of rule ids, reason); malformed pragmas land
-        # in pragma_errors as RL000 findings.
-        self.pragmas: dict[int, tuple[frozenset, str]] = {}
+        # line -> {"ids": [...], "reason": str, "comment_only": bool};
+        # malformed pragmas land in pragma_errors as RL000 findings.
+        self.pragmas: dict[int, dict] = {}
         self.pragma_errors: list[Finding] = []
         self._collect_pragmas()
 
@@ -147,8 +253,8 @@ class FileCtx:
                         )
                     )
                 continue
-            ids = frozenset(
-                t.strip() for t in m.group(1).split(",") if t.strip()
+            ids = sorted(
+                {t.strip() for t in m.group(1).split(",") if t.strip()}
             )
             reason = (m.group("reason") or "").strip()
             bad = [r for r in ids if r not in RULE_IDS]
@@ -173,23 +279,28 @@ class FileCtx:
                     )
                 )
                 continue
-            self.pragmas[i] = (ids, reason)
+            self.pragmas[i] = {
+                "ids": ids,
+                "reason": reason,
+                "comment_only": line.lstrip().startswith("#"),
+            }
 
-    def suppression_for(self, rule: str, line: int) -> Optional[str]:
-        """Reason string if ``rule`` is suppressed at ``line``.
 
-        A pragma applies to findings on its own line, or — when it sits on
-        a comment-only line — to the first following non-comment line.
-        """
-        ent = self.pragmas.get(line)
-        if ent and rule in ent[0]:
-            return ent[1]
-        prev = line - 1
-        if prev >= 1 and prev in self.pragmas:
-            ids, reason = self.pragmas[prev]
-            if rule in ids and self.lines[prev - 1].lstrip().startswith("#"):
-                return reason
-        return None
+def _suppression_for(
+    pragmas: dict, rule: str, line: int
+) -> Optional[str]:
+    """Reason string if ``rule`` is suppressed at ``line``.
+
+    A pragma applies to findings on its own line, or — when it sits on
+    a comment-only line — to the first following non-comment line.
+    """
+    ent = pragmas.get(line)
+    if ent and rule in ent["ids"]:
+        return ent["reason"]
+    prev = pragmas.get(line - 1)
+    if prev and rule in prev["ids"] and prev["comment_only"]:
+        return prev["reason"]
+    return None
 
 
 def parent(node: ast.AST) -> Optional[ast.AST]:
@@ -422,42 +533,42 @@ class EnvVarHygiene(Rule):
 
     CONFIG_RELPATH = os.path.join("ray_tpu", "core", "config.py")
 
-    def check(self, ctx: FileCtx) -> list[Finding]:
-        if ctx.relpath.replace(os.sep, "/").endswith("core/config.py"):
-            return []
-        findings = []
-        for node in ast.walk(ctx.tree):
-            key, line = _env_read(node)
-            if key is None or not key.startswith(ENV_PREFIX):
-                continue
-            findings.append(
-                Finding(self.ID, ctx.relpath, line, key)
-            )  # resolved in finalize against the config registry
-        return findings
-
     def finalize(self, tree: "TreeCtx") -> list[Finding]:
         knobs, bootstrap, knob_lines = tree.config_registry()
         out = []
-        for f in tree.pending.pop(self.ID, []):
-            key = f.message
-            field = key[len(ENV_PREFIX):].lower()
-            if field in knobs:
-                f.message = (
-                    f"direct read of config-knob env var {key}; use "
-                    f"GLOBAL_CONFIG.{field} (env reads outside "
-                    "core/config.py bypass the cluster-synced config)"
-                )
-                out.append(f)
-            elif key in bootstrap:
+        for facts in tree.facts.values():
+            if facts["relpath"].replace(os.sep, "/").endswith(
+                "core/config.py"
+            ):
                 continue
-            else:
-                f.message = (
-                    f"read of unregistered env var {key}: add it to "
-                    "core/config.py (a Config knob, or "
-                    "BOOTSTRAP_ENV_VARS for per-process bootstrap "
-                    "interfaces) and document it in README.md"
-                )
-                out.append(f)
+            for key, line in facts["env_reads"]:
+                if not key.startswith(ENV_PREFIX):
+                    continue
+                field = key[len(ENV_PREFIX):].lower()
+                if field in knobs:
+                    out.append(
+                        Finding(
+                            self.ID,
+                            facts["relpath"],
+                            line,
+                            f"direct read of config-knob env var {key}; use "
+                            f"GLOBAL_CONFIG.{field} (env reads outside "
+                            "core/config.py bypass the cluster-synced "
+                            "config)",
+                        )
+                    )
+                elif key not in bootstrap:
+                    out.append(
+                        Finding(
+                            self.ID,
+                            facts["relpath"],
+                            line,
+                            f"read of unregistered env var {key}: add it to "
+                            "core/config.py (a Config knob, or "
+                            "BOOTSTRAP_ENV_VARS for per-process bootstrap "
+                            "interfaces) and document it in README.md",
+                        )
+                    )
         # README completeness: every knob and bootstrap var is external
         # interface and must be documented.
         readme = tree.readme_text()
@@ -535,43 +646,29 @@ class RpcContract(Rule):
     )
 
     def finalize(self, tree: "TreeCtx") -> list[Finding]:
-        protocol = tree.file("ray_tpu/core/protocol.py")
+        protocol = tree.facts.get("ray_tpu/core/protocol.py")
         if protocol is None:
             return []
         handlers = tree.handler_names()
         findings = []
-        for node in ast.walk(protocol.tree):
-            if not (
-                isinstance(node, ast.Assign)
-                and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id in self.ALLOWLISTS
-            ):
-                continue
-            listname = node.targets[0].id
-            for c in ast.walk(node.value):
-                if not (
-                    isinstance(c, ast.Constant) and isinstance(c.value, str)
-                ):
-                    continue
-                entry = c.value
-                topic, dot, meth = entry.partition(".")
-                resolved = dot and (
-                    f"_h_{meth}" in handlers
-                    or f"_h_{topic}_{meth}" in handlers
-                )
-                if not resolved:
-                    findings.append(
-                        Finding(
-                            self.ID,
-                            protocol.relpath,
-                            c.lineno,
-                            f"{listname} entry {entry!r} does not resolve "
-                            "to any registered handler (_h_"
-                            f"{meth or entry} / _h_{topic}_{meth}): stale "
-                            "entry or renamed handler",
-                        )
+        for listname, entry, lineno in protocol["allowlists"]:
+            topic, dot, meth = entry.partition(".")
+            resolved = dot and (
+                f"_h_{meth}" in handlers
+                or f"_h_{topic}_{meth}" in handlers
+            )
+            if not resolved:
+                findings.append(
+                    Finding(
+                        self.ID,
+                        protocol["relpath"],
+                        lineno,
+                        f"{listname} entry {entry!r} does not resolve "
+                        "to any registered handler (_h_"
+                        f"{meth or entry} / _h_{topic}_{meth}): stale "
+                        "entry or renamed handler",
                     )
+                )
         return findings
 
 
@@ -626,6 +723,1396 @@ def _handler_acts(body: list) -> bool:
     return False
 
 
+# -- jax helpers (shared by RL101/RL102/RL103 and the facts extractor) --------
+
+
+def _alias_base(base: Optional[str], imports: dict) -> Optional[str]:
+    """Resolve an attribute base through the file's import aliases
+    (``np`` -> 'numpy', ``jnp`` -> 'jax.numpy')."""
+    if base is None:
+        return None
+    return imports.get(base, base)
+
+
+def _collect_imports(tree: ast.AST) -> tuple[dict, dict]:
+    """(imports, from_imports): local alias -> dotted module, and local
+    name -> (dotted module, attr). Relative from-imports are left with a
+    leading '.'-count prefix resolved later against the module path."""
+    imports: dict[str, str] = {}
+    from_imports: dict[str, tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                # `import x.y as z` -> z: x.y; `import x.y` -> x: x (the
+                # bound name is the top-level package).
+                if a.asname:
+                    imports[a.asname] = a.name
+                else:
+                    imports[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            mod = ("." * node.level) + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                from_imports[a.asname or a.name] = (mod, a.name)
+    return imports, from_imports
+
+
+def _is_jit_call(node: ast.Call, imports: dict) -> bool:
+    """True for jax.jit(...) / jit(...) / pjit(...) / shard_map(...)."""
+    base, attr = _call_name(node)
+    rb = _alias_base(base, imports)
+    if attr in ("jit", "pjit") and rb in (None, "jax", "jax.experimental.pjit"):
+        return True
+    if attr == "shard_map":
+        return True
+    return False
+
+
+def _is_trace_call(node: ast.Call, imports: dict) -> bool:
+    """True for transforms whose first argument becomes traced code:
+    jit/shard_map plus jax.grad/value_and_grad/vmap/pmap/remat/checkpoint."""
+    if _is_jit_call(node, imports):
+        return True
+    base, attr = _call_name(node)
+    rb = _alias_base(base, imports)
+    if rb == "jax" and attr in (
+        "grad", "value_and_grad", "vmap", "pmap", "remat", "checkpoint"
+    ):
+        return True
+    if base is None and attr == "value_and_grad":
+        return True
+    return False
+
+
+def _is_partial_jit(node: ast.Call, imports: dict) -> bool:
+    """functools.partial(jax.jit, ...) — the decorator spelling."""
+    base, attr = _call_name(node)
+    if attr != "partial" or _alias_base(base, imports) not in (
+        None, "functools"
+    ):
+        return False
+    return bool(
+        node.args
+        and isinstance(node.args[0], (ast.Attribute, ast.Name))
+        and _is_jit_call(
+            ast.Call(func=node.args[0], args=[], keywords=[]), imports
+        )
+    )
+
+
+def _const_only(node: ast.AST) -> bool:
+    """True when the expression is a constant / tuple-list of constants —
+    a hashable, data-independent static_argnums value."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_const_only(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _const_only(node.operand)
+    return False
+
+
+def _enclosing(node: ast.AST, kinds, stop_at_def: bool = True):
+    """Nearest ancestor of one of ``kinds``, not crossing def boundaries."""
+    n = parent(node)
+    while n is not None:
+        if isinstance(n, kinds):
+            return n
+        if stop_at_def and isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return None
+        n = parent(n)
+    return None
+
+
+class RecompilationHazard(Rule):
+    ID = "RL102"
+    TITLE = "jax recompilation hazard"
+
+    def check(self, ctx: FileCtx) -> list[Finding]:
+        findings = []
+        imports, _ = _collect_imports(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_jit_call(
+                node, imports
+            ):
+                continue
+            base, attr = _call_name(node)
+            what = f"{base + '.' if base else ''}{attr}"
+            loop = _enclosing(
+                node, (ast.For, ast.While, ast.AsyncFor)
+            )
+            if loop is not None:
+                findings.append(
+                    Finding(
+                        self.ID,
+                        ctx.relpath,
+                        node.lineno,
+                        f"{what}(...) constructed inside a loop — every "
+                        "iteration builds a fresh wrapper and retraces/"
+                        "recompiles; hoist the jit out of the loop (or "
+                        "cache it keyed on the static config)",
+                    )
+                )
+            p = parent(node)
+            if isinstance(p, ast.Call) and p.func is node:
+                findings.append(
+                    Finding(
+                        self.ID,
+                        ctx.relpath,
+                        node.lineno,
+                        f"{what}(fn)(...) wrapped-and-immediately-called — "
+                        "the jit cache dies with the wrapper, so every "
+                        "invocation retraces AND recompiles; bind the "
+                        "jitted callable once and reuse it",
+                    )
+                )
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") and (
+                    not _const_only(kw.value)
+                ):
+                    findings.append(
+                        Finding(
+                            self.ID,
+                            ctx.relpath,
+                            kw.value.lineno,
+                            f"data-dependent {kw.arg} ({ast.unparse(kw.value)}) "
+                            "— static args must be compile-time constants; "
+                            "a value that varies per call means a silent "
+                            "recompile per distinct value (or an unhashable-"
+                            "type error at dispatch)",
+                        )
+                    )
+        return findings
+
+
+def _target_token(node: ast.AST) -> Optional[str]:
+    """'x' for Name, 'self.x' for self-attributes — the donated-arg
+    identity RL103 tracks."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return f"self.{node.attr}"
+    return None
+
+
+class DonationHygiene(Rule):
+    ID = "RL103"
+    TITLE = "jit donation hygiene"
+
+    def check(self, ctx: FileCtx) -> list[Finding]:
+        findings = []
+        imports, _ = _collect_imports(ctx.tree)
+        # 1) Which bound names carry donation? token -> set of donated
+        #    positional indices (constant donate_argnums only).
+        donate_bound: dict[str, tuple] = {}
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)
+                and _is_jit_call(node.value, imports)
+            ):
+                continue
+            token = _target_token(node.targets[0])
+            if token is None:
+                continue
+            for kw in node.value.keywords:
+                if kw.arg == "donate_argnums" and _const_only(kw.value):
+                    positions = tuple(
+                        e.value
+                        for e in (
+                            kw.value.elts
+                            if isinstance(kw.value, (ast.Tuple, ast.List))
+                            else [kw.value]
+                        )
+                        if isinstance(e, ast.Constant)
+                    )
+                    if positions:
+                        donate_bound[token] = positions
+        # 2) Advisory: step-shaped jit with no donation at all.
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _is_jit_call(node, imports)
+                and node.args
+            ):
+                continue
+            fn_name = None
+            a0 = node.args[0]
+            if isinstance(a0, ast.Name):
+                fn_name = a0.id
+            elif isinstance(a0, ast.Attribute):
+                fn_name = a0.attr
+            if (
+                fn_name
+                and _STEP_SHAPED.search(fn_name)
+                and not any(
+                    kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in node.keywords
+                )
+            ):
+                findings.append(
+                    Finding(
+                        self.ID,
+                        ctx.relpath,
+                        node.lineno,
+                        f"step-shaped jit of `{fn_name}` without donation — "
+                        "donating the state argument(s) lets XLA alias "
+                        "input/output buffers (halves HBM for the state); "
+                        "donate, or pragma-document why not (e.g. CPU "
+                        "harness: donated inputs block dispatch)",
+                        advisory=True,
+                    )
+                )
+        if not donate_bound:
+            return findings
+        # 3) Donated arg read after the jitted call, inside each function.
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            findings.extend(self._use_after_donate(ctx, fn, donate_bound))
+        return findings
+
+    def _use_after_donate(
+        self, ctx: FileCtx, fn: ast.AST, donate_bound: dict
+    ) -> list[Finding]:
+        findings = []
+        loads: dict[str, list] = {}
+        stores: dict[str, list] = {}
+        for n in ast.walk(fn):
+            tok = _target_token(n)
+            if tok is None:
+                continue
+            c = getattr(n, "ctx", None)
+            if isinstance(c, ast.Store):
+                stores.setdefault(tok, []).append(n.lineno)
+            elif isinstance(c, ast.Load):
+                loads.setdefault(tok, []).append(n.lineno)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            ftok = _target_token(node.func)
+            if ftok not in donate_bound:
+                continue
+            for pos in donate_bound[ftok]:
+                if pos >= len(node.args):
+                    continue
+                tok = _target_token(node.args[pos])
+                if tok is None:
+                    continue
+                call_line = node.lineno
+                # A multi-line call puts its own argument loads on lines
+                # past lineno; only loads past the call's FULL span are
+                # use-after-donate.
+                call_end = getattr(node, "end_lineno", node.lineno)
+                later_stores = sorted(
+                    ln for ln in stores.get(tok, []) if ln >= call_line
+                )
+                kill = later_stores[0] if later_stores else None
+                bad = [
+                    ln
+                    for ln in loads.get(tok, [])
+                    if ln > call_end and (kill is None or ln < kill)
+                ]
+                # Loop bodies: a donated arg that is never re-bound in the
+                # loop is stale on the next iteration even if the load line
+                # precedes the call line.
+                loop = _enclosing(node, (ast.For, ast.While, ast.AsyncFor))
+                if loop is not None and not any(
+                    loop.lineno <= ln <= max(
+                        getattr(loop, "end_lineno", loop.lineno),
+                        loop.lineno,
+                    )
+                    for ln in stores.get(tok, [])
+                ):
+                    bad.extend(
+                        ln
+                        for ln in loads.get(tok, [])
+                        if loop.lineno <= ln <= getattr(
+                            loop, "end_lineno", loop.lineno
+                        )
+                        and not (call_line <= ln <= call_end)
+                    )
+                for ln in sorted(set(bad)):
+                    findings.append(
+                        Finding(
+                            self.ID,
+                            ctx.relpath,
+                            ln,
+                            f"`{tok}` is donated to `{ftok}` (donate_argnums "
+                            f"position {pos}, call at line {call_line}) and "
+                            "read afterwards — a donated buffer is "
+                            "invalidated by the call; rebind the result or "
+                            "drop the donation",
+                        )
+                    )
+        return findings
+
+
+class CollectiveOrder(Rule):
+    ID = "RL104"
+    TITLE = "collective op under rank-conditional branch"
+
+    def _in_scope(self, relpath: str) -> bool:
+        rel = relpath.replace(os.sep, "/")
+        return rel.startswith(_RL104_PATHS) or rel in _RL104_FILES
+
+    def check(self, ctx: FileCtx) -> list[Finding]:
+        if not self._in_scope(ctx.relpath):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            _base, attr = _call_name(node)
+            if attr not in _COLLECTIVE_OPS:
+                continue
+            cond = self._rankish_if(node)
+            if cond is not None:
+                findings.append(
+                    Finding(
+                        self.ID,
+                        ctx.relpath,
+                        node.lineno,
+                        f"collective `{attr}` under the rank-/slice-"
+                        f"conditional branch at line {cond.lineno} "
+                        f"(`{ast.unparse(cond.test)[:60]}`) — ranks taking "
+                        "different branches issue different collective "
+                        "sequences and the group hangs; hoist the "
+                        "collective out of the branch or pragma-document "
+                        "the by-construction uniformity",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _rankish_if(node: ast.AST):
+        """Nearest enclosing rank-conditional If/IfExp, else None."""
+        n = parent(node)
+        while n is not None:
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return None
+            if isinstance(n, (ast.If, ast.IfExp)):
+                for t in ast.walk(n.test):
+                    name = None
+                    if isinstance(t, ast.Name):
+                        name = t.id
+                    elif isinstance(t, ast.Attribute):
+                        name = t.attr
+                    if name and any(k in name.lower() for k in _RANKISH):
+                        # If and IfExp both diverge: `allreduce(g) if
+                        # rank == 0 else g` hangs ranks != 0 just the same.
+                        return n
+            n = parent(n)
+        return None
+
+
+# ==== facts layer ============================================================
+# Everything the cross-file rules need, extracted once per file and
+# serialized to .raylint_cache keyed on content hash: per-file findings,
+# pragmas, env reads, handlers, the call graph (functions + call
+# descriptors + jit bindings + traced roots), and the lock facts
+# (definitions + acquisition regions).
+
+
+def _module_dotted(relpath: str) -> str:
+    rel = relpath.replace(os.sep, "/")
+    if rel.endswith("/__init__.py"):
+        rel = rel[: -len("/__init__.py")]
+    elif rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+def _resolve_relative(mod: str, own_module: str, is_pkg_init: bool) -> str:
+    """Turn a '.'-prefixed from-import module into a dotted absolute."""
+    if not mod.startswith("."):
+        return mod
+    level = len(mod) - len(mod.lstrip("."))
+    rest = mod.lstrip(".")
+    parts = own_module.split(".")
+    # level 1 = own package; __init__ modules ARE their package.
+    keep = len(parts) - (level - 1 if is_pkg_init else level)
+    base = parts[:max(keep, 0)]
+    return ".".join(base + ([rest] if rest else []))
+
+
+def _expr_desc(e: ast.AST) -> Optional[list]:
+    """Call/lock descriptor for an expression:
+    ["name", n] | ["selfattr", a] | ["modattr", base, a] |
+    ["objattr", selfattr, a] (self.X.a)."""
+    if isinstance(e, ast.Name):
+        return ["name", e.id]
+    if isinstance(e, ast.Attribute):
+        v = e.value
+        if isinstance(v, ast.Name):
+            if v.id in ("self", "cls"):
+                return ["selfattr", e.attr]
+            return ["modattr", v.id, e.attr]
+        if (
+            isinstance(v, ast.Attribute)
+            and isinstance(v.value, ast.Name)
+            and v.value.id in ("self", "cls")
+        ):
+            return ["objattr", v.attr, e.attr]
+    return None
+
+
+class _FactsWalker(ast.NodeVisitor):
+    """One pass over a file's AST collecting the cross-file facts."""
+
+    def __init__(self, ctx: FileCtx, module: str):
+        self.ctx = ctx
+        self.module = module
+        self.imports, raw_from = _collect_imports(ctx.tree)
+        is_init = ctx.relpath.replace(os.sep, "/").endswith("__init__.py")
+        self.from_imports = {
+            name: [_resolve_relative(mod, module, is_init), attr]
+            for name, (mod, attr) in raw_from.items()
+        }
+        self.functions: dict[str, dict] = {}
+        self.classes: dict[str, dict] = {}
+        self.module_locks: dict[str, str] = {}
+        self.module_jit: list[str] = []
+        self.traced: list[dict] = []
+        self.handlers: list[str] = []
+        self.env_reads: list[list] = []
+        self._scope: list[str] = []       # qualname parts
+        self._fstack: list[dict] = []     # function recs
+        self._cstack: list[str] = []      # class names
+        self._wstack: list[dict] = []     # active lock regions
+
+    # -- helpers -------------------------------------------------------------
+
+    def _qual(self) -> str:
+        return ".".join(self._scope)
+
+    def _cur_class(self) -> Optional[str]:
+        return self._cstack[-1] if self._cstack else None
+
+    def _class_rec(self, name: str) -> dict:
+        return self.classes.setdefault(
+            name, {"bases": [], "itypes": {}, "locks": {}, "jit_attrs": []}
+        )
+
+    def _record_traced(self, desc: list) -> None:
+        self.traced.append(
+            {
+                "desc": desc,
+                "cls": self._cur_class(),
+                "scope": self._qual() or None,
+            }
+        )
+
+    def _maybe_traced_target(self, call: ast.Call) -> None:
+        if not call.args:
+            return
+        desc = _expr_desc(call.args[0])
+        if desc is not None:
+            self._record_traced(desc)
+
+    # -- scopes --------------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        rec = self._class_rec(node.name)
+        for b in node.bases:
+            d = _expr_desc(b)
+            if d is not None:
+                rec["bases"].append(d)
+        self._scope.append(node.name)
+        self._cstack.append(node.name)
+        self.generic_visit(node)
+        self._cstack.pop()
+        self._scope.pop()
+
+    def _visit_funcdef(self, node) -> None:
+        if node.name.startswith("_h_"):
+            self.handlers.append(node.name)
+        self._scope.append(node.name)
+        qual = self._qual()
+        rec = {
+            "qual": qual,
+            "cls": self._cur_class(),
+            "line": node.lineno,
+            "calls": [],
+            "sync": [],
+            "scalar": [],
+            "jit_local": [],
+            "regions": [],
+        }
+        # A nested def is conservatively assumed callable from its
+        # encloser (closure creation sits on the encloser's path).
+        if self._fstack:
+            self._fstack[-1]["calls"].append([["nested", qual], node.lineno])
+        self.functions[qual] = rec
+        # jit-ish decorators make the def traced AND jit-bound.
+        for dec in node.decorator_list:
+            traced = False
+            if isinstance(dec, (ast.Attribute, ast.Name)):
+                probe = ast.Call(func=dec, args=[], keywords=[])
+                traced = _is_jit_call(probe, self.imports)
+            elif isinstance(dec, ast.Call):
+                traced = _is_jit_call(dec, self.imports) or _is_partial_jit(
+                    dec, self.imports
+                )
+            if traced:
+                self._record_traced(
+                    ["name", node.name]
+                    if not self._cur_class()
+                    else ["selfattr", node.name]
+                )
+                if self._cur_class():
+                    self._class_rec(self._cur_class())["jit_attrs"].append(
+                        node.name
+                    )
+                else:
+                    self.module_jit.append(node.name)
+        self._fstack.append(rec)
+        saved_w, self._wstack = self._wstack, []
+        self.generic_visit(node)
+        self._wstack = saved_w
+        self._fstack.pop()
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_funcdef
+    visit_AsyncFunctionDef = _visit_funcdef
+
+    # -- statements ----------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1:
+            self._handle_binding(node.targets[0], node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        # `self._lock: threading.Lock = threading.Lock()` — annotated
+        # definitions bind locks/jits exactly like plain assignments.
+        if node.value is not None:
+            self._handle_binding(node.target, node.value)
+        self.generic_visit(node)
+
+    def _handle_binding(self, target: ast.AST, value: ast.AST) -> None:
+        if isinstance(value, ast.Call):
+            tdesc = _expr_desc(target)
+            vb, va = _call_name(value)
+            rvb = _alias_base(vb, self.imports)
+            if tdesc is not None:
+                # lock definitions
+                if rvb in (None, "threading") and va in ("Lock", "RLock"):
+                    if tdesc[0] == "selfattr" and self._cur_class():
+                        self._class_rec(self._cur_class())["locks"][
+                            tdesc[1]
+                        ] = va
+                    elif tdesc[0] == "name" and not self._fstack:
+                        self.module_locks[tdesc[1]] = va
+                # jit bindings
+                if _is_jit_call(value, self.imports):
+                    if tdesc[0] == "selfattr" and self._cur_class():
+                        self._class_rec(self._cur_class())[
+                            "jit_attrs"
+                        ].append(tdesc[1])
+                    elif tdesc[0] == "name":
+                        if self._fstack:
+                            self._fstack[-1]["jit_local"].append(tdesc[1])
+                        else:
+                            self.module_jit.append(tdesc[1])
+                # instance typing: self.X = ClassName(...) / mod.Class(...)
+                if (
+                    tdesc[0] == "selfattr"
+                    and self._cur_class()
+                    and va
+                    and va[:1].isupper()
+                ):
+                    vdesc = _expr_desc(value.func)
+                    if vdesc is not None:
+                        self._class_rec(self._cur_class())["itypes"][
+                            tdesc[1]
+                        ] = vdesc
+
+    def visit_With(self, node: ast.With) -> None:
+        self._visit_with(node)
+
+    def _visit_with(self, node) -> None:
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        regions = []
+        for item in node.items:
+            d = _expr_desc(item.context_expr)
+            if d is None or not self._fstack:
+                continue
+            region = {"lock": d, "line": node.lineno, "calls": [], "locks": []}
+            for outer in self._wstack:
+                outer["locks"].append([d, node.lineno])
+            self._fstack[-1]["regions"].append(region)
+            self._wstack.append(region)
+            regions.append(region)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in regions:
+            self._wstack.pop()
+
+    # -- expressions ---------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        key, line = _env_read(node)
+        if key is not None:
+            self.env_reads.append([key, line])
+        base, attr = _call_name(node)
+        rb = _alias_base(base, self.imports)
+        if _is_trace_call(node, self.imports):
+            self._maybe_traced_target(node)
+        if self._fstack:
+            rec = self._fstack[-1]
+            desc = _expr_desc(node.func)
+            if desc is not None:
+                rec["calls"].append([desc, node.lineno])
+                for region in self._wstack:
+                    region["calls"].append([desc, node.lineno])
+                # Explicit .acquire() counts as an acquisition event.
+                if desc[0] in ("selfattr", "modattr", "objattr") and (
+                    node.func.attr == "acquire"
+                    if isinstance(node.func, ast.Attribute)
+                    else False
+                ):
+                    inner = _expr_desc(node.func.value)
+                    if inner is not None:
+                        for outer in self._wstack:
+                            outer["locks"].append([inner, node.lineno])
+            # RL101 sync-site candidates.
+            sync = None
+            if attr == "device_get" and (
+                rb == "jax"
+                or (
+                    base is None
+                    and self.from_imports.get("device_get", [None])[0]
+                    == "jax"
+                )
+            ):
+                sync = ["device_get", node.lineno,
+                        "jax.device_get forces device->host readback"]
+            elif attr == "asarray" and rb == "numpy":
+                sync = ["np_asarray", node.lineno,
+                        "np.asarray forces device->host readback of a "
+                        "device-resident value"]
+            elif attr == "block_until_ready" and isinstance(
+                node.func, ast.Attribute
+            ):
+                sync = ["block_until_ready", node.lineno,
+                        ".block_until_ready() blocks the host on device "
+                        "completion"]
+            elif (
+                attr == "item"
+                and isinstance(node.func, ast.Attribute)
+                and not node.args
+                and not node.keywords
+            ):
+                sync = ["item", node.lineno,
+                        ".item() forces device->host readback of a scalar"]
+            if sync is not None:
+                rec["sync"].append(sync)
+            if (
+                base is None
+                and attr in ("float", "int", "bool")
+                and len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                rec["scalar"].append([node.lineno, attr])
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        key, line = _env_read(node)
+        if key is not None:
+            self.env_reads.append([key, line])
+        self.generic_visit(node)
+
+
+def _config_registry_from_tree(tree: ast.AST) -> dict:
+    """Knob fields / bootstrap env vars / lines, parsed statically from a
+    core/config.py AST — raylint never imports the tree."""
+    knobs: list[str] = []
+    bootstrap: list[str] = []
+    lines: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    knobs.append(stmt.target.id)
+                    lines[stmt.target.id] = stmt.lineno
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "BOOTSTRAP_ENV_VARS"
+        ):
+            lines["__bootstrap__"] = node.lineno
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    bootstrap.append(c.value)
+    return {"knobs": knobs, "bootstrap": bootstrap, "lines": lines}
+
+
+def _allowlists_from_tree(tree: ast.AST) -> list:
+    out = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id in RpcContract.ALLOWLISTS
+        ):
+            continue
+        listname = node.targets[0].id
+        for c in ast.walk(node.value):
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                out.append([listname, c.value, c.lineno])
+    return out
+
+
+def extract_facts(ctx: FileCtx) -> dict:
+    """All per-file analysis results, as one JSON-serializable dict."""
+    module = _module_dotted(ctx.relpath)
+    walker = _FactsWalker(ctx, module)
+    walker.visit(ctx.tree)
+    findings: list[Finding] = []
+    for rule in ALL_RULES:
+        findings.extend(rule.check(ctx))
+    rel = ctx.relpath.replace(os.sep, "/")
+    facts = {
+        "version": SCHEMA_VERSION,
+        "relpath": ctx.relpath,
+        "module": module,
+        "pragmas": {str(k): v for k, v in ctx.pragmas.items()},
+        "pragma_errors": [f.to_json() for f in ctx.pragma_errors],
+        "findings": [f.to_json() for f in findings],
+        "env_reads": walker.env_reads,
+        "handlers": walker.handlers,
+        "imports": walker.imports,
+        "from_imports": walker.from_imports,
+        "functions": walker.functions,
+        "classes": walker.classes,
+        "module_locks": walker.module_locks,
+        "module_jit": walker.module_jit,
+        "traced": walker.traced,
+        "config": (
+            _config_registry_from_tree(ctx.tree)
+            if rel.endswith("core/config.py")
+            else None
+        ),
+        "allowlists": (
+            _allowlists_from_tree(ctx.tree)
+            if rel.endswith("core/protocol.py")
+            else None
+        ),
+    }
+    return facts
+
+
+# ==== cross-file analyses ====================================================
+
+
+class _Resolver:
+    """Name resolution over the facts layer: call descriptors ->
+    (relpath, qualname) function nodes, lock descriptors -> lock ids."""
+
+    _MAX_HOPS = 4  # from-import re-export chains (__init__ hops)
+
+    def __init__(self, tree: "TreeCtx"):
+        self.tree = tree
+        self.by_module: dict[str, dict] = {}
+        for facts in tree.facts.values():
+            self.by_module[facts["module"]] = facts
+        # lock id -> kind ("Lock"/"RLock")
+        self.lock_defs: dict[str, str] = {}
+        for facts in tree.facts.values():
+            rel = facts["relpath"]
+            for name, kind in facts["module_locks"].items():
+                self.lock_defs[f"{rel}::{name}"] = kind
+            for cls, crec in facts["classes"].items():
+                for attr, kind in crec["locks"].items():
+                    self.lock_defs[f"{rel}::{cls}.{attr}"] = kind
+
+    # -- function resolution -------------------------------------------------
+
+    def rec(self, nid: tuple) -> Optional[dict]:
+        facts = self.tree.facts.get(nid[0])
+        return facts["functions"].get(nid[1]) if facts else None
+
+    def module_func(self, dotted: str, name: str, hops: int = 0):
+        facts = self.by_module.get(dotted)
+        if facts is None or hops > self._MAX_HOPS:
+            return None
+        if name in facts["functions"] and "." not in name:
+            return (facts["relpath"], name)
+        fi = facts["from_imports"].get(name)
+        if fi is not None:
+            return self.module_func(fi[0], fi[1], hops + 1)
+        return None
+
+    def find_class(self, dotted: str, name: str, hops: int = 0):
+        facts = self.by_module.get(dotted)
+        if facts is None or hops > self._MAX_HOPS:
+            return None
+        if name in facts["classes"]:
+            return (facts["module"], name)
+        fi = facts["from_imports"].get(name)
+        if fi is not None:
+            return self.find_class(fi[0], fi[1], hops + 1)
+        return None
+
+    def _class_desc(self, facts: dict, desc: list):
+        """Resolve a class-reference descriptor to (module, class)."""
+        if desc[0] == "name":
+            return self.find_class(facts["module"], desc[1])
+        if desc[0] == "modattr":
+            dotted = facts["imports"].get(desc[1])
+            if dotted is None:
+                fi = facts["from_imports"].get(desc[1])
+                if fi is not None:
+                    dotted = f"{fi[0]}.{fi[1]}"
+            if dotted is not None:
+                return self.find_class(dotted, desc[2])
+        return None
+
+    def method_on_class(
+        self, module: str, cls: str, attr: str, depth: int = 0
+    ):
+        if depth > self._MAX_HOPS:
+            return None
+        facts = self.by_module.get(module)
+        if facts is None:
+            return None
+        crec = facts["classes"].get(cls)
+        if crec is None:
+            return None
+        qual = f"{cls}.{attr}"
+        if qual in facts["functions"]:
+            return (facts["relpath"], qual)
+        for bdesc in crec["bases"]:
+            owner = self._class_desc(facts, bdesc)
+            if owner is not None:
+                hit = self.method_on_class(
+                    owner[0], owner[1], attr, depth + 1
+                )
+                if hit is not None:
+                    return hit
+        return None
+
+    def resolve_call(
+        self, facts: dict, caller_qual: Optional[str],
+        caller_cls: Optional[str], desc: list,
+    ) -> list:
+        kind = desc[0]
+        if kind == "nested":
+            return [(facts["relpath"], desc[1])]
+        if kind == "name":
+            n = desc[1]
+            # enclosing-scope nested defs first, innermost out
+            if caller_qual:
+                parts = caller_qual.split(".")
+                for i in range(len(parts), 0, -1):
+                    q = ".".join(parts[:i] + [n])
+                    if q in facts["functions"]:
+                        return [(facts["relpath"], q)]
+            if n in facts["functions"] and "." not in n:
+                return [(facts["relpath"], n)]
+            fi = facts["from_imports"].get(n)
+            if fi is not None:
+                hit = self.module_func(fi[0], fi[1], 1)
+                return [hit] if hit else []
+            return []
+        if kind == "selfattr":
+            if caller_cls is None:
+                return []
+            hit = self.method_on_class(facts["module"], caller_cls, desc[1])
+            return [hit] if hit else []
+        if kind == "modattr":
+            m, a = desc[1], desc[2]
+            dotted = facts["imports"].get(m)
+            if dotted is None:
+                fi = facts["from_imports"].get(m)
+                if fi is not None:
+                    dotted = f"{fi[0]}.{fi[1]}"
+            if dotted is not None:
+                hit = self.module_func(dotted, a)
+                return [hit] if hit else []
+            return []
+        if kind == "objattr":
+            if caller_cls is None:
+                return []
+            crec = facts["classes"].get(caller_cls, {})
+            tdesc = crec.get("itypes", {}).get(desc[1])
+            if tdesc is None:
+                return []
+            owner = self._class_desc(facts, tdesc)
+            if owner is None:
+                return []
+            hit = self.method_on_class(owner[0], owner[1], desc[2])
+            return [hit] if hit else []
+        return []
+
+    # -- lock resolution -----------------------------------------------------
+
+    def resolve_lock(
+        self, facts: dict, caller_cls: Optional[str], desc: list
+    ) -> Optional[str]:
+        kind = desc[0]
+        if kind == "selfattr":
+            if caller_cls is None:
+                return None
+            return self._lock_on_class(
+                facts["module"], caller_cls, desc[1]
+            )
+        if kind == "name":
+            n = desc[1]
+            if n in facts["module_locks"]:
+                return f"{facts['relpath']}::{n}"
+            fi = facts["from_imports"].get(n)
+            if fi is not None:
+                other = self.by_module.get(fi[0])
+                if other and fi[1] in other["module_locks"]:
+                    return f"{other['relpath']}::{fi[1]}"
+            return None
+        if kind == "modattr":
+            m, a = desc[1], desc[2]
+            dotted = facts["imports"].get(m)
+            if dotted is not None:
+                other = self.by_module.get(dotted)
+                if other and a in other["module_locks"]:
+                    return f"{other['relpath']}::{a}"
+                return None
+            # `box._lock` where box is a local: unique same-module class
+            # holding a lock attribute of this name.
+            owners = [
+                cls
+                for cls, crec in facts["classes"].items()
+                if a in crec["locks"]
+            ]
+            if len(owners) == 1:
+                return f"{facts['relpath']}::{owners[0]}.{a}"
+            return None
+        if kind == "objattr":
+            if caller_cls is None:
+                return None
+            crec = facts["classes"].get(caller_cls, {})
+            tdesc = crec.get("itypes", {}).get(desc[1])
+            if tdesc is None:
+                return None
+            owner = self._class_desc(facts, tdesc)
+            if owner is None:
+                return None
+            return self._lock_on_class(owner[0], owner[1], desc[2])
+        return None
+
+    def _lock_on_class(
+        self, module: str, cls: str, attr: str, depth: int = 0
+    ) -> Optional[str]:
+        if depth > self._MAX_HOPS:
+            return None
+        facts = self.by_module.get(module)
+        if facts is None:
+            return None
+        crec = facts["classes"].get(cls)
+        if crec is None:
+            return None
+        if attr in crec["locks"]:
+            return f"{facts['relpath']}::{cls}.{attr}"
+        for bdesc in crec["bases"]:
+            owner = self._class_desc(facts, bdesc)
+            if owner is not None:
+                hit = self._lock_on_class(
+                    owner[0], owner[1], attr, depth + 1
+                )
+                if hit is not None:
+                    return hit
+        return None
+
+
+def _lock_short(lock_id: str) -> str:
+    rel, _, name = lock_id.partition("::")
+    return f"{os.path.basename(rel)}::{name}"
+
+
+class HostSyncInDeviceHot(Rule):
+    ID = "RL101"
+    TITLE = "host-device sync in device-hot code"
+
+    def finalize(self, tree: "TreeCtx") -> list[Finding]:
+        res = tree.resolver()
+        hot_roots: dict[tuple, str] = {}
+        traced_roots: dict[tuple, str] = {}
+        for rel in sorted(tree.facts):
+            facts = tree.facts[rel]
+            for t in facts["traced"]:
+                for nid in res.resolve_call(
+                    facts, t["scope"], t["cls"], t["desc"]
+                ):
+                    if res.rec(nid) is not None:
+                        traced_roots.setdefault(nid, "is passed to jit/shard_map")
+            for qual in sorted(facts["functions"]):
+                rec = facts["functions"][qual]
+                full = f"{facts['module']}.{qual}"
+                if full in DEVICE_HOT_ENTRYPOINTS:
+                    hot_roots.setdefault(
+                        (rel, qual), "is a registered device-hot entrypoint"
+                    )
+                    continue
+                cls_jit = set()
+                if rec["cls"]:
+                    cls_jit = set(
+                        facts["classes"].get(rec["cls"], {}).get(
+                            "jit_attrs", []
+                        )
+                    )
+                local_jit = set(rec["jit_local"]) | set(facts["module_jit"])
+                for cdesc, _line in rec["calls"]:
+                    if (
+                        cdesc[0] == "name" and cdesc[1] in local_jit
+                    ) or (cdesc[0] == "selfattr" and cdesc[1] in cls_jit):
+                        hot_roots.setdefault(
+                            (rel, qual), "dispatches a jitted callable"
+                        )
+                        break
+        hot, hot_parent = self._reach(tree, res, set(hot_roots))
+        traced, traced_parent = self._reach(tree, res, set(traced_roots))
+        findings = []
+        for rel in sorted(tree.facts):
+            facts = tree.facts[rel]
+            for qual in sorted(facts["functions"]):
+                nid = (rel, qual)
+                rec = facts["functions"][qual]
+                in_traced = nid in traced
+                in_hot = nid in hot
+                if not (in_hot or in_traced):
+                    continue
+                if in_traced:
+                    via = self._via(
+                        nid, traced_parent, traced_roots, "traced"
+                    )
+                else:
+                    via = self._via(nid, hot_parent, hot_roots, "device-hot")
+                for kind, line, detail in rec["sync"]:
+                    findings.append(
+                        Finding(
+                            self.ID,
+                            rel,
+                            line,
+                            f"{detail} in "
+                            f"{'traced' if in_traced else 'device-hot'} "
+                            f"`{qual}` ({via}) — move the readback off the "
+                            "step path, batch it at a flush point, or "
+                            "pragma-document the intended sync",
+                        )
+                    )
+                if in_traced:
+                    for line, name in rec["scalar"]:
+                        findings.append(
+                            Finding(
+                                self.ID,
+                                rel,
+                                line,
+                                f"{name}() on a traced value in `{qual}` "
+                                f"({via}) — concretizes at trace time "
+                                "(ConcretizationTypeError, or a silent "
+                                "host sync + retrace per value)",
+                            )
+                        )
+        return findings
+
+    @staticmethod
+    def _reach(tree, res, roots: set):
+        parentmap: dict[tuple, Optional[tuple]] = {r: None for r in roots}
+        stack = sorted(roots)
+        seen = set(roots)
+        while stack:
+            nid = stack.pop()
+            rec = res.rec(nid)
+            if rec is None:
+                continue
+            facts = tree.facts[nid[0]]
+            for cdesc, _line in rec["calls"]:
+                for callee in res.resolve_call(
+                    facts, rec["qual"], rec["cls"], cdesc
+                ):
+                    if callee not in seen and res.rec(callee) is not None:
+                        seen.add(callee)
+                        parentmap[callee] = nid
+                        stack.append(callee)
+        return seen, parentmap
+
+    @staticmethod
+    def _via(nid, parentmap, roots, label) -> str:
+        chain = []
+        cur = nid
+        while cur is not None and len(chain) < 6:
+            chain.append(cur)
+            if cur in roots:
+                break
+            cur = parentmap.get(cur)
+        root = chain[-1]
+        why = roots.get(root, "a device-hot root")
+        path = " <- ".join(q for _rel, q in chain)
+        return f"{label} via {path}; `{root[1]}` {why}"
+
+
+class LockOrderCycles(Rule):
+    ID = "RL105"
+    TITLE = "cross-file lock-order deadlock"
+
+    def finalize(self, tree: "TreeCtx") -> list[Finding]:
+        res = tree.resolver()
+        # lockset(fn) = every lock the function may acquire, itself or
+        # transitively; each lock carries one example witness chain.
+        # Results computed while a call-graph cycle member is on-stack are
+        # INCOMPLETE (the on-stack callee contributes {}); memoizing them
+        # would permanently drop lock edges, so only clean results are
+        # cached — tainted ones recompute per top-level query, which is
+        # correct because each fresh query sees the full subtree.
+        memo: dict[tuple, dict] = {}
+        onstack: set = set()
+
+        def lockset(nid: tuple) -> dict:
+            return _lockset(nid)[0]
+
+        def _lockset(nid: tuple) -> tuple:
+            if nid in memo:
+                return memo[nid], True
+            if nid in onstack:
+                return {}, False
+            rec = res.rec(nid)
+            if rec is None:
+                return {}, True
+            onstack.add(nid)
+            facts = tree.facts[nid[0]]
+            out: dict[str, list] = {}
+            clean = True
+            for region in rec["regions"]:
+                lid = res.resolve_lock(facts, rec["cls"], region["lock"])
+                if lid is not None and lid not in out:
+                    out[lid] = [
+                        f"{nid[0]}:{region['line']} `{rec['qual']}` takes "
+                        f"{_lock_short(lid)}"
+                    ]
+            for cdesc, cline in rec["calls"]:
+                for callee in res.resolve_call(
+                    facts, rec["qual"], rec["cls"], cdesc
+                ):
+                    sub, sub_clean = _lockset(callee)
+                    clean = clean and sub_clean
+                    for lid, chain in sub.items():
+                        if lid not in out:
+                            out[lid] = [
+                                f"{nid[0]}:{cline} `{rec['qual']}` -> "
+                                f"`{callee[1]}`"
+                            ] + chain
+            onstack.discard(nid)
+            if clean:
+                memo[nid] = out
+            return out, clean
+
+        # Edges: lock M acquired (directly or through a call) while L held.
+        edges: dict[tuple, dict] = {}
+
+        def add_edge(L, M, site, chain):
+            key = (L, M)
+            if key not in edges:
+                edges[key] = {"site": site, "chain": chain}
+
+        findings: list[Finding] = []
+        nodes_acquired: set = set()
+        for rel in sorted(tree.facts):
+            facts = tree.facts[rel]
+            for qual in sorted(facts["functions"]):
+                rec = facts["functions"][qual]
+                for region in rec["regions"]:
+                    L = res.resolve_lock(facts, rec["cls"], region["lock"])
+                    if L is None:
+                        continue
+                    nodes_acquired.add(L)
+                    owner_rel = L.partition("::")[0]
+                    if owner_rel != rel:
+                        findings.append(
+                            Finding(
+                                self.ID,
+                                rel,
+                                region["line"],
+                                f"foreign lock {_lock_short(L)} (defined in "
+                                f"{owner_rel}) acquired directly from "
+                                f"`{qual}` — a private lock taken outside "
+                                "its owning component makes lock order "
+                                "impossible to reason about locally (the "
+                                "deadlock-cycle precondition); add an "
+                                "owner-side method that takes its own lock",
+                            )
+                        )
+                    for mdesc, mline in region["locks"]:
+                        M = res.resolve_lock(facts, rec["cls"], mdesc)
+                        if M is None:
+                            continue
+                        nodes_acquired.add(M)
+                        add_edge(
+                            L, M, (rel, mline),
+                            [
+                                f"{rel}:{mline} `{qual}` takes "
+                                f"{_lock_short(M)} while holding "
+                                f"{_lock_short(L)}"
+                            ],
+                        )
+                    for cdesc, cline in region["calls"]:
+                        for callee in res.resolve_call(
+                            facts, qual, rec["cls"], cdesc
+                        ):
+                            for M, chain in lockset(callee).items():
+                                nodes_acquired.add(M)
+                                add_edge(
+                                    L, M, (rel, cline),
+                                    [
+                                        f"{rel}:{cline} `{qual}` (holding "
+                                        f"{_lock_short(L)}) -> "
+                                        f"`{callee[1]}`"
+                                    ] + chain,
+                                )
+        # Self-deadlock: a non-reentrant Lock re-acquired while held.
+        n_cycles = 0
+        for (L, M), info in sorted(edges.items()):
+            if L == M and res.lock_defs.get(L) == "Lock":
+                n_cycles += 1
+                findings.append(
+                    Finding(
+                        self.ID,
+                        info["site"][0],
+                        info["site"][1],
+                        f"non-reentrant Lock {_lock_short(L)} acquired "
+                        "while already held — same-instance re-entry "
+                        "self-deadlocks (and cross-instance nesting of one "
+                        "lock class has no defined order); witness: "
+                        + " ; ".join(info["chain"]),
+                    )
+                )
+        # AB/BA (and longer) cycles: SCCs of the lock digraph.
+        adj: dict[str, list] = {}
+        for (L, M) in edges:
+            if L != M:
+                adj.setdefault(L, []).append(M)
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            n_cycles += 1
+            cyc = self._concrete_cycle(scc, adj)
+            legs = []
+            for a, b in zip(cyc, cyc[1:]):
+                info = edges[(a, b)]
+                legs.append(
+                    f"{_lock_short(a)} -> {_lock_short(b)} "
+                    f"[{' ; '.join(info['chain'])}]"
+                )
+            site = edges[(cyc[0], cyc[1])]["site"]
+            findings.append(
+                Finding(
+                    self.ID,
+                    site[0],
+                    site[1],
+                    "lock-order cycle "
+                    + " -> ".join(_lock_short(x) for x in cyc)
+                    + " — threads taking these locks in opposite orders "
+                    "deadlock; establish one global order or release "
+                    "before calling across the boundary. Witness paths: "
+                    + " || ".join(legs),
+                )
+            )
+        tree.lock_graph = {
+            "nodes": len(nodes_acquired),
+            "edges": sum(1 for (L, M) in edges if L != M),
+            "cycles": n_cycles,
+        }
+        return findings
+
+    @staticmethod
+    def _concrete_cycle(scc: list, adj: dict) -> list:
+        """A concrete cycle path a -> ... -> a inside one SCC (BFS)."""
+        start = sorted(scc)[0]
+        sset = set(scc)
+        prev = {start: None}
+        queue = [start]
+        while queue:
+            cur = queue.pop(0)
+            for nxt in sorted(adj.get(cur, [])):
+                if nxt == start:
+                    seq = []
+                    node = cur
+                    while node is not None:
+                        seq.append(node)
+                        node = prev[node]
+                    seq.reverse()  # [start, ..., cur]
+                    return seq + [start]
+                if nxt in sset and nxt not in prev:
+                    prev[nxt] = cur
+                    queue.append(nxt)
+        return [start, start]
+
+
+def _sccs(adj: dict) -> list:
+    """Strongly connected components (iterative Tarjan)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on: set = set()
+    stack: list = []
+    out: list = []
+    counter = [0]
+    nodes = sorted(set(adj) | {m for ms in adj.values() for m in ms})
+
+    for root in nodes:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, []))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on.add(nxt)
+                    work.append((nxt, iter(sorted(adj.get(nxt, [])))))
+                    advanced = True
+                    break
+                elif nxt in on:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pnode = work[-1][0]
+                low[pnode] = min(low[pnode], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out
+
+
 ALL_RULES: list[Rule] = [
     BlockingInAsync(),
     LockAcrossAwait(),
@@ -633,6 +2120,11 @@ ALL_RULES: list[Rule] = [
     EnvVarHygiene(),
     RpcContract(),
     SilentExcept(),
+    HostSyncInDeviceHot(),
+    RecompilationHazard(),
+    DonationHygiene(),
+    CollectiveOrder(),
+    LockOrderCycles(),
 ]
 RULE_IDS = frozenset(r.ID for r in ALL_RULES) | {"RL000"}
 
@@ -640,22 +2132,135 @@ RULE_IDS = frozenset(r.ID for r in ALL_RULES) | {"RL000"}
 # -- tree driver --------------------------------------------------------------
 
 
-class TreeCtx:
-    """Whole-tree context: parsed files + cross-file registries."""
+def _tool_salt() -> str:
+    """Hash of this file's own source: editing any rule invalidates every
+    cache entry without manual version bumps."""
+    try:
+        with open(os.path.abspath(__file__), "rb") as f:
+            src = f.read()
+    except OSError:
+        src = b""
+    return hashlib.sha256(SCHEMA_VERSION.encode() + src).hexdigest()[:16]
 
-    def __init__(self, repo_root: str, scan_root: Optional[str] = None):
+
+class FactsCache:
+    """Content-addressed per-file facts under <repo>/.raylint_cache/."""
+
+    def __init__(self, repo_root: str, enabled: bool = True):
+        self.salt = _tool_salt()
+        self.root = os.path.join(repo_root, CACHE_DIRNAME)
+        # Entries live under a per-salt subdirectory: editing raylint
+        # itself re-keys EVERY entry, so the old generation is dead
+        # weight the moment the salt changes — prune() sweeps it.
+        self.dir = os.path.join(self.root, self.salt)
+        self.enabled = enabled
+        self.hits = 0
+        self.misses = 0
+        self._touched: set = set()
+
+    def key(self, relpath: str, source: str) -> str:
+        # relpath is part of the key: two files with identical content
+        # (empty __init__.py's) must not share an entry — facts embed the
+        # relpath, and module identity drives the cross-file analyses.
+        h = hashlib.sha256(self.salt.encode())
+        h.update(relpath.encode("utf-8", "surrogatepass"))
+        h.update(b"\x00")
+        h.update(source.encode("utf-8", "surrogatepass"))
+        return h.hexdigest()
+
+    def get(self, relpath: str, source: str) -> Optional[dict]:
+        if not self.enabled:
+            return None
+        name = self.key(relpath, source) + ".json"
+        self._touched.add(name)
+        path = os.path.join(self.dir, name)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                facts = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if (
+            facts.get("version") != SCHEMA_VERSION
+            or facts.get("relpath") != relpath
+        ):
+            return None
+        self.hits += 1
+        return facts
+
+    def put(self, relpath: str, source: str, facts: dict) -> None:
+        if not self.enabled:
+            return
+        self.misses += 1
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            name = self.key(relpath, source) + ".json"
+            self._touched.add(name)
+            path = os.path.join(self.dir, name)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(facts, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # cache is best-effort; lint result is unaffected
+
+    def prune(self) -> None:
+        """Drop entries this run did not touch (superseded file versions)
+        and every other-salt generation — a full-tree run touches exactly
+        the live tree's entries, so the cache never outgrows the tree."""
+        if not self.enabled:
+            return
+        try:
+            for entry in os.listdir(self.root):
+                full = os.path.join(self.root, entry)
+                if entry != self.salt and os.path.isdir(full):
+                    for fn in os.listdir(full):
+                        try:
+                            os.unlink(os.path.join(full, fn))
+                        except OSError:
+                            pass
+                    try:
+                        os.rmdir(full)
+                    except OSError:
+                        pass
+            if os.path.isdir(self.dir):
+                for fn in os.listdir(self.dir):
+                    stale = fn not in self._touched  # superseded version
+                    if not fn.endswith(".json"):
+                        stale = True  # .tmp<pid> orphan of a killed put()
+                    if stale:
+                        try:
+                            os.unlink(os.path.join(self.dir, fn))
+                        except OSError:
+                            pass
+        except OSError:
+            pass
+
+
+class TreeCtx:
+    """Whole-tree context: the per-file facts + cross-file registries."""
+
+    def __init__(
+        self,
+        repo_root: Optional[str],
+        scan_root: Optional[str] = None,
+        use_cache: bool = True,
+        facts_map: Optional[dict] = None,
+    ):
         self.repo_root = repo_root
+        self.facts: dict[str, dict] = {}
+        self.lock_graph: Optional[dict] = None  # set by RL105.finalize
+        self.cache: Optional[FactsCache] = None
+        self._resolver: Optional[_Resolver] = None
+        if facts_map is not None:
+            self.facts = facts_map
+            return
         self.scan_root = scan_root or os.path.join(repo_root, "ray_tpu")
-        self.files: dict[str, FileCtx] = {}
-        # rule id -> findings parked by check() for finalize() resolution
-        self.pending: dict[str, list[Finding]] = {}
+        self.cache = FactsCache(repo_root, enabled=use_cache)
         self._load()
 
     def _load(self) -> None:
         for dirpath, dirnames, filenames in os.walk(self.scan_root):
-            dirnames[:] = [
-                d for d in dirnames if d != "__pycache__"
-            ]
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
             for fn in sorted(filenames):
                 if not fn.endswith(".py"):
                     continue
@@ -665,53 +2270,38 @@ class TreeCtx:
                 )
                 with open(path, "r", encoding="utf-8") as f:
                     src = f.read()
-                self.files[rel] = FileCtx(path, rel, src)
+                facts = self.cache.get(rel, src)
+                if facts is None:
+                    facts = extract_facts(FileCtx(path, rel, src))
+                    self.cache.put(rel, src, facts)
+                self.facts[rel] = facts
+        self.cache.prune()
 
-    def file(self, relpath: str) -> Optional[FileCtx]:
-        return self.files.get(relpath)
-
-    def handler_names(self) -> frozenset:
-        out = set()
-        for ctx in self.files.values():
-            for n in ast.walk(ctx.tree):
-                if isinstance(
-                    n, (ast.FunctionDef, ast.AsyncFunctionDef)
-                ) and n.name.startswith("_h_"):
-                    out.add(n.name)
-        return frozenset(out)
+    def resolver(self) -> "_Resolver":
+        """The cross-file name/lock resolver, built once per lint run and
+        shared by every finalize() pass (RL101 + RL105)."""
+        if self._resolver is None:
+            self._resolver = _Resolver(self)
+        return self._resolver
 
     def config_registry(self) -> tuple[set, set, dict]:
         """(knob field names, bootstrap env var names, field->line) parsed
         statically from core/config.py — raylint never imports the tree."""
-        knobs: set[str] = set()
-        bootstrap: set[str] = set()
-        lines: dict[str, int] = {}
-        cfg = self.file("ray_tpu/core/config.py")
-        if cfg is None:
-            return knobs, bootstrap, lines
-        for node in ast.walk(cfg.tree):
-            if isinstance(node, ast.ClassDef) and node.name == "Config":
-                for stmt in node.body:
-                    if isinstance(stmt, ast.AnnAssign) and isinstance(
-                        stmt.target, ast.Name
-                    ):
-                        knobs.add(stmt.target.id)
-                        lines[stmt.target.id] = stmt.lineno
-            if (
-                isinstance(node, ast.Assign)
-                and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and node.targets[0].id == "BOOTSTRAP_ENV_VARS"
-            ):
-                lines["__bootstrap__"] = node.lineno
-                for c in ast.walk(node.value):
-                    if isinstance(c, ast.Constant) and isinstance(
-                        c.value, str
-                    ):
-                        bootstrap.add(c.value)
-        return knobs, bootstrap, lines
+        cfg = self.facts.get("ray_tpu/core/config.py")
+        if cfg is None or cfg.get("config") is None:
+            return set(), set(), {}
+        reg = cfg["config"]
+        return set(reg["knobs"]), set(reg["bootstrap"]), dict(reg["lines"])
+
+    def handler_names(self) -> frozenset:
+        out = set()
+        for facts in self.facts.values():
+            out.update(facts["handlers"])
+        return frozenset(out)
 
     def readme_text(self) -> str:
+        if not self.repo_root:
+            return ""
         path = os.path.join(self.repo_root, "README.md")
         try:
             with open(path, "r", encoding="utf-8") as f:
@@ -720,66 +2310,83 @@ class TreeCtx:
             return ""
 
 
-def _apply_suppressions(
-    findings: list[Finding], files: dict[str, FileCtx]
-) -> None:
+def _apply_suppressions(findings: list, facts_map: dict) -> None:
+    tables: dict[str, dict] = {}
     for f in findings:
-        ctx = files.get(f.path)
-        if ctx is None:
+        facts = facts_map.get(f.path)
+        if facts is None:
             continue
-        reason = ctx.suppression_for(f.rule, f.line)
+        table = tables.get(f.path)
+        if table is None:
+            table = {int(k): v for k, v in facts["pragmas"].items()}
+            tables[f.path] = table
+        reason = _suppression_for(table, f.rule, f.line)
         if reason is not None:
             f.suppressed = True
             f.reason = reason
+
+
+def _run_rules(tree: TreeCtx, only: Optional[set]) -> list:
+    findings: list[Finding] = []
+    for rel in sorted(tree.facts):
+        facts = tree.facts[rel]
+        findings.extend(
+            Finding.from_json(d) for d in facts["pragma_errors"]
+        )
+        for d in facts["findings"]:
+            f = Finding.from_json(d)
+            if only is None or f.rule in only:
+                findings.append(f)
+    for rule in ALL_RULES:
+        if only is None or rule.ID in only:
+            findings.extend(rule.finalize(tree))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    _apply_suppressions(findings, tree.facts)
+    return findings
+
+
+def lint_tree_ex(
+    repo_root: str = REPO_ROOT,
+    scan_root: Optional[str] = None,
+    only: Optional[set] = None,
+    use_cache: bool = True,
+) -> tuple[list, dict]:
+    """Run the rule engine over the tree; returns (findings, meta) where
+    meta carries the lock-graph summary and cache telemetry."""
+    tree = TreeCtx(repo_root, scan_root, use_cache=use_cache)
+    findings = _run_rules(tree, only)
+    meta = {
+        "lock_graph": tree.lock_graph,
+        "cache": {
+            "hits": tree.cache.hits if tree.cache else 0,
+            "misses": tree.cache.misses if tree.cache else 0,
+        },
+    }
+    return findings, meta
 
 
 def lint_tree(
     repo_root: str = REPO_ROOT,
     scan_root: Optional[str] = None,
     only: Optional[set] = None,
-) -> list[Finding]:
-    """Run the rule engine over the tree; returns ALL findings (callers
-    filter on ``.suppressed``)."""
-    tree = TreeCtx(repo_root, scan_root)
-    rules = [r for r in ALL_RULES if only is None or r.ID in only]
-    findings: list[Finding] = []
-    for ctx in tree.files.values():
-        findings.extend(ctx.pragma_errors)
-        for rule in rules:
-            got = rule.check(ctx)
-            if isinstance(rule, EnvVarHygiene):
-                tree.pending.setdefault(rule.ID, []).extend(got)
-            else:
-                findings.extend(got)
-    for rule in rules:
-        findings.extend(rule.finalize(tree))
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    _apply_suppressions(findings, tree.files)
-    return findings
+    use_cache: bool = True,
+) -> list:
+    """Back-compat driver: findings only (callers filter ``.suppressed``)."""
+    return lint_tree_ex(repo_root, scan_root, only, use_cache)[0]
 
 
 def lint_text(
     source: str, relpath: str = "fixture.py", only: Optional[set] = None
-) -> list[Finding]:
-    """Lint a source snippet with the per-file rules (fixture test hook).
-    Cross-file resolution (RL004 registry, RL005 handlers) needs
-    ``lint_tree`` over a real tree."""
+) -> list:
+    """Lint a source snippet as a single-file tree (fixture test hook).
+    All rules run, including the cross-file analyses, against a tree
+    containing only this file — RL004 resolves against an empty registry
+    (every RAY_TPU_* read is unregistered), RL101 reachability and the
+    RL105 lock graph see just this file's call graph."""
     ctx = FileCtx("<fixture>", relpath, source)
-    rules = [r for r in ALL_RULES if only is None or r.ID in only]
-    findings = list(ctx.pragma_errors)
-    for rule in rules:
-        got = rule.check(ctx)
-        if isinstance(rule, EnvVarHygiene):
-            # Fixture mode: resolve against an empty registry — every
-            # RAY_TPU_* read is "unregistered".
-            for f in got:
-                f.message = f"read of unregistered env var {f.message}"
-            findings.extend(got)
-        else:
-            findings.extend(got)
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    _apply_suppressions(findings, {relpath: ctx})
-    return findings
+    facts = extract_facts(ctx)
+    tree = TreeCtx(None, facts_map={relpath: facts})
+    return _run_rules(tree, only)
 
 
 def summarize(findings: Iterable[Finding]) -> dict:
@@ -788,11 +2395,61 @@ def summarize(findings: Iterable[Finding]) -> dict:
         "total": len(fs),
         "suppressed": sum(1 for f in fs if f.suppressed),
         "unsuppressed": sum(1 for f in fs if not f.suppressed),
+        "advisory": sum(1 for f in fs if f.advisory),
         "by_rule": {
             rid: sum(1 for f in fs if f.rule == rid)
             for rid in sorted({f.rule for f in fs})
         },
     }
+
+
+def _gate_findings(findings: Iterable[Finding]) -> list:
+    """The findings that flip the exit code: unsuppressed, non-advisory."""
+    return [f for f in findings if not f.suppressed and not f.advisory]
+
+
+def _git_changed_files(repo_root: str) -> Optional[set]:
+    """Repo-relative paths changed vs HEAD (staged + unstaged + untracked);
+    None when git is unavailable."""
+    try:
+        changed = subprocess.run(
+            # --relative: paths relative to repo_root (not the git
+            # toplevel) and scoped to it — findings carry root-relative
+            # paths, and a vendored-subdir checkout must still match.
+            ["git", "-C", repo_root, "diff", "--relative", "--name-only",
+             "HEAD"],
+            capture_output=True, text=True, timeout=30,
+        )
+        untracked = subprocess.run(
+            ["git", "-C", repo_root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30,
+        )
+        if changed.returncode != 0 or untracked.returncode != 0:
+            return None
+        out = set()
+        for blob in (changed.stdout, untracked.stdout):
+            out.update(p.strip() for p in blob.splitlines() if p.strip())
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _expand_only(spec: str, ap: argparse.ArgumentParser) -> set:
+    only: set = set()
+    for tok in (t.strip() for t in spec.split(",")):
+        if not tok:
+            continue
+        if tok.lower() in RULE_GROUPS:
+            only |= RULE_GROUPS[tok.lower()]
+        elif tok in RULE_IDS:
+            only.add(tok)
+        else:
+            ap.error(
+                f"unknown rule id or group: {tok!r} "
+                f"(groups: {sorted(RULE_GROUPS)}, ids: RLxxx)"
+            )
+    return only
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -805,8 +2462,9 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument(
         "--only",
         default=None,
-        help="comma-separated rule ids (e.g. RL003,RL006), or 'metrics' "
-        "to run the metrics-catalog lint (tools/metrics_lint.py)",
+        help="comma-separated rule ids (e.g. RL003,RL006), a group "
+        "('jax' = RL101-RL104, 'locks' = RL105), or 'metrics' to run "
+        "the metrics-catalog lint (tools/metrics_lint.py)",
     )
     ap.add_argument(
         "--root",
@@ -818,6 +2476,17 @@ def main(argv: Optional[list] = None) -> int:
         action="store_true",
         help="also print suppressed findings",
     )
+    ap.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="report only findings in files changed vs git HEAD "
+        "(cross-file analysis still runs over the whole tree)",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="bypass the .raylint_cache/ per-file facts cache",
+    )
     args = ap.parse_args(argv)
 
     if args.only and args.only.strip().lower() == "metrics":
@@ -828,31 +2497,67 @@ def main(argv: Optional[list] = None) -> int:
 
         return metrics_lint.main()
 
-    only = None
-    if args.only:
-        only = {t.strip() for t in args.only.split(",") if t.strip()}
-        unknown = only - RULE_IDS
-        if unknown:
-            ap.error(f"unknown rule id(s): {sorted(unknown)}")
+    only = _expand_only(args.only, ap) if args.only else None
 
-    findings = lint_tree(repo_root=args.root, only=only)
-    counts = summarize(findings)
-    if args.json:
-        print(
-            json.dumps(
-                {**counts, "findings": [f.to_json() for f in findings]}
+    findings, meta = lint_tree_ex(
+        repo_root=args.root, only=only, use_cache=not args.no_cache
+    )
+    if args.changed_only:
+        changed = _git_changed_files(args.root)
+        if changed is None:
+            print(
+                "raylint: --changed-only needs git; reporting full tree",
+                file=sys.stderr,
             )
-        )
+        elif "tools/raylint.py" in changed:
+            # The tool itself changed: rule behavior may have shifted in
+            # EVERY file, so the changed-file filter would green-light
+            # findings full CI rejects. Report the whole tree.
+            print(
+                "raylint: tools/raylint.py changed; --changed-only "
+                "reporting the full tree",
+                file=sys.stderr,
+            )
+        else:
+            # Keep (a) findings in changed files and (b) UNSUPPRESSED
+            # findings from the cross-file rules wherever they anchor — a
+            # local edit can break RL004/RL005/RL101/RL105 invariants in a
+            # file you didn't touch (rename a handler, move a jit root),
+            # and hiding those would green-light a commit full CI rejects.
+            cross = {"RL004", "RL005", "RL101", "RL105"}
+            findings = [
+                f
+                for f in findings
+                if f.path in changed
+                or (not f.suppressed and f.rule in cross)
+            ]
+    counts = summarize(findings)
+    lg = meta["lock_graph"]  # None unless RL105 actually ran
+    if args.json:
+        payload = {
+            **counts,
+            "cache": meta["cache"],
+            "findings": [f.to_json() for f in findings],
+        }
+        if lg is not None:
+            payload["lock_graph"] = lg
+        print(json.dumps(payload))
     else:
         for f in findings:
             if f.suppressed and not args.show_suppressed:
                 continue
             print(f.format())
-        print(
+        summary = (
             f"raylint: {counts['unsuppressed']} unsuppressed, "
             f"{counts['suppressed']} suppressed finding(s)"
         )
-    return 1 if counts["unsuppressed"] else 0
+        if lg is not None:
+            summary += (
+                f"; lock graph {lg['nodes']} locks / {lg['edges']} edges"
+                f" / {lg['cycles']} cycle(s)"
+            )
+        print(summary)
+    return 1 if _gate_findings(findings) else 0
 
 
 if __name__ == "__main__":
