@@ -381,86 +381,38 @@ def _data_plane_rows() -> dict:
     return {}
 
 
-def _serve_llm_rows() -> dict:
-    """LLM-serving A/B record (round-12): aggregate tok/s + p99 TTFT with
-    prefix-affinity routing ON vs OFF (``--no-prefix-routing``), via
-    ``tools/ray_perf.py --quick --serve-llm-only``. CPU-only (tiny model,
-    a wedged TPU tunnel can't block it) and best-effort: any failure
-    returns {} so the headline one-JSON-line contract stands."""
+def _ab_rows(
+    label: str, base_flags: tuple, off_flags: tuple, timeout_s: int
+) -> dict:
+    """Shared ON/OFF A/B runner over ``tools/ray_perf.py --quick``: the
+    ON arm runs HEAD defaults, the OFF arm adds the kill-switch flags.
+    CPU-only (a wedged TPU tunnel can't block these rows), all-or-nothing
+    (a one-armed record would break round-over-round diffs), and
+    best-effort: any failure returns {} so the headline one-JSON-line
+    contract stands."""
     repo = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    out = {}
-    for arm, flags in (("on", ()), ("off", ("--no-prefix-routing",))):
+    out: dict = {}
+    for arm, flags in (("on", ()), ("off", off_flags)):
         try:
             r = subprocess.run(
                 [
                     sys.executable,
                     os.path.join(repo, "tools", "ray_perf.py"),
                     "--quick",
-                    "--serve-llm-only",
+                    *base_flags,
                     *flags,
                 ],
-                timeout=600,
+                timeout=timeout_s,
                 capture_output=True,
                 text=True,
                 env=env,
                 cwd=repo,
             )
             if r.returncode != 0:
-                _log(f"serve_llm arm {arm} failed rc={r.returncode}; skipping")
-                return {}
-            for line in reversed(r.stdout.strip().splitlines()):
-                try:
-                    out[arm] = json.loads(line)
-                    break
-                except json.JSONDecodeError:
-                    continue
-        except Exception as e:  # noqa: BLE001 — never fail the headline
-            _log(f"serve_llm rows skipped: {type(e).__name__}: {e}")
-            return {}
-    if "on" in out and "off" in out:
-        on_t = out["on"].get("serve_llm_shared_prefix", 0)
-        off_t = out["off"].get("serve_llm_shared_prefix", 0)
-        if off_t:
-            out["shared_prefix_tok_s_ratio"] = round(on_t / off_t, 3)
-    return out
-
-
-def _train_overlap_rows() -> dict:
-    """Host-free train-step A/B (round-13): steps/s + host-blocked ms per
-    step with async dispatch + device prefetch ON vs the kill-switch arm
-    (``--no-async-dispatch``), via ``tools/ray_perf.py --quick
-    --train-only``. CPU-only (pure-jax single-process loop — a wedged TPU
-    tunnel can't block it) and best-effort: any failure returns {} so the
-    headline one-JSON-line contract stands."""
-    repo = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    out = {}
-    for arm, flags in (("on", ()), ("off", ("--no-async-dispatch",))):
-        try:
-            r = subprocess.run(
-                [
-                    sys.executable,
-                    os.path.join(repo, "tools", "ray_perf.py"),
-                    "--quick",
-                    "--train-only",
-                    *flags,
-                ],
-                timeout=420,
-                capture_output=True,
-                text=True,
-                env=env,
-                cwd=repo,
-            )
-            if r.returncode != 0:
-                _log(
-                    f"train_overlap arm {arm} failed rc={r.returncode}; "
-                    f"skipping"
-                )
+                _log(f"{label} arm {arm} failed rc={r.returncode}; skipping")
                 return {}
             for line in reversed(r.stdout.strip().splitlines()):
                 try:
@@ -469,13 +421,53 @@ def _train_overlap_rows() -> dict:
                 except json.JSONDecodeError:
                     continue
             if arm not in out:
-                # rc=0 but no parsable summary line: all-or-nothing — a
-                # one-armed record would break round-over-round diffs.
-                _log(f"train_overlap arm {arm} produced no JSON; skipping")
+                _log(f"{label} arm {arm} produced no JSON; skipping")
                 return {}
         except Exception as e:  # noqa: BLE001 — never fail the headline
-            _log(f"train_overlap rows skipped: {type(e).__name__}: {e}")
+            _log(f"{label} rows skipped: {type(e).__name__}: {e}")
             return {}
+    return out
+
+
+def _serve_llm_rows() -> dict:
+    """LLM-serving A/B record (round-12): aggregate tok/s + p99 TTFT with
+    prefix-affinity routing ON vs OFF (``--no-prefix-routing``)."""
+    out = _ab_rows(
+        "serve_llm", ("--serve-llm-only",), ("--no-prefix-routing",), 600
+    )
+    if "on" in out and "off" in out:
+        on_t = out["on"].get("serve_llm_shared_prefix", 0)
+        off_t = out["off"].get("serve_llm_shared_prefix", 0)
+        if off_t:
+            out["shared_prefix_tok_s_ratio"] = round(on_t / off_t, 3)
+    return out
+
+
+def _serve_overload_rows() -> dict:
+    """Overload-protection A/B record (round-15): shed rate +
+    admitted-interactive p99 under a SEEDED flash crowd
+    (tools/traffic_gen.py) with the admission plane ON vs OFF
+    (``--no-admission``). Both arms replay the same seed-7 arrival
+    schedule."""
+    out = _ab_rows(
+        "serve_overload", ("--serve-overload",), ("--no-admission",), 420
+    )
+    if "on" in out and "off" in out:
+        on_p99 = out["on"].get("serve_overload_admitted_p99_ttft_ms", 0)
+        off_p99 = out["off"].get("serve_overload_admitted_p99_ttft_ms", 0)
+        if on_p99:
+            # >1 = the plane bounded the interactive tail the OFF arm paid.
+            out["admitted_p99_off_on_ratio"] = round(off_p99 / on_p99, 3)
+    return out
+
+
+def _train_overlap_rows() -> dict:
+    """Host-free train-step A/B (round-13): steps/s + host-blocked ms per
+    step with async dispatch + device prefetch ON vs the kill-switch arm
+    (``--no-async-dispatch``); pure-jax single-process loop."""
+    out = _ab_rows(
+        "train_overlap", ("--train-only",), ("--no-async-dispatch",), 420
+    )
     if "on" in out and "off" in out:
         on_b = out["on"].get("train_step_host_blocked_ms", 0)
         off_b = out["off"].get("train_step_host_blocked_ms", 0)
@@ -533,6 +525,7 @@ def _emit(
     serve_llm: dict | None = None,
     raylint: dict | None = None,
     train_overlap: dict | None = None,
+    serve_overload: dict | None = None,
 ) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
@@ -541,6 +534,10 @@ def _emit(
         # the serving number (tok/s + p99 TTFT, routing ON vs OFF) from
         # round 12 on, TPU availability notwithstanding.
         record = {**record, "serve_llm": serve_llm}
+    if serve_overload:
+        # Overload-protection A/B (admission ON vs OFF under the seeded
+        # flash crowd) rides every record from round 15 on.
+        record = {**record, "serve_overload": serve_overload}
     if train_overlap:
         # Train-overlap A/B (async dispatch + prefetch ON vs kill switch)
         # rides every record like data_plane/serve_llm from round 13 on.
@@ -568,6 +565,7 @@ def main() -> None:
     # every plane).
     data_plane = _data_plane_rows()
     serve_llm = _serve_llm_rows()
+    serve_overload = _serve_overload_rows()
     train_overlap = _train_overlap_rows()
     raylint = _raylint_rows()
 
@@ -576,7 +574,7 @@ def main() -> None:
     def emit(record: dict) -> None:
         _emit(
             record, data_plane, probe_record, serve_llm, raylint,
-            train_overlap,
+            train_overlap, serve_overload,
         )
 
     try:

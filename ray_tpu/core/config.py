@@ -160,6 +160,42 @@ class Config:
     # re-prefill, the pre-routing behavior).
     prefix_routing: bool = True
     prefix_route_staleness_s: float = 2.0
+    # Serve overload protection (ROADMAP "millions of users" admission
+    # tier). ``admission`` is the kill switch (RAY_TPU_ADMISSION=0): off,
+    # routing tables carry no admission/shed state, routers never consult
+    # tenant buckets or shed levels, and replicas accept work exactly as
+    # before this tier — the pre-admission router/replica behavior,
+    # byte-identical. The plane itself is per-deployment OPT-IN
+    # (DeploymentConfig.admission_config); these knobs are the cluster
+    # defaults an admission_config inherits where it leaves fields unset.
+    admission: bool = True
+    # Default per-replica concurrency budget (was a hard-coded 8 in
+    # serve/router.py and the controller's max_concurrent_queries
+    # fallbacks): the router's saturation-spill margin and the replica
+    # actor's max_concurrency derive from it.
+    serve_max_concurrent: int = 8
+    # Bounded replica queue: an admission-enabled replica fails a request
+    # fast (OverloadedError, reason="queue_full") once its in-flight count
+    # reaches max_concurrent_queries * this factor, instead of queuing
+    # without limit. The router retries exactly once against a different
+    # replica, then sheds. <= 0 disables the bound even for
+    # admission-enabled deployments.
+    serve_queue_cap_factor: float = 2.0
+    # Load-shed watermarks (admission_config defaults): shed level RISES
+    # when the deployment's mean per-replica queue depth crosses
+    # queue_high (or rolling TTFT crosses ttft_high_ms, where replicas
+    # advertise one), and FALLS one level only after the signals sit
+    # below the low watermarks for a hold period — hysteresis, so the
+    # shed state cannot flap at the boundary. ttft 0 = that signal off.
+    serve_shed_queue_high: float = 8.0
+    serve_shed_queue_low: float = 3.0
+    serve_shed_ttft_high_ms: float = 0.0
+    serve_shed_ttft_low_ms: float = 0.0
+    # Tenant-key contract: the request header (HTTP, lower-cased) the
+    # ingress/router derives the admission tenant from; absent header =
+    # the "default" tenant bucket. gRPC callers pass "tenant" in the call
+    # envelope instead.
+    serve_tenant_header: str = "x-raytpu-tenant"
     # Graceful node drain (reference: gcs_service.proto DrainNode + the
     # raylet's graceful-drain deadline). A draining node stops taking new
     # leases, migrates its sole-copy (primary) objects to healthy peers,

@@ -62,6 +62,35 @@ class PeerUnavailableError(RayTpuError, ConnectionError):
     connection loss it stands in for."""
 
 
+class OverloadedError(RayTpuError):
+    """Request rejected by the serve overload-protection plane instead of
+    queuing: per-tenant token budget exhausted (``reason="throttled"``),
+    priority shed while a deployment is past its watermarks
+    (``reason="shed"``), or a replica's bounded queue failed fast
+    (``reason="queue_full"``). Carries ``retry_after_s`` — the ingress maps
+    it onto HTTP 429 + ``Retry-After`` and gRPC RESOURCE_EXHAUSTED. The
+    kill switch RAY_TPU_ADMISSION=0 removes every raise site."""
+
+    def __init__(
+        self,
+        message: str = "overloaded",
+        retry_after_s: float = 1.0,
+        reason: str = "shed",
+    ):
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+        super().__init__(message)
+
+    def __reduce__(self):
+        # Explicit: the error crosses the replica->router RPC boundary as a
+        # TaskError cause and must unpickle with its fields intact.
+        return (
+            OverloadedError,
+            (self.args[0] if self.args else "overloaded",
+             self.retry_after_s, self.reason),
+        )
+
+
 class FaultInjectedError(RayTpuError):
     """Raised by the deterministic fault-injection plane (core/faults.py);
     never seen in production (the injector is off unless RAY_TPU_FAULTS or

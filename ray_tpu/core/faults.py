@@ -251,6 +251,15 @@ def active() -> Optional[FaultInjector]:
     return _ACTIVE
 
 
+def active_seed() -> Optional[int]:
+    """Seed of the installed injector, or None when chaos is off. The
+    seeded traffic generator (tools/traffic_gen.py) defaults its own seed
+    to this, so one RAY_TPU_FAULTS value pins BOTH the fault schedule and
+    the arrival schedule — a chaos run replays end-to-end from one seed."""
+    inj = _ACTIVE
+    return None if inj is None else inj.seed
+
+
 def sleep_if_delayed(site: str, name: str = "") -> None:
     """Synchronous delay hook for non-async seams (dag channel reads)."""
     inj = _ACTIVE

@@ -282,6 +282,19 @@ class LLMEngine:
         self._pf_rr = 0  # round-robin cursor over prefilling slots
         self._steps = 0
         self._published_tokens = 0  # tokens already inc'd into the counter
+        # Rolling TTFT window ((monotonic, seconds) pairs): a ROUTING/
+        # overload signal, not telemetry — recorded regardless of the
+        # metrics kill switch and read by router_state() advertisements
+        # (serve admission watermark "rolling TTFT"). Samples EXPIRE by
+        # age as well as by count: an idle engine must stop advertising
+        # its last crisis, or a shed level raised on TTFT would latch
+        # forever on the frozen window it caused (no admissions -> no new
+        # samples). Appends from the pump thread, p95 reads from the
+        # report loop: deque ops are atomic, the reader copies.
+        from collections import deque
+
+        self._ttft_window: deque = deque(maxlen=64)
+        self.TTFT_WINDOW_S = 30.0
 
     # -- jitted bodies (slot-batched cache update) ---------------------------
     def _prefill_impl(self, params, tokens, length, cache, slot, cfg):
@@ -490,6 +503,9 @@ class LLMEngine:
             req.generated.append(tok)
             self.stats["tokens_generated"] += 1
             req.t_last_token = _time.perf_counter()
+            self._ttft_window.append(
+                (_time.monotonic(), req.t_last_token - req.t_admit)
+            )
             if _metrics.metrics_enabled():
                 _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
             self.slot_free[slot] = False
@@ -808,6 +824,9 @@ class LLMEngine:
         req.generated.append(tok)
         self.stats["tokens_generated"] += 1
         req.t_last_token = _time.perf_counter()
+        self._ttft_window.append(
+            (_time.monotonic(), req.t_last_token - req.t_admit)
+        )
         if _metrics.metrics_enabled():
             _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
         self.positions[req.slot] = T
@@ -977,6 +996,19 @@ class LLMEngine:
             "prefill_tokens": self.stats["prefill_tokens"],
             "prefix_tokens_reused": self.stats["prefix_tokens_reused"],
         }
+
+    def rolling_ttft_ms(self) -> float:
+        """p95 of the recent-TTFT window, in milliseconds, counting only
+        samples younger than TTFT_WINDOW_S (0.0 when none — an idle
+        engine advertises recovery, so a TTFT-raised shed level can come
+        back down). The serve controller compares this — advertised via
+        router_state() — against the admission ttft watermarks."""
+        cutoff = _time.monotonic() - self.TTFT_WINDOW_S
+        window = sorted(v for t, v in list(self._ttft_window) if t >= cutoff)
+        if not window:
+            return 0.0
+        idx = min(len(window) - 1, int(0.95 * len(window)))
+        return round(window[idx] * 1e3, 3)
 
     def has_unfinished(self) -> bool:
         return any(not r.finished for r in self.requests.values())

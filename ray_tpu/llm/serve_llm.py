@@ -178,9 +178,13 @@ class LLMServer:
         """Routing advertisement, pushed by the hosting ReplicaActor's
         report loop: which prefix blocks this replica's KV pool already
         holds (stable digests), plus hit-rate/KV-util — the signals the
-        prefix-affinity router biases pow-2 on. Reads only atomic engine
-        snapshots, so it is safe against the pump's executor thread."""
-        return self.engine.prefix_digest()
+        prefix-affinity router biases pow-2 on — and the rolling p95 TTFT
+        the serve controller's overload watermarks compare against. Reads
+        only atomic engine snapshots, so it is safe against the pump's
+        executor thread."""
+        state = self.engine.prefix_digest()
+        state["ttft_ms"] = self.engine.rolling_ttft_ms()
+        return state
 
     @staticmethod
     def _sampling(body: dict) -> SamplingParams:
@@ -295,16 +299,26 @@ class LLMServer:
 
 
 def build_openai_app(
-    config: LLMConfig, *, name: str = "llm", num_replicas: int = 1
+    config: LLMConfig,
+    *,
+    name: str = "llm",
+    num_replicas: int = 1,
+    admission_config: dict | None = None,
 ):
     """An Application serving OpenAI-style routes under /{name}/v1/...
-    (reference: ray.serve.llm build_openai_app)."""
+    (reference: ray.serve.llm build_openai_app). ``admission_config``
+    opts the deployment into the serve overload plane (tenant token
+    buckets, priority shedding on queue/TTFT watermarks, bounded replica
+    queues — see README "Overload protection"); LLM replicas advertise a
+    rolling p95 TTFT, so the ttft_high_ms/ttft_low_ms watermarks are
+    live for this deployment."""
     from ray_tpu.util.prefix_digest import BYTE_BOS_SCHEME
 
     dep = serve_api.deployment(
         LLMServer,
         name=name,
         num_replicas=num_replicas,
+        admission_config=admission_config,
         ray_actor_options=dict(config.placement),
         # Same-prefix requests stick to a replica whose engine already
         # pooled that prefix's KV (no re-prefill of shared system prompts).

@@ -26,7 +26,10 @@ from ray_tpu.serve.multiplex import (  # noqa: F401 (serve.multiplexed)
 class DeploymentConfig:
     name: str
     num_replicas: int = 1
-    max_concurrent_queries: int = 8
+    # None = the cluster default (config knob serve_max_concurrent,
+    # historically a hard-coded 8); the controller resolves it into the
+    # routing table so routers and replicas agree on one number.
+    max_concurrent_queries: Optional[int] = None
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
     user_config: Any = None
     # {"min_replicas", "max_replicas", "target_ongoing_requests",
@@ -45,6 +48,16 @@ class DeploymentConfig:
     # holds them (see util/prefix_digest.py). None = router-local
     # affinity only.
     request_affinity_config: Optional[dict] = None
+    # Overload protection (serve/admission.py). None = this deployment
+    # opts out entirely (no admission keys in its routing table, no
+    # bounded replica queue). A dict opts in; unset fields inherit the
+    # serve_shed_*/serve_queue_cap_factor cluster knobs:
+    #   {"tenant_rate": req/s refill (0 = unlimited), "tenant_burst": n,
+    #    "tenants": {key: {"rate", "burst"}},       # per-tenant override
+    #    "queue_high"/"queue_low": per-replica mean queue watermarks,
+    #    "ttft_high_ms"/"ttft_low_ms": rolling-TTFT watermarks (0 = off),
+    #    "down_hold_s": hysteresis dwell, "retry_after_s": shed hint}
+    admission_config: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

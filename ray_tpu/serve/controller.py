@@ -14,6 +14,8 @@ import time
 
 import ray_tpu
 from ray_tpu.core import api as core_api
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.serve import admission as _admission
 from ray_tpu.util.tasks import spawn
 
 CONTROLLER_NAME = "serve::controller"
@@ -34,10 +36,10 @@ class ServeController:
         # every waiter exactly once per change (reference:
         # serve/_private/long_poll.py LongPollHost).
         self._version_event: asyncio.Event | None = None
-        # replica_id -> (queue_len, monotonic): pushed by replicas so the
-        # autoscaler reads a table instead of fanning out queue_len RPCs
-        # every tick.
-        self._replica_metrics: dict[str, tuple[int, float]] = {}
+        # replica_id -> (queue_len, monotonic, router_state): pushed by
+        # replicas so the autoscaler/shed-state/router-state reads come
+        # from a table instead of fanning out queue_len RPCs every tick.
+        self._replica_metrics: dict[str, tuple] = {}
         self._loop_running = False
         self._proxy = None
         self._proxy_port = None
@@ -137,6 +139,16 @@ class ServeController:
         except Exception:  # raylint: disable=RL006 -- ping probe: any failure IS the un-healthy verdict
             return False
 
+    @staticmethod
+    def _max_concurrent(cfg: dict) -> int:
+        """Resolved per-replica concurrency budget: the deployment's
+        max_concurrent_queries, else the serve_max_concurrent knob (the
+        hoisted former hard-coded 8)."""
+        return int(
+            cfg.get("max_concurrent_queries")
+            or GLOBAL_CONFIG.serve_max_concurrent
+        )
+
     async def get_routing(self, name: str, version: int = -1) -> dict:
         """Routing table for one deployment. Routers pass their last seen
         version; a matching version returns just {"version": v} (cheap
@@ -146,13 +158,27 @@ class ServeController:
             return {"version": -1, "replicas": None, "missing": True}
         if dep["version"] == version:
             return {"version": version}
-        return {
+        table = {
             "version": dep["version"],
             "replicas": [r for r, _ in dep["replicas"]],
-            "max_concurrent": dep["config"].get("max_concurrent_queries", 8),
+            "max_concurrent": self._max_concurrent(dep["config"]),
             "affinity": dep["config"].get("request_affinity"),
             "affinity_config": dep["config"].get("request_affinity_config"),
         }
+        # Overload plane: the resolved admission config plus the CURRENT
+        # shed level ride the table (and every level change bumps the
+        # version), so routers make admission decisions from state they
+        # already hold — never a control-plane await on the request path.
+        # With the kill switch thrown (RAY_TPU_ADMISSION=0) the table is
+        # byte-identical to the pre-admission one.
+        if GLOBAL_CONFIG.admission:
+            info = _admission.resolve_admission_config(
+                dep["config"].get("admission_config")
+            )
+            if info is not None:
+                table["admission"] = info
+                table["shed_level"] = dep.get("_shed_level", 0)
+        return table
 
     async def poll_routing(
         self, name: str, version: int = -1, timeout_s: float = 30.0
@@ -250,6 +276,7 @@ class ServeController:
             for name in list(self._deployments):
                 try:
                     await self._reconcile_one(name)
+                    self._update_shed_state(name)
                 except Exception:  # noqa: BLE001 — per-deployment: one
                     # broken deployment must not starve the others
                     log.exception(
@@ -411,6 +438,51 @@ class ServeController:
         if started:
             dep["version"] = self._bump()
 
+    def _update_shed_state(self, name: str) -> None:
+        """One watermark-tracker tick for an admission-enabled deployment:
+        feed the PUSHED per-replica queue depths (and any advertised
+        rolling TTFT) into the hysteresis state machine; a level change
+        bumps the routing version so the long-poll pushes the new shed
+        level to every router within one tick."""
+        dep = self._deployments.get(name)
+        if dep is None or not GLOBAL_CONFIG.admission:
+            return
+        info = _admission.resolve_admission_config(
+            dep["config"].get("admission_config")
+        )
+        if info is None:
+            return
+        tracker = dep.get("_shed_tracker")
+        if tracker is None:
+            tracker = dep["_shed_tracker"] = _admission.WatermarkTracker(
+                info
+            )
+        elif tracker.cfg != info:
+            # A reconfig must not reset live shed state: swap the config
+            # in place, keeping the level AND the down-hold dwell clock
+            # (recreating mid-dwell would silently defer recovery a full
+            # extra hold period).
+            tracker.cfg = info
+        now = time.monotonic()
+        depths, ttft_ms = [], 0.0
+        for r, _ in dep["replicas"]:
+            m = self._replica_metrics.get(r._actor_id)
+            # Freshness guard (same 7 s window the autoscaler applies): a
+            # replica whose reporter wedged mid-spike must not pin the
+            # shed level on a frozen queue depth forever.
+            if m is None or now - m[1] >= 7.0:
+                continue
+            depths.append(m[0])
+            state = m[2]
+            if isinstance(state, dict):
+                ttft_ms = max(ttft_ms, float(state.get("ttft_ms") or 0.0))
+        mean_q = sum(depths) / len(depths) if depths else 0.0
+        level = tracker.update(mean_q, ttft_ms, now)
+        if level != dep.get("_shed_level", 0):
+            dep["_shed_level"] = level
+            dep["version"] = self._bump()
+        _admission.set_shed_gauge(name, level)
+
     def _start_replica(self, name: str, dep: dict):
         import uuid
 
@@ -425,10 +497,33 @@ class ServeController:
         opts["name"] = (
             f"serve::{name}#{dep['next_replica_id']}-{uuid.uuid4().hex[:6]}"
         )
-        opts["max_concurrency"] = cfg.get("max_concurrent_queries", 8) + 2
+        mc = self._max_concurrent(cfg)
+        queue_cap = 0
+        if (
+            GLOBAL_CONFIG.admission
+            and cfg.get("admission_config") is not None
+            and GLOBAL_CONFIG.serve_queue_cap_factor > 0
+        ):
+            # Bounded replica queue: in-flight beyond the cap fails fast
+            # back to the router; in-cap surplus waits on the replica's
+            # execution semaphore (sized mc + 2 — the pre-plane width, so
+            # opting in never widens concurrent execution). The actor's
+            # task concurrency sits two above the CAP so the rejection
+            # handler always has a slot to RUN in — a full replica must
+            # shed instantly, not queue the shed decision behind the work
+            # it is shedding.
+            queue_cap = max(
+                1, int(mc * GLOBAL_CONFIG.serve_queue_cap_factor)
+            )
+        opts["max_concurrency"] = (queue_cap or mc) + 2
         cls = ray_tpu.remote(ReplicaActor)
         return cls.options(**opts).remote(
-            name, dep["payload"], dep["init"], cfg.get("user_config")
+            name,
+            dep["payload"],
+            dep["init"],
+            cfg.get("user_config"),
+            queue_cap,
+            mc,
         )
 
     def _bump(self) -> int:

@@ -44,9 +44,17 @@ def _handle_factory(proxy):
         model_id = req.get("multiplexed_model_id", "")
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
+        # Admission identity: gRPC callers have no HTTP headers, so the
+        # call envelope carries the tenant key / priority class directly
+        # (the metadata-equivalent of the serve_tenant_header contract).
+        tenant = req.get("tenant", "")
+        priority = req.get("priority", "")
+        if tenant or priority:
+            handle = handle.options(tenant=tenant, priority=priority)
         return handle, req.get("request")
 
     async def call_unary(request_bytes, context):
+        from ray_tpu.core.errors import OverloadedError
         from ray_tpu.serve.router import DeploymentNotFoundError
 
         try:
@@ -55,12 +63,20 @@ def _handle_factory(proxy):
             return cloudpickle.dumps(result)
         except (KeyError, DeploymentNotFoundError) as e:
             await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except OverloadedError as e:
+            # Admission rejection -> RESOURCE_EXHAUSTED (the gRPC twin of
+            # HTTP 429); the retry hint rides the status message.
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"{e} (retry after {e.retry_after_s:.1f}s)",
+            )
         except Exception as e:  # noqa: BLE001 — user errors -> INTERNAL
             await context.abort(
                 grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
             )
 
     async def call_stream(request_bytes, context):
+        from ray_tpu.core.errors import OverloadedError
         from ray_tpu.serve.router import DeploymentNotFoundError
 
         try:
@@ -70,6 +86,11 @@ def _handle_factory(proxy):
                 yield cloudpickle.dumps(chunk)
         except (KeyError, DeploymentNotFoundError) as e:
             await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except OverloadedError as e:
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"{e} (retry after {e.retry_after_s:.1f}s)",
+            )
         except Exception as e:  # noqa: BLE001
             await context.abort(
                 grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
@@ -117,9 +138,13 @@ def call(
     request: Any,
     *,
     multiplexed_model_id: str = "",
+    tenant: str = "",
+    priority: str = "",
     timeout: float = 60.0,
 ):
-    """One unary call to the ingress at ``target`` ("host:port")."""
+    """One unary call to the ingress at ``target`` ("host:port").
+    ``tenant``/``priority`` are the admission identity (overload plane);
+    an over-budget or shed request fails with RESOURCE_EXHAUSTED."""
     import grpc
 
     with grpc.insecure_channel(target) as channel:
@@ -131,6 +156,10 @@ def call(
         payload = {"deployment": deployment, "request": request}
         if multiplexed_model_id:
             payload["multiplexed_model_id"] = multiplexed_model_id
+        if tenant:
+            payload["tenant"] = tenant
+        if priority:
+            payload["priority"] = priority
         return cloudpickle.loads(
             fn(cloudpickle.dumps(payload), timeout=timeout)
         )

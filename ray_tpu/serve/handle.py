@@ -22,17 +22,30 @@ class DeploymentHandle:
         method: str = "__call__",
         stream: bool = False,
         multiplexed_model_id: str = "",
+        tenant: str = "",
+        priority: str = "",
     ):
         self._deployment = deployment
         self._method = method
         self._stream = stream
         self._model_id = multiplexed_model_id
+        # Admission identity (overload plane): explicit options win over
+        # the request envelope's headers; empty = derive from headers.
+        self._tenant = tenant
+        self._priority = priority
         self._router: Router | None = None
 
     def __reduce__(self):
         return (
             DeploymentHandle,
-            (self._deployment, self._method, self._stream, self._model_id),
+            (
+                self._deployment,
+                self._method,
+                self._stream,
+                self._model_id,
+                self._tenant,
+                self._priority,
+            ),
         )
 
     async def _ensure_router(self) -> Router:
@@ -54,7 +67,12 @@ class DeploymentHandle:
 
     def method(self, name: str) -> "DeploymentHandle":
         h = DeploymentHandle(
-            self._deployment, name, self._stream, self._model_id
+            self._deployment,
+            name,
+            self._stream,
+            self._model_id,
+            self._tenant,
+            self._priority,
         )
         h._router = self._router  # share routing state
         return h
@@ -64,12 +82,16 @@ class DeploymentHandle:
         *,
         stream: bool | None = None,
         multiplexed_model_id: str | None = None,
+        tenant: str | None = None,
+        priority: str | None = None,
     ) -> "DeploymentHandle":
         """``stream=True``: remote() / remote_async() return an iterator of
         response chunks instead of one value. ``multiplexed_model_id``:
         route to a replica with that model resident and bind
         serve.get_multiplexed_model_id() there (reference: serve/handle.py
-        DeploymentHandle.options)."""
+        DeploymentHandle.options). ``tenant``/``priority``: explicit
+        admission identity for the overload plane (overrides the request
+        envelope's headers; priority in admission.PRIORITIES)."""
         h = DeploymentHandle(
             self._deployment,
             self._method,
@@ -77,6 +99,8 @@ class DeploymentHandle:
             self._model_id
             if multiplexed_model_id is None
             else multiplexed_model_id,
+            self._tenant if tenant is None else tenant,
+            self._priority if priority is None else priority,
         )
         h._router = self._router
         return h
@@ -87,9 +111,21 @@ class DeploymentHandle:
         router = await self._ensure_router()
         if self._stream:
             return router.route_stream(
-                self._method, args, kwargs, self._model_id
+                self._method,
+                args,
+                kwargs,
+                self._model_id,
+                tenant=self._tenant,
+                priority=self._priority,
             )
-        return await router.route(self._method, args, kwargs, self._model_id)
+        return await router.route(
+            self._method,
+            args,
+            kwargs,
+            self._model_id,
+            tenant=self._tenant,
+            priority=self._priority,
+        )
 
     def remote(self, *args, **kwargs):
         """Route from a sync context (driver). Plain: a Future whose

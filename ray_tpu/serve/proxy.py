@@ -14,8 +14,10 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 from urllib.parse import parse_qs, urlparse
 
+from ray_tpu.core.errors import OverloadedError
 from ray_tpu.serve.handle import DeploymentHandle
 
 _ASGI = object()  # _route's "raw ASGI response" status sentinel
@@ -25,8 +27,22 @@ _REASONS = {
     302: "Found", 304: "Not Modified", 400: "Bad Request",
     401: "Unauthorized", 403: "Forbidden", 404: "Not Found",
     405: "Method Not Allowed", 422: "Unprocessable Entity",
+    429: "Too Many Requests",
     500: "Internal Server Error", 503: "Service Unavailable",
 }
+
+
+def _overload_response(e: OverloadedError) -> tuple:
+    """(status, payload, headers) for an admission rejection: HTTP 429
+    with a whole-second Retry-After (ceil — "retry in 0 s" would invite
+    an immediate stampede)."""
+    retry_after = max(1, int(math.ceil(e.retry_after_s)))
+    return (
+        429,
+        {"error": str(e), "reason": e.reason,
+         "retry_after_s": e.retry_after_s},
+        {"Retry-After": str(retry_after)},
+    )
 
 
 class HTTPProxyActor:
@@ -95,7 +111,7 @@ class HTTPProxyActor:
                         writer, method, target, headers, parsed, body
                     )
                     return  # streamed responses close the connection
-                status, payload = await self._route(
+                status, payload, extra = await self._route(
                     method, target, headers, parsed, body
                 )
                 if status is _ASGI:
@@ -104,7 +120,7 @@ class HTTPProxyActor:
                 keep = (
                     headers.get("connection", "keep-alive").lower() != "close"
                 )
-                await self._respond(writer, status, payload, keep)
+                await self._respond(writer, status, payload, keep, extra)
                 if not keep:
                     return
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -144,7 +160,7 @@ class HTTPProxyActor:
             method, target, headers, parsed, raw
         )
         if err is not None:
-            return 404, {"error": err}
+            return 404, {"error": err}, None
         try:
             handle = self._handle_for(deployment)
             model_id = headers.get("serve_multiplexed_model_id", "")
@@ -159,12 +175,17 @@ class HTTPProxyActor:
             ):
                 # A drained ASGI generator: [head, chunk, chunk, ...] —
                 # reply with the app's own status/headers/body.
-                return _ASGI, result
-            return 200, result
+                return _ASGI, result, None
+            return 200, result, None
         except DeploymentNotFoundError as e:
-            return 404, {"error": str(e)}
+            return 404, {"error": str(e)}, None
+        except OverloadedError as e:
+            # Admission rejection (shed / throttled / replica queue full):
+            # predictable degradation is an HTTP contract — 429 with a
+            # Retry-After the client can honor, not a 500.
+            return _overload_response(e)
         except Exception as e:  # noqa: BLE001 — user errors are 500s
-            return 500, {"error": f"{type(e).__name__}: {e}"}
+            return 500, {"error": f"{type(e).__name__}: {e}"}, None
 
     @staticmethod
     def _parse_body(body: bytes):
@@ -220,6 +241,10 @@ class HTTPProxyActor:
                 exhausted = True
         except DeploymentNotFoundError as e:
             await self._respond(writer, 404, {"error": str(e)})
+            return
+        except OverloadedError as e:
+            status, payload, extra = _overload_response(e)
+            await self._respond(writer, status, payload, extra_headers=extra)
             return
         except Exception as e:  # noqa: BLE001 — pre-stream errors are 500s
             await self._respond(
@@ -301,18 +326,22 @@ class HTTPProxyActor:
         except Exception:  # noqa: BLE001 — mid-stream: connection close  # raylint: disable=RL006 -- mid-stream client disconnect; nothing to send the rest to
             pass
 
-    async def _respond(self, writer, status: int, payload, keep=False):
-        reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
-            status, "Internal Server Error"
-        )
+    async def _respond(
+        self, writer, status: int, payload, keep=False, extra_headers=None
+    ):
+        reason = _REASONS.get(status, "Internal Server Error")
         try:
             data = json.dumps(payload, default=str).encode()
         except (TypeError, ValueError):
             data = json.dumps({"result": str(payload)}).encode()
+        extra = "".join(
+            f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items()
+        )
         writer.write(
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: application/json\r\n"
             f"Content-Length: {len(data)}\r\n"
+            f"{extra}"
             f"Connection: {'keep-alive' if keep else 'close'}\r\n"
             f"\r\n".encode() + data
         )

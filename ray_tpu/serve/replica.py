@@ -16,6 +16,8 @@ import time as _time
 import cloudpickle
 
 from ray_tpu.core import serialization
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import OverloadedError
 from ray_tpu.util import metrics as _metrics
 
 # Replica-side half of the serve request breakdown (router wait is
@@ -42,8 +44,23 @@ class ReplicaActor:
         payload: bytes,
         init_payload: bytes,
         user_config,
+        queue_cap: int = 0,
+        max_concurrent: int = 0,
     ):
         self._deployment = deployment_name
+        # Bounded queue (overload plane): with a positive cap the replica
+        # fails a request FAST once its in-flight count reaches the cap,
+        # instead of queuing without limit — the router retries once on a
+        # different replica, then sheds. In-flight work below the cap but
+        # beyond ``max_concurrent`` WAITS on an execution semaphore sized
+        # to the pre-plane width (max_concurrent + 2), so opting into
+        # admission bounds the queue without widening concurrent
+        # execution. 0 = unbounded (pre-admission behavior; also what the
+        # RAY_TPU_ADMISSION=0 kill switch yields, because the controller
+        # then passes 0).
+        self._queue_cap = int(queue_cap)
+        self._max_concurrent = int(max_concurrent)
+        self._exec_sem: asyncio.Semaphore | None = None
         target = cloudpickle.loads(payload)
         args, kwargs = serialization.loads(init_payload)[0]
         if inspect.isclass(target):
@@ -134,6 +151,38 @@ class ReplicaActor:
             }
         return self._metric_tags
 
+    def _check_queue_cap(self) -> None:
+        """Bounded-queue fail-fast, BEFORE the payload is even unpickled:
+        rejecting must stay cheap exactly when the replica is drowning."""
+        if (
+            self._queue_cap > 0
+            and self._inflight >= self._queue_cap
+            and GLOBAL_CONFIG.admission
+        ):
+            raise OverloadedError(
+                f"{self._deployment}: replica queue full "
+                f"({self._inflight}/{self._queue_cap})",
+                retry_after_s=0.5,
+                reason="queue_full",
+            )
+
+    def _execution_gate(self) -> asyncio.Semaphore | None:
+        """The execution-width bound for admission-enabled replicas:
+        ``max_concurrent + 2`` — exactly the actor max_concurrency a
+        replica ran at before the overload plane, so opting in changes
+        what happens to EXCESS work (bounded wait, then fail-fast), not
+        how wide admitted work executes. None = ungated (no cap, or the
+        kill switch is thrown)."""
+        if (
+            self._queue_cap <= 0
+            or self._max_concurrent <= 0
+            or not GLOBAL_CONFIG.admission
+        ):
+            return None
+        if self._exec_sem is None:  # lazily: __init__ may run off-loop
+            self._exec_sem = asyncio.Semaphore(self._max_concurrent + 2)
+        return self._exec_sem
+
     async def ping(self) -> bool:
         self._ensure_reporter()
         return True
@@ -156,6 +205,7 @@ class ReplicaActor:
         from ray_tpu.serve.multiplex import _set_model_id
 
         self._ensure_reporter()
+        self._check_queue_cap()
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
         _set_model_id(model_id)
@@ -164,7 +214,7 @@ class ReplicaActor:
         self._inflight += 1
         if instrument:
             _QUEUE_LEN.set(float(self._inflight), self._tags())
-        try:
+        async def run():
             if inspect.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
             else:
@@ -180,6 +230,13 @@ class ReplicaActor:
             if inspect.isgenerator(result):
                 return list(result)
             return result
+
+        try:
+            gate = self._execution_gate()
+            if gate is None:
+                return await run()
+            async with gate:  # in-cap surplus WAITS here (the queue)
+                return await run()
         finally:
             self._inflight -= 1
             if instrument:
@@ -199,6 +256,12 @@ class ReplicaActor:
         from ray_tpu.serve.multiplex import _set_model_id
 
         self._ensure_reporter()
+        # Streams share the bounded-queue fail-fast but NOT the execution
+        # semaphore: a continuous-batching replica multiplexes its streams
+        # (consumer pacing included), so gating a stream's whole lifetime
+        # at handle() width would serialize them for no protection the
+        # in-flight cap doesn't already give.
+        self._check_queue_cap()
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
         _set_model_id(model_id)

@@ -16,7 +16,13 @@ import time as _time
 from ray_tpu.core import api as core_api
 from ray_tpu.core import serialization
 from ray_tpu.core.config import GLOBAL_CONFIG
-from ray_tpu.core.errors import ActorDiedError, ActorUnavailableError
+from ray_tpu.core.errors import (
+    ActorDiedError,
+    ActorUnavailableError,
+    OverloadedError,
+    TaskError,
+)
+from ray_tpu.serve import admission as _admission
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util.prefix_digest import chat_prompt, prompt_digests
 
@@ -67,6 +73,77 @@ ROUTE_RETRIES = 8
 DEAD_MEMORY_S = 30.0
 
 
+class _RequestAdmission:
+    """Per-request admission state shared by route()/route_stream(): the
+    once-per-request check, the exactly-one-counter-event invariant, and
+    the bounded-queue retry-once classification — ONE copy, so the
+    invariants pinned by test_drain_during_overload_never_double_sheds
+    cannot drift between the buffered and streaming paths."""
+
+    __slots__ = (
+        "_router", "_args", "_kwargs", "tenant", "priority",
+        "_admitted", "_counted", "exclude", "last_overload",
+    )
+
+    def __init__(
+        self, router: "Router", args: tuple, kwargs: dict,
+        tenant: str, priority: str,
+    ):
+        self._router = router
+        self._args, self._kwargs = args, kwargs
+        self.tenant, self.priority = tenant, priority
+        self._admitted = False
+        self._counted = False
+        # The one replica a bounded-queue retry must avoid.
+        self.exclude: str | None = None
+        # A rejection held when the retry budget ran out: the final
+        # verdict is then a shed (429 contract), not a 500.
+        self.last_overload: OverloadedError | None = None
+
+    def ensure_checked(self) -> None:
+        """Admission, once, before the first dispatch: raises
+        OverloadedError (shed/throttled — counted by the check itself)."""
+        if self._admitted:
+            return
+        router = self._router
+        if router._admission_on():
+            self.tenant, self.priority = router._resolve_identity(
+                self._args, self._kwargs, self.tenant, self.priority
+            )
+            router._admission.check(
+                self.tenant, self.priority, router._shed_level
+            )
+        else:
+            self._counted = True  # plane off: nothing to count, ever
+        self._admitted = True
+
+    def count_once(self, decision: str) -> None:
+        if self._admitted and not self._counted:
+            self._counted = True
+            self._router._count_admission(decision, self.priority)
+
+    def retry_overload(self, ov: OverloadedError, rid: str) -> bool:
+        """Classify a replica's bounded-queue rejection: True = retry
+        ONCE on a different replica (no backoff); False = the verdict is
+        a shed (already counted) and the caller raises ``ov``."""
+        if self.exclude is not None or len(self._router._replicas) <= 1:
+            self.count_once("shed")
+            return False
+        self.exclude = rid
+        self.last_overload = ov  # the loop may end before the retry runs
+        return True
+
+    def exhausted(self) -> OverloadedError | None:
+        """End-of-retry-loop verdict: the held rejection to raise as a
+        shed, or None (the request counts as admitted — it failed, if it
+        failed, for non-overload reasons)."""
+        if self.last_overload is not None:
+            self.count_once("shed")
+            return self.last_overload
+        self.count_once("admitted")
+        return None
+
+
 class Router:
     def __init__(self, controller, deployment: str):
         self._controller = controller
@@ -103,7 +180,14 @@ class Router:
         self._replica_state: dict = {}
         self._state_fetched = 0.0
         self._state_task: asyncio.Task | None = None
-        self._max_concurrent = 8
+        self._max_concurrent = GLOBAL_CONFIG.serve_max_concurrent
+        # Overload plane (serve/admission.py): the deployment's resolved
+        # admission config and current shed level ride the routing table,
+        # so every admission decision here is local — never a
+        # control-plane await. None = the deployment did not opt in (or
+        # RAY_TPU_ADMISSION=0 stripped the table keys).
+        self._admission: _admission.AdmissionController | None = None
+        self._shed_level = 0
 
     def close(self) -> None:
         for attr in ("_listen_task", "_state_task"):
@@ -217,7 +301,20 @@ class Router:
             return
         self._affinity = table.get("affinity")
         self._affinity_cfg = table.get("affinity_config")
-        self._max_concurrent = table.get("max_concurrent") or 8
+        self._max_concurrent = (
+            table.get("max_concurrent") or GLOBAL_CONFIG.serve_max_concurrent
+        )
+        self._shed_level = int(table.get("shed_level") or 0)
+        adm = table.get("admission")
+        if isinstance(adm, dict):
+            if self._admission is None:
+                self._admission = _admission.AdmissionController(
+                    self._deployment, adm
+                )
+            elif self._admission.config != adm:
+                self._admission.reconfigure(adm)
+        else:
+            self._admission = None
         import time
 
         now = time.monotonic()
@@ -336,7 +433,9 @@ class Router:
     # advertises it, and joins the hot set — capacity follows demand).
     PREFIX_SPILL_MARGIN = 2
 
-    def _pick_prefix(self, digests: list, count: bool = True):
+    def _pick_prefix(
+        self, digests: list, count: bool = True, candidates: list | None = None
+    ):
         """The replica whose ADVERTISED prefix pool holds the longest
         leading-block match for this prompt, or None to fall back to
         load-only routing (no match anywhere, or the matched replica is
@@ -345,7 +444,8 @@ class Router:
         ``count=False`` suppresses the outcome counters (dead-replica
         RETRIES of one request must not double-count it, and an
         attempt-1 'hit' that then died avoided no re-prefill)."""
-        alive = {r._actor_id: r for r in self._replicas}
+        candidates = candidates if candidates is not None else self._replicas
+        alive = {r._actor_id: r for r in candidates}
         best, best_score = None, 0
         for rid, info in self._replica_state.items():
             r = alive.get(rid)
@@ -366,7 +466,7 @@ class Router:
                 _PREFIX_ROUTE_MISSES.inc(1.0, tags)
             return None
         load = lambda r: self._inflight.get(r._actor_id, 0)  # noqa: E731
-        others = [r for r in self._replicas if r is not best]
+        others = [r for r in candidates if r is not best]
         margin = max(self.PREFIX_SPILL_MARGIN, self._max_concurrent // 2)
         if others and load(best) > min(map(load, others)) + margin:
             if instrument:
@@ -381,21 +481,32 @@ class Router:
         model_id: str = "",
         digests: list | None = None,
         count_prefix: bool = True,
+        exclude: str | None = None,
     ):
         """Power of two choices on the local in-flight estimates; with a
         model id, prefer replicas that model was recently routed to (its
         weights are probably still resident — reference: multiplexed
         routing in python/ray/serve/_private/replica_scheduler). With
         prompt digests, first prefer the replica whose advertised prefix
-        pool already holds them (prefix-affinity routing)."""
-        if len(self._replicas) == 1:
-            return self._replicas[0]
+        pool already holds them (prefix-affinity routing). ``exclude``
+        drops one replica from consideration — the overload retry must
+        land on a DIFFERENT replica than the one that just failed fast
+        (when one exists)."""
+        candidates = self._replicas
+        if exclude is not None:
+            filtered = [r for r in candidates if r._actor_id != exclude]
+            if filtered:
+                candidates = filtered
+        if len(candidates) == 1:
+            return candidates[0]
         if digests:
-            best = self._pick_prefix(digests, count=count_prefix)
+            best = self._pick_prefix(
+                digests, count=count_prefix, candidates=candidates
+            )
             if best is not None:
                 return best
         if model_id:
-            alive = {r._actor_id: r for r in self._replicas}
+            alive = {r._actor_id: r for r in candidates}
             known = [
                 alive[rid]
                 for rid in self._model_replicas.get(model_id, [])
@@ -404,14 +515,14 @@ class Router:
             if known:
                 load = lambda r: self._inflight.get(r._actor_id, 0)  # noqa
                 best = min(known, key=load)
-                others = [r for r in self._replicas if r not in known]
+                others = [r for r in candidates if r not in known]
                 # Affinity holds only while the model's replicas aren't
                 # clearly hotter than the rest: a saturated hot model must
                 # SPILL to a fresh replica (which loads the weights and
                 # joins the affinity set) rather than cap at one replica.
                 if not others or load(best) <= min(map(load, others)) + 2:
                     return best
-        a, b = random.sample(self._replicas, 2)
+        a, b = random.sample(candidates, 2)
         return (
             a
             if self._inflight.get(a._actor_id, 0)
@@ -460,26 +571,75 @@ class Router:
         if len(reps) > 4:  # bound the memory per model
             reps.pop(0)
 
+    # -- admission (overload plane) ------------------------------------------
+
+    def _admission_on(self) -> bool:
+        return self._admission is not None and GLOBAL_CONFIG.admission
+
+    def _resolve_identity(
+        self, args: tuple, kwargs: dict, tenant: str, priority: str
+    ) -> tuple[str, str]:
+        """(tenant, priority) for admission: explicit handle options win,
+        else the request envelope's headers (the ingress contract), else
+        the defaults."""
+        if tenant and priority:
+            return tenant, _admission.normalize_priority(priority)
+        h_tenant, h_priority = _admission.extract_identity(args, kwargs)
+        return (
+            tenant or h_tenant,
+            _admission.normalize_priority(priority) if priority else h_priority,
+        )
+
+    def _count_admission(self, decision: str, priority: str) -> None:
+        if self._admission_on():
+            self._admission.count(decision, priority)
+
+    @staticmethod
+    def _overload_cause(e: TaskError) -> OverloadedError | None:
+        """The replica's bounded-queue rejection, if that is what this
+        TaskError carries (it crosses the RPC boundary as the cause)."""
+        cause = getattr(e, "cause", None)
+        return cause if isinstance(cause, OverloadedError) else None
+
     async def route(
-        self, method: str, args: tuple, kwargs: dict, model_id: str = ""
+        self,
+        method: str,
+        args: tuple,
+        kwargs: dict,
+        model_id: str = "",
+        tenant: str = "",
+        priority: str = "",
     ):
-        """Route one request; returns the result value."""
+        """Route one request; returns the result value.
+
+        Overload semantics: admission (tenant token bucket + priority vs
+        the advertised shed level) runs ONCE per request, locally, before
+        the first dispatch; a replica's bounded-queue rejection is retried
+        exactly once against a different replica, then the request is shed
+        (OverloadedError to the caller — the ingress turns it into 429 +
+        Retry-After). Exactly one raytpu_serve_admission_total event per
+        admission-checked request, whatever the outcome."""
         payload = serialization.dumps((args, kwargs))[0]
         instrument = _metrics.metrics_enabled()
         t0 = _time.perf_counter() if instrument else 0.0
         last_err: Exception | None = None
+        adm = _RequestAdmission(self, args, kwargs, tenant, priority)
         for attempt in range(ROUTE_RETRIES):
             if self._version < -1 or not self._replicas:
                 await self._refresh(force=attempt > 0)
                 if not self._replicas:
                     await asyncio.sleep(0.2)
                     continue
+            adm.ensure_checked()  # raises shed/throttled, pre-counted
             pick_key = model_id or self._affinity_key(args, kwargs)
             digests = None
             if not model_id and self._prefix_routing_on():
                 self._maybe_refresh_state()
                 digests = self._prompt_digests(args, kwargs)
-            replica = self._pick(pick_key, digests, count_prefix=attempt == 0)
+            replica = self._pick(
+                pick_key, digests, count_prefix=attempt == 0,
+                exclude=adm.exclude,
+            )
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             if instrument:
@@ -491,7 +651,18 @@ class Router:
                 ref = replica.handle.remote(method, payload, model_id)
                 result = await core_api.get_async(ref)
                 self._note_model(pick_key, rid)
+                adm.count_once("admitted")
                 return result
+            except TaskError as e:
+                ov = self._overload_cause(e)
+                if ov is None:
+                    # Application error: admitted, surfaced as-is.
+                    adm.count_once("admitted")
+                    raise
+                if not adm.retry_overload(ov, rid):
+                    # Second saturated replica (or nowhere else to go):
+                    # shed fast — no backoff, the client owns the retry.
+                    raise ov from None
             except (ActorDiedError, ActorUnavailableError) as e:
                 # Replica died mid-request: drop it locally, force-refresh
                 # membership, back off (the controller may still be
@@ -509,6 +680,9 @@ class Router:
             finally:
                 if rid in self._inflight:
                     self._inflight[rid] -= 1
+        held = adm.exhausted()
+        if held is not None:
+            raise held from None
         if _metrics.metrics_enabled():
             _ERRORS.inc(1.0, {"deployment": self._deployment})
         raise last_err or RuntimeError(
@@ -517,28 +691,42 @@ class Router:
         )
 
     async def route_stream(
-        self, method: str, args: tuple, kwargs: dict, model_id: str = ""
+        self,
+        method: str,
+        args: tuple,
+        kwargs: dict,
+        model_id: str = "",
+        tenant: str = "",
+        priority: str = "",
     ):
         """Route one STREAMING request; an async generator of response
         chunks. Dead-replica retry only before the first chunk arrives —
         once items flowed, a failure surfaces to the caller (the reference
-        behaves the same: a stream is not transparently restartable)."""
+        behaves the same: a stream is not transparently restartable).
+        Admission and the single bounded-queue retry mirror route(); a
+        replica rejection can only happen pre-first-chunk (the replica
+        fails fast at generator start)."""
         payload = serialization.dumps((args, kwargs))[0]
         instrument = _metrics.metrics_enabled()
         t0 = _time.perf_counter() if instrument else 0.0
         last_err: Exception | None = None
+        adm = _RequestAdmission(self, args, kwargs, tenant, priority)
         for attempt in range(ROUTE_RETRIES):
             if self._version < -1 or not self._replicas:
                 await self._refresh(force=attempt > 0)
                 if not self._replicas:
                     await asyncio.sleep(0.2)
                     continue
+            adm.ensure_checked()  # raises shed/throttled, pre-counted
             pick_key = model_id or self._affinity_key(args, kwargs)
             digests = None
             if not model_id and self._prefix_routing_on():
                 self._maybe_refresh_state()
                 digests = self._prompt_digests(args, kwargs)
-            replica = self._pick(pick_key, digests, count_prefix=attempt == 0)
+            replica = self._pick(
+                pick_key, digests, count_prefix=attempt == 0,
+                exclude=adm.exclude,
+            )
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             if instrument:
@@ -555,9 +743,18 @@ class Router:
                     value = await core_api.get_async(ref)
                     if not delivered:
                         self._note_model(pick_key, rid)
+                        adm.count_once("admitted")
                     delivered = True
                     yield value
+                adm.count_once("admitted")  # zero-chunk streams admitted too
                 return
+            except TaskError as e:
+                ov = self._overload_cause(e)
+                if ov is None or delivered:
+                    adm.count_once("admitted")
+                    raise
+                if not adm.retry_overload(ov, rid):
+                    raise ov from None
             except (ActorDiedError, ActorUnavailableError) as e:
                 if delivered:
                     raise
@@ -574,6 +771,9 @@ class Router:
             finally:
                 if rid in self._inflight:
                     self._inflight[rid] -= 1
+        held = adm.exhausted()
+        if held is not None:
+            raise held from None
         if _metrics.metrics_enabled():
             _ERRORS.inc(1.0, {"deployment": self._deployment})
         raise last_err or RuntimeError(

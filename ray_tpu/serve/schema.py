@@ -18,6 +18,7 @@ fields this runtime drives:
         user_config: {temperature: 0.7}
         autoscaling_config: {min_replicas: 1, max_replicas: 4}
         request_affinity: prompt_prefix
+        admission_config: {tenant_rate: 50, queue_high: 12}
         ray_actor_options: {num_cpus: 1}
 
 ``import_path`` resolves "module.sub:attr"; the attr may be a Deployment
@@ -40,6 +41,7 @@ _APP_KEYS = {
     "user_config",
     "autoscaling_config",
     "request_affinity",
+    "admission_config",
     "ray_actor_options",
 }
 _TOP_KEYS = {"applications", "http", "grpc"}
@@ -132,6 +134,7 @@ def _to_application(entry: dict):
             "user_config",
             "autoscaling_config",
             "request_affinity",
+            "admission_config",
             "ray_actor_options",
         )
         if k in entry

@@ -751,6 +751,145 @@ def test_dcn_site_parses_and_is_seeded():
         faults.parse_rule("dcn.kill_worker")  # action/site mismatch
 
 
+# -- overload plane (seeded traffic replay) -----------------------------------
+
+
+def test_flash_crowd_replay_bit_identical():
+    """The overload acceptance contract: a flash-crowd scenario is a
+    replayable artifact. Same seed -> the same arrival schedule (to the
+    bit) AND the same admit/shed/throttle decision sequence through the
+    REAL admission primitives; a different seed diverges. The seed rides
+    RAY_TPU_FAULTS (faults.active_seed), so one value pins the fault
+    schedule and the traffic that drives it."""
+    from tools.traffic_gen import schedule, schedule_digest, simulate
+
+    # Seed defaulting rides the installed fault injector.
+    faults.install(faults.parse_spec(7, "send.delay,p=0.1,ms=1"))
+    s_implicit = schedule(
+        "flash_crowd", duration_s=12.0, base_rps=30.0, peak_factor=6.0
+    )
+    faults.clear()
+    s_explicit = schedule(
+        "flash_crowd", seed=7, duration_s=12.0, base_rps=30.0,
+        peak_factor=6.0,
+    )
+    assert schedule_digest(s_implicit) == schedule_digest(s_explicit)
+    assert s_implicit == s_explicit
+
+    # Bit-identical decisions: tenant buckets (throttles), watermark
+    # shedding (sheds), and admits all replay exactly from the seed.
+    # Capacity 30 req/s until the "autoscaler" lands 10x at t=4.5 — the
+    # crowd (6x base over the middle third) overwhelms the first, not
+    # the second.
+    cfg = {
+        "tenant_rate": 20.0,
+        "tenant_burst": 30.0,
+        "queue_high": 5.0,
+        "queue_low": 2.0,
+        "down_hold_s": 1.0,
+    }
+    kw = dict(
+        capacity_rps=30.0, admission_config=cfg, scale_up_at=4.5,
+        scale_factor=10.0,
+    )
+    r1 = simulate(s_explicit, **kw)
+    r2 = simulate(s_explicit, **kw)
+    assert r1["decisions"] == r2["decisions"]
+    assert r1["counts"] == r2["counts"]
+    assert r1["counts"]["shed"] > 0 and r1["counts"]["throttled"] > 0
+    assert r1["counts"]["admitted"] > 0
+    # Predictable degradation, in the deterministic model: the admitted
+    # interactive latency stays bounded while shed-rate absorbs the
+    # crowd, and after the capacity step-up the time-tail runs shed-free
+    # with the watermark state fully recovered.
+    assert r1["p99_latency_s"]["interactive"] < 2.0
+    assert r1["tail_shed"] == 0 and r1["final_level"] == 0
+    # A different seed is a different run.
+    s8 = schedule(
+        "flash_crowd", seed=8, duration_s=12.0, base_rps=30.0,
+        peak_factor=6.0,
+    )
+    assert schedule_digest(s8) != schedule_digest(s_explicit)
+    assert simulate(s8, **kw)["decisions"] != r1["decisions"]
+
+
+def test_drain_during_overload_never_double_sheds(chaos_cluster):
+    """Kill (the drain-path trigger) one of two replicas while an
+    overload burst is in flight: every request resolves to exactly ONE
+    outcome — success or a single typed OverloadedError — and the
+    admission counter records exactly one decision per request (a
+    replica death mid-retry must not re-shed or re-admit a request that
+    already has a verdict)."""
+    import asyncio
+    import threading
+
+    import ray_tpu.serve as serve
+    from ray_tpu.core.errors import OverloadedError
+    from ray_tpu.util.metrics import registry
+
+    def counter_total():
+        return sum(
+            v
+            for n, _t, v in registry().snapshot()["points"]
+            if n == "raytpu_serve_admission_total"
+        )
+
+    class Sleepy:
+        async def __call__(self, request):
+            await asyncio.sleep(0.3)
+            return {"ok": True}
+
+    dep = serve.deployment(
+        Sleepy,
+        name="drained",
+        num_replicas=2,
+        max_concurrent_queries=2,  # queue cap 4 per replica
+        ray_actor_options={"num_cpus": 0.5},
+        admission_config={"queue_high": 3.0, "queue_low": 1.0,
+                          "down_hold_s": 0.5},
+    )
+    try:
+        handle = serve.run(dep.bind())
+        before = counter_total()
+        n = 40
+        outcomes = [None] * n
+
+        def fire(i):
+            try:
+                outcomes[i] = handle.options(
+                    priority=("best_effort" if i % 3 == 0 else "interactive")
+                ).remote({"body": {}}).result(timeout=120)
+            except OverloadedError as e:
+                outcomes[i] = e
+            except Exception as e:  # noqa: BLE001 — the invariant breaker
+                outcomes[i] = e
+
+        threads = [
+            threading.Thread(target=fire, args=(i,)) for i in range(n)
+        ]
+        for i, t in enumerate(threads):
+            t.start()
+            if i == 12:  # mid-burst: one replica goes away
+                rid = serve.status()["drained"]["replica_ids"][0]
+                ray_tpu.kill(ray_tpu.ActorHandle(rid, "Replica"))
+            time.sleep(0.01)
+        for t in threads:
+            t.join(timeout=180)
+        ok = [o for o in outcomes if o == {"ok": True}]
+        overloaded = [o for o in outcomes if isinstance(o, OverloadedError)]
+        other = [
+            o
+            for o in outcomes
+            if o != {"ok": True} and not isinstance(o, OverloadedError)
+        ]
+        assert not other, other[:3]  # dead-replica retries stay invisible
+        assert len(ok) + len(overloaded) == n
+        # The one-decision-per-request invariant, through replica death:
+        assert counter_total() - before == n
+    finally:
+        serve.shutdown()
+
+
 @pytest.mark.slow
 def test_chaos_worker_kill_wave_converges(chaos_cluster):
     """Randomized (seeded) worker kills mid-task: the reap-and-retry path

@@ -126,6 +126,27 @@ def test_declare_runtime_metric_enforces_rules():
         m.declare_runtime_metric("raytpu_test_lint_series", "gauge")
 
 
+def test_admission_series_registered_and_linted():
+    """Overload-plane series (round-15): the admission outcome counter,
+    the per-tenant token gauge, and the watermark-state gauge are
+    declared through the catalog so the lint covers them."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    assert "raytpu_serve_admission_total" in catalog
+    assert catalog["raytpu_serve_admission_total"]["kind"] == "counter"
+    assert catalog["raytpu_serve_admission_total"]["tag_keys"] == (
+        "deployment", "decision", "priority",
+    )
+    assert "raytpu_serve_tenant_tokens" in catalog
+    assert catalog["raytpu_serve_tenant_tokens"]["kind"] == "gauge"
+    assert catalog["raytpu_serve_tenant_tokens"]["tag_keys"] == (
+        "deployment", "tenant",
+    )
+    assert "raytpu_serve_shed_watermark_state" in catalog
+    assert catalog["raytpu_serve_shed_watermark_state"]["kind"] == "gauge"
+    assert lint_catalog(catalog) == []
+
+
 def test_prefix_routing_series_registered_and_linted():
     """Round-12 cache-aware serving series: the router's prefix-routing
     outcome counters are declared through the catalog (the engine's

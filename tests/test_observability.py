@@ -414,8 +414,15 @@ def test_serve_request_breakdown_in_scrape(cluster):
 
         def ready():
             t = state.cluster_metrics_text()
+            # Wait for THIS deployment's rows, not just any serve rows:
+            # the driver registry is process-global, so serve tests in
+            # earlier-sorted modules (admission, chaos) leave
+            # requests_total/bucket rows that would otherwise satisfy the
+            # predicate from a push snapshot taken BEFORE Echo's counters
+            # landed.
             return (
-                _scrape_value(t, "raytpu_serve_requests_total") >= 5
+                'deployment="Echo"' in t
+                and _scrape_value(t, "raytpu_serve_requests_total") >= 5
                 and "raytpu_serve_router_wait_seconds_bucket" in t
                 and "raytpu_serve_replica_exec_seconds_bucket" in t
                 and t

@@ -1,0 +1,39 @@
+"""Same-session A/B of serve overload protection.
+
+Runs ``tools/ray_perf.py --serve-overload`` alternately with the
+admission plane ON (HEAD defaults: tenant token buckets, priority
+shedding on queue watermarks, bounded replica queues) and OFF
+(``--no-admission``, equivalent to RAY_TPU_ADMISSION=0) on the SAME
+commit, interleaved so ambient box load hits both arms equally (the
+round-3 lesson). The traffic is a SEEDED flash crowd
+(tools/traffic_gen.py, seed 7), so both arms see a bit-identical arrival
+schedule — the only variable is the plane.
+
+    python tools/ab_admission.py [--rounds 3] [--full]
+
+Read the result as: the ON arm's serve_overload_shed_rate is the crowd
+absorbed as fast rejections, and serve_overload_admitted_p99_ttft_ms is
+the interactive SLO the plane protects — compare it against the OFF
+arm's collapse (where shed_rate is ~0 because everything queues, and the
+p99 pays for it). The interleaved-median machinery is shared with
+tools/ab_coalesce.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import ab_main  # noqa: E402 — shared harness
+
+
+def main() -> int:
+    return ab_main(
+        "--no-admission", "admission", base_flags=("--serve-overload",)
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,7 @@ _CATALOG_MODULES = [
     "ray_tpu.core.gcs",  # drain lifecycle counters
     "ray_tpu.serve.router",
     "ray_tpu.serve.replica",
+    "ray_tpu.serve.admission",  # overload-plane series (429 tier)
     "ray_tpu.data.executor",
     "ray_tpu.train.context",
     "ray_tpu.train.input",  # prefetch-miss counter (host-free train tier)

@@ -367,6 +367,108 @@ def _serve_llm_rows(results: dict, no_chunked_prefill: bool, quick: bool):
     )
 
 
+def _serve_overload_rows(results: dict, no_admission: bool, quick: bool):
+    """Overload-protection rows: a seeded flash crowd (tools/traffic_gen)
+    fired open-loop at a slow 2-replica deployment whose admission config
+    sheds on queue watermarks. The A/B (--no-admission) shows what the
+    plane buys: with it, low-priority traffic absorbs the crowd as fast
+    429-style rejections and admitted interactive p99 stays bounded;
+    without it, every request queues and the whole tail collapses.
+
+      serve_overload_shed_rate            rejected fraction of offered load
+      serve_overload_admitted_p99_ttft_ms p99 latency of ADMITTED
+                                          interactive requests (the SLO
+                                          the plane protects)
+      serve_overload_p99_ttft_ms          p99 over every completed request
+      serve_overload_{admitted,shed,throttled} router admission counters
+    """
+    import sys as _sys
+
+    from ray_tpu import serve
+    from ray_tpu.core.errors import OverloadedError
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from traffic_gen import schedule, replay  # noqa: E402
+
+    class SlowEcho:
+        async def __call__(self, request):
+            import asyncio as _a
+
+            await _a.sleep(0.15)
+            return {"ok": True}
+
+    dep = serve.deployment(
+        SlowEcho,
+        name="overload",
+        num_replicas=2,
+        max_concurrent_queries=8,
+        admission_config={
+            "queue_high": 5.0,
+            "queue_low": 2.0,
+            "down_hold_s": 1.0,
+            "retry_after_s": 0.2,
+        },
+    )
+    handle = serve.run(dep.bind())
+    sched = schedule(
+        "flash_crowd",
+        seed=7,
+        duration_s=6.0 if quick else 12.0,
+        base_rps=15.0,
+        tenants=4,
+        peak_factor=10.0,
+    )
+
+    def submit(a):
+        t0 = time.perf_counter()
+        try:
+            handle.options(tenant=a.tenant, priority=a.priority).remote(
+                {"body": {"i": a.index}}
+            ).result(timeout=120)
+            return ("ok", a.priority, time.perf_counter() - t0)
+        except OverloadedError:
+            return ("rejected", a.priority, time.perf_counter() - t0)
+
+    outcomes = replay(sched, submit, max_workers=96)
+    done = [o for o in outcomes if isinstance(o, tuple)]
+    rejected = [o for o in done if o[0] == "rejected"]
+    ok_interactive = [
+        o for o in done if o[0] == "ok" and o[1] == "interactive"
+    ]
+    results["serve_overload_requests"] = len(sched)
+    results["serve_overload_shed_rate"] = round(
+        len(rejected) / max(1, len(done)), 4
+    )
+    results["serve_overload_admitted_p99_ttft_ms"] = _p99_ms(
+        [o[2] for o in ok_interactive]
+    )
+    results["serve_overload_p99_ttft_ms"] = _p99_ms(
+        [o[2] for o in done if o[0] == "ok"]
+    )
+    # Router-side admission counters (the routers run in THIS process).
+    from ray_tpu.util.metrics import registry
+
+    for decision in ("admitted", "shed", "throttled"):
+        total = 0.0
+        for n, tags, v in registry().snapshot()["points"]:
+            if (
+                n == "raytpu_serve_admission_total"
+                and tags.get("decision") == decision
+            ):
+                total += v
+        results[f"serve_overload_{decision}"] = total
+    arm = "no-admission" if no_admission else "admission"
+    print(
+        f"serve_overload [{arm}]: {len(sched)} offered, shed rate "
+        f"{results['serve_overload_shed_rate']:.1%}, admitted "
+        f"interactive p99 "
+        f"{results['serve_overload_admitted_p99_ttft_ms']} ms "
+        f"(all-ok p99 {results['serve_overload_p99_ttft_ms']} ms)",
+        flush=True,
+    )
+    serve.shutdown()
+
+
 def _hist_sum_count(name: str) -> tuple:
     """(sum, count) of one histogram across this process's registry."""
     from ray_tpu.util.metrics import registry
@@ -597,6 +699,22 @@ def main() -> int:
         "prefill (PERF.md round-12)",
     )
     ap.add_argument(
+        "--serve-overload",
+        action="store_true",
+        help="run only the overload-protection rows (seeded flash crowd "
+        "from tools/traffic_gen.py against a slow 2-replica deployment): "
+        "serve_overload_shed_rate + admitted-interactive p99 — the "
+        "admission A/B rides this via tools/ab_admission.py and "
+        "bench.py's serve_overload record",
+    )
+    ap.add_argument(
+        "--no-admission",
+        action="store_true",
+        help="kill switch: no admission control, priority shedding, or "
+        "bounded replica queues (equivalent to RAY_TPU_ADMISSION=0) — "
+        "the A/B baseline for the overload-protection tier",
+    )
+    ap.add_argument(
         "--train-only",
         action="store_true",
         help="run only the host-free train-step rows (pure-jax CPU loop, "
@@ -652,6 +770,7 @@ def main() -> int:
         or args.no_hierarchical
         or args.no_quantized
         or args.no_prefix_routing
+        or args.no_admission
     ):
         from ray_tpu.core.config import GLOBAL_CONFIG
 
@@ -668,6 +787,8 @@ def main() -> int:
             GLOBAL_CONFIG.collective_quantize_dcn = False
         if args.no_prefix_routing:
             GLOBAL_CONFIG.prefix_routing = False
+        if args.no_admission:
+            GLOBAL_CONFIG.admission = False
 
     if args.serve_llm_only:
         # Replica actors must run CPU jax even where a TPU plugin is
@@ -682,6 +803,14 @@ def main() -> int:
             results,
             no_chunked_prefill=args.no_chunked_prefill,
             quick=args.quick,
+        )
+        print(json.dumps(results), flush=True)
+        ray_tpu.shutdown()
+        return 0
+
+    if args.serve_overload:
+        _serve_overload_rows(
+            results, no_admission=args.no_admission, quick=args.quick
         )
         print(json.dumps(results), flush=True)
         ray_tpu.shutdown()

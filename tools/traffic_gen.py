@@ -1,0 +1,360 @@
+"""Seeded open-loop traffic generator (overload-plane validation).
+
+Produces a deterministic ARRIVAL SCHEDULE — (t, tenant, priority) tuples —
+for the serve overload scenarios, so a load test is a replayable artifact
+instead of an anecdote:
+
+    diurnal      sinusoidal ramp between ~0.3x and ~1.7x of base_rps
+                 (the daily cycle an autoscaler tracks)
+    flash_crowd  base_rps, then peak_factor * base_rps for the middle
+                 third of the run, then base again (the spike admission
+                 control exists to absorb while the autoscaler reacts)
+    tenant_skew  flat rate, but tenant-0 sends ~60% of it (the noisy
+                 neighbor per-tenant token buckets exist to contain)
+
+Determinism contract: ``schedule()`` is a pure function of
+(seed, scenario, duration_s, base_rps, tenants, peak_factor,
+priority_mix) — same inputs, bit-identical schedule, any host, any time.
+The seed defaults to the installed fault injector's seed
+(``faults.active_seed()``), so one ``RAY_TPU_FAULTS`` value pins both the
+fault schedule AND the traffic that drives it.
+
+``replay()`` fires a schedule open-loop (arrivals never wait for
+completions — overload means offered load exceeds capacity, and a
+closed-loop driver would self-throttle exactly when the test matters).
+``simulate()`` replays a schedule through the REAL admission primitives
+(serve/admission.py) against a virtual clock and a fluid-queue capacity
+model: the admit/shed decision sequence it returns is bit-identical run
+to run, which is what tests/test_chaos.py pins.
+
+    python tools/traffic_gen.py flash_crowd --seed 7 --digest
+    python tools/traffic_gen.py flash_crowd --seed 7 --url \
+        http://127.0.0.1:8000/Echo
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import math
+import os
+import random
+import sys
+import time
+from typing import Callable, Optional
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+SCENARIOS = ("diurnal", "flash_crowd", "tenant_skew")
+
+# Default priority mix: half normal user traffic, the rest labeled
+# sheddable (cumulative weights drawn against one uniform per arrival).
+PRIORITY_MIX = (
+    ("interactive", 0.5),
+    ("batch", 0.3),
+    ("best_effort", 0.2),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    t: float  # seconds from schedule start
+    tenant: str
+    priority: str
+    index: int
+
+
+def _rate(
+    scenario: str, t: float, duration_s: float, base_rps: float,
+    peak_factor: float,
+) -> float:
+    if scenario == "diurnal":
+        # Trough at t=0, peak mid-run: 0.3x .. 1.7x.
+        return base_rps * (1.0 + 0.7 * math.sin(
+            2.0 * math.pi * t / duration_s - math.pi / 2.0
+        ))
+    if scenario == "flash_crowd":
+        third = duration_s / 3.0
+        return base_rps * (peak_factor if third <= t < 2.0 * third else 1.0)
+    return base_rps  # tenant_skew: flat rate, skewed tenant choice
+
+
+def schedule(
+    scenario: str,
+    *,
+    seed: Optional[int] = None,
+    duration_s: float = 10.0,
+    base_rps: float = 50.0,
+    tenants: int = 4,
+    peak_factor: float = 8.0,
+    priority_mix=PRIORITY_MIX,
+) -> list:
+    """The deterministic arrival schedule for one scenario (see module
+    docstring for the replay contract). Arrivals are a thinned Poisson
+    process against the scenario's rate curve — every random draw comes
+    from ONE stream keyed on every schedule parameter, so an unrelated
+    parameter change cannot silently alias two schedules."""
+    if scenario not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {scenario!r} (scenarios: {SCENARIOS})"
+        )
+    if seed is None:
+        from ray_tpu.core import faults
+
+        seed = faults.active_seed() or 0
+    rng = random.Random(
+        f"traffic:{seed}:{scenario}:{duration_s}:{base_rps}:{tenants}:"
+        f"{peak_factor}:{tuple(priority_mix)}"
+    )
+    r_max = base_rps * (
+        peak_factor if scenario == "flash_crowd" else 1.7
+    )
+    out: list = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(r_max)
+        if t >= duration_s:
+            return out
+        # Thinning: accept with p = rate(t)/r_max. The draw happens for
+        # every candidate point, accepted or not — part of the contract
+        # that keeps the stream replay-exact.
+        accept = rng.random() < (
+            _rate(scenario, t, duration_s, base_rps, peak_factor) / r_max
+        )
+        u_tenant = rng.random()
+        u_prio = rng.random()
+        if not accept:
+            continue
+        if scenario == "tenant_skew":
+            # tenant-0 is the noisy neighbor (~60%); the rest uniform.
+            if u_tenant < 0.6 or tenants == 1:
+                tenant = "tenant-0"
+            else:
+                tenant = f"tenant-{1 + int(u_tenant * 97) % (tenants - 1)}"
+        else:
+            tenant = f"tenant-{int(u_tenant * tenants) % tenants}"
+        priority, acc = priority_mix[-1][0], 0.0
+        for name, w in priority_mix:
+            acc += w
+            if u_prio < acc:
+                priority = name
+                break
+        out.append(Arrival(t, tenant, priority, len(out)))
+
+
+def schedule_digest(sched: list) -> str:
+    """Stable hash of a schedule — the bit-identical-replay witness."""
+    h = hashlib.sha256()
+    for a in sched:
+        h.update(f"{a.t!r}:{a.tenant}:{a.priority};".encode())
+    return h.hexdigest()[:16]
+
+
+def replay(
+    sched: list,
+    submit: Callable[[Arrival], object],
+    *,
+    speed: float = 1.0,
+    max_workers: int = 64,
+) -> list:
+    """Fire ``submit(arrival)`` at each arrival's offset, OPEN-LOOP (the
+    next arrival never waits for an earlier completion), and return the
+    per-arrival results in schedule order (an exception becomes the
+    result value). ``speed`` > 1 compresses time."""
+    import concurrent.futures
+
+    results: list = [None] * len(sched)
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(max_workers) as pool:
+        futs = {}
+        for a in sched:
+            delay = a.t / speed - (time.perf_counter() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            futs[pool.submit(submit, a)] = a.index
+        for f in concurrent.futures.as_completed(futs):
+            try:
+                results[futs[f]] = f.result()
+            except Exception as e:  # noqa: BLE001 — outcome, not crash
+                results[futs[f]] = e
+    return results
+
+
+def simulate(
+    sched: list,
+    *,
+    capacity_rps: float,
+    admission_config: Optional[dict] = None,
+    scale_up_at: Optional[float] = None,
+    scale_factor: float = 2.0,
+) -> dict:
+    """Replay a schedule through the REAL admission primitives against a
+    virtual clock + fluid-queue capacity model; fully deterministic.
+
+    The queue drains at ``capacity_rps`` admitted-requests/s (times
+    ``scale_factor`` from ``scale_up_at`` on — the autoscaler having
+    caught up); each admitted request queues one unit and its virtual
+    latency is the queue depth ahead of it over capacity. The watermark
+    tracker sees that queue (the single-pool analogue of the
+    controller's mean per-replica depth) and the admission controller
+    the schedule's tenants/priorities — so the returned ``decisions``
+    sequence is exactly the plane's behavior for this schedule.
+    """
+    from ray_tpu.core.errors import OverloadedError
+    from ray_tpu.serve.admission import (
+        AdmissionController,
+        WatermarkTracker,
+        resolve_admission_config,
+    )
+
+    cfg = resolve_admission_config(admission_config or {})
+    clock = [0.0]
+    ac = AdmissionController(
+        "sim", cfg, now_fn=lambda: clock[0], instrument=False
+    )
+    tracker = WatermarkTracker(cfg)
+    queue = 0.0
+    last_t = 0.0
+    decisions: list = []
+    latency: dict = {p: [] for p, _ in PRIORITY_MIX}
+    counts = {"admitted": 0, "shed": 0, "throttled": 0}
+    for a in sched:
+        cap = capacity_rps * (
+            scale_factor
+            if scale_up_at is not None and a.t >= scale_up_at
+            else 1.0
+        )
+        queue = max(0.0, queue - (a.t - last_t) * cap)
+        last_t = a.t
+        clock[0] = a.t
+        level = tracker.update(queue, 0.0, a.t)
+        try:
+            ac.check(a.tenant, a.priority, level)
+        except OverloadedError as e:
+            d = e.reason if e.reason in counts else "shed"
+            decisions.append(d)
+            counts[d] += 1
+            continue
+        decisions.append("admitted")
+        counts["admitted"] += 1
+        latency.setdefault(a.priority, []).append(queue / cap)
+        queue += 1.0
+
+    def p99(xs: list) -> float:
+        if not xs:
+            return 0.0
+        s = sorted(xs)
+        return round(s[min(len(s) - 1, int(0.99 * len(s)))], 4)
+
+    # Convergence witness: the last 20% of the run BY TIME (a count-based
+    # tail would sit inside the crowd, where most arrivals land). After a
+    # scale_up_at inside the run, a converged system admits everything
+    # here.
+    t_end = sched[-1].t if sched else 0.0
+    tail_from = 0.8 * t_end
+    return {
+        "decisions": decisions,
+        "counts": counts,
+        "shed_rate": round(
+            (counts["shed"] + counts["throttled"]) / max(1, len(sched)), 4
+        ),
+        "p99_latency_s": {p: p99(xs) for p, xs in latency.items()},
+        "tail_shed": sum(
+            1
+            for a, d in zip(sched, decisions)
+            if a.t >= tail_from and d != "admitted"
+        ),
+        "final_level": tracker.level,
+    }
+
+
+def _http_submit(url: str, timeout: float) -> Callable[[Arrival], dict]:
+    import urllib.error
+    import urllib.request
+
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.serve.admission import PRIORITY_HEADER
+
+    def submit(a: Arrival) -> dict:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps({"index": a.index}).encode(),
+            headers={
+                "Content-Type": "application/json",
+                GLOBAL_CONFIG.serve_tenant_header: a.tenant,
+                PRIORITY_HEADER: a.priority,
+            },
+            method="POST",
+        )
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                resp.read()
+                status = resp.status
+        except urllib.error.HTTPError as e:
+            status = e.code
+        return {
+            "index": a.index,
+            "status": status,
+            "latency_s": round(time.perf_counter() - t0, 4),
+            "priority": a.priority,
+        }
+
+    return submit
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("scenario", choices=SCENARIOS)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--rps", type=float, default=50.0)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--peak", type=float, default=8.0)
+    ap.add_argument(
+        "--digest",
+        action="store_true",
+        help="print the schedule digest + size and exit (the replay "
+        "witness: same seed must print the same line anywhere)",
+    )
+    ap.add_argument(
+        "--url",
+        help="fire the schedule open-loop at this HTTP endpoint with "
+        "tenant/priority headers; prints a per-status summary",
+    )
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args()
+    sched = schedule(
+        args.scenario,
+        seed=args.seed,
+        duration_s=args.duration,
+        base_rps=args.rps,
+        tenants=args.tenants,
+        peak_factor=args.peak,
+    )
+    if args.digest or not args.url:
+        print(
+            json.dumps(
+                {
+                    "scenario": args.scenario,
+                    "arrivals": len(sched),
+                    "digest": schedule_digest(sched),
+                }
+            )
+        )
+        return 0
+    results = replay(sched, _http_submit(args.url, args.timeout))
+    by_status: dict = {}
+    for r in results:
+        key = str(r["status"]) if isinstance(r, dict) else type(r).__name__
+        by_status[key] = by_status.get(key, 0) + 1
+    print(json.dumps({"arrivals": len(sched), "by_status": by_status}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
