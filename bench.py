@@ -381,51 +381,54 @@ def _data_plane_rows() -> dict:
     return {}
 
 
-def _ab_rows(
-    label: str, base_flags: tuple, off_flags: tuple, timeout_s: int
-) -> dict:
-    """Shared ON/OFF A/B runner over ``tools/ray_perf.py --quick``: the
-    ON arm runs HEAD defaults, the OFF arm adds the kill-switch flags.
-    CPU-only (a wedged TPU tunnel can't block these rows), all-or-nothing
-    (a one-armed record would break round-over-round diffs), and
-    best-effort: any failure returns {} so the headline one-JSON-line
-    contract stands."""
+def _one_arm(label: str, flags: tuple, timeout_s: int) -> dict | None:
+    """One ``tools/ray_perf.py --quick`` run; returns its JSON row dict,
+    or None on any failure (CPU-only, best-effort — callers drop the
+    whole record so a one-armed A/B never lands)."""
     repo = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "tools", "ray_perf.py"),
+                "--quick",
+                *flags,
+            ],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=repo,
+        )
+        if r.returncode != 0:
+            _log(f"{label} failed rc={r.returncode}; skipping")
+            return None
+        for line in reversed(r.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        _log(f"{label} produced no JSON; skipping")
+    except Exception as e:  # noqa: BLE001 — never fail the headline
+        _log(f"{label} skipped: {type(e).__name__}: {e}")
+    return None
+
+
+def _ab_rows(
+    label: str, base_flags: tuple, off_flags: tuple, timeout_s: int
+) -> dict:
+    """Shared ON/OFF A/B runner: the ON arm runs HEAD defaults, the OFF
+    arm adds the kill-switch flags. All-or-nothing (a one-armed record
+    would break round-over-round diffs)."""
     out: dict = {}
     for arm, flags in (("on", ()), ("off", off_flags)):
-        try:
-            r = subprocess.run(
-                [
-                    sys.executable,
-                    os.path.join(repo, "tools", "ray_perf.py"),
-                    "--quick",
-                    *base_flags,
-                    *flags,
-                ],
-                timeout=timeout_s,
-                capture_output=True,
-                text=True,
-                env=env,
-                cwd=repo,
-            )
-            if r.returncode != 0:
-                _log(f"{label} arm {arm} failed rc={r.returncode}; skipping")
-                return {}
-            for line in reversed(r.stdout.strip().splitlines()):
-                try:
-                    out[arm] = json.loads(line)
-                    break
-                except json.JSONDecodeError:
-                    continue
-            if arm not in out:
-                _log(f"{label} arm {arm} produced no JSON; skipping")
-                return {}
-        except Exception as e:  # noqa: BLE001 — never fail the headline
-            _log(f"{label} rows skipped: {type(e).__name__}: {e}")
+        row = _one_arm(f"{label} arm {arm}", base_flags + flags, timeout_s)
+        if row is None:
             return {}
+        out[arm] = row
     return out
 
 
@@ -440,6 +443,37 @@ def _serve_llm_rows() -> dict:
         off_t = out["off"].get("serve_llm_shared_prefix", 0)
         if off_t:
             out["shared_prefix_tok_s_ratio"] = round(on_t / off_t, 3)
+    return out
+
+
+def _serve_disagg_rows(serve_llm: dict) -> dict:
+    """Disaggregated-serving + speculative-decoding A/B record (round
+    16): the decode-stall probe (cold long prompt joins the decode
+    engine as a KV handoff vs local prefill) and the spec-decode rows
+    (tok/s, per-token p99, accept rate). The ON arm is REUSED from the
+    serve_llm record (byte-identical ray_perf command — running it twice
+    would burn ~10 min of bench budget for the same numbers); only the
+    OFF arm (``--no-disagg --no-spec-decode``) runs here."""
+    on = (serve_llm or {}).get("on")
+    if not on or "serve_llm_disagg_stall_ms" not in on:
+        return {}
+    off = _one_arm(
+        "serve_disagg arm off",
+        ("--serve-llm-only", "--no-disagg", "--no-spec-decode"),
+        700,
+    )
+    if off is None:
+        return {}
+    out = {"on": on, "off": off}
+    on_s = on.get("serve_llm_disagg_stall_ms", 0)
+    off_s = off.get("serve_llm_disagg_stall_ms", 0)
+    if on_s:
+        # >1 = the handoff bounded the stall local prefill paid.
+        out["disagg_stall_off_on_ratio"] = round(off_s / on_s, 3)
+    on_t = on.get("serve_llm_spec_decode_tok_s", 0)
+    off_t = off.get("serve_llm_spec_decode_tok_s", 0)
+    if off_t:
+        out["spec_decode_tok_s_ratio"] = round(on_t / off_t, 3)
     return out
 
 
@@ -526,6 +560,7 @@ def _emit(
     raylint: dict | None = None,
     train_overlap: dict | None = None,
     serve_overload: dict | None = None,
+    serve_disagg: dict | None = None,
 ) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
@@ -534,6 +569,10 @@ def _emit(
         # the serving number (tok/s + p99 TTFT, routing ON vs OFF) from
         # round 12 on, TPU availability notwithstanding.
         record = {**record, "serve_llm": serve_llm}
+    if serve_disagg:
+        # Disagg + spec-decode A/B (stall probe, tok/s, accept rate)
+        # rides every record from round 16 on.
+        record = {**record, "serve_disagg": serve_disagg}
     if serve_overload:
         # Overload-protection A/B (admission ON vs OFF under the seeded
         # flash crowd) rides every record from round 15 on.
@@ -565,6 +604,7 @@ def main() -> None:
     # every plane).
     data_plane = _data_plane_rows()
     serve_llm = _serve_llm_rows()
+    serve_disagg = _serve_disagg_rows(serve_llm)
     serve_overload = _serve_overload_rows()
     train_overlap = _train_overlap_rows()
     raylint = _raylint_rows()
@@ -574,7 +614,7 @@ def main() -> None:
     def emit(record: dict) -> None:
         _emit(
             record, data_plane, probe_record, serve_llm, raylint,
-            train_overlap, serve_overload,
+            train_overlap, serve_overload, serve_disagg,
         )
 
     try:

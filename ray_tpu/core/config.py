@@ -169,6 +169,20 @@ class Config:
     # (DeploymentConfig.admission_config); these knobs are the cluster
     # defaults an admission_config inherits where it leaves fields unset.
     admission: bool = True
+    # Disaggregated LLM serving (round 16). ``disagg`` is the kill switch
+    # (RAY_TPU_DISAGG=0): off, the serve controller advertises no replica
+    # roles and routers never run the prefill->decode two-hop — the
+    # round-12 unified serving path, byte-identical. The plane itself is
+    # per-deployment OPT-IN (build_openai_app prefill_replicas > 0) and
+    # requires the paged KV cache (handoffs ship pool blocks over the
+    # transfer fabric). ``spec_decode`` is the speculative-decoding kill
+    # switch (RAY_TPU_SPEC_DECODE=0): off, engines never build a draft
+    # model and every decode step is the vanilla one-token program,
+    # whatever LLMConfig.spec_decode_tokens says — greedy outputs are
+    # token-identical either way (CI-pinned); the switch exists for the
+    # A/B and as the operational escape hatch.
+    disagg: bool = True
+    spec_decode: bool = True
     # Default per-replica concurrency budget (was a hard-coded 8 in
     # serve/router.py and the controller's max_concurrent_queries
     # fallbacks): the router's saturation-spill margin and the replica

@@ -28,6 +28,13 @@ site       actions                injected where
                                   DeadlineExceededError, never a hang.
                                   ``match`` globs the group name, ``peer``
                                   globs the affected slice name.
+``kvship`` sever delay            disaggregated-serving KV handoff pull
+                                  (``llm/disagg.py``): ``sever`` = the
+                                  prefill->decode block transfer fails →
+                                  the decode replica falls back to local
+                                  (chunked) prefill, token-identical, no
+                                  hang; ``delay`` sleeps the pull.
+                                  ``match`` globs the request id.
 =========  =====================  ==============================================
 
 Determinism: every rule owns a ``random.Random`` seeded from
@@ -76,6 +83,7 @@ _SITE_ACTIONS = {
     "store": frozenset({"pull_corrupt", "pull_lose"}),
     "chan": frozenset({"read_delay"}),
     "dcn": frozenset({"sever", "delay"}),
+    "kvship": frozenset({"sever", "delay"}),
 }
 
 

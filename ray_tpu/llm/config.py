@@ -64,6 +64,21 @@ class LLMConfig:
     # kill-switch arm of the A/B). Paged mode requires a multiple of
     # kv_block_size, same as prefix_chunk.
     prefill_chunk_tokens: int = 0
+    # Speculative decoding (reference: the draft/target scheme vLLM runs
+    # under ray.llm; the Gemma-on-TPU serving playbook in PAPERS.md): a
+    # small draft model proposes up to this many greedy tokens per engine
+    # step and the target model verifies them in ONE multi-token forward
+    # (models.paged.paged_verify / the dense twin) — each step then yields
+    # 1..k+1 tokens instead of exactly 1, at one target forward per step.
+    # Greedy outputs are token-identical to vanilla decode (CI-pinned).
+    # 0 = off. RAY_TPU_SPEC_DECODE=0 is the cluster kill switch.
+    spec_decode_tokens: int = 0
+    # Draft model for speculative decoding: a model config (same families
+    # as model_config) whose vocab matches the target's. The draft SHARES
+    # the paged pool's block structure — same BlockManager, same block
+    # tables — through a parallel {"k","v"} pytree sized by its own
+    # layer/head dims. Required when spec_decode_tokens > 0.
+    draft_model_config: Any = None
 
     def build_model_config(self):
         from ray_tpu.models.gpt2 import GPT2Config

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models import gpt2
@@ -147,6 +148,16 @@ class _Request:
     # Admission failure surfaced via pop_finished (an impossible
     # reservation must fail the REQUEST, not wedge the engine loop).
     error: Optional[str] = None
+    # Disaggregated serving: prefill_only requests finish at their first
+    # sampled token and carry the exported KV descriptor out through
+    # ``handoff_out``; handoff-admitted requests carry the INBOUND
+    # descriptor in ``handoff`` until admission pulls (or falls back).
+    prefill_only: bool = False
+    handoff: Optional[dict] = None
+    handoff_out: Optional[dict] = None
+    # Speculative decoding: the draft model prefilled this request's
+    # prompt, so the slot may join spec steps.
+    spec_ready: bool = False
     # Telemetry anchors: admission wall-clock and the previous token's
     # timestamp (TTFT / inter-token latency).
     t_admit: float = 0.0
@@ -271,6 +282,14 @@ class LLMEngine:
             "prefix_lookups": 0,
             "prefix_tokens_reused": 0,
             "tokens_generated": 0,
+            # Disaggregated serving (llm/disagg.py):
+            "handoffs_out": 0,  # prefill-only requests exported
+            "handoffs_in": 0,  # handoff admissions that pulled KV
+            "kv_fallbacks": 0,  # pulls that failed -> local prefill
+            # Speculative decoding (llm/spec_decode.py):
+            "spec_steps": 0,
+            "spec_drafted": 0,
+            "spec_accepted": 0,
         }
         # Host-side slot state (numpy: mutated per step)
         self.positions = np.zeros(B, np.int32)  # next write position
@@ -295,6 +314,19 @@ class LLMEngine:
 
         self._ttft_window: deque = deque(maxlen=64)
         self.TTFT_WINDOW_S = 30.0
+        # Disaggregated serving: (uuid, armed_at) of KV exports awaiting a
+        # decode-replica pull (TTL-released by the next export).
+        self._kv_exports: list = []
+        # Speculative decoding: built only when the config asks for it AND
+        # the kill switch is not thrown — with self._spec None, step() is
+        # byte-identical to the round-12 engine.
+        self._spec = None
+        if config.spec_decode_tokens > 0 and GLOBAL_CONFIG.spec_decode:
+            from ray_tpu.llm.spec_decode import SpecDecoder
+
+            self._spec = SpecDecoder(
+                self, config.draft_model_config, config.spec_decode_tokens
+            )
 
     # -- jitted bodies (slot-batched cache update) ---------------------------
     def _prefill_impl(self, params, tokens, length, cache, slot, cfg):
@@ -362,7 +394,17 @@ class LLMEngine:
         request_id: str,
         prompt: "str | list",
         sampling: SamplingParams | None = None,
+        prefill_only: bool = False,
     ) -> None:
+        """Admit a request. ``prefill_only`` (disaggregated serving's
+        prefill leg; paged mode only) finishes the request at its first
+        sampled token with the prompt KV exported as ``handoff_out``
+        instead of joining the decode batch."""
+        if prefill_only and not self.paged:
+            raise ValueError(
+                "prefill_only requests need the paged KV cache "
+                "(kv_block_size > 0): handoffs ship pool blocks"
+            )
         sampling = sampling or SamplingParams()
         ids = (
             self.tokenizer.encode(prompt)
@@ -383,11 +425,47 @@ class LLMEngine:
             max_tokens=sampling.max_tokens,
             temperature=sampling.temperature,
             stop_token=stop,
+            prefill_only=prefill_only,
             t_admit=_time.perf_counter(),
         )
         if _metrics.metrics_enabled():
             _REQUESTS.inc(1.0)
             _PROMPT_TOKENS.inc(float(len(ids)))
+
+    def add_handoff_request(
+        self,
+        request_id: str,
+        handoff: dict,
+        sampling: SamplingParams | None = None,
+    ) -> None:
+        """Admit a disaggregated request from a prefill replica's handoff:
+        the prompt KV arrives over the transfer fabric at admission and
+        the request joins the decode batch with its first token already
+        sampled — this replica never prefills the prompt (unless the pull
+        fails, in which case admission falls back to the local, chunked
+        when configured, prefill path). Counts neither requests_total nor
+        prompt_tokens: the prefill replica already did."""
+        sampling = sampling or SamplingParams()
+        stop = (
+            sampling.stop_token
+            if sampling.stop_token is not None
+            else self.tokenizer.eos_id
+        )
+        ids = list(handoff.get("prompt") or [])
+        req = _Request(
+            request_id=request_id,
+            prompt=ids,
+            max_tokens=sampling.max_tokens,
+            temperature=sampling.temperature,
+            stop_token=stop,
+            handoff=dict(handoff),
+            t_admit=_time.perf_counter(),
+        )
+        if not self.paged:
+            # Dense engines cannot land shipped blocks: degrade to a plain
+            # re-prefill admission (greedy outputs identical).
+            req.handoff = None
+        self.requests[request_id] = req
 
     # -- prefix pool ---------------------------------------------------------
 
@@ -481,6 +559,17 @@ class LLMEngine:
                 slot = self.slot_free.index(True)
             except ValueError:
                 return admit_finished
+            if req.handoff is not None:
+                verdict = self._admit_handoff(req, slot)
+                if verdict == "wait":
+                    return admit_finished
+                if verdict == "done":
+                    if req.finished:
+                        admit_finished.append(req)
+                    continue
+                # "fallback": the pull failed and the handoff is cleared —
+                # the local admission paths below (chunked prefill
+                # included) take over, token-identical under greedy.
             if self.paged:
                 logits = self._admit_paged(req, slot)
             else:
@@ -500,6 +589,14 @@ class LLMEngine:
             T = len(req.prompt)
             tok = self._sample(np.asarray(logits), req)  # raylint: disable=RL101 -- admission sampling: first token sampled host-side from the last-logits readback
             req.slot = slot
+            self.slot_free[slot] = False
+            self._slot_req[slot] = req
+            if req.prefill_only:
+                # Disaggregated prefill leg: export the prompt KV and
+                # finish here — the decode tier takes it from the handoff.
+                self._finish_prefill_only(req, tok)
+                admit_finished.append(req)
+                continue
             req.generated.append(tok)
             self.stats["tokens_generated"] += 1
             req.t_last_token = _time.perf_counter()
@@ -508,14 +605,122 @@ class LLMEngine:
             )
             if _metrics.metrics_enabled():
                 _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
-            self.slot_free[slot] = False
-            self._slot_req[slot] = req
             self.positions[slot] = T
             self.last_tokens[slot] = tok
+            if self._spec is not None:
+                req.spec_ready = self._spec.prefill_draft(req)
             self._maybe_finish(req)
             if req.finished:
                 admit_finished.append(req)
         return admit_finished
+
+    def _admit_handoff(self, req: _Request, slot: int) -> str:
+        """Admit a disaggregated handoff: reserve blocks, pull the shipped
+        KV into them, join the decode batch with the first token already
+        sampled — this replica never prefills the prompt. Returns "done"
+        (admitted, or finished without a slot), "wait" (no blocks free —
+        the FIFO wave stops), or "fallback" (the pull failed: handoff
+        cleared, the caller runs local admission)."""
+        from ray_tpu.llm import disagg
+
+        h = req.handoff
+        if h.get("finished"):
+            # Stop token / max_tokens hit at prefill: the shipped first
+            # token IS the whole response; no KV, no slot.
+            req.handoff = None
+            req.generated.append(int(h["first_token"]))
+            self.stats["tokens_generated"] += 1
+            req.t_last_token = _time.perf_counter()
+            req.finished = True
+            return "done"
+        if (
+            not h.get("kv")
+            or int(h.get("block_size") or 0) != self._block_size
+        ):
+            # Malformed or foreign block geometry: local prefill.
+            req.handoff = None
+            self.stats["kv_fallbacks"] += 1
+            return "fallback"
+        T = len(req.prompt)
+        bs = self._block_size
+        total = min(T + req.max_tokens, self.config.max_seq)
+        nb_total = -(-total // bs)
+        nb_kv = int(h["nblocks"])
+        if nb_total > self.block_mgr.num_blocks - 1:
+            req.error = (
+                f"request {req.request_id} needs {nb_total} KV blocks but "
+                f"the pool only has {self.block_mgr.num_blocks - 1}; raise "
+                f"num_kv_blocks or lower max_tokens"
+            )
+            req.finished = True
+            return "done"
+        if not self.block_mgr.can_alloc(nb_total):
+            self._evict_prefixes_until(nb_total)
+            if not self.block_mgr.can_alloc(nb_total):
+                return "wait"
+        table = self.block_mgr.alloc(nb_total)
+        try:
+            kv = disagg.pull_kv(h, req.request_id)
+            pk = self.pool["k"]
+            if (
+                kv.shape[0] != 2
+                or kv.shape[1] != pk.shape[0]
+                or kv.shape[2] < nb_kv
+                or kv.shape[3:] != pk.shape[2:]
+            ):
+                raise ValueError(
+                    f"handoff KV shape {kv.shape} does not fit pool "
+                    f"{pk.shape}"
+                )
+        except Exception:  # raylint: disable=RL006 -- ANY pull failure (sever, dead peer, bad shape) takes the counted local-prefill fallback
+            self.block_mgr.decref(table)
+            req.handoff = None
+            self.stats["kv_fallbacks"] += 1
+            return "fallback"
+        self.pool = disagg.scatter_into_pool(self, kv, table[:nb_kv])
+        req.blocks = table
+        row = np.zeros(self._table_width, np.int32)
+        row[: len(table)] = table
+        self.block_tables[slot] = row
+        req.slot = slot
+        self.slot_free[slot] = False
+        self._slot_req[slot] = req
+        tok = int(h["first_token"])
+        req.handoff = None
+        req.generated.append(tok)
+        self.stats["tokens_generated"] += 1
+        self.stats["handoffs_in"] += 1
+        # No TTFT here: the first token was produced (and its TTFT
+        # observed) on the prefill replica; this clock anchors ITL only.
+        req.t_last_token = _time.perf_counter()
+        self.positions[slot] = T
+        self.last_tokens[slot] = tok
+        if self._spec is not None:
+            req.spec_ready = self._spec.prefill_draft(req)
+        self._maybe_finish(req)
+        return "done"
+
+    def _finish_prefill_only(self, req: _Request, tok: int) -> None:
+        """Finish a prefill-only request at its first sampled token:
+        record the token, export the prompt KV for the decode tier (while
+        the blocks are still held — the gather copies), then release the
+        slot. TTFT is observed HERE: the prefill replica produced the
+        first token."""
+        from ray_tpu.llm import disagg
+
+        req.generated.append(tok)
+        self.stats["tokens_generated"] += 1
+        req.t_last_token = _time.perf_counter()
+        self._ttft_window.append(
+            (_time.monotonic(), req.t_last_token - req.t_admit)
+        )
+        if _metrics.metrics_enabled():
+            _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
+        done = req.max_tokens <= 1 or tok == req.stop_token
+        req.handoff_out = disagg.export_kv(self, req, tok, finished=done)
+        self.stats["handoffs_out"] += 1
+        req.finished = True
+        self._release_slot(req)
 
     def _admit_paged(self, req: _Request, slot: int):
         """Reserve blocks, point the slot's table at them (sharing any
@@ -527,7 +732,10 @@ class LLMEngine:
         mid-decode — the no-preemption counterpart of vLLM's watermark."""
         T = len(req.prompt)
         bs = self._block_size
-        total = min(T + req.max_tokens, self.config.max_seq)
+        # Prefill-only requests (disagg) never decode here: reserve for
+        # the prompt + the one sampled token, not the decode budget.
+        mt = 1 if req.prefill_only else req.max_tokens
+        total = min(T + mt, self.config.max_seq)
         entry = self._find_prefix(req.prompt)
         P = 0
         if entry is not None:
@@ -821,6 +1029,14 @@ class LLMEngine:
             return []
         req.prefilling = False
         tok = self._sample(np.asarray(logits), req)  # raylint: disable=RL101 -- final-chunk sampling: first token sampled host-side from the chunk's last-logits
+        self._insert_prefix(
+            req.prompt, req.slot,
+            blocks=req.blocks if self.paged else None,
+        )
+        if req.prefill_only:
+            # Disaggregated prefill leg, chunked variant: export + finish.
+            self._finish_prefill_only(req, tok)
+            return [req]
         req.generated.append(tok)
         self.stats["tokens_generated"] += 1
         req.t_last_token = _time.perf_counter()
@@ -831,10 +1047,8 @@ class LLMEngine:
             _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
         self.positions[req.slot] = T
         self.last_tokens[req.slot] = tok
-        self._insert_prefix(
-            req.prompt, req.slot,
-            blocks=req.blocks if self.paged else None,
-        )
+        if self._spec is not None:
+            req.spec_ready = self._spec.prefill_draft(req)
         self._maybe_finish(req)
         return [req] if req.finished else []
 
@@ -855,20 +1069,23 @@ class LLMEngine:
         )
         if done:
             req.finished = True
-            if req.slot >= 0:
-                if self.paged:
-                    # Drop this request's references; shared prefix blocks
-                    # stay alive under the pool's own refs. Point the slot
-                    # at the scratch block so its garbage decode writes
-                    # can never land in a block someone else now owns.
-                    self.block_mgr.decref(req.blocks)
-                    req.blocks = []
-                    self.block_tables[req.slot] = 0
-                    self.positions[req.slot] = 0
-                    self.last_tokens[req.slot] = 0
-                self.slot_free[req.slot] = True
-                self._slot_req[req.slot] = None
-                req.slot = -1
+            self._release_slot(req)
+
+    def _release_slot(self, req: _Request) -> None:
+        """Return a request's slot and block references to the engine.
+        Shared prefix blocks stay alive under the pool's own refs; the
+        slot's table points at the scratch block so its garbage decode
+        writes can never land in a block someone else now owns."""
+        if req.slot >= 0:
+            if self.paged:
+                self.block_mgr.decref(req.blocks)
+                req.blocks = []
+                self.block_tables[req.slot] = 0
+                self.positions[req.slot] = 0
+                self.last_tokens[req.slot] = 0
+            self.slot_free[req.slot] = True
+            self._slot_req[req.slot] = None
+            req.slot = -1
 
     # -- the engine loop ------------------------------------------------------
     def step(self) -> list:
@@ -883,7 +1100,9 @@ class LLMEngine:
         active = [
             r for r in self._slot_req if r is not None and not r.prefilling
         ]
-        if active:
+        if active and self._spec is not None and self._spec_eligible(active):
+            finished += self._spec.step(active)
+        elif active:
             if self.paged:
                 self.pool, logits = self._pg_decode(
                     self.params,
@@ -918,6 +1137,27 @@ class LLMEngine:
         if instrument:
             self._publish_metrics()
         return finished
+
+    def _spec_eligible(self, active: list) -> bool:
+        """A spec step is legal only when EVERY active slot is greedy with
+        draft KV, and EVERY occupied slot (prefilling ones included: the
+        fixed-shape verify writes k+1 garbage rows at their cursor, like
+        vanilla decode writes one) sits k rows clear of max_seq — the
+        bound that keeps every verify write inside the block table. All-
+        or-nothing: the verify program is one fixed-shape batch; an
+        ineligible step runs the vanilla program, token-identical."""
+        k = self._spec.k
+        lim = self.config.max_seq - 1
+        for r in self._slot_req:
+            if r is None:
+                continue
+            if self.positions[r.slot] + k > lim:
+                return False
+            if not r.prefilling and not (
+                r.spec_ready and r.temperature <= 0.0
+            ):
+                return False
+        return True
 
     def _publish_metrics(self) -> None:
         """Per-step gauge/counter publication: the generated-token delta

@@ -68,8 +68,13 @@ class LLMServer:
     def _step_with_admissions(self) -> list:
         with self._pending_lock:
             batch, self._pending = self._pending, []
-        for rid, prompt, sampling in batch:
-            self.engine.add_request(rid, prompt, sampling)
+        for rid, prompt, sampling, prefill_only, handoff in batch:
+            if handoff is not None:
+                self.engine.add_handoff_request(rid, handoff, sampling)
+            else:
+                self.engine.add_request(
+                    rid, prompt, sampling, prefill_only=prefill_only
+                )
         finished = self.engine.step()
         for req in finished:
             self.engine.requests.pop(req.request_id, None)
@@ -120,14 +125,22 @@ class LLMServer:
                 if not more and not self._pending:
                     return
 
-    def _admit(self, prompt, sampling: SamplingParams) -> str:
+    def _admit(
+        self,
+        prompt,
+        sampling: SamplingParams,
+        prefill_only: bool = False,
+        handoff: dict | None = None,
+    ) -> str:
         rid = f"req-{next(self._counter)}"
         with self._pending_lock:
-            self._pending.append((rid, prompt, sampling))
+            self._pending.append((rid, prompt, sampling, prefill_only, handoff))
         return rid
 
-    async def _generate(self, prompt, sampling: SamplingParams) -> dict:
-        rid = self._admit(prompt, sampling)
+    async def _generate(
+        self, prompt, sampling: SamplingParams, handoff: dict | None = None
+    ) -> dict:
+        rid = self._admit(prompt, sampling, handoff=handoff)
         ev = asyncio.Event()
         self._events[rid] = ev
         self._ensure_pump()
@@ -144,11 +157,13 @@ class LLMServer:
             "error": getattr(req, "error", None),
         }
 
-    async def _stream_tokens(self, prompt, sampling: SamplingParams):
+    async def _stream_tokens(
+        self, prompt, sampling: SamplingParams, handoff: dict | None = None
+    ):
         """Async generator of decoded text pieces, one per generated token,
         emitted as each decode step lands (true token streaming: the chip is
         still decoding later tokens while early ones are on the wire)."""
-        rid = self._admit(prompt, sampling)
+        rid = self._admit(prompt, sampling, handoff=handoff)
         q: asyncio.Queue = asyncio.Queue()
         self._token_queues[rid] = q
         ev = asyncio.Event()
@@ -193,14 +208,63 @@ class LLMServer:
             temperature=float(body.get("temperature", 0.0)),
         )
 
-    def _stream_chunks(self, prompt, body: dict, created: int, chat: bool):
+    @staticmethod
+    def _prompt_of(request: dict) -> str:
+        """The prompt text this replica will tokenize — the same rules the
+        router's _extract_prompt mirrors (chat path -> the shared
+        chat_prompt join, everything else -> body['prompt'])."""
+        body = request.get("body") or {}
+        if not isinstance(body, dict):
+            return ""
+        if str(request.get("path", "")).endswith("/v1/chat/completions"):
+            from ray_tpu.util.prefix_digest import chat_prompt
+
+            msgs = body.get("messages", [])
+            return chat_prompt(msgs if isinstance(msgs, list) else [])
+        return body.get("prompt", "")
+
+    async def prefill_handoff(self, request: dict) -> dict:
+        """Prefill leg of the disaggregated two-hop (router-invoked on
+        prefill-role replicas): run admission + prefill for the request's
+        prompt, sample the first token, and return the handoff descriptor
+        — prompt ids, the first token, and the armed KV-block export the
+        decode replica pulls over the transfer fabric. Returns
+        {"unsupported": True} when this replica cannot export (dense
+        cache, or the RAY_TPU_DISAGG kill switch landed here first) — the
+        router then falls back to unified routing."""
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        if (
+            not getattr(self.engine, "paged", False)
+            or not GLOBAL_CONFIG.disagg
+        ):
+            return {"unsupported": True}
+        body = request.get("body") or {}
+        if not isinstance(body, dict):
+            return {"error": "JSON body required"}
+        rid = self._admit(
+            self._prompt_of(request), self._sampling(body), prefill_only=True
+        )
+        ev = asyncio.Event()
+        self._events[rid] = ev
+        self._ensure_pump()
+        await ev.wait()
+        req = self._finished.pop(rid)
+        if getattr(req, "error", None):
+            return {"error": req.error}
+        return req.handoff_out or {"unsupported": True}
+
+    def _stream_chunks(
+        self, prompt, body: dict, created: int, chat: bool,
+        handoff: dict | None = None,
+    ):
         """OpenAI-convention chunk objects (chat.completion.chunk /
         text_completion chunks), one per token, + a finish_reason tail."""
 
         async def chunks():
             idx = 0
             async for piece in self._stream_tokens(
-                prompt, self._sampling(body)
+                prompt, self._sampling(body), handoff=handoff
             ):
                 idx += 1
                 if chat:
@@ -252,14 +316,21 @@ class LLMServer:
         if not isinstance(body, dict):
             return {"error": "JSON body required"}
         created = int(time.time())
+        # Disaggregated two-hop: the router attaches the prefill replica's
+        # handoff; this (decode) replica joins the request mid-decode.
+        handoff = request.get("_handoff")
         if path.endswith("/v1/chat/completions"):
-            from ray_tpu.util.prefix_digest import chat_prompt
-
-            msgs = body.get("messages", [])
-            prompt = chat_prompt(msgs if isinstance(msgs, list) else [])
+            # ONE prompt-derivation rule (shared with prefill_handoff —
+            # the handoff pairing depends on both replicas deriving the
+            # same text the shipped KV encodes).
+            prompt = self._prompt_of(request)
             if body.get("stream"):
-                return self._stream_chunks(prompt, body, created, chat=True)
-            out = await self._generate(prompt, self._sampling(body))
+                return self._stream_chunks(
+                    prompt, body, created, chat=True, handoff=handoff
+                )
+            out = await self._generate(
+                prompt, self._sampling(body), handoff=handoff
+            )
             if out.get("error"):
                 return {"error": out["error"]}
             return {
@@ -280,10 +351,14 @@ class LLMServer:
                 "usage": {"completion_tokens": out["num_generated"]},
             }
         # default: completions
-        prompt = body.get("prompt", "")
+        prompt = self._prompt_of(request)
         if body.get("stream"):
-            return self._stream_chunks(prompt, body, created, chat=False)
-        out = await self._generate(prompt, self._sampling(body))
+            return self._stream_chunks(
+                prompt, body, created, chat=False, handoff=handoff
+            )
+        out = await self._generate(
+            prompt, self._sampling(body), handoff=handoff
+        )
         if out.get("error"):
             return {"error": out["error"]}
         return {
@@ -304,6 +379,7 @@ def build_openai_app(
     name: str = "llm",
     num_replicas: int = 1,
     admission_config: dict | None = None,
+    prefill_replicas: int = 0,
 ):
     """An Application serving OpenAI-style routes under /{name}/v1/...
     (reference: ray.serve.llm build_openai_app). ``admission_config``
@@ -311,14 +387,35 @@ def build_openai_app(
     buckets, priority shedding on queue/TTFT watermarks, bounded replica
     queues — see README "Overload protection"); LLM replicas advertise a
     rolling p95 TTFT, so the ttft_high_ms/ttft_low_ms watermarks are
-    live for this deployment."""
+    live for this deployment.
+
+    ``prefill_replicas`` > 0 opts into DISAGGREGATED serving: the
+    deployment runs ``prefill_replicas`` prefill-role replicas plus
+    ``num_replicas`` decode-role replicas, roles advertised in the
+    routing table. The router lands each request's prefill on a prefill
+    replica (prefix-digest bias preserved), ships the finished KV blocks
+    to a decode replica over the transfer fabric (the handoff carries the
+    first sampled token), and decode replicas never run whole-suffix
+    prefill — see README "Disaggregated serving". Requires the paged KV
+    cache; RAY_TPU_DISAGG=0 restores unified serving byte-identically."""
     from ray_tpu.util.prefix_digest import BYTE_BOS_SCHEME
 
+    disagg_config = None
+    if prefill_replicas > 0:
+        if config.kv_block_size <= 0:
+            raise ValueError(
+                "disaggregated serving (prefill_replicas > 0) requires "
+                "the paged KV cache (kv_block_size > 0): handoffs ship "
+                "pool blocks over the transfer fabric"
+            )
+        disagg_config = {"prefill_replicas": int(prefill_replicas)}
+        num_replicas = int(num_replicas) + int(prefill_replicas)
     dep = serve_api.deployment(
         LLMServer,
         name=name,
         num_replicas=num_replicas,
         admission_config=admission_config,
+        disagg_config=disagg_config,
         ray_actor_options=dict(config.placement),
         # Same-prefix requests stick to a replica whose engine already
         # pooled that prefix's KV (no re-prefill of shared system prompts).

@@ -58,6 +58,14 @@ class DeploymentConfig:
     #    "ttft_high_ms"/"ttft_low_ms": rolling-TTFT watermarks (0 = off),
     #    "down_hold_s": hysteresis dwell, "retry_after_s": shed hint}
     admission_config: Optional[dict] = None
+    # Disaggregated LLM serving (llm/disagg.py). None = unified replicas
+    # (every replica prefills AND decodes — the pre-round-16 behavior).
+    # {"prefill_replicas": n} assigns the deployment's first n replicas
+    # the "prefill" role and the rest "decode"; the controller advertises
+    # per-replica roles in the routing table and routers run the two-hop
+    # prefill->handoff->decode placement. RAY_TPU_DISAGG=0 strips the
+    # roles from every table (unified routing, byte-identical).
+    disagg_config: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

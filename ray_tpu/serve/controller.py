@@ -178,6 +178,22 @@ class ServeController:
             if info is not None:
                 table["admission"] = info
                 table["shed_level"] = dep.get("_shed_level", 0)
+        # Disaggregated serving: per-replica roles ride the table (first
+        # prefill_replicas entries in membership order are the prefill
+        # tier; replacements appended by the reconciler re-balance on the
+        # next table push). With the kill switch thrown
+        # (RAY_TPU_DISAGG=0) the table is byte-identical to the unified
+        # one — routers then never two-hop.
+        if GLOBAL_CONFIG.disagg:
+            dcfg = dep["config"].get("disagg_config")
+            if dcfg:
+                p = int(dcfg.get("prefill_replicas") or 0)
+                table["disagg"] = {
+                    "roles": {
+                        r._actor_id: ("prefill" if i < p else "decode")
+                        for i, (r, _) in enumerate(dep["replicas"])
+                    }
+                }
         return table
 
     async def poll_routing(

@@ -64,6 +64,15 @@ _PREFIX_ROUTE_MISSES = _metrics.Counter(
     "(digest miss or saturated hot replica)",
     tag_keys=("deployment",),
 )
+# Disaggregated serving: requests whose prefill ran on a prefill-role
+# replica and whose KV handoff was dispatched to a decode-role replica
+# (the two-hop placement). Requests that fell back to unified routing
+# (hop failure, empty role set, kill switch) are NOT counted.
+_DISAGG_HANDOFFS = _metrics.Counter(
+    "raytpu_serve_disagg_handoffs_total",
+    "requests routed through the disaggregated prefill->decode two-hop",
+    tag_keys=("deployment",),
+)
 
 
 class DeploymentNotFoundError(ValueError):
@@ -188,6 +197,10 @@ class Router:
         # RAY_TPU_ADMISSION=0 stripped the table keys).
         self._admission: _admission.AdmissionController | None = None
         self._shed_level = 0
+        # Disaggregated serving: per-replica roles from the routing table
+        # ({actor_id: "prefill"|"decode"}; empty = unified deployment or
+        # RAY_TPU_DISAGG=0 stripped them).
+        self._disagg_roles: dict = {}
 
     def close(self) -> None:
         for attr in ("_listen_task", "_state_task"):
@@ -305,6 +318,7 @@ class Router:
             table.get("max_concurrent") or GLOBAL_CONFIG.serve_max_concurrent
         )
         self._shed_level = int(table.get("shed_level") or 0)
+        self._disagg_roles = (table.get("disagg") or {}).get("roles") or {}
         adm = table.get("admission")
         if isinstance(adm, dict):
             if self._admission is None:
@@ -482,6 +496,7 @@ class Router:
         digests: list | None = None,
         count_prefix: bool = True,
         exclude: str | None = None,
+        candidates: list | None = None,
     ):
         """Power of two choices on the local in-flight estimates; with a
         model id, prefer replicas that model was recently routed to (its
@@ -491,8 +506,11 @@ class Router:
         pool already holds them (prefix-affinity routing). ``exclude``
         drops one replica from consideration — the overload retry must
         land on a DIFFERENT replica than the one that just failed fast
-        (when one exists)."""
-        candidates = self._replicas
+        (when one exists). ``candidates`` restricts the choice to a
+        subset of the table (disaggregated role picks); an empty subset
+        falls back to the full membership."""
+        if candidates is None or not candidates:
+            candidates = self._replicas
         if exclude is not None:
             filtered = [r for r in candidates if r._actor_id != exclude]
             if filtered:
@@ -571,6 +589,77 @@ class Router:
         if len(reps) > 4:  # bound the memory per model
             reps.pop(0)
 
+    # -- disaggregated two-hop (llm/disagg.py) --------------------------------
+
+    def _role_replicas(self, role: str) -> list:
+        roles = self._disagg_roles
+        return [r for r in self._replicas if roles.get(r._actor_id) == role]
+
+    def _disagg_active(self) -> bool:
+        """Two-hop placement applies: the table advertises roles (the
+        controller strips them under RAY_TPU_DISAGG=0), the runtime knob
+        agrees, and both tiers currently have members."""
+        return (
+            bool(self._disagg_roles)
+            and GLOBAL_CONFIG.disagg
+            and bool(self._role_replicas("prefill"))
+            and bool(self._role_replicas("decode"))
+        )
+
+    async def _prefill_hop(
+        self, args: tuple, kwargs: dict, model_id: str, payload: bytes
+    ):
+        """First hop of disaggregated placement: land the request's
+        prefill on a prefill-role replica (prefix-digest bias preserved
+        among that tier) and return the handoff descriptor, or None — ANY
+        failure (dead/overloaded prefill replica, dense engine, engine
+        error) degrades to unified routing over the full membership, so
+        the prefill tier can never take availability down with it.
+        ``payload`` is the caller's already-serialized (args, kwargs) —
+        at hop time it is still the original, handoff-free dump."""
+        request = args[0] if args else None
+        if not isinstance(request, dict):
+            return None
+        digests = None
+        if self._prefix_routing_on():
+            self._maybe_refresh_state()
+            digests = self._prompt_digests(args, kwargs)
+        replica = self._pick(
+            "", digests, count_prefix=True,
+            candidates=self._role_replicas("prefill"),
+        )
+        rid = replica._actor_id
+        self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        try:
+            out = await core_api.get_async(
+                replica.handle.remote("prefill_handoff", payload, model_id)
+            )
+        except (ActorDiedError, ActorUnavailableError):
+            import time
+
+            self._recently_dead[rid] = time.monotonic()
+            self._replicas = [
+                r for r in self._replicas if r._actor_id != rid
+            ]
+            self._forget_replica(rid)
+            self._version = -2
+            return None
+        except Exception:  # raylint: disable=RL006 -- hop failure (overload, deadline, engine error) degrades to unified routing
+            return None
+        finally:
+            if rid in self._inflight:
+                self._inflight[rid] -= 1
+        if (
+            not isinstance(out, dict)
+            or out.get("unsupported")
+            or out.get("error")
+            or "first_token" not in out
+        ):
+            return None
+        if _metrics.metrics_enabled():
+            _DISAGG_HANDOFFS.inc(1.0, {"deployment": self._deployment})
+        return out
+
     # -- admission (overload plane) ------------------------------------------
 
     def _admission_on(self) -> bool:
@@ -624,6 +713,7 @@ class Router:
         t0 = _time.perf_counter() if instrument else 0.0
         last_err: Exception | None = None
         adm = _RequestAdmission(self, args, kwargs, tenant, priority)
+        hop_tried = disagg_decode = False
         for attempt in range(ROUTE_RETRIES):
             if self._version < -1 or not self._replicas:
                 await self._refresh(force=attempt > 0)
@@ -631,15 +721,39 @@ class Router:
                     await asyncio.sleep(0.2)
                     continue
             adm.ensure_checked()  # raises shed/throttled, pre-counted
-            pick_key = model_id or self._affinity_key(args, kwargs)
-            digests = None
-            if not model_id and self._prefix_routing_on():
-                self._maybe_refresh_state()
-                digests = self._prompt_digests(args, kwargs)
-            replica = self._pick(
-                pick_key, digests, count_prefix=attempt == 0,
-                exclude=adm.exclude,
-            )
+            if not hop_tried and self._disagg_active():
+                # Disaggregated two-hop, leg 1: prefill on the prefill
+                # tier; on success the decode dispatch below carries the
+                # KV handoff. ONE hop per request — a decode-replica
+                # retry reuses the same handoff (its pull fails closed
+                # into local prefill on the retried replica).
+                hop_tried = True
+                h = await self._prefill_hop(args, kwargs, model_id, payload)
+                if h is not None:
+                    req2 = dict(args[0])
+                    req2["_handoff"] = h
+                    payload = serialization.dumps(
+                        ((req2,) + args[1:], kwargs)
+                    )[0]
+                    disagg_decode = True
+            if disagg_decode:
+                # Leg 2: load-only pow-2 over the decode tier (decode
+                # replicas never prefill, so digests carry no signal).
+                pick_key = ""
+                replica = self._pick(
+                    "", None, count_prefix=False, exclude=adm.exclude,
+                    candidates=self._role_replicas("decode"),
+                )
+            else:
+                pick_key = model_id or self._affinity_key(args, kwargs)
+                digests = None
+                if not model_id and self._prefix_routing_on():
+                    self._maybe_refresh_state()
+                    digests = self._prompt_digests(args, kwargs)
+                replica = self._pick(
+                    pick_key, digests, count_prefix=attempt == 0,
+                    exclude=adm.exclude,
+                )
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             if instrument:
@@ -711,6 +825,7 @@ class Router:
         t0 = _time.perf_counter() if instrument else 0.0
         last_err: Exception | None = None
         adm = _RequestAdmission(self, args, kwargs, tenant, priority)
+        hop_tried = disagg_decode = False
         for attempt in range(ROUTE_RETRIES):
             if self._version < -1 or not self._replicas:
                 await self._refresh(force=attempt > 0)
@@ -718,15 +833,34 @@ class Router:
                     await asyncio.sleep(0.2)
                     continue
             adm.ensure_checked()  # raises shed/throttled, pre-counted
-            pick_key = model_id or self._affinity_key(args, kwargs)
-            digests = None
-            if not model_id and self._prefix_routing_on():
-                self._maybe_refresh_state()
-                digests = self._prompt_digests(args, kwargs)
-            replica = self._pick(
-                pick_key, digests, count_prefix=attempt == 0,
-                exclude=adm.exclude,
-            )
+            if not hop_tried and self._disagg_active():
+                # Two-hop leg 1 (see route()): prefill before the stream
+                # opens; client TTFT includes this hop by construction.
+                hop_tried = True
+                h = await self._prefill_hop(args, kwargs, model_id, payload)
+                if h is not None:
+                    req2 = dict(args[0])
+                    req2["_handoff"] = h
+                    payload = serialization.dumps(
+                        ((req2,) + args[1:], kwargs)
+                    )[0]
+                    disagg_decode = True
+            if disagg_decode:
+                pick_key = ""
+                replica = self._pick(
+                    "", None, count_prefix=False, exclude=adm.exclude,
+                    candidates=self._role_replicas("decode"),
+                )
+            else:
+                pick_key = model_id or self._affinity_key(args, kwargs)
+                digests = None
+                if not model_id and self._prefix_routing_on():
+                    self._maybe_refresh_state()
+                    digests = self._prompt_digests(args, kwargs)
+                replica = self._pick(
+                    pick_key, digests, count_prefix=attempt == 0,
+                    exclude=adm.exclude,
+                )
             rid = replica._actor_id
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             if instrument:

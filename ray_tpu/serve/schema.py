@@ -42,6 +42,7 @@ _APP_KEYS = {
     "autoscaling_config",
     "request_affinity",
     "admission_config",
+    "disagg_config",
     "ray_actor_options",
 }
 _TOP_KEYS = {"applications", "http", "grpc"}
@@ -135,6 +136,7 @@ def _to_application(entry: dict):
             "autoscaling_config",
             "request_affinity",
             "admission_config",
+            "disagg_config",
             "ray_actor_options",
         )
         if k in entry

@@ -162,3 +162,32 @@ def test_prefix_routing_series_registered_and_linted():
         assert catalog[name]["kind"] == "counter"
         assert catalog[name]["tag_keys"] == ("deployment",)
     assert lint_catalog(catalog) == []
+
+
+def test_disagg_and_spec_decode_series_registered_and_linted():
+    """Round-16 disaggregated-serving series: the router's handoff
+    counter is always importable; the engine-side series (KV ship bytes,
+    draft/accept counters + rate gauge) ride the optional llm modules —
+    imported here directly because this box has jax, and their
+    kinds/tags must pass the catalog lint."""
+    populate_catalog(include_optional=False)
+    import ray_tpu.llm.disagg  # noqa: F401 — registers the ship counter
+    import ray_tpu.llm.spec_decode  # noqa: F401 — registers spec series
+
+    catalog = m.runtime_catalog()
+    assert "raytpu_serve_disagg_handoffs_total" in catalog
+    assert catalog["raytpu_serve_disagg_handoffs_total"]["kind"] == "counter"
+    assert catalog["raytpu_serve_disagg_handoffs_total"]["tag_keys"] == (
+        "deployment",
+    )
+    for name in (
+        "raytpu_llm_kv_ship_bytes_total",
+        "raytpu_llm_spec_drafted_total",
+        "raytpu_llm_spec_accepted_total",
+    ):
+        assert name in catalog, f"{name} missing from the runtime catalog"
+        assert catalog[name]["kind"] == "counter"
+        assert catalog[name]["tag_keys"] == ()
+    assert catalog["raytpu_llm_spec_accept_rate"]["kind"] == "gauge"
+    assert catalog["raytpu_llm_spec_accept_rate"]["tag_keys"] == ("replica",)
+    assert lint_catalog(catalog) == []

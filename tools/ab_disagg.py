@@ -1,0 +1,112 @@
+"""Same-session A/B of disaggregated serving + speculative decoding
+(PERF.md round-16).
+
+Runs ``tools/ray_perf.py --serve-llm-only`` alternately with the
+round-16 serving tier ON (HEAD defaults) and OFF on the SAME commit,
+interleaved so ambient box load hits both arms equally (the round-3
+lesson). Three arms, one kill switch each:
+
+    --arm disagg   ON vs --no-disagg (long prompts prefill locally on
+                   the decode engine; watch serve_llm_disagg_stall_ms —
+                   the worst decoder gap while a cold prompt joins)
+    --arm spec     ON vs --no-spec-decode (vanilla one-token decode;
+                   watch serve_llm_spec_decode_tok_s and the per-token
+                   p99 gap, plus the accept rate in the ON arm)
+    --arm both     ON vs both kill switches (the round-16 headline
+                   against the round-12 serving path)
+
+    python tools/ab_disagg.py [--arm disagg|spec|both]
+                              [--rounds 3] [--full]
+
+The interleaved-median machinery is shared with tools/ab_coalesce.py;
+the probes themselves live in ray_perf's serve-llm rows (controlled
+single-process engines: the disagg stall probe hands the long prompt's
+KV over the REAL transfer fabric; the spec probe runs a 1-layer draft
+against the 3-layer target at k=4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import interleaved_ab, run_once  # noqa: E402 — shared
+
+_ARMS = {
+    "disagg": "--no-disagg",
+    "spec": "--no-spec-decode",
+}
+
+
+def _both_arm(rounds: int, full: bool) -> None:
+    """ON vs BOTH kill switches (mirrors ab_prefix_routing._both_arm:
+    interleaved_ab takes one off flag, so the second rides as an OFF-arm
+    base flag through a small local loop)."""
+    import json
+    import statistics
+
+    on_runs, off_runs = [], []
+    for i in range(rounds):
+        order = [
+            ((), on_runs),
+            (("--no-disagg", "--no-spec-decode"), off_runs),
+        ]
+        if i % 2:
+            order.reverse()
+        for flags, sink in order:
+            arm = "off" if flags else "on "
+            print(f"[round {i}] disagg-serving {arm} ...", flush=True)
+            sink.append(
+                run_once(
+                    quick=not full,
+                    extra_flags=("--serve-llm-only",) + flags,
+                )
+            )
+    keys = sorted(
+        k
+        for k in on_runs[0]
+        if all(k in r for r in on_runs + off_runs)
+        and isinstance(on_runs[0][k], (int, float))
+    )
+    summary = {}
+    print(f"\n{'metric':<40} {'on':>12} {'off':>12} {'on/off':>8}")
+    for k in keys:
+        on_med = statistics.median(r[k] for r in on_runs)
+        off_med = statistics.median(r[k] for r in off_runs)
+        ratio = on_med / off_med if off_med else float("inf")
+        summary[k] = {"on": on_med, "off": off_med, "ratio": round(ratio, 3)}
+        print(f"{k:<40} {on_med:>12,.1f} {off_med:>12,.1f} {ratio:>8.2f}")
+    print(json.dumps(summary), flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--arm",
+        choices=sorted(_ARMS) + ["both"],
+        default="disagg",
+        help="which kill switch the OFF arm uses",
+    )
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument(
+        "--full", action="store_true", help="full (not --quick) perf runs"
+    )
+    args = ap.parse_args()
+    if args.arm == "both":
+        _both_arm(args.rounds, args.full)
+        return 0
+    interleaved_ab(
+        _ARMS[args.arm],
+        f"disagg-serving-{args.arm}",
+        args.rounds,
+        args.full,
+        base_flags=("--serve-llm-only",),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,7 +45,12 @@ _CATALOG_MODULES = [
     "ray_tpu.train.worker_group",
     "ray_tpu.util.collective.hierarchical",  # collective hop/byte series
 ]
-_OPTIONAL_MODULES = ["ray_tpu.llm.engine", "ray_tpu.llm.serve_llm"]
+_OPTIONAL_MODULES = [
+    "ray_tpu.llm.engine",
+    "ray_tpu.llm.serve_llm",
+    "ray_tpu.llm.disagg",  # KV-handoff ship-bytes counter (round 16)
+    "ray_tpu.llm.spec_decode",  # draft/accept series (round 16)
+]
 
 
 def populate_catalog(include_optional: bool = True) -> None:

@@ -94,7 +94,13 @@ def _hist_frac_above(before: dict, after: dict, boundary: str) -> float:
     return round((total - d.get(boundary, 0.0)) / total, 4)
 
 
-def _serve_llm_rows(results: dict, no_chunked_prefill: bool, quick: bool):
+def _serve_llm_rows(
+    results: dict,
+    no_chunked_prefill: bool,
+    quick: bool,
+    no_disagg: bool = False,
+    no_spec_decode: bool = False,
+):
     """Cache-aware LLM serving rows (PERF.md round-12): two tiny-model
     replicas behind the serve router, streaming clients from driver
     threads. Two traffic mixes:
@@ -363,6 +369,158 @@ def _serve_llm_rows(results: dict, no_chunked_prefill: bool, quick: bool):
         f"serve_llm_decode_stall_ms: "
         f"{results['serve_llm_decode_stall_ms']} ms (worst decoder gap "
         f"while a cold long prompt lands; median of 3)",
+        flush=True,
+    )
+
+    # Disaggregated-serving stall probe (round 16): the same worst-gap
+    # question, but the decode engine takes the long prompt as a KV
+    # HANDOFF prefilled on a separate engine (the prefill tier) instead
+    # of prefilling it locally — the decode clock pays only the pull +
+    # scatter. --no-disagg is the OFF arm (local admission, = the
+    # round-12 number).
+    from ray_tpu.llm.engine import LLMEngine as _Eng
+
+    probe_cfg = LLMConfig(
+        model_config=model,
+        max_slots=4,
+        max_seq=1024,
+        prefill_buckets=(32, 128, 1024),
+        num_kv_blocks=420,
+        enable_prefix_caching=False,
+        prefill_chunk_tokens=0 if no_chunked_prefill else 128,
+    )
+    dec = _Eng(probe_cfg)
+    pre = None if no_disagg else _Eng(probe_cfg)
+    # Warm/compile every path each arm uses (prefill buckets, decode,
+    # and — ON arm — the handoff gather/pull/scatter programs).
+    dec.add_request("warm", "w" * 950, SamplingParams(max_tokens=2))
+    while dec.has_unfinished():
+        dec.step()
+    dec.pop_finished()
+    if pre is not None:
+        pre.add_request(
+            "warmp", "w" * 950, SamplingParams(max_tokens=2),
+            prefill_only=True,
+        )
+        while pre.has_unfinished():
+            pre.step()
+        dec.add_handoff_request(
+            "warmh", pre.pop_finished()[0].handoff_out,
+            SamplingParams(max_tokens=2),
+        )
+        while dec.has_unfinished():
+            dec.step()
+        dec.pop_finished()
+    for i in range(3):
+        dec.add_request(
+            f"dd{i}", f"short {i}", SamplingParams(max_tokens=250)
+        )
+    dec.step()
+    dec.step()
+    stalls = []
+    for trial in range(3):
+        rid = f"dlong{trial}"
+        prompt = "y" * (930 + trial)
+        if pre is None:
+            dec.add_request(rid, prompt, SamplingParams(max_tokens=2))
+        else:
+            pre.add_request(
+                rid, prompt, SamplingParams(max_tokens=2),
+                prefill_only=True,
+            )
+            while pre.has_unfinished():
+                pre.step()  # the prefill tier's clock, not the decoders'
+            dec.add_handoff_request(
+                rid, pre.pop_finished()[0].handoff_out,
+                SamplingParams(max_tokens=2),
+            )
+        gaps, t_last = [], time.perf_counter()
+        for _ in range(64):
+            dec.step()
+            now = time.perf_counter()
+            gaps.append(now - t_last)
+            t_last = now
+            if not any(
+                r.request_id == rid and not r.finished
+                for r in dec.requests.values()
+            ):
+                break
+        dec.pop_finished()
+        stalls.append(max(gaps))
+    results["serve_llm_disagg_stall_ms"] = round(
+        statistics.median(stalls) * 1e3, 2
+    )
+    arm = "off (local prefill)" if no_disagg else "on (kv handoff)"
+    print(
+        f"serve_llm_disagg_stall_ms: "
+        f"{results['serve_llm_disagg_stall_ms']} ms (worst decoder gap "
+        f"while a cold long prompt joins the decode engine; disagg {arm})",
+        flush=True,
+    )
+
+    # Speculative-decoding probe (round 16): decode-bound traffic on one
+    # engine — greedy streams, no cache help. ON: a 1-layer draft
+    # proposes k=4 per step, the target verifies in one batched forward.
+    # Rows: decode tok/s, client-visible per-token p99 gap (burst tokens
+    # land together: first pays the step, the rest ~0), accept rate.
+    spec_kw = (
+        {}
+        if no_spec_decode
+        else dict(
+            spec_decode_tokens=4,
+            draft_model_config=GPT2Config.tiny(
+                n_layer=1, d_model=128, n_head=4, max_seq=1024
+            ),
+        )
+    )
+    eng_s = _Eng(
+        LLMConfig(
+            model_config=model,
+            max_slots=4,
+            max_seq=1024,
+            prefill_buckets=(32, 128, 1024),
+            num_kv_blocks=420,
+            enable_prefix_caching=False,
+            **spec_kw,
+        )
+    )
+    eng_s.add_request("warm", "warm me", SamplingParams(max_tokens=8))
+    while eng_s.has_unfinished():
+        eng_s.step()
+    eng_s.pop_finished()
+    n_tok = 80 if quick else 200
+    for i in range(3):
+        eng_s.add_request(
+            f"sp{i}", f"stream {i}", SamplingParams(max_tokens=n_tok)
+        )
+    tok0 = eng_s.stats["tokens_generated"]
+    token_gaps: list = []
+    t0 = time.perf_counter()
+    t_last = t0
+    while eng_s.has_unfinished():
+        before = eng_s.stats["tokens_generated"]
+        eng_s.step()
+        now = time.perf_counter()
+        produced = eng_s.stats["tokens_generated"] - before
+        if produced:
+            token_gaps.append(now - t_last)
+            token_gaps.extend([0.0] * (produced - 1))
+        t_last = now
+    dt = time.perf_counter() - t0
+    eng_s.pop_finished()
+    toks = eng_s.stats["tokens_generated"] - tok0
+    results["serve_llm_spec_decode_tok_s"] = round(toks / dt, 1)
+    results["serve_llm_spec_itl_p99_ms"] = _p99_ms(token_gaps)
+    drafted = eng_s.stats["spec_drafted"]
+    results["serve_llm_spec_accept_rate"] = round(
+        (eng_s.stats["spec_accepted"] / drafted) if drafted else 0.0, 4
+    )
+    arm = "off (vanilla)" if no_spec_decode else "on (k=4, 1-layer draft)"
+    print(
+        f"serve_llm_spec_decode: "
+        f"{results['serve_llm_spec_decode_tok_s']:,} tok/s, per-token "
+        f"p99 {results['serve_llm_spec_itl_p99_ms']} ms, accept rate "
+        f"{results['serve_llm_spec_accept_rate']:.1%} [spec {arm}]",
         flush=True,
     )
 
@@ -699,6 +857,21 @@ def main() -> int:
         "prefill (PERF.md round-12)",
     )
     ap.add_argument(
+        "--no-disagg",
+        action="store_true",
+        help="kill switch: unified serving — the disagg stall probe's "
+        "long prompts prefill LOCALLY on the decode engine (equivalent "
+        "to RAY_TPU_DISAGG=0; the A/B baseline for the round-16 "
+        "prefill/decode split)",
+    )
+    ap.add_argument(
+        "--no-spec-decode",
+        action="store_true",
+        help="kill switch: vanilla one-token decode on the spec probe "
+        "(equivalent to RAY_TPU_SPEC_DECODE=0; the A/B baseline for "
+        "round-16 speculative decoding)",
+    )
+    ap.add_argument(
         "--serve-overload",
         action="store_true",
         help="run only the overload-protection rows (seeded flash crowd "
@@ -771,6 +944,8 @@ def main() -> int:
         or args.no_quantized
         or args.no_prefix_routing
         or args.no_admission
+        or args.no_disagg
+        or args.no_spec_decode
     ):
         from ray_tpu.core.config import GLOBAL_CONFIG
 
@@ -789,6 +964,10 @@ def main() -> int:
             GLOBAL_CONFIG.prefix_routing = False
         if args.no_admission:
             GLOBAL_CONFIG.admission = False
+        if args.no_disagg:
+            GLOBAL_CONFIG.disagg = False
+        if args.no_spec_decode:
+            GLOBAL_CONFIG.spec_decode = False
 
     if args.serve_llm_only:
         # Replica actors must run CPU jax even where a TPU plugin is
@@ -803,6 +982,8 @@ def main() -> int:
             results,
             no_chunked_prefill=args.no_chunked_prefill,
             quick=args.quick,
+            no_disagg=args.no_disagg,
+            no_spec_decode=args.no_spec_decode,
         )
         print(json.dumps(results), flush=True)
         ray_tpu.shutdown()

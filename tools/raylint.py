@@ -153,6 +153,10 @@ DEVICE_HOT_ENTRYPOINTS = frozenset(
     {
         "ray_tpu.llm.engine.LLMEngine.step",
         "ray_tpu.llm.engine.LLMEngine.generate",
+        # The speculative-decoding draft/verify cycle runs inside every
+        # spec-eligible engine step (round 16).
+        "ray_tpu.llm.spec_decode.SpecDecoder.step",
+        "ray_tpu.llm.spec_decode.SpecDecoder.prefill_draft",
         "ray_tpu.train.context.TrainContext.report",
         "ray_tpu.rllib.learner.Learner.update",
     }
