@@ -514,6 +514,21 @@ def _train_overlap_rows() -> dict:
     return out
 
 
+def _podracer_rows() -> dict:
+    """Podracer decoupled-RL A/B (round-17): env_steps/s + learner
+    updates/s + weight-lag p99 on the emulated-cost CartPole with the
+    actor/inference/learner planes ON vs the kill-switch arm
+    (``--no-podracer``: the single-loop sample→update DQN iteration)."""
+    out = _ab_rows("podracer", ("--rl-only",), ("--no-podracer",), 900)
+    if "on" in out and "off" in out:
+        on_s = out["on"].get("rl_env_steps_per_s", 0)
+        off_s = out["off"].get("rl_env_steps_per_s", 0)
+        if off_s:
+            # >1 = decoupling actually bought acting throughput.
+            out["env_steps_per_s_ratio"] = round(on_s / off_s, 3)
+    return out
+
+
 def _raylint_rows() -> dict:
     """Static-analysis debt counts via ``tools/raylint.py --json`` (total /
     suppressed / unsuppressed + per-rule) so lint debt is tracked per round
@@ -561,6 +576,7 @@ def _emit(
     train_overlap: dict | None = None,
     serve_overload: dict | None = None,
     serve_disagg: dict | None = None,
+    podracer: dict | None = None,
 ) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
@@ -581,6 +597,10 @@ def _emit(
         # Train-overlap A/B (async dispatch + prefetch ON vs kill switch)
         # rides every record like data_plane/serve_llm from round 13 on.
         record = {**record, "train_overlap": train_overlap}
+    if podracer:
+        # Podracer decoupled-RL A/B (planes ON vs --no-podracer) rides
+        # every record from round 17 on.
+        record = {**record, "podracer": podracer}
     if raylint:
         # Lint-debt counts ride every record (tracked like perf: the
         # suppressed count is the justified-debt baseline; unsuppressed
@@ -607,6 +627,7 @@ def main() -> None:
     serve_disagg = _serve_disagg_rows(serve_llm)
     serve_overload = _serve_overload_rows()
     train_overlap = _train_overlap_rows()
+    podracer = _podracer_rows()
     raylint = _raylint_rows()
 
     probe_record: dict | None = None
@@ -614,7 +635,7 @@ def main() -> None:
     def emit(record: dict) -> None:
         _emit(
             record, data_plane, probe_record, serve_llm, raylint,
-            train_overlap, serve_overload, serve_disagg,
+            train_overlap, serve_overload, serve_disagg, podracer,
         )
 
     try:

@@ -183,6 +183,18 @@ class Config:
     # A/B and as the operational escape hatch.
     disagg: bool = True
     spec_decode: bool = True
+    # Podracer-style decoupled RL (round 17). ``podracer`` is the kill
+    # switch (RAY_TPU_PODRACER=0): off, PodracerDQN runs the single-loop
+    # DQN sample→update iteration byte-identically (no inference tier, no
+    # trajectory queue, no fabric weight sync — the A/B baseline of
+    # tools/ray_perf.py --rl-only --no-podracer). Existing algorithms
+    # never consult it: not using the podracer API leaves them untouched
+    # either way. The staleness bound itself is per-run configuration
+    # (PodracerConfig.podracer_staleness_steps), not a cluster knob:
+    # staleness 0 degenerates to the lockstep loop (CI-pinned
+    # bit-identical to DQN), >= 1 decouples acting from learning with
+    # actors at most that many published versions behind.
+    podracer: bool = True
     # Default per-replica concurrency budget (was a hard-coded 8 in
     # serve/router.py and the controller's max_concurrent_queries
     # fallbacks): the router's saturation-spill margin and the replica

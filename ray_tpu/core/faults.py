@@ -35,6 +35,23 @@ site       actions                injected where
                                   (chunked) prefill, token-identical, no
                                   hang; ``delay`` sleeps the pull.
                                   ``match`` globs the request id.
+``weightsync`` sever delay        podracer learner→actor weight sync
+                                  (``rllib/env_runner.py``
+                                  ``pull_flat_weights``): ``sever`` = the
+                                  fabric pull of a published params
+                                  version fails → the consumer keeps its
+                                  last-good params and reports the stale
+                                  version (the publisher counts the
+                                  lag); ``delay`` sleeps the pull.
+                                  ``match`` globs ``v<version>``.
+``envrun`` kill                   RL rollout actor, per vector env step
+                                  (``rllib/env_runner.py``
+                                  ``_record_episode_step``): the worker
+                                  process exits mid-rollout — the
+                                  podracer supervisor must restart the
+                                  runner and the trajectory queue must
+                                  never wedge. ``match`` globs
+                                  ``w<worker_index>``.
 =========  =====================  ==============================================
 
 Determinism: every rule owns a ``random.Random`` seeded from
@@ -84,6 +101,8 @@ _SITE_ACTIONS = {
     "chan": frozenset({"read_delay"}),
     "dcn": frozenset({"sever", "delay"}),
     "kvship": frozenset({"sever", "delay"}),
+    "weightsync": frozenset({"sever", "delay"}),
+    "envrun": frozenset({"kill"}),
 }
 
 

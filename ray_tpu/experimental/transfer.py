@@ -306,10 +306,21 @@ class _Fabric:
             partitions = (1,) * len(array.shape)
         sharding = _decomposed_sharding(partitions)
         staged = jax.device_put(array, sharding)
-        import time
-
         uid = _uuid.uuid4().int >> 65  # 63-bit
         self._ensure_server().await_pull(uid, [staged])
+        self._remember_armed(uid, oid, staged)
+        return {
+            "uuid": uid,
+            "address": self.address(),
+            "shape": tuple(array.shape),
+            "dtype": str(array.dtype),
+            "partitions": tuple(partitions),
+        }
+
+    def _remember_armed(self, uid: int, oid, staged) -> None:
+        """Record one armed entry and run the cap/TTL eviction sweep."""
+        import time
+
         evicted = []
         evicted_uids = []
         now = time.monotonic()
@@ -327,20 +338,60 @@ class _Fabric:
         # A TTL-evicted entry's fetch budget was consumed at arm time and
         # its pull can no longer land; refund it so the object is not lost
         # (every other failure path refunds the same way). oid None =
-        # channel-owned arm (DeviceChannel): no store entry to refund.
+        # channel-owned arm (DeviceChannel / trajectory-queue group): no
+        # store entry to refund.
         if evicted:
             from ray_tpu.experimental.device_objects import store
 
             for ev_oid, ev_staged, _t in evicted:
                 if ev_oid is not None:
                     store().restore_arm(ev_oid, ev_staged)
+
+    def arm_group(self, arrays: Sequence) -> dict:
+        """Stage SEVERAL arrays under ONE uid for one remote pull — the
+        trajectory-plane unit (a rollout fragment's columns travel
+        together: one arm RPC worth of descriptor, one pull, one TCP
+        connection on the socket-compat arm). Single-device layout on both
+        ends; a consumer that wants a sharded landing re-lays-out after
+        the pull, exactly like an over-decomposed :meth:`arm`."""
+        _repin_platform()
+        import jax
+        import jax.numpy as jnp
+
+        staged = [jax.device_put(jnp.asarray(a)) for a in arrays]
+        uid = _uuid.uuid4().int >> 65  # 63-bit
+        self._ensure_server().await_pull(uid, staged)
+        self._remember_armed(uid, None, staged)
         return {
             "uuid": uid,
             "address": self.address(),
-            "shape": tuple(array.shape),
-            "dtype": str(array.dtype),
-            "partitions": tuple(partitions),
+            "specs": [
+                {"shape": tuple(a.shape), "dtype": str(a.dtype)}
+                for a in staged
+            ],
+            "group": True,
         }
+
+    def pull_group(self, desc: dict) -> list:
+        """Pull an :meth:`arm_group` entry: every member array lands on
+        local devices (single-device layout, matching the producer's)."""
+        _repin_platform()
+        import jax
+        import jax.numpy as jnp
+
+        specs = [
+            jax.ShapeDtypeStruct(
+                tuple(s["shape"]),
+                jnp.dtype(s["dtype"]),
+                sharding=_decomposed_sharding((1,) * len(s["shape"])),
+            )
+            for s in desc["specs"]
+        ]
+        conn = self._connect(desc["address"])
+        out = conn.pull(desc["uuid"], specs)
+        with self._lock:
+            self._stats["pulls"] += 1
+        return out
 
     def _server_release(self, uids: Sequence[int]) -> None:
         """Unschedule never-pulled arms server-side where the transport

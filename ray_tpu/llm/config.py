@@ -79,6 +79,14 @@ class LLMConfig:
     # tables — through a parallel {"k","v"} pytree sized by its own
     # layer/head dims. Required when spec_decode_tokens > 0.
     draft_model_config: Any = None
+    # Initial draft weights: a path to a pickled params pytree for the
+    # draft model (same contract as weights_path for the target), or None
+    # for random init. Random init keeps tests hermetic but makes the
+    # accept-rate gauge meaningless (a random draft agrees with the
+    # target only by chance) — real deployments restore a trained/
+    # distilled draft checkpoint here so raytpu_llm_spec_accept_rate
+    # reads as actual speculation quality.
+    draft_weights_path: Optional[str] = None
 
     def build_model_config(self):
         from ray_tpu.models.gpt2 import GPT2Config

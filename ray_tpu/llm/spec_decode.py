@@ -158,9 +158,19 @@ class SpecDecoder:
             )
         self.cfg = draft_cfg
         self._model, self._decode_mod = _model_ops(draft_cfg)
-        self.params = self._model.init_params(
-            jax.random.key(engine.config.seed), draft_cfg
-        )
+        if engine.config.draft_weights_path:
+            # Trained/distilled draft checkpoint (same pickled-pytree
+            # contract as LLMConfig.weights_path for the target): the
+            # accept-rate gauge only means anything with one of these —
+            # a random-init draft agrees with the target by chance.
+            import pickle
+
+            with open(engine.config.draft_weights_path, "rb") as f:
+                self.params = jax.tree.map(jnp.asarray, pickle.load(f))
+        else:
+            self.params = self._model.init_params(
+                jax.random.key(engine.config.seed), draft_cfg
+            )
         B = engine.config.max_slots
         if engine.paged:
             from ray_tpu.models import paged

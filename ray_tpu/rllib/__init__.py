@@ -15,6 +15,13 @@ single_agent_env_runner.py:67), redesigned TPU-first:
   coordinator backend in tests).
 - **Algorithm** drives the sample → learn → weight-sync loop and is
   checkpointable (save/restore of module + optimizer state).
+- **Podracer planes** (:mod:`ray_tpu.rllib.podracer`) decouple acting
+  from learning Sebulba-style: an inference tier coalesces runner
+  requests into jitted device batches, fragments stream through a
+  bounded fabric-backed trajectory queue into a device-resident replay
+  ring, and versioned weights publish over the transfer fabric under a
+  bounded-staleness contract (staleness 0 = lockstep, CI-pinned
+  bit-identical to the single-loop learner).
 """
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
@@ -29,10 +36,17 @@ from ray_tpu.rllib.offline import (
 )
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerGroup
+from ray_tpu.rllib.podracer import (
+    InferenceServer,
+    PodracerConfig,
+    PodracerDQN,
+    PodracerEnvRunner,
+    WeightPublisher,
+)
 from ray_tpu.rllib.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rllib.cql import CQL, CQLConfig
 from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner, SACModule
-from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.replay_buffer import DeviceReplay, ReplayBuffer
 from ray_tpu.rllib.rl_module import MLPModule, RLModule
 from ray_tpu.rllib.sample_batch import SampleBatch
 
@@ -52,15 +66,21 @@ __all__ = [
     "DQN",
     "DQNConfig",
     "DQNLearner",
+    "DeviceReplay",
     "EnvRunner",
+    "InferenceServer",
     "Learner",
     "LearnerGroup",
     "MLPModule",
     "PPO",
     "PPOConfig",
     "PPOLearner",
+    "PodracerConfig",
+    "PodracerDQN",
+    "PodracerEnvRunner",
     "QModule",
     "ReplayBuffer",
+    "WeightPublisher",
     "RLModule",
     "SAC",
     "SACConfig",

@@ -109,6 +109,12 @@ class DQNEnvRunner(RolloutBase):
         self._epsilon = float(epsilon)
         return True
 
+    def greedy_actions(self, obs_in: np.ndarray) -> np.ndarray:
+        """Greedy (exploitation) actions for one connected-obs batch.
+        The podracer runner overrides this to route through the inference
+        tier; exploration stays local either way."""
+        return np.asarray(self._greedy(self._params, obs_in))  # raylint: disable=RL101 -- greedy actions cross the env boundary as numpy (same contract as the on-policy runner)
+
     def sample(self) -> SampleBatch:
         """One [T*N] fragment of transitions, autoreset dummy steps already
         filtered out (replay must never store fabricated rows)."""
@@ -122,7 +128,7 @@ class DQNEnvRunner(RolloutBase):
             obs_in = np.asarray(
                 self._env_to_module(self._obs), np.float32
             )
-            greedy = np.asarray(self._greedy(self._params, obs_in))
+            greedy = self.greedy_actions(obs_in)
             explore = self._rng.random(N) < self._epsilon
             actions = np.where(
                 explore, self._rng.integers(0, n_act, size=N), greedy
@@ -159,7 +165,7 @@ class DQNEnvRunner(RolloutBase):
                 sb.TERMINATEDS: np.concatenate(term_rows).astype(np.float32),
             }
         )
-        self._total_steps += len(batch)
+        self._count_env_steps(len(batch))
         return batch
 
 
@@ -246,7 +252,11 @@ class DQNLearner(Learner):
             )
         )
         stats = super().update(batch)
-        self._grad_steps += stats.get("num_grad_steps", 0)
+        self._maybe_refresh_target(stats.get("num_grad_steps", 0), stats)
+        return stats
+
+    def _maybe_refresh_target(self, grad_steps: int, stats: dict) -> None:
+        self._grad_steps += grad_steps
         if self._grad_steps >= self.dqn.target_network_update_freq:
             self._grad_steps = 0
             # Hard refresh (reference default); learners in a group apply
@@ -254,7 +264,42 @@ class DQNLearner(Learner):
             # equal. jnp.copy: donated-buffer aliasing, see build().
             self.target_params = jax.tree.map(jnp.copy, self.params)
             stats["target_net_updated"] = 1.0
-        return stats
+
+    def update_device(self, cols: dict) -> dict:
+        """One minibatch TD step with every operand device-resident — the
+        podracer learner plane's consume path (round-13 contract: no host
+        SampleBatch staging between the trajectory stream and the jitted
+        update). ``cols`` holds jax arrays keyed by the replay columns;
+        the minibatch is placed under the dp sharding, TD targets stay on
+        device, and the returned stats are device scalars the caller
+        reads back at its own cadence."""
+        if not self._built:
+            self.build()
+        # The stream's arrays arrive committed to one device (the replay
+        # ring's); re-lay them out under the dp sharding FIRST — params
+        # are mesh-replicated and jit refuses mixed committed device sets.
+        cols = jax.device_put(dict(cols), self._batch_sharding)
+        targets = self._td_targets(
+            self.params,
+            self.target_params,
+            cols[sb.NEXT_OBS],
+            cols[sb.REWARDS],
+            cols[sb.TERMINATEDS],
+        )
+        mb = {
+            sb.OBS: cols[sb.OBS],
+            sb.ACTIONS: cols[sb.ACTIONS],
+            TD_TARGETS: targets,
+        }
+        grads, stats = self._grad(self.params, mb)
+        if self._group_name is not None and self._world_size > 1:
+            grads = self._allreduce_grads(grads)
+        self.params, self.opt_state = self._apply(
+            self.params, self.opt_state, grads
+        )
+        out = dict(stats)
+        self._maybe_refresh_target(1, out)
+        return out
 
     def get_state(self) -> dict:
         state = super().get_state()

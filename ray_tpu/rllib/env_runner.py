@@ -10,6 +10,7 @@ host, keeping TPU chips free for the Learner's SPMD step.
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Callable
 
 import jax
@@ -18,6 +19,46 @@ import numpy as np
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.rl_module import RLModule, to_numpy
 from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.util import metrics as _metrics
+
+# Lifetime env steps across every rollout plane (counted where
+# _total_steps advances, so single-loop and podracer arms share one
+# series); worker registries push to the node, so the cluster scrape sums
+# all runners.
+_ENV_STEPS = _metrics.Counter(
+    "raytpu_rl_env_steps_total",
+    "environment steps sampled by RL rollout actors (loss-masked steps "
+    "only; autoreset dummy rows excluded)",
+)
+
+
+def pull_flat_weights(version: int, desc: dict):
+    """Pull one published flat-params vector from the transfer fabric.
+
+    The podracer ``weightsync`` fault site lives here: a seeded ``sever``
+    raises (callers keep their last-good params and report the stale
+    version — the publisher counts the lag); ``delay`` sleeps the pull.
+    """
+    from ray_tpu.core import faults
+
+    inj = faults.active()
+    if inj is not None:
+        rule = inj.decide("weightsync", name=f"v{version}")
+        if rule is not None:
+            if rule.action == "sever":
+                from ray_tpu.core.errors import FaultInjectedError
+
+                raise FaultInjectedError(
+                    f"injected weightsync sever at v{version}"
+                )
+            if rule.delay_s > 0.0:
+                import time
+
+                time.sleep(min(rule.delay_s, 3600.0))
+    from ray_tpu.experimental import transfer as xfer
+
+    [flat] = xfer.fabric().pull_group(desc)
+    return flat
 
 
 def compute_gae(
@@ -51,7 +92,68 @@ def compute_gae(
     return adv, adv + values
 
 
-class RolloutBase:
+class FabricWeightConsumer:
+    """Fabric weight-sync consumer (podracer plane), shared by rollout
+    actors and the inference tier: pull a versioned flat vector, unravel
+    it against the current params structure (unravel cached — the
+    structure is fixed between ``set_weights`` calls, so the steady-state
+    apply pays no per-sync ravel of the live params), install in place.
+    On a (seeded or real) sever the last-good params stay put and the
+    stale version is returned — the publisher counts the lag. An apply
+    that lost the race to a NEWER publish is dropped: the inference tier
+    runs applies concurrently (``max_concurrency``), and installing an
+    older vector after a newer one would regress params under a version
+    the staleness gate already counted as applied."""
+
+    _params = None
+
+    def _init_weight_sync(self) -> None:
+        self._params = None
+        self._weights_version = 0
+        self._weightsync_failures = 0
+        self._unravel = None
+        self._weights_lock = threading.Lock()
+
+    def _install_params(self, params) -> None:
+        """Store a freshly unravelled params pytree (subclass storage:
+        runners pin to the CPU device, the inference tier keeps jnp
+        arrays). Called under the weights lock from apply_weights; must
+        NOT reset the cached unravel."""
+        raise NotImplementedError
+
+    def apply_weights(self, version: int, desc: dict) -> int:
+        """Fabric weight sync: returns the version now applied (stale on
+        sever/race). Requires an initial ``set_weights`` (the structure
+        the flat vector unravels into)."""
+        if self._params is None:
+            raise RuntimeError("set_weights() before apply_weights()")
+        try:
+            flat = pull_flat_weights(version, desc)
+        except Exception:  # raylint: disable=RL006 -- sever fallback IS the contract: keep last-good params, report the stale version
+            self._weightsync_failures += 1
+            return self._weights_version
+        with self._weights_lock:
+            if version <= self._weights_version:
+                # A newer publish landed while this pull was in flight.
+                return self._weights_version
+            if self._unravel is None:
+                import jax.flatten_util
+
+                _, self._unravel = jax.flatten_util.ravel_pytree(
+                    self._params
+                )
+            self._install_params(self._unravel(flat))
+            self._weights_version = version
+        return version
+
+    def weight_state(self) -> dict:
+        return {
+            "version": self._weights_version,
+            "failures": self._weightsync_failures,
+        }
+
+
+class RolloutBase(FabricWeightConsumer):
     """Shared rollout-actor machinery: vector env, CPU-backend pinning,
     gymnasium NEXT_STEP autoreset bookkeeping, episode accounting, weight
     sync. Subclasses implement :meth:`sample` — the on-policy EnvRunner
@@ -85,10 +187,13 @@ class RolloutBase:
         self.module = module
         self.num_envs = num_envs
         self.fragment_len = rollout_fragment_length
+        self.worker_index = worker_index
         self._envs = gym.vector.SyncVectorEnv(
             [env_maker for _ in range(num_envs)]
         )
-        self._params = None
+        # Fabric weight-sync state (podracer plane): _params plus the
+        # last successfully applied version and sever-fallback count.
+        self._init_weight_sync()
         self._obs, _ = self._envs.reset(seed=seed * 7919 + worker_index)
         # Envs that finished on the previous step: gymnasium >=1.0 NEXT_STEP
         # vector autoreset makes their next step a reset (action ignored,
@@ -113,14 +218,37 @@ class RolloutBase:
         self._total_steps = 0
 
     # -- weight sync --------------------------------------------------------
-    def set_weights(self, params) -> bool:
+    def _install_params(self, params) -> None:
         params = to_numpy(params)
         if self._cpu is not None:
             # Committing the params to the CPU device pins every jitted
             # policy step to the CPU backend (inputs follow committed args).
             params = jax.device_put(params, self._cpu)
         self._params = params
+
+    def set_weights(self, params) -> bool:
+        self._install_params(params)
+        # External params may have a new structure: rebuild the cached
+        # unravel on the next fabric apply.
+        self._unravel = None
         return True
+
+    def weight_state(self) -> dict:
+        """Applied-version + sever-fallback telemetry, plus a digest of
+        the live params (the chaos tier's bit-identical-replay probe)."""
+        import hashlib
+
+        digest = ""
+        if self._params is not None:
+            h = hashlib.blake2b(digest_size=16)
+            for leaf in jax.tree.leaves(to_numpy(self._params)):
+                h.update(np.ascontiguousarray(leaf).tobytes())
+            digest = h.hexdigest()
+        return {
+            "version": self._weights_version,
+            "failures": self._weightsync_failures,
+            "digest": digest,
+        }
 
     def ping(self) -> bool:
         return True
@@ -139,6 +267,23 @@ class RolloutBase:
     def _record_episode_step(self, rew, live, term, trunc) -> np.ndarray:
         """Advance episode accounting for one vector step; returns the done
         mask (also the next step's autoreset set)."""
+        from ray_tpu.core import faults
+
+        inj = faults.active()
+        if inj is not None:
+            # Chaos site ``envrun.kill``: a seeded rule kills THIS rollout
+            # worker mid-fragment (the podracer supervisor must restart it
+            # and the trajectory queue must never wedge). Deterministic
+            # per process: one decide() per vector step.
+            rule = inj.decide(
+                "envrun",
+                name=f"w{self.worker_index}",
+                actions=frozenset({"kill"}),
+            )
+            if rule is not None:
+                import os
+
+                os._exit(1)
         self._ep_return += rew * live
         self._ep_len += live
         done = np.logical_or(term, trunc)
@@ -149,6 +294,13 @@ class RolloutBase:
             self._ep_len[i] = 0
         self._autoreset = done
         return done
+
+    def _count_env_steps(self, n: int) -> None:
+        """Advance the lifetime step counter + the runtime series (both
+        sample() flavors call this once per fragment)."""
+        self._total_steps += n
+        if n and _metrics.metrics_enabled():
+            _ENV_STEPS.inc(float(n))
 
     def sample(self) -> SampleBatch:
         raise NotImplementedError
@@ -263,7 +415,7 @@ class EnvRunner(RolloutBase):
             trunc_buf[t] = trunc
             self._record_episode_step(rew, live, term, trunc)
             self._obs = next_obs
-        self._total_steps += int(mask_buf.sum())
+        self._count_env_steps(int(mask_buf.sum()))
 
         last_vf = np.asarray(  # raylint: disable=RL101 -- bootstrap value joins the numpy GAE path
             self._vf(

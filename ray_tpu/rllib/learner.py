@@ -104,6 +104,14 @@ class Learner:
     def get_weights(self):
         return to_numpy(self.params)
 
+    def flat_weights(self):
+        """The live params raveled into one contiguous device vector — the
+        unit the podracer weight publisher arms on the transfer fabric
+        (one buffer per publish, no per-leaf descriptors; consumers
+        unravel against their own params structure)."""
+        flat, _ = jax.flatten_util.ravel_pytree(self.params)
+        return flat
+
     def set_weights(self, params) -> bool:
         self.params = jax.device_put(
             jax.tree.map(jnp.asarray, params), self._replicated
@@ -255,6 +263,27 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.get_weights()
         return ray_tpu.get(self._actors[0].get_weights.remote())
+
+    def flat_weights(self):
+        import ray_tpu
+
+        if self._local is not None:
+            return self._local.flat_weights()
+        return ray_tpu.get(self._actors[0].flat_weights.remote())
+
+    def update_device(self, cols: dict) -> dict:
+        """Device-resident minibatch update (podracer learner plane).
+        Only the in-process (TPU-path) learner supports it: actor-group
+        learners receive host batches over RPC by construction, so the
+        device stream would round-trip anyway — the podracer driver
+        requires num_learners <= 1 for the decoupled arm."""
+        if self._local is None:
+            raise RuntimeError(
+                "update_device() requires the in-process learner "
+                "(num_learners <= 1); actor-group learners take the host "
+                "update() path"
+            )
+        return self._local.update_device(cols)
 
     def get_state(self) -> dict:
         import ray_tpu

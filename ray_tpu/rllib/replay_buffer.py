@@ -12,6 +12,17 @@ from __future__ import annotations
 import numpy as np
 
 from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.util import metrics as _metrics
+
+# Fill fraction of whichever replay plane is live: the host ring (this
+# actor) or the podracer learner's device ring. One series, plane-tagged,
+# so the trajectory-plane dashboards read occupancy the same way either
+# arm runs.
+_REPLAY_OCC = _metrics.Gauge(
+    "raytpu_rl_replay_occupancy",
+    "replay buffer fill fraction (size / capacity)",
+    tag_keys=("plane",),
+)
 
 
 class ReplayBuffer:
@@ -65,6 +76,10 @@ class ReplayBuffer:
             self._write = end % self.capacity
             self._size = min(self.capacity, self._size + n)
         self._added += n
+        if _metrics.metrics_enabled():
+            _REPLAY_OCC.set(
+                self._size / self.capacity, {"plane": "host"}
+            )
         return self._size
 
     def sample(self, num_items: int) -> SampleBatch:
@@ -109,3 +124,153 @@ class ReplayBuffer:
         if rng is not None:
             self._rng = rng
         return True
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= ``n``. Part of the trajectory plane's
+    wire contract: the producer's pad bucket (stage_fragment, the
+    inference tier's batch pad) and the consumer's scatter bucket
+    (:meth:`DeviceReplay.add`) must agree, or the jitted scatter
+    recompiles per novel shape."""
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+class DeviceReplay:
+    """Device-resident uniform replay ring — the podracer learner plane's
+    storage. Columns live as ONE jax buffer each; fragments scatter in
+    with a jitted donated index-scatter (``buf.at[idx].set`` over
+    modulo-ring indices, so wraparound needs no host-side split) and
+    train minibatches gather out with a jitted take — neither side of
+    the stream stages through host numpy (the round-13 contract the
+    trajectory plane feeds).
+
+    Single-process (it belongs to the learner loop, not an actor).
+    Fragment row counts vary (DQN fragments drop autoreset rows), and a
+    jitted scatter compiles per distinct shape — so fragments pad to a
+    power-of-two row bucket and the pad rows scatter to an out-of-range
+    index under ``mode="drop"``. A handful of buckets compile ever,
+    instead of one compile per novel fragment size stalling the learner
+    loop mid-run."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = int(capacity)
+        self._cols: dict | None = None
+        self._write = 0
+        self._size = 0
+        self._added = 0
+        self._seed = seed
+        self._scatter = None
+        self._gather = None
+        self._draw = None
+        self._key = None
+
+    def _build(self, cols: dict) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        cap = self.capacity
+        self._cols = {
+            k: jnp.zeros((cap,) + v.shape[1:], v.dtype)
+            for k, v in cols.items()
+        }
+
+        def scatter(buf, frag, write, rows):
+            offs = jnp.arange(frag.shape[0], dtype=jnp.int32)
+            # Pad rows (offs >= rows) land out of range and are dropped.
+            idx = jnp.where(offs < rows, (write + offs) % cap, cap)
+            return buf.at[idx].set(frag, mode="drop")
+
+        self._scatter = jax.jit(scatter, donate_argnums=(0,))
+        self._gather = jax.jit(
+            lambda buf, idx: jnp.take(buf, idx, axis=0, mode="clip")
+        )
+        self._draw = jax.jit(
+            lambda key, hi, n: jax.random.randint(key, (n,), 0, hi),
+            static_argnums=(2,),
+        )
+        self._key = jax.random.key(self._seed)
+
+    def add(self, cols: dict, rows: int | None = None) -> int:
+        """Scatter one fragment of column arrays into the ring; returns
+        the post-add size. Columns must match the first add's schema.
+
+        ``rows`` is the count of VALID leading rows; rows beyond it are
+        producer padding and never land (the trajectory plane ships
+        bucket-padded fragments so the wire and the scatter see a
+        handful of shapes — see :func:`~ray_tpu.rllib.podracer.
+        stage_fragment`). Host numpy columns are bucket-padded here;
+        device arrays scatter at their native row count (pad them at
+        the producer — a host pad would stage the stream through numpy,
+        a device pad would re-compile per novel size, the exact stall
+        bucketing exists to kill)."""
+        import jax.numpy as jnp
+
+        if self._cols is None:
+            self._build(cols)
+        if set(cols.keys()) != set(self._cols.keys()):
+            raise ValueError(
+                f"fragment columns {sorted(cols)} != ring columns "
+                f"{sorted(self._cols)}"
+            )
+        arr_rows = len(next(iter(cols.values())))
+        rows = arr_rows if rows is None else int(rows)
+        if rows > arr_rows:
+            raise ValueError(
+                f"rows={rows} exceeds the fragment's {arr_rows} rows"
+            )
+        if rows == 0:
+            return self._size
+        if rows > self.capacity:  # keep only the newest capacity rows
+            cols = {
+                k: v[rows - self.capacity : rows] for k, v in cols.items()
+            }
+            rows = arr_rows = self.capacity
+        bucket = pow2_bucket(arr_rows)
+        for k, v in cols.items():
+            if isinstance(v, np.ndarray) and bucket > arr_rows:
+                pad = np.zeros(
+                    (bucket - arr_rows,) + v.shape[1:], v.dtype
+                )
+                v = np.concatenate([v, pad], axis=0)
+            self._cols[k] = self._scatter(
+                self._cols[k], jnp.asarray(v), self._write, rows
+            )
+        self._write = (self._write + rows) % self.capacity
+        self._size = min(self.capacity, self._size + rows)
+        self._added += rows
+        if _metrics.metrics_enabled():
+            _REPLAY_OCC.set(
+                self._size / self.capacity, {"plane": "device"}
+            )
+        return self._size
+
+    def sample(self, num_items: int) -> dict:
+        """Uniform sample WITH replacement, gathered on device: returns a
+        dict of jax arrays ready for the learner's device update."""
+        import jax
+
+        if self._size == 0:
+            raise ValueError("cannot sample from an empty device replay")
+        self._key, k = jax.random.split(self._key)
+        idx = self._draw(k, self._size, int(num_items))
+        return {k2: self._gather(v, idx) for k2, v in self._cols.items()}
+
+    def size(self) -> int:
+        return self._size
+
+    def added(self) -> int:
+        """Lifetime rows scattered in (never capped by capacity —
+        learning_starts-style gates must use this, not :meth:`size`)."""
+        return self._added
+
+    def stats(self) -> dict:
+        return {
+            "size": self._size,
+            "capacity": self.capacity,
+            "added_lifetime": self._added,
+        }

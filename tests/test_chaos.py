@@ -921,3 +921,142 @@ def test_chaos_data_pipeline_converges(chaos_cluster):
     ds = rd.range(64, parallelism=4).map(lambda r: {"y": r["id"] * 2})
     out = sorted(r["y"] for r in ds.take_all())
     assert out == [i * 2 for i in range(64)]
+
+
+# -- podracer RL planes (round 17) --------------------------------------------
+# The decoupled actor/inference/learner planes ride the same chaos
+# contract as every other tier: a seeded env-runner kill mid-rollout is
+# restart-and-continue (the trajectory queue never wedges), and a
+# weightsync sever schedule replays bit-identically from its
+# RAY_TPU_FAULTS seed.
+
+
+@pytest.mark.timeout(600)
+def test_podracer_envrun_kill_restarts_and_converges():
+    """A seeded ``envrun.kill`` takes worker 0 down mid-rollout — every
+    life (respawned workers inherit the env spec and die again after the
+    same number of vector steps). The supervisor restarts it each time,
+    the other runner keeps the planes fed, the run still reaches its
+    env-step target, and the trajectory queue drains clean (no wedge)."""
+    import os
+
+    from ray_tpu.rllib import PodracerConfig
+
+    os.environ["RAY_TPU_FAULTS"] = "13:envrun.kill,match=w0,after=40,count=1"
+    runtime = ray_tpu.init(num_cpus=8)
+    try:
+        algo = (
+            PodracerConfig(
+                num_env_runners=2,
+                num_envs_per_env_runner=4,
+                rollout_fragment_length=32,
+                lr=1e-3,
+                hidden=(32, 32),
+                seed=0,
+                epsilon_anneal_steps=2_000,
+                learning_starts=256,
+                train_batch_size=64,
+                num_train_batches_per_iteration=8,
+                target_network_update_freq=100,
+                podracer_staleness_steps=2,
+                trajectory_queue_depth=8,
+            )
+            .environment("CartPole-v1")
+            .build()
+        )
+        out = algo.run(2_500, time_budget_s=240)
+        assert out["mode"] == "decoupled"
+        assert out["errors"] == [], out["errors"]
+        # The seeded kill actually fired and the supervisor recovered it.
+        assert out["restarts"] >= 1, out
+        # Convergence despite the crash loop: the step target landed and
+        # the learner kept consuming (the queue never wedged on the dead
+        # producer's staged fragments — failed pulls are dropped+counted).
+        assert out["env_steps"] >= 2_500
+        assert out["grad_updates"] > 0
+        algo.stop()
+    finally:
+        del os.environ["RAY_TPU_FAULTS"]
+        faults.clear()
+        ray_tpu.shutdown()
+
+
+def test_podracer_weightsync_sever_replays_bit_identically():
+    """The weightsync chaos contract: one RAY_TPU_FAULTS seed pins the
+    sever schedule — two replays of the same publish/apply sequence make
+    bit-identical sever decisions AND leave bit-identical params on the
+    consumer; a different seed diverges. Severed pulls fall back to
+    last-good params with the version lag counted."""
+    import hashlib
+
+    import jax
+
+    from ray_tpu.rllib import QModule, WeightPublisher
+    from ray_tpu.rllib.env_runner import RolloutBase
+    from ray_tpu.rllib.rl_module import to_numpy
+
+    module = QModule(obs_dim=4, num_actions=2, hidden=(16,))
+    versions = [
+        module.init(jax.random.key(i)) for i in range(10)
+    ]  # a deterministic "training trajectory" to publish
+
+    def digest(params) -> str:
+        h = hashlib.blake2b(digest_size=16)
+        for leaf in jax.tree.leaves(to_numpy(params)):
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        return h.hexdigest()
+
+    class _Lg:
+        def __init__(self):
+            self.params = None
+
+        def flat_weights(self):
+            import jax.flatten_util
+
+            flat, _ = jax.flatten_util.ravel_pytree(self.params)
+            return flat
+
+    def replay(seed: int):
+        """One full publish/apply run under the seeded injector; returns
+        (applied-version sequence, per-step param digests, lag counts)."""
+        faults.install(
+            faults.parse_spec(seed, "weightsync.sever,p=0.5")
+        )
+        try:
+            lg = _Lg()
+            pub = WeightPublisher(lg)
+            consumer = RolloutBase.__new__(RolloutBase)
+            # No vector env in this unit: skip the CPU device pinning.
+            consumer._cpu = None
+            consumer._init_weight_sync()
+            consumer.set_weights(versions[0])
+            applied, digests, lags = [], [], []
+            for p in versions:
+                lg.params = p
+                v = pub.publish()
+                applied.append(
+                    consumer.apply_weights(v, pub.descriptor())
+                )
+                digests.append(digest(consumer._params))
+                lags.append(pub.note_applied([applied[-1]]))
+            pub.close()
+            return applied, digests, lags, consumer.weight_state()
+        finally:
+            faults.clear()
+
+    a1 = replay(23)
+    a2 = replay(23)
+    assert a1 == a2, "same seed must replay the sever schedule exactly"
+    applied, digests, lags, wstate = a1
+    # The schedule actually severed something AND let something through.
+    assert wstate["failures"] > 0
+    assert max(applied) > 0
+    # Severed steps: version stalls, lag counted, params stay last-good.
+    stalls = [
+        i for i in range(1, len(applied)) if applied[i] == applied[i - 1]
+    ]
+    assert stalls and all(lags[i] > 0 for i in stalls)
+    for i in stalls:
+        assert digests[i] == digests[i - 1]
+    # A different seed is a different schedule.
+    assert replay(24)[0] != applied

@@ -191,3 +191,28 @@ def test_disagg_and_spec_decode_series_registered_and_linted():
     assert catalog["raytpu_llm_spec_accept_rate"]["kind"] == "gauge"
     assert catalog["raytpu_llm_spec_accept_rate"]["tag_keys"] == ("replica",)
     assert lint_catalog(catalog) == []
+
+
+def test_podracer_rl_series_registered_and_linted():
+    """Round-17 podracer RL series ride the optional rllib modules
+    (jax-heavy, imported here directly because this box has jax): the
+    env-step counter, the inference-tier coalescing histogram, the
+    weight-version lag gauge, and the plane-tagged replay occupancy —
+    kinds/tags must pass the catalog lint."""
+    populate_catalog(include_optional=False)
+    import ray_tpu.rllib.env_runner  # noqa: F401 — env-step counter
+    import ray_tpu.rllib.podracer  # noqa: F401 — batch hist + lag gauge
+    import ray_tpu.rllib.replay_buffer  # noqa: F401 — occupancy gauge
+
+    catalog = m.runtime_catalog()
+    assert catalog["raytpu_rl_env_steps_total"]["kind"] == "counter"
+    assert catalog["raytpu_rl_env_steps_total"]["tag_keys"] == ()
+    assert catalog["raytpu_rl_inference_batch_size"]["kind"] == "histogram"
+    assert catalog["raytpu_rl_inference_batch_size"]["tag_keys"] == ()
+    assert catalog["raytpu_rl_weight_version_lag"]["kind"] == "gauge"
+    assert catalog["raytpu_rl_weight_version_lag"]["tag_keys"] == ()
+    # One occupancy series for both replay planes, tagged by plane —
+    # bounded cardinality ({host, device}), never an id.
+    assert catalog["raytpu_rl_replay_occupancy"]["kind"] == "gauge"
+    assert catalog["raytpu_rl_replay_occupancy"]["tag_keys"] == ("plane",)
+    assert lint_catalog(catalog) == []

@@ -1,0 +1,483 @@
+"""Podracer decoupled RL: actor / inference / learner planes.
+
+Covers the round-17 contracts one plane at a time, then end to end:
+
+- DeviceReplay: device-resident ring semantics (variable fragment sizes,
+  wraparound scatter, sampling without host staging);
+- transfer-fabric group arm/pull (the trajectory plane's wire unit);
+- InferenceServer request coalescing (batching-window/size knob);
+- fabric weight sync: versioned publish -> in-place pull, sever keeps
+  last-good params;
+- **the parity pin**: staleness 0 degenerates to lockstep and is
+  bit-identical (same seed => same params trajectory) to the single-loop
+  DQN — the CI contract ISSUE round 17 names;
+- the decoupled arm: env-step target reached, grad updates land
+  alongside, weight lag bounded by podracer_staleness_steps;
+- the RAY_TPU_PODRACER kill switch.
+"""
+
+import asyncio
+import hashlib
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import faults
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.rllib import (
+    DQNConfig,
+    DeviceReplay,
+    PodracerConfig,
+    PodracerDQN,
+    QModule,
+    WeightPublisher,
+)
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.podracer import InferenceServer
+
+pytestmark = [
+    pytest.mark.filterwarnings("ignore"),
+    pytest.mark.timeout(600),
+]
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def _digest(params) -> str:
+    import jax
+
+    from ray_tpu.rllib.rl_module import to_numpy
+
+    h = hashlib.blake2b(digest_size=16)
+    for leaf in jax.tree.leaves(to_numpy(params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+_COMMON = dict(
+    num_env_runners=2,
+    num_envs_per_env_runner=4,
+    rollout_fragment_length=32,
+    lr=1e-3,
+    hidden=(32, 32),
+    seed=0,
+    epsilon_anneal_steps=2_000,
+    learning_starts=256,
+    train_batch_size=64,
+    num_train_batches_per_iteration=8,
+    target_network_update_freq=100,
+)
+
+
+# -- trajectory plane: the device-resident ring -------------------------------
+
+
+def _cols(rng, n, obs_dim=4):
+    return {
+        sb.OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        sb.ACTIONS: rng.integers(0, 2, size=(n,)).astype(np.int32),
+        sb.REWARDS: rng.normal(size=(n,)).astype(np.float32),
+        sb.NEXT_OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        sb.TERMINATEDS: (rng.random(n) < 0.1).astype(np.float32),
+    }
+
+
+def test_device_replay_variable_fragments_and_wrap():
+    rng = np.random.default_rng(0)
+    ring = DeviceReplay(capacity=100, seed=0)
+    # DQN fragments drop autoreset rows, so sizes vary add to add.
+    assert ring.add(_cols(rng, 30)) == 30
+    assert ring.add(_cols(rng, 17)) == 47
+    assert ring.add(_cols(rng, 90)) == 100  # wrapped mid-fragment
+    out = ring.sample(64)
+    assert set(out.keys()) == {
+        sb.OBS, sb.ACTIONS, sb.REWARDS, sb.NEXT_OBS, sb.TERMINATEDS,
+    }
+    assert out[sb.OBS].shape == (64, 4)
+    # Samples are jax arrays (no host staging on the learner path).
+    import jax
+
+    assert all(isinstance(v, jax.Array) for v in out.values())
+    assert ring.stats()["added_lifetime"] == 137
+    # Oversized add keeps only the newest capacity rows.
+    assert ring.add(_cols(rng, 250)) == 100
+    # Empty fragment is a no-op, empty ring refuses to sample.
+    assert ring.add(_cols(rng, 0)) == 100
+    with pytest.raises(ValueError, match="empty"):
+        DeviceReplay(capacity=10).sample(1)
+    with pytest.raises(ValueError, match="positive"):
+        DeviceReplay(capacity=0)
+
+
+def test_device_replay_rejects_mismatched_columns():
+    rng = np.random.default_rng(1)
+    ring = DeviceReplay(capacity=10)
+    ring.add(_cols(rng, 5))
+    with pytest.raises(ValueError, match="columns"):
+        ring.add({sb.OBS: np.zeros((2, 4), np.float32)})
+
+
+def test_device_replay_ring_overwrites_oldest():
+    """Wraparound scatter lands new rows over the oldest ones: after
+    capacity+k adds of distinct constants, only the newest capacity
+    constants remain reachable."""
+    ring = DeviceReplay(capacity=8, seed=0)
+    for i in range(12):
+        ring.add(
+            {
+                sb.OBS: np.full((1, 2), float(i), np.float32),
+                sb.ACTIONS: np.zeros((1,), np.int32),
+                sb.REWARDS: np.zeros((1,), np.float32),
+                sb.NEXT_OBS: np.zeros((1, 2), np.float32),
+                sb.TERMINATEDS: np.zeros((1,), np.float32),
+            }
+        )
+    vals = {float(v) for v in np.asarray(ring._cols[sb.OBS])[:, 0]}
+    assert vals == {float(i) for i in range(4, 12)}
+
+
+# -- trajectory plane: fabric group arm/pull ----------------------------------
+
+
+def test_fabric_arm_group_roundtrip(cluster):
+    """A fragment's columns travel under ONE uid: one descriptor, one
+    pull, every member value-identical."""
+    from ray_tpu.experimental import transfer as xfer
+    from ray_tpu.rllib.podracer import load_fragment, stage_fragment
+    from ray_tpu.rllib.sample_batch import SampleBatch
+
+    rng = np.random.default_rng(2)
+    batch = SampleBatch(_cols(rng, 12))
+    entry, uid = stage_fragment(batch)
+    assert entry["steps"] == 12 and entry["desc"]["uuid"] == uid
+    cols = load_fragment(entry)
+    for k in cols:
+        # Wire arrays are padded to the power-of-two row bucket (16);
+        # entry["steps"] bounds the valid rows.
+        assert len(cols[k]) == 16
+        np.testing.assert_allclose(
+            np.asarray(cols[k])[:12], np.asarray(batch[k]), rtol=1e-6
+        )
+    # A second pull of the same serve-once entry must NOT wedge: it
+    # fails, is counted, and returns None (the dead-producer path).
+    before = xfer.fabric().stats().get("fallbacks", 0)
+    assert load_fragment(entry) is None
+    assert xfer.fabric().stats().get("fallbacks", 0) == before + 1
+
+
+# -- inference tier -----------------------------------------------------------
+
+
+def test_inference_server_coalesces_concurrent_requests():
+    """Requests landing inside one batching window fuse into one padded
+    forward; answers split back per caller and match the local greedy."""
+    module = QModule(obs_dim=4, num_actions=2, hidden=(16,))
+    import jax
+
+    params = module.init(jax.random.key(0))
+    srv = InferenceServer(module, batch_window_s=0.02, max_batch=64)
+    srv.set_weights(params)
+
+    rng = np.random.default_rng(3)
+    chunks = [rng.normal(size=(n, 4)).astype(np.float32) for n in (3, 5, 2)]
+
+    async def drive():
+        return await asyncio.gather(*(srv.infer(c) for c in chunks))
+
+    outs = asyncio.run(drive())
+    stats = srv.get_stats()
+    assert stats["requests"] == 3
+    assert stats["batches"] == 1  # one window, one fused forward
+    assert stats["rows"] == 10 and stats["max_batch_rows"] == 10
+    import jax.numpy as jnp
+
+    expect = np.asarray(
+        jnp.argmax(
+            module.forward(params, np.concatenate(chunks))["q"], axis=-1
+        )
+    )
+    got = np.concatenate([np.asarray(o) for o in outs])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_inference_server_row_cap_flushes_early():
+    module = QModule(obs_dim=4, num_actions=2, hidden=(8,))
+    import jax
+
+    srv = InferenceServer(module, batch_window_s=5.0, max_batch=4)
+    srv.set_weights(module.init(jax.random.key(0)))
+    obs = np.zeros((4, 4), np.float32)
+
+    async def drive():
+        # One request already at the cap: flushes without the window.
+        return await asyncio.wait_for(srv.infer(obs), timeout=2.0)
+
+    out = asyncio.run(drive())
+    assert out.shape == (4,)
+    assert srv.get_stats()["batches"] == 1
+
+
+# -- weight-sync plane --------------------------------------------------------
+
+
+class _Lg:
+    """Stub learner group: just the flat_weights surface the publisher
+    arms (a real Learner backs the end-to-end tests)."""
+
+    def __init__(self, params):
+        self.params = params
+
+    def flat_weights(self):
+        import jax
+        import jax.flatten_util
+
+        flat, _ = jax.flatten_util.ravel_pytree(self.params)
+        return flat
+
+
+def test_weight_publish_pull_roundtrip(cluster):
+    """Versioned publish over the fabric lands value-identical params on
+    a consumer via in-place unravel (RolloutBase.apply_weights)."""
+    import jax
+
+    from ray_tpu.rllib.env_runner import RolloutBase
+
+    module = QModule(obs_dim=4, num_actions=2, hidden=(16,))
+    p_src = module.init(jax.random.key(1))
+    p_dst = module.init(jax.random.key(2))
+    assert _digest(p_src) != _digest(p_dst)
+
+    consumer = RolloutBase.__new__(RolloutBase)
+    consumer._cpu = None  # no vector env in this unit: skip device pinning
+    consumer._init_weight_sync()
+    consumer.set_weights(p_dst)
+
+    pub = WeightPublisher(_Lg(p_src))
+    v = pub.publish()
+    assert consumer.apply_weights(v, pub.descriptor()) == 1
+    assert _digest(consumer._params) == _digest(p_src)
+    assert consumer.weight_state()["version"] == 1
+    assert consumer.weight_state()["failures"] == 0
+    assert pub.note_applied([1]) == 0
+    pub.close()
+
+
+def test_weightsync_sever_keeps_last_good_params(cluster):
+    """A severed pull (seeded fault) leaves the consumer on last-good
+    params, reports the stale version, and counts the failure; the next
+    clean publish catches it up."""
+    import jax
+
+    from ray_tpu.rllib.env_runner import RolloutBase
+
+    module = QModule(obs_dim=4, num_actions=2, hidden=(16,))
+    p_src = module.init(jax.random.key(1))
+    p_dst = module.init(jax.random.key(2))
+
+    consumer = RolloutBase.__new__(RolloutBase)
+    consumer._cpu = None  # no vector env in this unit: skip device pinning
+    consumer._init_weight_sync()
+    consumer.set_weights(p_dst)
+    d_before = _digest(p_dst)
+
+    pub = WeightPublisher(_Lg(p_src))
+    try:
+        faults.install(
+            faults.parse_spec(11, "weightsync.sever,match=v1")
+        )
+        v = pub.publish()
+        assert consumer.apply_weights(v, pub.descriptor()) == 0  # stale
+        assert _digest(consumer._params) == d_before  # last-good kept
+        assert consumer.weight_state()["failures"] == 1
+        assert pub.note_applied([0]) == 1  # the lag is visible
+        # v2 is not matched by the rule: the consumer catches up.
+        v = pub.publish()
+        assert consumer.apply_weights(v, pub.descriptor()) == 2
+        assert _digest(consumer._params) == _digest(p_src)
+        assert pub.note_applied([2]) == 0
+    finally:
+        faults.clear()
+        pub.close()
+
+
+def test_apply_weights_drops_stale_race(cluster):
+    """Regression: an apply that lost the race to a NEWER publish is
+    dropped — the inference tier runs applies concurrently
+    (max_concurrency), and installing the older vector would regress
+    params under a version the staleness gate already counted as
+    applied. Also pins the release horizon: with staleness_steps=2 the
+    v1 entry must still be armed when v2 publishes (a slow consumer's
+    v1 apply is legitimately in flight)."""
+    import jax
+
+    from ray_tpu.rllib.env_runner import RolloutBase
+
+    module = QModule(obs_dim=4, num_actions=2, hidden=(16,))
+    p1 = module.init(jax.random.key(1))
+    p2 = module.init(jax.random.key(2))
+
+    consumer = RolloutBase.__new__(RolloutBase)
+    consumer._cpu = None  # no vector env in this unit: skip device pinning
+    consumer._init_weight_sync()
+    consumer.set_weights(p1)
+
+    lg = _Lg(p1)
+    pub = WeightPublisher(lg, staleness_steps=2)
+    v1 = pub.publish()
+    d1 = pub.descriptor()  # armed for v1 (params p1)
+    lg.params = p2
+    v2 = pub.publish()
+    assert consumer.apply_weights(v2, pub.descriptor()) == 2
+    after = _digest(consumer._params)
+    # The late v1 apply pulls fine (entry still armed) but must be
+    # dropped, not regress params to p1.
+    assert consumer.apply_weights(v1, d1) == 2
+    assert _digest(consumer._params) == after
+    assert consumer.weight_state()["failures"] == 0
+    pub.close()
+
+
+# -- the parity pin -----------------------------------------------------------
+
+
+def test_staleness_zero_lockstep_bit_identical_to_dqn(cluster):
+    """THE round-17 CI pin: PodracerConfig(podracer_staleness_steps=0)
+    runs the exact single-loop DQN schedule — same seed => bit-identical
+    params trajectory — with only the weight sync riding the fabric
+    (f32 ravel/unravel round-trips exactly)."""
+    digests = []
+    for cfg in (
+        DQNConfig(**_COMMON),
+        PodracerConfig(**_COMMON, podracer_staleness_steps=0),
+    ):
+        algo = cfg.environment("CartPole-v1").build()
+        trail = []
+        for _ in range(3):
+            algo.train()
+            trail.append(_digest(algo.learner_group.get_weights()))
+        digests.append(trail)
+        algo.stop()
+    assert digests[0] == digests[1], (
+        "staleness-0 lockstep diverged from the single-loop DQN "
+        f"params trajectory: {digests}"
+    )
+
+
+def test_run_with_staleness_zero_reports_lockstep_mode(cluster):
+    algo = (
+        PodracerConfig(**_COMMON, podracer_staleness_steps=0)
+        .environment("CartPole-v1")
+        .build()
+    )
+    out = algo.run(400, time_budget_s=120)
+    assert out["mode"] == "lockstep"
+    assert out["env_steps"] >= 400
+    assert out["weight_lag_p99"] == 0.0
+    algo.stop()
+
+
+# -- the decoupled arm --------------------------------------------------------
+
+
+def test_decoupled_run_reaches_target_with_bounded_lag(cluster):
+    algo = (
+        PodracerConfig(
+            **_COMMON,
+            podracer_staleness_steps=2,
+            num_inference_replicas=1,
+            trajectory_queue_depth=8,
+        )
+        .environment("CartPole-v1")
+        .build()
+    )
+    # Warmup run: pays the learner/inference jit compiles so the measured
+    # run's learner isn't racing a compile against µs CartPole steps —
+    # and regression-covers the re-run lag accounting (a second run()
+    # must NOT see a phantom lag from versions published in the first).
+    algo.run(1_500, time_budget_s=120)
+    out = algo.run(3_000, time_budget_s=180)
+    assert out["mode"] == "decoupled"
+    assert out["errors"] == []  # a crashed plane must surface, not hide
+    assert out["env_steps"] >= 3_000
+    assert out["grad_updates"] > 0
+    # The staleness gate: a publish may outrun the slowest consumer by
+    # at most the bound (+1 for the just-published version the gate is
+    # currently draining).
+    assert out["weight_lag_p99"] <= 2 + 1
+    assert out["weight_version"] > 0
+    # The inference tier actually served the acting plane.
+    assert out["inference"]["requests"] > 0
+    assert out["inference"]["rows"] >= out["inference"]["batches"]
+    # Clean teardown: nothing left armed/queued.
+    assert out["restarts"] == 0
+    # Regression: ONE lag sample per sync round — the gate must not
+    # append a sample per 2 ms spin iteration (which biases the p99
+    # toward over-bound waits and grows the window unboundedly).
+    rounds = out["grad_updates"] // algo.config.num_train_batches_per_iteration
+    assert len(algo._publisher._lag_samples) <= rounds + 1
+    # Regression: a lockstep run after a decoupled one starts a fresh
+    # lag window — it must NOT report the decoupled run's samples.
+    algo.config.podracer_staleness_steps = 0
+    out_ls = algo.run(200, time_budget_s=60)
+    assert out_ls["mode"] == "lockstep"
+    assert out_ls["weight_lag_p99"] == 0.0
+    algo.stop()
+
+
+def test_decoupled_small_ring_still_trains(cluster):
+    """Regression: the learner gate counts LIFETIME rows pulled into the
+    ring, not ring size — a device ring smaller than learning_starts
+    (valid, and trains fine on the lockstep arm) must not disable
+    training forever."""
+    algo = (
+        PodracerConfig(
+            **_COMMON,
+            podracer_staleness_steps=2,
+            decoupled_replay_capacity=128,  # < learning_starts=256
+        )
+        .environment("CartPole-v1")
+        .build()
+    )
+    # Warmup pays the compiles and fills the ring past learning_starts
+    # LIFETIME rows (the ring itself saturates at 128): under the bug
+    # the gate never opens no matter how long the planes run, so the
+    # measured run still lands zero updates.
+    algo.run(800, time_budget_s=120)
+    out = algo.run(800, time_budget_s=120)
+    assert out["mode"] == "decoupled"
+    assert out["errors"] == []
+    assert out["grad_updates"] > 0, (
+        "ring capacity < learning_starts silently disabled the learner"
+    )
+    algo.stop()
+
+
+def test_podracer_kill_switch_forces_lockstep(cluster):
+    """RAY_TPU_PODRACER=0 (GLOBAL_CONFIG.podracer False): run() loops
+    the single-loop iteration even with staleness >= 1 — the A/B
+    baseline arm of tools/ray_perf.py --rl-only --no-podracer."""
+    prev = GLOBAL_CONFIG.podracer
+    GLOBAL_CONFIG.podracer = False
+    try:
+        algo = (
+            PodracerConfig(**_COMMON, podracer_staleness_steps=2)
+            .environment("CartPole-v1")
+            .build()
+        )
+        out = algo.run(400, time_budget_s=120)
+        assert out["mode"] == "lockstep"
+        algo.stop()
+    finally:
+        GLOBAL_CONFIG.podracer = prev
+
+
+def test_podracer_config_builds_podracer_dqn():
+    cfg = PodracerConfig(**_COMMON)
+    assert cfg.algo_class is PodracerDQN
+    assert cfg.podracer_staleness_steps == 1  # decoupled by default

@@ -1,0 +1,56 @@
+"""Same-session A/B of the podracer decoupled RL planes (PERF.md
+round 17).
+
+Runs ``tools/ray_perf.py --rl-only`` alternately with the decoupled
+actor/inference/learner planes ON (HEAD defaults) and OFF
+(``--no-podracer``: the single-loop sample→update DQN iteration,
+byte-identical to the pre-round-17 learner) on the SAME commit,
+interleaved so ambient box load hits both arms equally (the round-3
+lesson). Watch:
+
+    rl_env_steps_per_s        the headline — acting-plane throughput on
+                              the emulated-cost CartPole (~0.25 ms/step;
+                              a raw CartPole step is 1000x cheaper than
+                              any production simulator and would make
+                              every acting design look control-bound)
+    rl_learner_updates_per_s  grad steps landing alongside the acting
+    rl_weight_lag_p99         bounded by podracer_staleness_steps on the
+                              ON arm; identically 0 single-loop
+
+    python tools/ab_podracer.py [--rounds 3] [--full]
+
+The interleaved-median machinery is shared with tools/ab_coalesce.py;
+bench.py records the same pair per round as the ``podracer`` BENCH
+record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import interleaved_ab  # noqa: E402 — shared machinery
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument(
+        "--full", action="store_true", help="full (not --quick) perf runs"
+    )
+    args = ap.parse_args()
+    interleaved_ab(
+        "--no-podracer",
+        "podracer-rl",
+        args.rounds,
+        args.full,
+        base_flags=("--rl-only",),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

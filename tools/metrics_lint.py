@@ -50,6 +50,12 @@ _OPTIONAL_MODULES = [
     "ray_tpu.llm.serve_llm",
     "ray_tpu.llm.disagg",  # KV-handoff ship-bytes counter (round 16)
     "ray_tpu.llm.spec_decode",  # draft/accept series (round 16)
+    # Podracer RL planes (round 17): env-step counter + replay occupancy
+    # + inference batch histogram + weight-version lag. jax-heavy like
+    # the llm modules, so optional for jax-free lint environments.
+    "ray_tpu.rllib.env_runner",
+    "ray_tpu.rllib.replay_buffer",
+    "ray_tpu.rllib.podracer",
 ]
 
 

@@ -792,6 +792,89 @@ def _train_rows(results: dict, no_async_dispatch: bool, quick: bool):
     )
 
 
+def _podracer_env_maker():
+    """CartPole with a ~0.25 ms per-env-step cost emulating a non-trivial
+    simulator (a raw CartPole step is ~1 µs — three orders of magnitude
+    under any production env, which would make ANY acting-plane design
+    look control-plane-bound). Module-level so worker processes can
+    unpickle it."""
+    import time as _t
+
+    import gymnasium as gym
+
+    class _SlowStep(gym.Wrapper):
+        def step(self, action):
+            _t.sleep(0.00025)
+            return self.env.step(action)
+
+    return _SlowStep(gym.make("CartPole-v1"))
+
+
+def _rl_rows(results: dict, no_podracer: bool, quick: bool):
+    """Podracer RL rows: one fixed-budget DQN run on the emulated-cost
+    CartPole (see _podracer_env_maker), decoupled planes ON (HEAD
+    defaults) vs the --no-podracer kill switch (the single-loop
+    sample→update iteration, byte-identical to DQN). Rows:
+
+      rl_env_steps_per_s        acting-plane throughput — the headline
+      rl_learner_updates_per_s  grad steps/s landed alongside the acting
+      rl_weight_lag_p99         p99 published-vs-applied version lag
+                                (bounded by podracer_staleness_steps;
+                                identically 0 on the lockstep arm)
+      rl_inference_batch_mean   coalesced rows per inference forward
+                                (decoupled arm only)
+    """
+    from ray_tpu.rllib import PodracerConfig
+
+    target = 4000 if quick else 12000
+    arm = "single-loop" if no_podracer else "podracer"
+    config = PodracerConfig(
+        num_env_runners=2,
+        num_envs_per_env_runner=16,
+        rollout_fragment_length=16,
+        lr=1e-3,
+        hidden=(128, 128),
+        seed=0,
+        epsilon_anneal_steps=4 * target,
+        learning_starts=512,
+        train_batch_size=256,
+        num_train_batches_per_iteration=16,
+        target_network_update_freq=200,
+        podracer_staleness_steps=2,
+        trajectory_queue_depth=8,
+        inference_batch_window_s=0.001,
+        inference_max_batch=64,
+    ).environment(_podracer_env_maker)
+    algo = config.build()
+    # Warm the jitted paths out of the measured window (both arms pay
+    # their compiles here). The warmup must run PAST learning_starts so
+    # the learner's update/scatter programs compile now, not inside the
+    # measured window.
+    algo.run(1_536, time_budget_s=180)
+    t0 = time.perf_counter()
+    out = algo.run(target, time_budget_s=300 if quick else 600)
+    dt = time.perf_counter() - t0
+    results["rl_env_steps_per_s"] = round(out["env_steps"] / dt, 1)
+    results["rl_learner_updates_per_s"] = round(
+        out["grad_updates"] / dt, 2
+    )
+    results["rl_weight_lag_p99"] = round(out["weight_lag_p99"], 2)
+    infer = out.get("inference") or {}
+    if infer.get("batches"):
+        results["rl_inference_batch_mean"] = round(
+            infer["rows"] / infer["batches"], 2
+        )
+    results["rl_restarts"] = out.get("restarts", 0)
+    results["rl_queue_drops"] = out.get("queue_drops", 0)
+    print(
+        f"rl [{arm}]: {results['rl_env_steps_per_s']:,.0f} env_steps/s, "
+        f"{results['rl_learner_updates_per_s']:,.1f} updates/s, "
+        f"weight-lag p99 {results['rl_weight_lag_p99']}",
+        flush=True,
+    )
+    algo.stop()
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -904,6 +987,21 @@ def main() -> int:
         "round-13 host-free train steps",
     )
     ap.add_argument(
+        "--rl-only",
+        action="store_true",
+        help="run only the podracer RL rows (decoupled DQN on an "
+        "emulated-cost CartPole): rl_env_steps_per_s + learner updates/s "
+        "+ weight-lag p99 — the round-17 A/B rides this via "
+        "tools/ab_podracer.py and bench.py's podracer record",
+    )
+    ap.add_argument(
+        "--no-podracer",
+        action="store_true",
+        help="kill switch: single-loop sample→update DQN iteration "
+        "(equivalent to RAY_TPU_PODRACER=0; the A/B baseline for the "
+        "round-17 decoupled actor/inference/learner planes)",
+    )
+    ap.add_argument(
         "--faults",
         metavar="SEED:SPEC",
         help="enable the fault-injection plane for the whole run "
@@ -946,6 +1044,7 @@ def main() -> int:
         or args.no_admission
         or args.no_disagg
         or args.no_spec_decode
+        or args.no_podracer
     ):
         from ray_tpu.core.config import GLOBAL_CONFIG
 
@@ -968,6 +1067,13 @@ def main() -> int:
             GLOBAL_CONFIG.disagg = False
         if args.no_spec_decode:
             GLOBAL_CONFIG.spec_decode = False
+        if args.no_podracer:
+            GLOBAL_CONFIG.podracer = False
+
+    if args.rl_only:
+        # Runner/learner jax stays on CPU even where a TPU plugin is
+        # installed: workers inherit the driver env.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     if args.serve_llm_only:
         # Replica actors must run CPU jax even where a TPU plugin is
@@ -993,6 +1099,12 @@ def main() -> int:
         _serve_overload_rows(
             results, no_admission=args.no_admission, quick=args.quick
         )
+        print(json.dumps(results), flush=True)
+        ray_tpu.shutdown()
+        return 0
+
+    if args.rl_only:
+        _rl_rows(results, no_podracer=args.no_podracer, quick=args.quick)
         print(json.dumps(results), flush=True)
         ray_tpu.shutdown()
         return 0

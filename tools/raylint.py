@@ -159,6 +159,11 @@ DEVICE_HOT_ENTRYPOINTS = frozenset(
         "ray_tpu.llm.spec_decode.SpecDecoder.prefill_draft",
         "ray_tpu.train.context.TrainContext.report",
         "ray_tpu.rllib.learner.Learner.update",
+        # Podracer planes (round 17): the inference tier's coalesced
+        # forward and the learner plane's device-resident minibatch step
+        # both sit on the decoupled hot path.
+        "ray_tpu.rllib.podracer.InferenceServer._flush",
+        "ray_tpu.rllib.dqn.DQNLearner.update_device",
     }
 )
 
