@@ -529,6 +529,24 @@ def _podracer_rows() -> dict:
     return out
 
 
+def _data_governor_rows() -> dict:
+    """Memory-governed data-plane A/B (round-18): out-of-core pipeline
+    rows/s + peak store occupancy + spill count with the governor ON vs
+    the kill-switch arm (``--no-data-governor``). The workload caps the
+    object store 4x below the dataset, so the OFF arm spills where the
+    ON arm stays under the high watermark."""
+    out = _ab_rows(
+        "data_governor", ("--data-only",), ("--no-data-governor",), 420
+    )
+    if "on" in out and "off" in out:
+        on_r = out["on"].get("data_pipeline_rows_per_s", 0)
+        off_r = out["off"].get("data_pipeline_rows_per_s", 0)
+        if off_r:
+            # >1 = bounded-memory streaming beat spill-and-restore.
+            out["rows_per_s_ratio"] = round(on_r / off_r, 3)
+    return out
+
+
 def _raylint_rows() -> dict:
     """Static-analysis debt counts via ``tools/raylint.py --json`` (total /
     suppressed / unsuppressed + per-rule) so lint debt is tracked per round
@@ -577,9 +595,14 @@ def _emit(
     serve_overload: dict | None = None,
     serve_disagg: dict | None = None,
     podracer: dict | None = None,
+    data_governor: dict | None = None,
 ) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
+    if data_governor:
+        # Memory-governed data-plane A/B (occupancy bound + spill count,
+        # governor ON vs kill switch) rides every record from round 18 on.
+        record = {**record, "data_governor": data_governor}
     if serve_llm:
         # Serving A/B rides every record too: the BENCH trajectory tracks
         # the serving number (tok/s + p99 TTFT, routing ON vs OFF) from
@@ -628,6 +651,7 @@ def main() -> None:
     serve_overload = _serve_overload_rows()
     train_overlap = _train_overlap_rows()
     podracer = _podracer_rows()
+    data_governor = _data_governor_rows()
     raylint = _raylint_rows()
 
     probe_record: dict | None = None
@@ -636,6 +660,7 @@ def main() -> None:
         _emit(
             record, data_plane, probe_record, serve_llm, raylint,
             train_overlap, serve_overload, serve_disagg, podracer,
+            data_governor,
         )
 
     try:

@@ -761,6 +761,7 @@ def nodes() -> list[dict]:
          "Available": v["available"], "Labels": v["labels"],
          "Address": tuple(v["addr"]),
          "Draining": v.get("draining", False),
+         "StoreStats": v.get("store"),
          "DeathReason": v.get("death_reason")}
         for nid, v in view.items()
     ]

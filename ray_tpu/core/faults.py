@@ -44,6 +44,16 @@ site       actions                injected where
                                   version (the publisher counts the
                                   lag); ``delay`` sleeps the pull.
                                   ``match`` globs ``v<version>``.
+``datapool`` kill                 data actor-pool map actor, per block
+                                  (``data/executor.py``
+                                  ``_ChainActor.run_governed`` — the
+                                  governed path only; the kill-switch
+                                  loop has no restart handling): the
+                                  pool worker process exits mid-block —
+                                  the executor must restart the actor,
+                                  resubmit the block to a replacement,
+                                  and preserve output block order.
+                                  ``match`` globs ``a<actor_index>``.
 ``envrun`` kill                   RL rollout actor, per vector env step
                                   (``rllib/env_runner.py``
                                   ``_record_episode_step``): the worker
@@ -103,6 +113,7 @@ _SITE_ACTIONS = {
     "kvship": frozenset({"sever", "delay"}),
     "weightsync": frozenset({"sever", "delay"}),
     "envrun": frozenset({"kill"}),
+    "datapool": frozenset({"kill"}),
 }
 
 

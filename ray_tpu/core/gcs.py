@@ -341,6 +341,8 @@ class GcsServer:
         meta = self.node_meta.setdefault(p["node_id"], {})
         meta["shm_root"] = p.get("shm_root")
         meta["hostname"] = p.get("hostname", "localhost")
+        if p.get("store") is not None:
+            meta["store"] = p["store"]
         # A partition survivor re-registering is alive again: its stale
         # death verdict must not keep tainting error messages.
         meta.pop("death_reason", None)
@@ -424,6 +426,10 @@ class GcsServer:
         view.total = new_total
         meta = self.node_meta.setdefault(p["node_id"], {})
         meta["pending_demand"] = p.get("pending_demand", [])
+        if p.get("store") is not None:
+            # Object-store occupancy gauges (used/capacity/spills): served
+            # through the cluster view for the data-plane memory governor.
+            meta["store"] = p["store"]
         if p.get("idle"):
             meta.setdefault("idle_since", time.monotonic())
         else:
@@ -451,6 +457,9 @@ class GcsServer:
             "death_reason": meta.get("death_reason"),
             "shm_root": meta.get("shm_root"),
             "hostname": meta.get("hostname", "localhost"),
+            # Last-heartbeat object-store occupancy (None until the first
+            # beat lands): the memory governor's arbitration signal.
+            "store": meta.get("store"),
         }
 
     def _bump_node_version(self, nid: str) -> None:

@@ -273,6 +273,9 @@ class NodeManager:
                 "shm_root": self.shm_root,
                 "hostname": socket.gethostname(),
                 "session_id": self.session_id,
+                # Initial store gauges, so the memory governor sees this
+                # node's capacity from registration (not first heartbeat).
+                "store": self._store_gauges(),
             },
         )
         if reply["session_id"] != self.session_id:
@@ -639,6 +642,18 @@ class NodeManager:
             self._piggyback_saved += 1
         return extra
 
+    def _store_gauges(self) -> dict | None:
+        """Object-store occupancy for registration + every heartbeat (one
+        stats() lock hold): the memory governor's arbitration signal."""
+        if self.store is None:
+            return None
+        st = self.store.stats()
+        return {
+            "used_bytes": st["used_bytes"],
+            "capacity_bytes": st["capacity_bytes"],
+            "spills": st["spills"],
+        }
+
     async def _heartbeat_loop(self):
         while not self._stopping:
             # Stage the beat's one-shot cargo OUTSIDE the try: a dropped
@@ -672,6 +687,11 @@ class NodeManager:
                 if "metrics" in extra:
                     self._last_metrics_report = prev_metrics_report
 
+            # Object-store occupancy rides every beat: the data-plane
+            # memory governor (data/governor.py) arbitrates task
+            # submission on these gauges, so they must be as fresh as the
+            # resource view (one stats() lock hold per interval).
+            store_stats = self._store_gauges()
             try:
                 # retries=0: a retried heartbeat carries STALE state —
                 # the loop's next interval sends a fresh one, which both
@@ -686,6 +706,7 @@ class NodeManager:
                         "node_id": self.node_id,
                         "available": self.available,
                         "total": self.total,
+                        "store": store_stats,
                         "resources_freed": freed,
                         # Queued lease demand this node cannot serve right
                         # now — the autoscaler's scale-up signal (reference:

@@ -14,7 +14,7 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.iterator import DataIterator
-from ray_tpu.data.plan import DataPlan
+from ray_tpu.data.plan import ActorPoolStrategy, DataPlan
 
 
 def _from_source(source, parallelism: int) -> Dataset:
@@ -83,6 +83,7 @@ def read_datasource(source, *, parallelism: int = -1) -> Dataset:
 
 
 __all__ = [
+    "ActorPoolStrategy",
     "Block",
     "BlockAccessor",
     "BlockMetadata",

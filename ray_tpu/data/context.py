@@ -11,15 +11,22 @@ import threading
 from dataclasses import dataclass, field
 
 
+def _default_max_in_flight() -> int:
+    """The per-operator in-flight window: the ``data_max_inflight_per_op``
+    knob (0 = auto: max(4, 2 * host cores) — the heuristic that used to be
+    hard-coded here)."""
+    from ray_tpu.data.governor import resolved_max_inflight_per_op
+
+    return resolved_max_inflight_per_op()
+
+
 @dataclass
 class DataContext:
     default_parallelism: int = field(
         default_factory=lambda: max(2, (os.cpu_count() or 1))
     )
     target_max_block_size: int = 128 * 1024 * 1024
-    max_in_flight_blocks: int = field(
-        default_factory=lambda: max(4, 2 * (os.cpu_count() or 1))
-    )
+    max_in_flight_blocks: int = field(default_factory=_default_max_in_flight)
 
     _local = threading.local()
 

@@ -62,10 +62,15 @@ class Dataset:
         """compute: None (stateless tasks), "actors", an int pool size, or
         an ActorPoolStrategy — actor pools amortize expensive per-process
         setup across blocks (reference: Dataset.map_batches compute=).
-        num_cpus/memory/resources: this operator's per-task resource
-        budget (reference: map_batches ray_remote_args) — the scheduler
-        places the stage's tasks under these demands, so e.g. a 4-CPU
-        preprocessing fn can't oversubscribe a node."""
+        ``ActorPoolStrategy(min_size=, max_size=)`` gets an AUTOSCALING
+        pool under the memory governor: it grows on queue depth up to
+        max_size, shrinks when idle or throttled, restarts dead actors,
+        and preserves block order (output is block-identical to the
+        stateless task path). num_cpus/memory/resources: this operator's
+        per-task resource budget (reference: map_batches
+        ray_remote_args) — the scheduler places the stage's tasks under
+        these demands, so e.g. a 4-CPU preprocessing fn can't
+        oversubscribe a node."""
         from ray_tpu.data.plan import ActorPoolStrategy
 
         if compute == "actors":
@@ -221,9 +226,28 @@ class Dataset:
     def stats(self) -> str:
         """Per-operator execution statistics of the most recent execution
         (materialize/take/iter_*) of this dataset (reference:
-        Dataset.stats()). Empty string if it never executed."""
+        Dataset.stats()). Empty string if it never executed. With the
+        memory governor on, a trailing line reports peak store occupancy
+        and throttle events for the execution."""
         ex = self._last_executor
-        return ex.stats.summary() if ex is not None else ""
+        if ex is None:
+            return ""
+        out = ex.stats.summary()
+        gov = ex.governor_stats()
+        if gov is not None:
+            out += (
+                f"\nGovernor: peak store occupancy "
+                f"{gov['peak_occupancy_frac']:.1%}, "
+                f"{gov['throttle_events']} throttle events"
+            )
+        return out
+
+    def governor_stats(self) -> Optional[dict]:
+        """The most recent execution's MemoryGovernor summary (peak
+        occupancy fraction, throttle events, per-operator budgets), or
+        None (never executed / governor disabled)."""
+        ex = self._last_executor
+        return ex.governor_stats() if ex is not None else None
 
     def stats_dict(self) -> list[dict]:
         """The same stats as structured rows (one per stage/barrier)."""

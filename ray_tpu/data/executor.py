@@ -7,17 +7,38 @@ tasks run at once, and new tasks are only submitted as the consumer drains
 outputs — blocks stream through the object store without ever materializing
 the whole dataset in one process. Barrier ops (repartition/shuffle/sort)
 materialize the stage boundary's refs.
+
+Memory governance (round 18): with the ``data_governor`` knob on (default),
+every map-stage submission additionally asks a per-execution
+:class:`~ray_tpu.data.governor.MemoryGovernor` for a permit — per-operator
+in-flight bytes and global store occupancy (watermarks
+``data_store_high_frac``/``data_store_low_frac`` with hysteresis; AIMD
+budgets halve on a high crossing and recover below the low one) bound
+what the pipeline can have racing toward the object store, so an
+out-of-core dataset streams at bounded memory instead of spilling.
+Actor-pool map stages (``compute=ActorPoolStrategy(min_size, max_size)``)
+run on an autoscaling, self-healing :class:`_ActorPool` under the same
+permits. ``RAY_TPU_DATA_GOVERNOR=0`` restores the pre-governor submission
+loop byte-identically (``_stream_stage_inner_legacy``).
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Iterator, Optional
 
 import cloudpickle
 import numpy as np
 
 import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import (
+    ActorDiedError,
+    ActorUnavailableError,
+    ObjectLostError,
+    WorkerCrashedError,
+)
 from ray_tpu.data.block import BlockAccessor, concat_blocks
 from ray_tpu.util import metrics as _metrics
 
@@ -48,6 +69,11 @@ _TASK_ROWS = _metrics.Counter(
 _TASK_BYTES = _metrics.Counter(
     "raytpu_data_block_bytes_total",
     "bytes produced by data block tasks (worker-side)",
+)
+_POOL_SIZE = _metrics.Gauge(
+    "raytpu_data_actor_pool_size",
+    "live actors in one map stage's autoscaling actor pool",
+    tag_keys=("operator",),
 )
 
 
@@ -89,20 +115,62 @@ def _run_chain(chain_payload: bytes, source, is_read_task: bool):
     return block, block.num_rows
 
 
+def _run_chain_governed(chain_payload: bytes, source, is_read_task: bool):
+    """Governed twin of :func:`_run_chain`: the meta return additionally
+    carries the block's byte size, which the driver-side governor folds
+    into the operator's in-flight accounting. Kept separate so the
+    kill-switch arm keeps today's task contract byte-identically."""
+    chain = cloudpickle.loads(chain_payload)
+    block = source() if is_read_task else source
+    for op in chain:
+        block = apply_chain_op(op, block)
+    _record_block_output(block)
+    return block, (block.num_rows, block.nbytes)
+
+
 class _ChainActor:
     """Actor-pool compute: holds one deserialized chain for its lifetime so
     expensive fn state (models, jit caches) amortizes across blocks
     (reference: ActorPoolMapOperator)."""
 
-    def __init__(self, chain_payload: bytes):
+    def __init__(self, chain_payload: bytes, index: int = 0):
         self._chain = cloudpickle.loads(chain_payload)
+        self._index = index
+
+    def _maybe_chaos(self) -> None:
+        """Seeded ``datapool.kill`` site: the pool worker process exits
+        mid-block — the governed executor must restart the actor and
+        resubmit the block without reordering the output."""
+        from ray_tpu.core import faults
+
+        if faults._ACTIVE is None:
+            return
+        rule = faults._ACTIVE.decide(
+            "datapool", f"a{self._index}", actions=frozenset({"kill"})
+        )
+        if rule is not None:
+            import os
+
+            os._exit(1)
 
     def run(self, source, is_read_task: bool):
+        # No chaos hook here: the legacy (kill-switch) loop has no
+        # restart/resubmit handling, so the datapool site only fires on
+        # the governed path (run_governed), where the contract holds.
         block = source() if is_read_task else source
         for op in self._chain:
             block = apply_chain_op(op, block)
         _record_block_output(block)
         return block, block.num_rows
+
+    def run_governed(self, source, is_read_task: bool):
+        """Like :meth:`run`, with (rows, bytes) meta for the governor."""
+        self._maybe_chaos()
+        block = source() if is_read_task else source
+        for op in self._chain:
+            block = apply_chain_op(op, block)
+        _record_block_output(block)
+        return block, (block.num_rows, block.nbytes)
 
     def ping(self) -> bool:
         return True
@@ -268,6 +336,161 @@ def _hash_join_task(key: str, how: str, n_left: int, *parts):
     return out, out.num_rows
 
 
+# Errors at meta-get time that mean "the pool actor is gone", not "the
+# user fn failed": the governed pool replaces the actor and resubmits the
+# block. Application exceptions propagate unchanged.
+def _pool_death_errors() -> tuple:
+    from ray_tpu.core.protocol import ConnectionLost
+
+    return (
+        ActorDiedError,
+        ActorUnavailableError,
+        WorkerCrashedError,
+        ObjectLostError,
+        ConnectionLost,  # severed worker transport
+        ConnectionError,
+    )
+
+
+_POOL_DEATH_ERRORS = _pool_death_errors()
+# Resubmission ceiling per block: a block that kills its actor this many
+# times in a row is a poison pill, not a crash — surface the error.
+_POOL_RETRY_LIMIT = 4
+
+# Sentinel for "no source held": a governed refill that was denied a
+# permit parks the already-pulled source here (None is a valid source).
+_NO_SRC = object()
+
+
+class _PoolActor:
+    __slots__ = ("handle", "index", "inflight")
+
+    def __init__(self, handle, index: int):
+        self.handle = handle
+        self.index = index
+        self.inflight = 0
+
+
+class _ActorPool:
+    """Autoscaling, self-healing actor pool for one governed map stage.
+
+    Contract (README "Streaming data plane"):
+
+    * **Statefulness** — each actor holds the stage's deserialized chain
+      (and whatever state the UDF builds) for its lifetime; a block runs
+      on exactly one pool actor.
+    * **Scaling** — starts at ``strategy.min_size`` actors; when a submit
+      finds every actor at ``max_tasks_in_flight_per_actor`` the pool
+      grows (queue depth IS the signal), up to ``strategy.max_size``;
+      :meth:`scale_down_idle` reaps idle actors back toward ``min_size``
+      (the executor calls it while the memory governor is throttled, and
+      on the stage's drain tail).
+    * **Restarts** — an actor death observed at result time replaces the
+      actor (same pool slot budget, fresh index) and the caller resubmits
+      the victim block; ordering is preserved because the executor
+      consumes strictly FIFO.
+    """
+
+    def __init__(self, strategy, actor_opts: dict, payload: bytes,
+                 op_name: str):
+        self._strategy = strategy
+        self._opts = dict(actor_opts)
+        self._payload = payload
+        self._op_name = op_name
+        self._next_index = 0
+        self._actors: list[_PoolActor] = []
+        self.restarts = 0
+        for _ in range(strategy.min_size):
+            self._spawn()
+
+    @property
+    def size(self) -> int:
+        return len(self._actors)
+
+    def _record_size(self) -> None:
+        if _metrics.metrics_enabled():
+            _POOL_SIZE.set(float(len(self._actors)),
+                           {"operator": self._op_name})
+
+    def _spawn(self) -> _PoolActor:
+        index = self._next_index
+        self._next_index += 1
+        handle = (
+            ray_tpu.remote(_ChainActor)
+            .options(**self._opts)
+            .remote(self._payload, index)
+        )
+        actor = _PoolActor(handle, index)
+        self._actors.append(actor)
+        self._record_size()
+        return actor
+
+    def _kill(self, actor: _PoolActor) -> None:
+        try:
+            ray_tpu.kill(actor.handle)
+        except Exception:  # raylint: disable=RL006 -- teardown kill; actor may already be dead
+            pass
+
+    def submit(self, src, is_read: bool):
+        """Run one block on the least-loaded actor (growing the pool when
+        every actor is saturated). Returns (block_ref, meta_ref, actor)."""
+        free = [
+            a for a in self._actors
+            if a.inflight < self._strategy.max_tasks_in_flight_per_actor
+        ]
+        if not free and len(self._actors) < self._strategy.max_size:
+            actor = self._spawn()
+        elif free:
+            actor = min(free, key=lambda a: a.inflight)
+        else:
+            # Saturated at max_size (the executor's window normally
+            # prevents this): queue on the least-loaded actor.
+            actor = min(self._actors, key=lambda a: a.inflight)
+        actor.inflight += 1
+        block_ref, meta_ref = actor.handle.run_governed.options(
+            num_returns=2
+        ).remote(src, is_read)
+        return block_ref, meta_ref, actor
+
+    def note_done(self, actor: _PoolActor) -> None:
+        actor.inflight = max(0, actor.inflight - 1)
+
+    def note_death(self, actor: _PoolActor) -> None:
+        """Replace a dead actor. Idempotent: several pending blocks can
+        observe the same death; only the first replaces it."""
+        if actor not in self._actors:
+            return
+        self._actors.remove(actor)
+        self._kill(actor)  # reap the GCS record; the process is gone
+        self.restarts += 1
+        if len(self._actors) < self._strategy.min_size:
+            self._spawn()
+        else:
+            self._record_size()
+
+    def scale_down_idle(self) -> None:
+        """Reap idle actors above ``min_size`` (memory pressure / drain
+        tail): their worker slots and any warm state go back to the
+        cluster."""
+        changed = False
+        while len(self._actors) > self._strategy.min_size:
+            idle = [a for a in self._actors if a.inflight == 0]
+            if not idle:
+                break
+            victim = idle[-1]
+            self._actors.remove(victim)
+            self._kill(victim)
+            changed = True
+        if changed:
+            self._record_size()
+
+    def shutdown(self) -> None:
+        for actor in self._actors:
+            self._kill(actor)
+        self._actors.clear()
+        self._record_size()
+
+
 class StageStats:
     """Execution record of one streamed stage or barrier (reference:
     DatasetStats / _StatsActor per-operator rows in ray.data)."""
@@ -322,6 +545,20 @@ class StreamingExecutor:
         self._shard = shard
         self._limit = limit
         self.stats = ExecutionStats()
+        # Memory governance (knob read per execution so tests and the
+        # ray_perf kill-switch arm can flip it at runtime; the env var
+        # RAY_TPU_DATA_GOVERNOR=0 lands here through the knob table).
+        self._governor = None
+        if GLOBAL_CONFIG.data_governor:
+            from ray_tpu.data.governor import MemoryGovernor
+
+            self._governor = MemoryGovernor()
+
+    def governor_stats(self) -> Optional[dict]:
+        """The execution's governor summary (peak occupancy fraction,
+        throttle events, per-operator budgets), or None when the governor
+        is disabled."""
+        return None if self._governor is None else self._governor.stats()
 
     # Each yielded item is (block_ref, num_rows).
     def iter_blocks(self) -> Iterator[tuple]:
@@ -436,7 +673,8 @@ class StreamingExecutor:
             rec.blocks_in = len(sources)
         self.stats.stages.append(rec)
         inner = self._stream_stage_inner(
-            chain, sources, is_read, apply_shard, apply_limit
+            chain, sources, is_read, apply_shard, apply_limit,
+            op_name=rec.name,
         )
         # Charge ONLY time spent inside the pipeline: a slow consumer
         # between next() calls (e.g. a training step per batch) must not
@@ -465,27 +703,36 @@ class StreamingExecutor:
                     _STAGE_BLOCKS.inc(float(rec.blocks_out), tags)
 
     def _stream_stage_inner(
-        self, chain, sources, is_read, apply_shard, apply_limit
+        self, chain, sources, is_read, apply_shard, apply_limit,
+        op_name: str = "(stage)",
     ):
-        remote_chain = ray_tpu.remote(_run_chain)
-        payload = cloudpickle.dumps(chain)
-        if apply_shard and self._shard is not None:
-            world, rank = self._shard
-            sources = [s for j, s in enumerate(sources) if j % world == rank]
-        # Actor-pool compute: the largest requested pool serves the whole
-        # fused chain; submission round-robins over the pool.
+        if self._governor is None:
+            # Kill switch (RAY_TPU_DATA_GOVERNOR=0): the pre-governor
+            # submission loop, byte-identical.
+            yield from self._stream_stage_inner_legacy(
+                chain, sources, is_read, apply_shard, apply_limit
+            )
+        else:
+            yield from self._stream_stage_inner_governed(
+                chain, sources, is_read, apply_shard, apply_limit, op_name
+            )
+
+    @staticmethod
+    def _stage_opts_for(chain) -> tuple:
+        """(strategy, stage_opts) for one fused chain. Actor-pool compute:
+        the largest requested pool serves the whole fused chain. Per-op
+        resource budgets (reference: map_batches ray_remote_args): the
+        fused stage schedules under the LARGEST demand of any op in its
+        chain (a stage is one task — its footprint is its hungriest
+        operator's). Ops without an explicit budget implicitly demand the
+        default 1 CPU, so fusing a num_cpus=0.25 op with a plain map
+        cannot shrink the stage below the default; a stage where EVERY op
+        explicitly says num_cpus=0 genuinely reserves none."""
         strategy = None
         for op in chain:
             c = getattr(op, "compute", None)
             if c is not None and (strategy is None or c.size > strategy.size):
                 strategy = c
-        # Per-op resource budgets (reference: map_batches ray_remote_args):
-        # the fused stage schedules under the LARGEST demand of any op in
-        # its chain (a stage is one task — its footprint is its hungriest
-        # operator's). Ops without an explicit budget implicitly demand the
-        # default 1 CPU, so fusing a num_cpus=0.25 op with a plain map
-        # cannot shrink the stage below the default; a stage where EVERY op
-        # explicitly says num_cpus=0 genuinely reserves none.
         stage_opts: dict = {}
         cpu_demands = []
         for op in chain:
@@ -498,6 +745,149 @@ class StreamingExecutor:
                 res[k] = max(res.get(k, 0), v)
         if cpu_demands and any(c != 1.0 for c in cpu_demands):
             stage_opts["num_cpus"] = max(cpu_demands)
+        return strategy, stage_opts
+
+    def _stream_stage_inner_governed(
+        self, chain, sources, is_read, apply_shard, apply_limit, op_name
+    ):
+        """The governed submission loop: every submit needs a MemoryGovernor
+        permit; actor-pool stages run on an autoscaling, self-healing
+        :class:`_ActorPool`; results are consumed strictly FIFO so block
+        order survives pool scaling and restarts."""
+        gov = self._governor
+        remote_chain = ray_tpu.remote(_run_chain_governed)
+        payload = cloudpickle.dumps(chain)
+        sources = list(sources)
+        if apply_shard and self._shard is not None:
+            world, rank = self._shard
+            sources = [s for j, s in enumerate(sources) if j % world == rank]
+        strategy, stage_opts = self._stage_opts_for(chain)
+        pool = None
+        window = self._window
+        if strategy is not None:
+            # Clamp the pool bounds to the block count (the legacy loop's
+            # min(size, len(sources)) rule): a pool wider than the input
+            # would hold worker slots no block can ever use.
+            n_src = max(len(sources), 1)
+            if strategy.min_size > n_src or strategy.max_size > n_src:
+                from ray_tpu.data.plan import ActorPoolStrategy
+
+                strategy = ActorPoolStrategy(
+                    min_size=min(strategy.min_size, n_src),
+                    max_size=min(strategy.max_size, n_src),
+                    max_tasks_in_flight_per_actor=(
+                        strategy.max_tasks_in_flight_per_actor
+                    ),
+                )
+            actor_opts = {"num_cpus": stage_opts.get("num_cpus", 1)}
+            if stage_opts.get("resources"):
+                actor_opts["resources"] = stage_opts["resources"]
+            pool = _ActorPool(strategy, actor_opts, payload, op_name)
+            window = min(
+                window,
+                strategy.max_size * strategy.max_tasks_in_flight_per_actor,
+            )
+
+        def submit(src):
+            if pool is not None:
+                return [*pool.submit(src, is_read), src]
+            block_ref, meta_ref = remote_chain.options(
+                num_returns=2, **stage_opts
+            ).remote(payload, src, is_read)
+            return [block_ref, meta_ref, None, src]
+
+        def finish(entry):
+            """Await one FIFO entry; on pool-actor death, replace the
+            actor and resubmit the block (bounded retries) — the caller
+            is strictly FIFO, so order is preserved."""
+            attempts = 0
+            while True:
+                block_ref, meta_ref, actor, src = entry
+                try:
+                    num_rows, nbytes = ray_tpu.get(meta_ref)
+                except _POOL_DEATH_ERRORS:
+                    if pool is None or actor is None:
+                        raise
+                    attempts += 1
+                    if attempts > _POOL_RETRY_LIMIT:
+                        raise
+                    pool.note_death(actor)
+                    entry = [*pool.submit(src, is_read), src]
+                    continue
+                if pool is not None and actor is not None:
+                    pool.note_done(actor)
+                return block_ref, num_rows, nbytes
+
+        pending: deque = deque()  # FIFO entries, submission order
+        produced_rows = 0
+        src_iter = iter(sources)
+        exhausted = False
+        held_src = _NO_SRC  # permit-denied source, resubmitted next round
+        try:
+            while True:
+                while not exhausted and len(pending) < window:
+                    if held_src is _NO_SRC:
+                        try:
+                            held_src = next(src_iter)
+                        except StopIteration:
+                            exhausted = True
+                            break
+                    if not gov.try_acquire(op_name):
+                        # Throttled (watermark/budget/byte gate): stop
+                        # refilling; the pop below keeps draining, which
+                        # is what lowers occupancy.
+                        if pool is not None:
+                            pool.scale_down_idle()
+                        break
+                    src, held_src = held_src, _NO_SRC
+                    pending.append(submit(src))
+                if exhausted and pool is not None:
+                    # Drain tail: no more submissions are coming — idle
+                    # actors above min_size only hold worker slots now.
+                    pool.scale_down_idle()
+                if not pending:
+                    return
+                entry = pending.popleft()
+                block_ref, num_rows, nbytes = finish(entry)
+                gov.release(op_name, nbytes)
+                if (
+                    apply_limit
+                    and self._limit is not None
+                    and produced_rows + num_rows > self._limit
+                ):
+                    keep = self._limit - produced_rows
+                    trim = ray_tpu.remote(_trim_task)
+                    block_ref, _meta = trim.options(num_returns=2).remote(
+                        block_ref, keep
+                    )
+                    yield block_ref, keep
+                    return
+                produced_rows += num_rows
+                yield block_ref, num_rows
+                if (
+                    apply_limit
+                    and self._limit is not None
+                    and produced_rows >= self._limit
+                ):
+                    return
+        finally:
+            gov.forget(op_name)
+            if pool is not None:
+                pool.shutdown()
+
+    def _stream_stage_inner_legacy(
+        self, chain, sources, is_read, apply_shard, apply_limit
+    ):
+        remote_chain = ray_tpu.remote(_run_chain)
+        payload = cloudpickle.dumps(chain)
+        if apply_shard and self._shard is not None:
+            world, rank = self._shard
+            sources = [s for j, s in enumerate(sources) if j % world == rank]
+        # Strategy + per-op resource budgets: the shared _stage_opts_for
+        # rules (largest pool serves the fused chain; the stage schedules
+        # under its hungriest operator's demand). Submission round-robins
+        # over a FIXED pool here — the kill-switch arm's behavior.
+        strategy, stage_opts = self._stage_opts_for(chain)
         pool: list = []
         window = self._window
         if strategy is not None:

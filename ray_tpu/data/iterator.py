@@ -69,7 +69,13 @@ class DataIterator:
         ahead of consumption, so ``data → train`` feeds a jitted step with
         no host staging in the timed region. ``prefetch_depth`` overrides
         the trainer's ``DataConfig`` value (else the
-        ``train_prefetch_depth`` config default); 0 = host passthrough."""
+        ``train_prefetch_depth`` config default); 0 = host passthrough.
+
+        This is the governed pipeline's device-side terminus: upstream,
+        the MemoryGovernor bounds what the executor races into the object
+        store (``data → governed executor → DevicePrefetchIterator →
+        step``), so an out-of-core dataset feeds a train loop continuously
+        at bounded host memory."""
         from ray_tpu.train.input import DevicePrefetchIterator
 
         if prefetch_depth is None:

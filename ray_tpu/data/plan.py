@@ -19,18 +19,63 @@ from ray_tpu.data.block import Block, BlockAccessor, concat_blocks, rows_to_bloc
 # -- logical ops -------------------------------------------------------------
 
 
-@dataclass
 class ActorPoolStrategy:
     """compute= strategy for map stages (reference:
     ray.data.ActorPoolStrategy): run the stage's fused chain inside a pool
     of long-lived actors so per-block setup (model load, jit compile)
-    amortizes across blocks."""
+    amortizes across blocks.
 
-    def __init__(self, size: int = 2, max_tasks_in_flight_per_actor: int = 2):
-        if size < 1:
-            raise ValueError("actor pool size must be >= 1")
-        self.size = size
-        self.max_tasks_in_flight_per_actor = max_tasks_in_flight_per_actor
+    ``min_size``/``max_size`` bound an AUTOSCALING pool (reference:
+    ActorPoolStrategy(min_size=, max_size=)): the governed executor
+    starts ``min_size`` actors, grows toward ``max_size`` on queue depth
+    (under the memory governor's budget), shrinks idle actors back toward
+    ``min_size``, and restarts dead actors in place. ``size=`` remains
+    the legacy fixed-pool spelling (min == max == size). Defaults come
+    from the ``data_actor_pool_*`` config knobs."""
+
+    def __init__(
+        self,
+        size: Optional[int] = None,
+        max_tasks_in_flight_per_actor: Optional[int] = None,
+        *,
+        min_size: Optional[int] = None,
+        max_size: Optional[int] = None,
+    ):
+        from ray_tpu.core.config import GLOBAL_CONFIG
+
+        if size is not None:
+            if min_size is not None or max_size is not None:
+                raise ValueError(
+                    "size= (fixed pool) and min_size=/max_size= "
+                    "(autoscaling pool) are mutually exclusive"
+                )
+            if size < 1:
+                raise ValueError("actor pool size must be >= 1")
+            min_size = max_size = size
+        else:
+            if min_size is None:
+                min_size = GLOBAL_CONFIG.data_actor_pool_min_size
+            if max_size is None:
+                max_size = max(
+                    min_size, GLOBAL_CONFIG.data_actor_pool_max_size
+                )
+        if min_size < 1 or max_size < min_size:
+            raise ValueError(
+                f"actor pool bounds must satisfy 1 <= min_size <= "
+                f"max_size (got {min_size}..{max_size})"
+            )
+        self.min_size = min_size
+        self.max_size = max_size
+        self.max_tasks_in_flight_per_actor = (
+            max_tasks_in_flight_per_actor
+            if max_tasks_in_flight_per_actor is not None
+            else GLOBAL_CONFIG.data_actor_pool_max_tasks_per_actor
+        )
+
+    @property
+    def size(self) -> int:
+        """Legacy fixed-pool view: the pool's upper bound."""
+        return self.max_size
 
 
 @dataclass

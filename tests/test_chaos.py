@@ -923,6 +923,108 @@ def test_chaos_data_pipeline_converges(chaos_cluster):
     assert out == [i * 2 for i in range(64)]
 
 
+# -- data plane: actor-pool + shuffle chaos (round 18) ------------------------
+# The governed data plane's chaos contract: a seeded ``datapool.kill``
+# takes a pool actor down mid-block — the executor must replace the actor,
+# resubmit the block, and keep output BLOCK ORDER; a seeded worker kill
+# mid-shuffle converges through task retry/lineage. Both replay
+# bit-identically from the RAY_TPU_FAULTS seed (the output, not just the
+# multiset, is compared across runs).
+
+
+def _pool_chaos_run(spec: str):
+    """One governed actor-pool pipeline under an env-exported fault spec
+    (worker processes inherit it). Rows are tagged with the serving pid so
+    the test can PROVE the kill + restart happened. Returns the output
+    row list."""
+    import os
+
+    os.environ["RAY_TPU_FAULTS"] = spec
+    runtime = ray_tpu.init(num_cpus=4)
+    try:
+        import ray_tpu.data as rd
+        from ray_tpu.data import ActorPoolStrategy
+
+        def tag(b):
+            import os as _os
+
+            return {
+                "id": b["id"] * 2,
+                "pid": np.full(len(b["id"]), _os.getpid()),
+            }
+
+        ds = rd.range(120, parallelism=6).map_batches(
+            tag, compute=ActorPoolStrategy(size=1)
+        )
+        return ds.take_all()
+    finally:
+        del os.environ["RAY_TPU_FAULTS"]
+        faults.clear()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.timeout(300)
+def test_datapool_kill_restarts_actor_preserves_order_and_replays():
+    """A seeded ``datapool.kill`` fires in the single pool actor after two
+    blocks: the worker process dies mid-block, the executor replaces the
+    actor and resubmits, output rows stay complete AND in block order, the
+    pid column proves a second process served the tail — and the whole
+    run replays bit-identically from the same seed."""
+    spec = "29:datapool.kill,match=a0,after=2,count=1"
+    out1 = _pool_chaos_run(spec)
+    assert [r["id"] for r in out1] == [2 * i for i in range(120)]
+    # The kill actually happened: a size-1 pool used TWO worker processes.
+    assert len({r["pid"] for r in out1}) == 2
+    out2 = _pool_chaos_run(spec)
+    assert [(r["id"], ) for r in out2] == [(r["id"], ) for r in out1]
+
+
+@pytest.mark.timeout(300)
+def test_data_chaos_kills_mid_shuffle_converge_and_replay():
+    """Kill a pool actor AND a leased map worker while a seeded shuffle is
+    streaming: the pipeline converges to the exact row set with no wedge,
+    and two runs from the same RAY_TPU_FAULTS seed produce IDENTICAL
+    output (order included — the shuffle's per-block seeds are assigned
+    by deterministic arrival order, so retries don't perturb it)."""
+    import os
+
+    spec = (
+        "31:datapool.kill,match=a0,after=1,count=1;"
+        "node.kill_worker,count=1"
+    )
+
+    def run():
+        os.environ["RAY_TPU_FAULTS"] = spec
+        runtime = ray_tpu.init(num_cpus=4)
+        # The node-site rule fires in the in-process node's monitor sweep
+        # (driver process): install the same seeded spec here too.
+        faults.install(faults.parse_env(spec))
+        try:
+            import ray_tpu.data as rd
+            from ray_tpu.data import ActorPoolStrategy
+
+            ds = (
+                rd.range(96, parallelism=6)
+                .map_batches(
+                    lambda b: {"id": b["id"] + 1},
+                    compute=ActorPoolStrategy(min_size=1, max_size=2),
+                )
+                .random_shuffle(seed=5)
+                .map_batches(lambda b: {"id": b["id"] * 10})
+            )
+            return [r["id"] for r in ds.take_all()]
+        finally:
+            del os.environ["RAY_TPU_FAULTS"]
+            faults.clear()
+            ray_tpu.shutdown()
+
+    out1 = run()
+    assert sorted(out1) == [(i + 1) * 10 for i in range(96)]
+    assert out1 != sorted(out1)  # the shuffle actually shuffled
+    out2 = run()
+    assert out2 == out1, "same seed must replay the pipeline bit-identically"
+
+
 # -- podracer RL planes (round 17) --------------------------------------------
 # The decoupled actor/inference/learner planes ride the same chaos
 # contract as every other tier: a seeded env-runner kill mid-rollout is

@@ -1,0 +1,385 @@
+"""Memory-governed streaming data plane (round 18).
+
+THE acceptance invariant: an out-of-core pipeline (dataset >= 4x the
+configured store cap) under the governor keeps store occupancy at or
+under ``data_store_high_frac`` for the whole run and never spills, while
+the ``RAY_TPU_DATA_GOVERNOR=0`` arm on the same workload spills and
+blows through the watermark. Plus: governor arbitration units (injected
+occupancy — no cluster), actor-pool order/restart/scale units, and the
+``data -> governed executor -> DevicePrefetchIterator -> step`` e2e.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.data import ActorPoolStrategy
+from ray_tpu.data.governor import (
+    MemoryGovernor,
+    resolved_max_inflight_per_op,
+)
+
+STORE_CAP = 4 * 1024 * 1024  # tiny: the out-of-core runs are ~5x this
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    saved = GLOBAL_CONFIG.object_store_bytes
+    GLOBAL_CONFIG.object_store_bytes = STORE_CAP
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+    GLOBAL_CONFIG.object_store_bytes = saved
+
+
+@pytest.fixture(autouse=True)
+def _governor_on():
+    """Every test starts governed; the kill-switch arm flips it itself."""
+    saved = GLOBAL_CONFIG.data_governor
+    GLOBAL_CONFIG.data_governor = True
+    yield
+    GLOBAL_CONFIG.data_governor = saved
+
+
+# -- governor arbitration units (no cluster) ----------------------------------
+
+
+def _gov(occ, **kw):
+    kw.setdefault("high_frac", 0.75)
+    kw.setdefault("low_frac", 0.5)
+    kw.setdefault("max_inflight_per_op", 8)
+    kw.setdefault("poll_interval_s", 0.0)  # every acquire sees fresh state
+    return MemoryGovernor(occupancy_fn=occ, **kw)
+
+
+def test_governor_liveness_floor_always_grants_first_task():
+    # Occupancy pinned OVER the high watermark: an operator with nothing
+    # in flight still gets exactly one task (the backpressure loop can
+    # only drain by moving blocks), and nothing beyond it.
+    gov = _gov(lambda: (95, 100, 0))
+    assert gov.try_acquire("op")
+    assert not gov.try_acquire("op")
+    assert gov.throttled
+
+
+def test_governor_first_block_probe_is_serial():
+    # Plenty of headroom, but the operator has produced nothing yet: its
+    # output size is unknown, so it runs one probe task until release()
+    # seeds the moving average.
+    gov = _gov(lambda: (0, 1000, 0))
+    assert gov.try_acquire("op")
+    assert not gov.try_acquire("op")  # probe still in flight
+    gov.release("op", 10.0)
+    assert gov.try_acquire("op")  # avg known: parallelism opens
+    assert gov.try_acquire("op")
+
+
+def test_governor_byte_gate_denies_over_high_watermark():
+    used = [0]
+    gov = _gov(lambda: (used[0], 1000, 0))
+    assert gov.try_acquire("op")
+    gov.release("op", 300.0)  # avg_bytes = 300
+    # used 200 + charge 300 + next estimate 300 > 750 -> denied.
+    used[0] = 200
+    assert gov.try_acquire("op")
+    before = gov.throttle_events
+    assert not gov.try_acquire("op")
+    assert gov.throttle_events == before + 1
+    # Consumer drains: the same grant goes through.
+    gov.release("op", 300.0)
+    used[0] = 0
+    assert gov.try_acquire("op")
+
+
+def test_governor_watermark_hysteresis_and_aimd():
+    used = [0]
+    gov = _gov(lambda: (used[0], 1000, 0))
+    assert gov.try_acquire("op")
+    gov.release("op", 1.0)  # tiny blocks: the byte gate never binds
+    for _ in range(3):
+        assert gov.try_acquire("op")
+    # Cross the high watermark: throttled, budget halves toward inflight.
+    used[0] = 800
+    assert not gov.try_acquire("op")
+    assert gov.throttled and gov.throttle_events >= 1
+    budget_after_cut = gov.stats()["operators"]["op"]["budget"]
+    assert budget_after_cut <= 3 / 2 + 1
+    # In the band (between low and high): STILL throttled (hysteresis).
+    used[0] = 600
+    assert not gov.try_acquire("op")
+    # Back under the low watermark: the throttle releases, but the cut
+    # budget still binds until the in-flight tasks drain.
+    used[0] = 100
+    for _ in range(3):
+        gov.release("op", 1.0)
+    assert gov.try_acquire("op")
+    assert not gov.throttled
+    for _ in range(40):
+        gov.release("op", 1.0)
+        gov.try_acquire("op")
+    assert gov.stats()["operators"]["op"]["budget"] == 8  # back at the cap
+
+
+def test_governor_spill_counts_as_over_watermark():
+    spills = [0]
+    gov = _gov(lambda: (10, 1000, spills[0]))
+    assert gov.try_acquire("op")
+    gov.release("op", 1.0)
+    assert gov.try_acquire("op")
+    spills[0] = 3  # a node spilled since the last poll: emergency brake
+    assert not gov.try_acquire("op")
+    assert gov.throttled
+    spills[0] = 3  # spilling stopped, occupancy under low: release
+    gov.release("op", 1.0)
+    assert gov.try_acquire("op")
+
+
+def test_governor_drain_aware_occupancy(cluster):
+    """cluster_store_occupancy: a DRAINING node's capacity is excluded
+    from headroom while its used bytes still count."""
+    from ray_tpu.data.governor import cluster_store_occupancy
+
+    used, capacity, _spills = cluster_store_occupancy()
+    assert capacity == STORE_CAP  # the head's configured store
+    assert used >= 0
+    # Simulate the draining view without actually draining the node.
+    real_nodes = ray_tpu.nodes()
+    assert all(n["StoreStats"] is not None for n in real_nodes)
+
+    def fake_nodes():
+        out = [dict(n) for n in real_nodes]
+        out[0]["Draining"] = True
+        return out
+
+    orig = ray_tpu.nodes
+    ray_tpu.nodes = fake_nodes
+    try:
+        _used2, capacity2, _ = cluster_store_occupancy()
+        assert capacity2 == 0  # the only store is draining: no headroom
+    finally:
+        ray_tpu.nodes = orig
+
+
+def test_max_inflight_knob_hoisted():
+    """data_max_inflight_per_op: 0 = the old heuristic; >0 wins."""
+    import os as _os
+
+    saved = GLOBAL_CONFIG.data_max_inflight_per_op
+    try:
+        GLOBAL_CONFIG.data_max_inflight_per_op = 0
+        assert resolved_max_inflight_per_op() == max(
+            4, 2 * (_os.cpu_count() or 1)
+        )
+        GLOBAL_CONFIG.data_max_inflight_per_op = 3
+        assert resolved_max_inflight_per_op() == 3
+        # ...and the DataContext default routes through the knob.
+        from ray_tpu.data.context import DataContext
+
+        assert DataContext().max_in_flight_blocks == 3
+    finally:
+        GLOBAL_CONFIG.data_max_inflight_per_op = saved
+
+
+def test_actor_pool_strategy_bounds_and_compat():
+    s = ActorPoolStrategy(size=3)
+    assert (s.min_size, s.max_size, s.size) == (3, 3, 3)
+    s2 = ActorPoolStrategy(min_size=1, max_size=4)
+    assert (s2.min_size, s2.max_size) == (1, 4)
+    with pytest.raises(ValueError):
+        ActorPoolStrategy(size=2, max_size=4)  # mutually exclusive
+    with pytest.raises(ValueError):
+        ActorPoolStrategy(size=0)
+    with pytest.raises(ValueError):
+        ActorPoolStrategy(min_size=3, max_size=2)
+
+
+# -- THE out-of-core invariant ------------------------------------------------
+
+
+def _run_out_of_core(runtime):
+    """16 blocks x ~1.23 MB (~5x the 4 MB cap) through map_batches ->
+    iter_batches, sampling the head store's occupancy the whole run.
+    Returns (rows, peak_used_bytes, spills_delta, dataset)."""
+    store = runtime.head.store
+    spills_before = store.stats()["spills"]
+    peak = [0]
+    stop = [False]
+
+    def poll():
+        while not stop[0]:
+            peak[0] = max(peak[0], store.stats()["used_bytes"])
+            time.sleep(0.01)
+
+    t = threading.Thread(target=poll, daemon=True)
+    t.start()
+    # A closure (not a module-level fn): cloudpickle ships it by value,
+    # so pool/task workers never need to import this test module.
+    payload = lambda b: {  # noqa: E731
+        "id": b["id"],
+        "x": np.ones((len(b["id"]), 1200), np.float64),
+    }
+    ds = rd.range(16 * 128, parallelism=16).map_batches(payload)
+    rows = 0
+    try:
+        for batch in ds.iter_batches(batch_size=128):
+            rows += len(batch["id"])
+    finally:
+        stop[0] = True
+        t.join()
+    spills = store.stats()["spills"] - spills_before
+    return rows, peak[0], spills, ds
+
+
+@pytest.mark.timeout(300)
+def test_out_of_core_governed_bounded_then_kill_switch_spills(cluster):
+    """Acceptance: the governed arm completes the out-of-core pipeline
+    with peak occupancy <= data_store_high_frac and ZERO spills; the
+    RAY_TPU_DATA_GOVERNOR=0 arm on the same workload spills (or exceeds
+    the watermark). Governed arm runs first so the spill counter baseline
+    is clean."""
+    high = GLOBAL_CONFIG.data_store_high_frac
+    rows, peak, spills, ds = _run_out_of_core(cluster)
+    assert rows == 16 * 128
+    assert spills == 0, f"governed arm spilled {spills}x"
+    assert peak <= high * STORE_CAP, (
+        f"governed arm peak {peak} > {high:.2f} * {STORE_CAP}"
+    )
+    gov = ds.governor_stats()
+    assert gov is not None and gov["throttle_events"] > 0
+    assert "Governor:" in ds.stats()
+
+    # Kill-switch arm: same workload, pre-governor executor.
+    GLOBAL_CONFIG.data_governor = False
+    rows2, peak2, spills2, ds2 = _run_out_of_core(cluster)
+    assert rows2 == 16 * 128
+    assert ds2.governor_stats() is None
+    assert spills2 > 0 or peak2 > high * STORE_CAP, (
+        f"kill-switch arm stayed bounded (peak {peak2}, spills {spills2})"
+        " — the governor is not doing anything"
+    )
+
+
+# -- actor pool: order / restart / scale --------------------------------------
+
+
+def test_actor_pool_output_block_order_identical_to_task_path(cluster):
+    """Acceptance: actor-pool map output is block-order-identical to the
+    stateless task path (row lists compared EXACTLY, not as multisets)."""
+
+    def triple(b):
+        return {"id": b["id"] * 3}
+
+    base = [
+        r["id"]
+        for r in rd.range(160, parallelism=8).map_batches(triple).take_all()
+    ]
+    pooled = [
+        r["id"]
+        for r in rd.range(160, parallelism=8)
+        .map_batches(triple, compute=ActorPoolStrategy(min_size=2, max_size=3))
+        .take_all()
+    ]
+    assert pooled == base
+
+
+def test_actor_pool_scales_up_and_down(cluster):
+    """_ActorPool unit: queue depth grows the pool to max_size; idle
+    actors above min_size are reaped by scale_down_idle."""
+    import cloudpickle
+
+    from ray_tpu.data.executor import _ActorPool
+
+    strategy = ActorPoolStrategy(
+        min_size=1, max_size=3, max_tasks_in_flight_per_actor=2
+    )
+    pool = _ActorPool(
+        strategy, {"num_cpus": 0}, cloudpickle.dumps([]), "unit"
+    )
+    try:
+        assert pool.size == 1
+        entries = []
+        blocks = rd.range(6, parallelism=6).materialize()
+        srcs = [ref for ref, _ in blocks.iter_internal_block_refs()]
+        for src in srcs:  # 6 submits, 2 per actor -> grows 1 -> 3
+            entries.append(pool.submit(src, False))
+        assert pool.size == 3
+        for block_ref, meta_ref, actor in entries:
+            rows, nbytes = ray_tpu.get(meta_ref)
+            assert rows == 1 and nbytes > 0
+            pool.note_done(actor)
+        pool.scale_down_idle()
+        assert pool.size == 1
+    finally:
+        pool.shutdown()
+    assert pool.size == 0
+
+
+def test_actor_pool_restarts_dead_actor_and_resubmits(cluster):
+    """_ActorPool unit: an actor killed mid-stream is replaced
+    (note_death) and the victim block resubmits on the replacement —
+    the executor-level path that keeps output order is strictly FIFO."""
+    import cloudpickle
+
+    from ray_tpu.data.executor import _ActorPool, _POOL_DEATH_ERRORS
+
+    strategy = ActorPoolStrategy(size=1)
+    pool = _ActorPool(
+        strategy, {"num_cpus": 0}, cloudpickle.dumps([]), "unit-restart"
+    )
+    try:
+        blocks = rd.range(4, parallelism=2).materialize()
+        srcs = [ref for ref, _ in blocks.iter_internal_block_refs()]
+        block_ref, meta_ref, actor = pool.submit(srcs[0], False)
+        assert ray_tpu.get(meta_ref)[0] == 2
+        pool.note_done(actor)
+        # Kill the sole pool actor out from under the next submit.
+        ray_tpu.kill(actor.handle)
+        block_ref, meta_ref, actor2 = pool.submit(srcs[1], False)
+        with pytest.raises(_POOL_DEATH_ERRORS):
+            ray_tpu.get(meta_ref)
+        pool.note_death(actor2)
+        assert pool.size == 1 and pool.restarts == 1
+        block_ref, meta_ref, actor3 = pool.submit(srcs[1], False)
+        assert ray_tpu.get(meta_ref)[0] == 2  # replacement serves the block
+        pool.note_done(actor3)
+    finally:
+        pool.shutdown()
+
+
+# -- data -> train e2e through iter_device_batches ---------------------------
+
+
+@pytest.mark.timeout(300)
+def test_data_to_train_e2e_through_device_batches(cluster):
+    """The governed pipeline's device-side terminus: data -> governed
+    executor -> DevicePrefetchIterator -> jitted step, continuously.
+    The step consumes device-resident batches; totals are exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.data.iterator import DataIterator
+
+    ds = rd.range(512, parallelism=8).map_batches(
+        lambda b: {"x": b["id"].astype(np.float32)}
+    )
+    it = DataIterator(ds, prefetch_depth=2)
+
+    @jax.jit
+    def step(acc, x):
+        return acc + jnp.sum(x)
+
+    acc = jnp.zeros((), jnp.float32)
+    n_batches = 0
+    for batch in it.iter_device_batches(batch_size=64):
+        assert isinstance(batch["x"], jax.Array)  # staged on device
+        acc = step(acc, batch["x"])
+        n_batches += 1
+    assert n_batches == 512 // 64
+    assert float(acc) == float(sum(range(512)))
+    # The run went through the governed executor.
+    assert ds.governor_stats() is not None

@@ -114,6 +114,30 @@ def test_train_overlap_series_registered_and_linted():
     assert lint_catalog(catalog) == []
 
 
+def test_data_governor_series_registered_and_linted():
+    """Round-18 memory-governed data plane: the per-operator in-flight
+    bytes gauge, the throttle-event counter, and the actor-pool size
+    gauge are declared through the catalog so the lint covers them —
+    the 'operator' tag is the fused chain's class-name string (bounded
+    by the op vocabulary, never an id)."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    assert "raytpu_data_operator_inflight_bytes" in catalog
+    assert catalog["raytpu_data_operator_inflight_bytes"]["kind"] == "gauge"
+    assert catalog["raytpu_data_operator_inflight_bytes"]["tag_keys"] == (
+        "operator",
+    )
+    assert "raytpu_data_throttle_events_total" in catalog
+    assert catalog["raytpu_data_throttle_events_total"]["kind"] == "counter"
+    assert catalog["raytpu_data_throttle_events_total"]["tag_keys"] == ()
+    assert "raytpu_data_actor_pool_size" in catalog
+    assert catalog["raytpu_data_actor_pool_size"]["kind"] == "gauge"
+    assert catalog["raytpu_data_actor_pool_size"]["tag_keys"] == (
+        "operator",
+    )
+    assert lint_catalog(catalog) == []
+
+
 def test_declare_runtime_metric_enforces_rules():
     with pytest.raises(ValueError, match="prefix"):
         m.declare_runtime_metric("unprefixed_series", "counter")

@@ -1,0 +1,56 @@
+"""Same-session A/B of the memory-governed streaming data plane (PERF.md
+round 18).
+
+Runs ``tools/ray_perf.py --data-only`` alternately with the governor ON
+(HEAD defaults) and OFF (``--no-data-governor``: the pre-governor
+submission loop, byte-identical to the round-17 executor) on the SAME
+commit, interleaved so ambient box load hits both arms equally (the
+round-3 lesson). The workload is an out-of-core map pipeline: the object
+store is capped 4x below the dataset, so the arms CANNOT both stay
+bounded. Watch:
+
+    data_pipeline_rows_per_s  throughput — the governed arm should win or
+                              tie (spill-to-disk round trips are pure tax)
+    data_peak_store_frac      governed: <= data_store_high_frac; OFF: at
+                              the cap (the store saved itself by spilling)
+    data_store_spills         governed: 0; OFF: > 0 — THE invariant
+    data_throttle_events      governed arm only: the governor actually
+                              arbitrated
+
+    python tools/ab_data_governor.py [--rounds 3] [--full]
+
+The interleaved-median machinery is shared with tools/ab_coalesce.py;
+bench.py records the same pair per round as the ``data_governor`` BENCH
+record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import interleaved_ab  # noqa: E402 — shared machinery
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument(
+        "--full", action="store_true", help="full (not --quick) perf runs"
+    )
+    args = ap.parse_args()
+    interleaved_ab(
+        "--no-data-governor",
+        "data-governor",
+        args.rounds,
+        args.full,
+        base_flags=("--data-only",),
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

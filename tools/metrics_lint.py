@@ -40,6 +40,7 @@ _CATALOG_MODULES = [
     "ray_tpu.serve.replica",
     "ray_tpu.serve.admission",  # overload-plane series (429 tier)
     "ray_tpu.data.executor",
+    "ray_tpu.data.governor",  # memory-governor series (round 18)
     "ray_tpu.train.context",
     "ray_tpu.train.input",  # prefetch-miss counter (host-free train tier)
     "ray_tpu.train.worker_group",

@@ -875,6 +875,72 @@ def _rl_rows(results: dict, no_podracer: bool, quick: bool):
     algo.stop()
 
 
+def _data_rows(results: dict, quick: bool) -> None:
+    """Governed out-of-core data-pipeline rows (round-18 memory-governed
+    streaming data plane): the object store is capped WELL below the
+    dataset size, a map pipeline streams ~4x the cap through
+    iter_batches, and the rows report throughput + how the store
+    behaved. The caller shrank GLOBAL_CONFIG.object_store_bytes BEFORE
+    init (capacity is fixed at store creation) and flipped
+    data_governor for the --no-data-governor arm."""
+    import threading
+
+    import ray_tpu.data as rd
+    from ray_tpu.core.config import GLOBAL_CONFIG
+
+    cap = GLOBAL_CONFIG.object_store_bytes
+    n_blocks = 16 if quick else 32
+    rows_per_block = 128
+    # ~8 MB/block: 1024 float64 payload lanes per row.
+    lanes = 8 * 1024 * 1024 // (rows_per_block * 8)
+
+    peak = [0]
+    spills = [0]
+    stop = [False]
+
+    def poll():
+        while not stop[0]:
+            used = sp = 0
+            for n in ray_tpu.nodes():
+                st = n.get("StoreStats") or {}
+                used += int(st.get("used_bytes", 0))
+                sp += int(st.get("spills", 0))
+            peak[0] = max(peak[0], used)
+            spills[0] = sp
+            time.sleep(0.025)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    payload = lambda b: {  # noqa: E731 — shipped by value to workers
+        "id": b["id"],
+        "x": np.ones((len(b["id"]), lanes), np.float64),
+    }
+    ds = rd.range(n_blocks * rows_per_block, parallelism=n_blocks)
+    ds = ds.map_batches(payload)
+    t0 = time.perf_counter()
+    rows = 0
+    for batch in ds.iter_batches(batch_size=rows_per_block):
+        rows += len(batch["id"])
+    dt = time.perf_counter() - t0
+    stop[0] = True
+    poller.join()
+    results["data_pipeline_rows_per_s"] = round(rows / dt, 1)
+    results["data_peak_store_frac"] = round(peak[0] / cap, 3)
+    results["data_store_spills"] = spills[0]
+    gov = ds.governor_stats()
+    results["data_throttle_events"] = (
+        0 if gov is None else gov["throttle_events"]
+    )
+    print(
+        f"data_pipeline [{'governed' if gov is not None else 'kill-switch'}]"
+        f": {results['data_pipeline_rows_per_s']:,.0f} rows/s, peak store "
+        f"{results['data_peak_store_frac']:.0%} of cap, "
+        f"{results['data_store_spills']} spills, "
+        f"{results['data_throttle_events']} throttles",
+        flush=True,
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1002,6 +1068,23 @@ def main() -> int:
         "round-17 decoupled actor/inference/learner planes)",
     )
     ap.add_argument(
+        "--data-only",
+        action="store_true",
+        help="run only the governed out-of-core data-pipeline rows "
+        "(object store capped ~4x below the dataset): rows/s + peak "
+        "store occupancy + spills — the round-18 memory-governor A/B "
+        "rides this via tools/ab_data_governor.py and bench.py's "
+        "data_governor record",
+    )
+    ap.add_argument(
+        "--no-data-governor",
+        action="store_true",
+        help="kill switch: ungoverned streaming executor (equivalent to "
+        "RAY_TPU_DATA_GOVERNOR=0) — the A/B baseline for the round-18 "
+        "memory-governed data plane; on the --data-only workload this "
+        "arm spills where the governed arm stays under the watermark",
+    )
+    ap.add_argument(
         "--faults",
         metavar="SEED:SPEC",
         help="enable the fault-injection plane for the whole run "
@@ -1045,6 +1128,7 @@ def main() -> int:
         or args.no_disagg
         or args.no_spec_decode
         or args.no_podracer
+        or args.no_data_governor
     ):
         from ray_tpu.core.config import GLOBAL_CONFIG
 
@@ -1069,6 +1153,21 @@ def main() -> int:
             GLOBAL_CONFIG.spec_decode = False
         if args.no_podracer:
             GLOBAL_CONFIG.podracer = False
+        if args.no_data_governor:
+            GLOBAL_CONFIG.data_governor = False
+
+    if args.data_only:
+        # The store must be capped BEFORE init (capacity is fixed at
+        # store creation): 4x below the dataset the rows stream through.
+        from ray_tpu.core.config import GLOBAL_CONFIG as _DCFG
+
+        _DCFG.object_store_bytes = 32 * 1024 * 1024
+        ray_tpu.init(num_cpus=4)
+        results = {}
+        _data_rows(results, quick=args.quick)
+        print(json.dumps(results), flush=True)
+        ray_tpu.shutdown()
+        return 0
 
     if args.rl_only:
         # Runner/learner jax stays on CPU even where a TPU plugin is
