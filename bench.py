@@ -547,6 +547,26 @@ def _data_governor_rows() -> dict:
     return out
 
 
+def _fleet_scale_rows() -> dict:
+    """Fleet-scale control-plane A/B (round-19): placement p50/p99 at
+    100/500/1,000 emulated nodes with the feasibility-indexed scheduler
+    ON vs the full-scan kill-switch arm (``--no-sched-index``). Both arms
+    replay the same seeded lease schedule through the in-process fleet
+    emulator — no cluster runtime, so this reports even when the TPU
+    tunnel is wedged."""
+    out = _ab_rows(
+        "fleet_scale", ("--fleet-only",), ("--no-sched-index",), 420
+    )
+    if "on" in out and "off" in out:
+        on_p99 = out["on"].get("fleet_place_p99_ms_1000", 0)
+        off_p99 = out["off"].get("fleet_place_p99_ms_1000", 0)
+        if on_p99:
+            # >1 = the bounded-sample index beat the scan; the round-19
+            # acceptance bar is >=2.0 on this row.
+            out["place_p99_1000_off_on_ratio"] = round(off_p99 / on_p99, 3)
+    return out
+
+
 def _raylint_rows() -> dict:
     """Static-analysis debt counts via ``tools/raylint.py --json`` (total /
     suppressed / unsuppressed + per-rule) so lint debt is tracked per round
@@ -596,6 +616,7 @@ def _emit(
     serve_disagg: dict | None = None,
     podracer: dict | None = None,
     data_governor: dict | None = None,
+    fleet_scale: dict | None = None,
 ) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
@@ -603,6 +624,11 @@ def _emit(
         # Memory-governed data-plane A/B (occupancy bound + spill count,
         # governor ON vs kill switch) rides every record from round 18 on.
         record = {**record, "data_governor": data_governor}
+    if fleet_scale:
+        # Fleet-scale scheduler A/B (feasibility index ON vs full-scan
+        # kill switch at 1,000 emulated nodes) rides every record from
+        # round 19 on.
+        record = {**record, "fleet_scale": fleet_scale}
     if serve_llm:
         # Serving A/B rides every record too: the BENCH trajectory tracks
         # the serving number (tok/s + p99 TTFT, routing ON vs OFF) from
@@ -652,6 +678,7 @@ def main() -> None:
     train_overlap = _train_overlap_rows()
     podracer = _podracer_rows()
     data_governor = _data_governor_rows()
+    fleet_scale = _fleet_scale_rows()
     raylint = _raylint_rows()
 
     probe_record: dict | None = None
@@ -660,7 +687,7 @@ def main() -> None:
         _emit(
             record, data_plane, probe_record, serve_llm, raylint,
             train_overlap, serve_overload, serve_disagg, podracer,
-            data_governor,
+            data_governor, fleet_scale,
         )
 
     try:

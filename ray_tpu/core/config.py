@@ -350,6 +350,30 @@ class Config:
     data_actor_pool_min_size: int = 1
     data_actor_pool_max_size: int = 2
     data_actor_pool_max_tasks_per_actor: int = 2
+    # Fleet-scale control plane (round 19). ``sched_index`` is the kill
+    # switch (RAY_TPU_SCHED_INDEX=0): off, every placement decision takes
+    # the original full-scan pick_node path byte-identically (the A/B
+    # baseline of tools/ab_fleet.py / ray_perf --no-sched-index). On, the
+    # GCS and node-side schedulers consult a FeasibilityIndex
+    # (core/sched_index.py): candidates bucketed by resource-key shape +
+    # exact label set, hybrid placement probes a bounded
+    # power-of-two-choices sample (``sched_index_probes`` fitting
+    # candidates, rotating per-bucket cursors) and picks max headroom
+    # among the sample instead of scanning every NodeView. The index
+    # returns None exactly when the scan would (probing keeps extending
+    # until it either finds ``sched_index_probes`` fits or exhausts every
+    # shape/label-feasible bucket), so feasibility semantics are
+    # unchanged; only WHICH fitting node wins may differ from the scan.
+    sched_index: bool = True
+    sched_index_probes: int = 8
+    # Fleet emulation harness defaults (tools/fleet_emu.py +
+    # core/fleet_emu.py): emulated-node count and lease-op count per
+    # profiled scale when the CLI flags are not given. Emulated nodes
+    # drive the REAL GCS wire handlers (register/heartbeat/lease traffic)
+    # without spawning workers; schedules replay bit-identically from the
+    # seed.
+    fleet_emu_nodes: int = 100
+    fleet_emu_lease_ops: int = 400
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

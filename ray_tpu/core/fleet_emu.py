@@ -1,0 +1,526 @@
+"""Fleet emulation harness — drive the REAL GCS at 1,000 nodes, cheaply.
+
+The control-plane hot paths (placement picks, heartbeat ingest, view-delta
+fan-out) only show their fleet-scale behavior past a few hundred nodes, and
+a real node daemon costs a process + an object store + worker pools — three
+orders of magnitude too heavy to spawn a thousand of. This module emulates
+the *nodes* and keeps everything node-facing in the GCS real: emulated
+nodes register, heartbeat (with store gauges), take actor placements, and
+drain through the same ``gcs.*`` wire handlers a live cluster uses. The
+GCS cannot tell the difference.
+
+Two deliberate asymmetries versus a live cluster:
+
+- **One shared host endpoint.** A real deployment has one Endpoint (one
+  event-loop thread) per node; a thousand threads is exactly the cost this
+  harness exists to avoid. All emulated nodes advertise the SAME endpoint
+  address and the GCS's ``node.*`` RPCs are routed by the ``node_id`` key
+  that travels in ``_start_spec`` / drain payloads (real nodes ignore it —
+  they ARE the target).
+- **Driver-paced time.** Heartbeats, drains and lease traffic are issued
+  synchronously by the driver from a seeded schedule; the GCS health loop
+  is parked behind enlarged timeouts (saved/restored around the run). With
+  every GCS-side decision happening inside some blocking driver call, a
+  replay from the same seed reproduces the exact decision sequence —
+  ``decision_digest()`` is the bit-identity witness the chaos tests and
+  the ``RAY_TPU_SCHED_INDEX=0`` A/B acceptance check assert on.
+
+Schedules follow the ``tools/traffic_gen.py`` pattern: a pure generator
+keyed by ``(seed, scenario, params)`` emits the op list; ``fleet_digest``
+hashes it so two processes can prove they replayed the same tape.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import time
+from dataclasses import dataclass, field
+from random import Random
+from typing import Optional
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.errors import SchedulingError
+
+# -- seeded schedules ---------------------------------------------------------
+
+#: Lease demand mix: mostly small CPU asks (the task-lease shape), some
+#: gang-sized CPU, some TPU with a hard accelerator selector. Hybrid-only
+#: by default — spread picks are a full ordered scan by CONTRACT in both
+#: the index and scan arms, so they carry no A/B signal and would dominate
+#: the latency tail; the scheduler-index tests cover spread equivalence.
+_DEMANDS = (
+    ("cpu1", {"CPU": 1.0}, {}),
+    ("cpu1", {"CPU": 1.0}, {}),
+    ("cpu4", {"CPU": 4.0}, {}),
+    ("tpu4", {"TPU": 4.0}, {"accelerator": "tpu-v4"}),
+)
+
+
+def node_specs(n: int) -> list:
+    """Deterministic fleet shape mix: index ``i`` always gets the same
+    resources/labels, so the bucket structure is a pure function of the
+    fleet size. ~70% CPU-only boxes, ~20% mixed CPU+TPU, ~10% slice heads
+    (8 slice labels — the label-bucket fan the index must cope with)."""
+    out = []
+    for i in range(n):
+        slot = i % 10
+        if slot < 7:
+            res = {"CPU": 16.0}
+            labels = {"pool": "cpu"}
+        elif slot < 9:
+            res = {"CPU": 16.0, "TPU": 4.0}
+            labels = {"accelerator": "tpu-v4", "pool": "mixed"}
+        else:
+            res = {"CPU": 8.0, "TPU": 8.0}
+            labels = {
+                "accelerator": "tpu-v4",
+                "pool": "head",
+                "slice": f"slice-{(i // 10) % 8}",
+            }
+        out.append((f"emu-{i:05d}", res, labels))
+    return out
+
+
+def schedule_events(
+    seed: int,
+    scenario: str,
+    nodes: int,
+    ops: int,
+    wave_fraction: float = 0.1,
+) -> list:
+    """Seeded op tape for one emulator run. Ops (executed in order):
+
+    - ``("lease", kind, demand, selector, max_restarts)`` — create an
+      actor with that demand;
+    - ``("release", idx)`` — kill the ``idx % alive``-th oldest live
+      actor (index resolved at replay time against the active set);
+    - ``("wave", start_frac, count)`` — drain ``count`` consecutive nodes
+      starting at ``start_frac * fleet`` (slice-preemption wave);
+    - ``("churn", node_idx)`` — kill node ``node_idx`` outright and
+      re-register it (rolling restart).
+
+    Scenarios: ``steady`` (pure lease/release), ``churn`` (lease traffic
+    with rolling node restarts), ``preempt_wave`` (one mid-run wave of
+    ``wave_fraction`` of the fleet). The tape is a pure function of the
+    arguments — replays are bit-identical from the seed.
+    """
+    rng = Random(f"fleet:{seed}:{scenario}:{nodes}:{ops}:{wave_fraction}")
+    tape: list = []
+    active = 0
+    for i in range(ops):
+        if scenario == "churn" and i > 0 and i % 25 == 0:
+            tape.append(("churn", rng.randrange(nodes)))
+            continue
+        if (
+            scenario == "preempt_wave"
+            and i == ops // 2
+            and wave_fraction > 0
+        ):
+            count = max(1, int(nodes * wave_fraction))
+            start = rng.randrange(max(1, nodes - count))
+            tape.append(("wave", start, count))
+            continue
+        if active > 0 and rng.random() < 0.35:
+            tape.append(("release", rng.randrange(1 << 16)))
+            active -= 1
+        else:
+            kind, demand, selector = _DEMANDS[
+                rng.randrange(len(_DEMANDS))
+            ]
+            tape.append(("lease", kind, dict(demand), dict(selector), 0))
+            active += 1
+    return tape
+
+
+def fleet_digest(items: list) -> str:
+    """Stable 16-hex digest of a schedule or decision log (the
+    ``traffic_gen.schedule_digest`` pattern)."""
+    h = hashlib.sha256()
+    for it in items:
+        h.update(repr(it).encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:16]
+
+
+# -- emulated fleet -----------------------------------------------------------
+
+
+@dataclass
+class EmulatedNode:
+    """Node-side truth for one emulated node: the availability ledger the
+    ``node.start_actor`` / ``node.kill_worker`` stubs debit and credit —
+    the emulated analogue of ``Node.available``."""
+
+    node_id: str
+    total: dict
+    available: dict = field(default_factory=dict)
+    labels: dict = field(default_factory=dict)
+    alive: bool = True
+    draining: bool = False
+    dirty: bool = True  # availability changed since its last heartbeat
+
+
+class FleetEmulator:
+    """In-process GCS + N emulated nodes behind one shared host endpoint.
+
+    All driving methods are synchronous and block until the GCS handler
+    (and anything it does in-line — placement, pending-actor retries,
+    drain fan-out) completes, which is what makes seeded runs replay
+    decision-for-decision. Everything the A/B tooling measures is read
+    straight off the in-process ``GcsServer`` (``gcs.place_latency_ms``
+    carries exact per-pick latency, free of RPC overhead).
+    """
+
+    _SAVED_KNOBS = ("node_heartbeat_interval_s", "node_death_timeout_s")
+
+    def __init__(self, n_nodes: int = 0, seed: int = 0):
+        if n_nodes <= 0:
+            n_nodes = GLOBAL_CONFIG.fleet_emu_nodes
+        self.seed = seed
+        self.emu_nodes: dict[str, EmulatedNode] = {}
+        for node_id, res, labels in node_specs(n_nodes):
+            self.emu_nodes[node_id] = EmulatedNode(
+                node_id=node_id, total=dict(res), available=dict(res),
+                labels=labels,
+            )
+        self.decision_log: list = []
+        self._undecided: list[str] = []
+        self._live_actors: list[str] = []  # creation order, live only
+        self._worker_homes: dict[str, tuple] = {}  # wid -> (node_id, res)
+        self._actor_seq = 0
+        self._worker_seq = 0
+        self._saved: dict = {}
+        self.gcs = None
+        self.host = None
+        self.gcs_addr: Optional[tuple] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, park_health_loop: bool = True):
+        from ray_tpu.core.gcs import GcsServer
+        from ray_tpu.core.protocol import Endpoint
+
+        for k in self._SAVED_KNOBS:
+            self._saved[k] = getattr(GLOBAL_CONFIG, k)
+        if park_health_loop:
+            # Driver-paced time: the health loop must not race the tape.
+            # (The blackhole scenario re-arms these to SMALL values after
+            # start() so heartbeat-timeout deaths actually fire.)
+            GLOBAL_CONFIG.node_heartbeat_interval_s = 3600.0
+            GLOBAL_CONFIG.node_death_timeout_s = 7200.0
+        self.gcs = GcsServer(session_id=f"fleet-emu-{self.seed}")
+        self.gcs_addr = self.gcs.start(host="127.0.0.1", port=0)
+        self.host = Endpoint("fleet-emu-host")
+        self.host.register("node.start_actor", self._h_start_actor)
+        self.host.register("node.kill_worker", self._h_kill_worker)
+        self.host.register("node.drain", self._h_drain)
+        self.host.register("node.restart_node_actors", self._h_ack)
+        self.host.register("node.return_pg", self._h_ack)
+        self.host.start(host="127.0.0.1", port=0)
+        return self
+
+    def stop(self) -> None:
+        if self.host is not None:
+            self.host.stop()
+            self.host = None
+        if self.gcs is not None:
+            self.gcs.stop()
+            self.gcs = None
+        for k, v in self._saved.items():
+            setattr(GLOBAL_CONFIG, k, v)
+        self._saved.clear()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- node.* stubs (served FOR every emulated node, routed by node_id) ----
+
+    async def _h_start_actor(self, conn, p):
+        record = p["record"]
+        emu = self.emu_nodes.get(record.get("node_id") or "")
+        if emu is None or not emu.alive:
+            raise SchedulingError("emulated node is gone")
+        resources = record["spec"].get("resources", {})
+        if emu.draining:
+            raise SchedulingError(
+                f"node {emu.node_id} is draining; actor must place elsewhere"
+            )
+        for k, v in resources.items():
+            if emu.available.get(k, 0.0) + 1e-9 < v:
+                # Same capacity-style rejection a real node raises when its
+                # actual availability lags the gossiped view: the GCS must
+                # requeue, not fail, the actor.
+                raise SchedulingError(
+                    f"node {emu.node_id} cannot fit actor {resources}"
+                )
+        for k, v in resources.items():
+            emu.available[k] = emu.available.get(k, 0.0) - v
+        emu.dirty = True
+        self._worker_seq += 1
+        wid = f"emu-w-{self._worker_seq:06d}"
+        self._worker_homes[wid] = (emu.node_id, dict(resources))
+        return {"worker_addr": tuple(self.host.address), "worker_id": wid}
+
+    async def _h_kill_worker(self, conn, p):
+        home = self._worker_homes.pop(p.get("worker_id"), None)
+        if home is None:
+            return False
+        node_id, resources = home
+        emu = self.emu_nodes.get(node_id)
+        if emu is not None and emu.alive:
+            for k, v in resources.items():
+                emu.available[k] = emu.available.get(k, 0.0) + v
+            emu.dirty = True
+        return True
+
+    async def _h_drain(self, conn, p):
+        emu = self.emu_nodes.get(p.get("node_id") or "")
+        if emu is not None:
+            emu.draining = True
+        return {"accepted": True}
+
+    async def _h_ack(self, conn, p):
+        return True
+
+    # -- driving (all synchronous, all through the real wire handlers) -------
+
+    def _call(self, method: str, payload: dict, timeout: float = 60.0):
+        return self.host.call(self.gcs_addr, method, payload, timeout=timeout)
+
+    def register_node(self, emu: EmulatedNode) -> None:
+        self._call(
+            "gcs.register_node",
+            {
+                "node_id": emu.node_id,
+                "addr": tuple(self.host.address),
+                "resources": dict(emu.total),
+                "labels": dict(emu.labels),
+                "session_id": self.gcs.session_id,
+                "shm_root": None,
+                "hostname": emu.node_id,
+            },
+        )
+        emu.alive = True
+        emu.draining = False
+        emu.dirty = True
+        self._collect_decisions("register")
+
+    def register_all(self) -> None:
+        for emu in self.emu_nodes.values():
+            self.register_node(emu)
+
+    def heartbeat(self, emu: EmulatedNode, resources_freed: bool = False,
+                  store: Optional[dict] = None) -> bool:
+        ok = self._call(
+            "gcs.node_heartbeat",
+            {
+                "node_id": emu.node_id,
+                "available": dict(emu.available),
+                "total": dict(emu.total),
+                "store": store,
+                "resources_freed": resources_freed,
+            },
+        )
+        if ok:
+            emu.dirty = False
+        else:
+            # The GCS declared this node dead (or never knew it): the real
+            # daemon re-registers on the next beat; the harness records the
+            # verdict and leaves re-registration to the schedule.
+            emu.alive = False
+        if resources_freed:
+            self._collect_decisions("freed")
+        return bool(ok)
+
+    def heartbeat_dirty(self) -> int:
+        """Beat every live node whose availability changed since its last
+        report (the steady-state gossip a real fleet produces)."""
+        n = 0
+        for emu in self.emu_nodes.values():
+            if emu.alive and emu.dirty:
+                self.heartbeat(emu)
+                n += 1
+        return n
+
+    def create_actor(
+        self,
+        resources: dict,
+        label_selector: Optional[dict] = None,
+        policy: str = "hybrid",
+        max_restarts: int = 0,
+    ) -> dict:
+        self._actor_seq += 1
+        aid = f"emu-a-{self.seed}-{self._actor_seq:06d}"
+        info = self._call(
+            "gcs.create_actor",
+            {
+                "spec": {
+                    "actor_id": aid,
+                    "resources": dict(resources),
+                    "label_selector": dict(label_selector or {}),
+                    "soft_label_selector": {},
+                    "policy": policy,
+                    "max_restarts": max_restarts,
+                    "name": None,
+                }
+            },
+        )
+        self.decision_log.append(
+            ("place", aid, info["state"], info.get("node_id"))
+        )
+        if info["state"] == "PENDING":
+            self._undecided.append(aid)
+        if info["state"] != "DEAD":
+            self._live_actors.append(aid)
+        return info
+
+    def kill_actor(self, actor_id: str) -> None:
+        rec = self.gcs.actors.get(actor_id)
+        home = rec.node_id if rec is not None else None
+        self._call("gcs.kill_actor", {"actor_id": actor_id})
+        if actor_id in self._live_actors:
+            self._live_actors.remove(actor_id)
+        if actor_id in self._undecided:
+            self._undecided.remove(actor_id)
+        # The freed capacity gossips back and wakes pending placements —
+        # in-line, so retry decisions land before the next tape op.
+        emu = self.emu_nodes.get(home or "")
+        if emu is not None and emu.alive:
+            self.heartbeat(emu, resources_freed=True)
+
+    def drain_wave(self, node_ids: list, reason: str = "preempted") -> None:
+        """Slice-preemption wave: gracefully drain then retire each node,
+        exactly the DRAINING->drain_complete path a real preemption notice
+        drives. Sequential: every restart/reschedule decision the wave
+        triggers lands before this returns."""
+        for nid in node_ids:
+            emu = self.emu_nodes[nid]
+            if not emu.alive:
+                continue
+            self._call(
+                "gcs.drain_node",
+                {"node_id": nid, "reason": reason, "grace_s": 3600.0,
+                 "self_initiated": True},
+            )
+            emu.draining = True
+        for nid in node_ids:
+            emu = self.emu_nodes[nid]
+            if not emu.alive:
+                continue
+            self._call("gcs.drain_complete", {"node_id": nid})
+            emu.alive = False
+            emu.draining = False
+            emu.available = {}
+        self._collect_decisions("wave")
+
+    def churn_node(self, node_id: str) -> None:
+        """Rolling restart: force-kill the node record, then re-register
+        it empty (lost workers stay lost — their ledger entries are
+        dropped, like a real machine reboot)."""
+        emu = self.emu_nodes[node_id]
+        self._call(
+            "gcs.drain_node",
+            {"node_id": node_id, "reason": "churn", "force": True},
+        )
+        self._worker_homes = {
+            wid: home
+            for wid, home in self._worker_homes.items()
+            if home[0] != node_id
+        }
+        self._live_actors = [
+            aid
+            for aid in self._live_actors
+            if self.gcs.actors[aid].state not in ("DEAD",)
+        ]
+        self._collect_decisions("churn-kill")
+        emu.available = dict(emu.total)
+        self.register_node(emu)
+
+    def run_schedule(self, tape: list) -> None:
+        """Replay one seeded op tape (see ``schedule_events``)."""
+        n = len(self.emu_nodes)
+        ids = list(self.emu_nodes)
+        for op in tape:
+            kind = op[0]
+            if kind == "lease":
+                _, _, demand, selector, max_restarts = op
+                self.create_actor(
+                    demand, selector or None, max_restarts=max_restarts
+                )
+                self.heartbeat_dirty()
+            elif kind == "release":
+                if self._live_actors:
+                    self.kill_actor(
+                        self._live_actors[op[1] % len(self._live_actors)]
+                    )
+            elif kind == "wave":
+                start, count = op[1], op[2]
+                self.drain_wave([ids[(start + j) % n] for j in range(count)])
+            elif kind == "churn":
+                self.churn_node(ids[op[1] % n])
+            else:  # pragma: no cover - schedule generator is closed-world
+                raise ValueError(f"unknown fleet op {op!r}")
+
+    def _collect_decisions(self, cause: str) -> None:
+        """Fold placements the GCS made INSIDE the last driver call (pending
+        retries, drain restarts) into the decision log, in actor order —
+        the log stays a pure function of the tape."""
+        still = []
+        for aid in self._undecided:
+            rec = self.gcs.actors.get(aid)
+            if rec is None or rec.state == "PENDING":
+                still.append(aid)
+                continue
+            self.decision_log.append(
+                (cause, aid, rec.state, rec.node_id)
+            )
+        self._undecided = still
+
+    # -- measurement ---------------------------------------------------------
+
+    def decision_digest(self) -> str:
+        """Bit-identity witness over every placement decision this run
+        made, in the order it was made."""
+        return fleet_digest(self.decision_log)
+
+    def final_state_digest(self) -> str:
+        """Order-free witness: final (actor -> state, node) mapping. Used
+        where concurrent death detection (blackhole) makes the in-window
+        decision ORDER timing-dependent but the fixed point is not."""
+        items = sorted(
+            (rec.actor_id, rec.state, rec.node_id or "")
+            for rec in self.gcs.actors.values()
+        )
+        return fleet_digest(items)
+
+    def place_latencies_ms(self) -> list:
+        return list(self.gcs.place_latency_ms)
+
+    def heartbeat_burst_us(self, count: int = 200) -> float:
+        """Mean wall-clock per heartbeat RPC (dial + ingest + reply) over a
+        burst from rotating live nodes. RPC-inclusive by design — it is
+        the node-observed cost, not the handler-only cost."""
+        live = [e for e in self.emu_nodes.values() if e.alive]
+        if not live:
+            return 0.0
+        t0 = time.perf_counter()
+        for i in range(count):
+            self.heartbeat(live[i % len(live)])
+        return (time.perf_counter() - t0) / count * 1e6
+
+    def delta_probe(self, since: int) -> dict:
+        """One consumer view-sync as a real node would issue it: returns
+        the delta's pickled wire size, changed-node count, and new cursor."""
+        reply = self._call("gcs.get_cluster_view", {"since": since})
+        changed = reply.get("changed", {})
+        return {
+            "version": reply["version"],
+            "changed": len(changed),
+            "bytes": len(pickle.dumps(reply, protocol=5)),
+            "full": bool(reply.get("full")),
+        }

@@ -32,7 +32,12 @@ from ray_tpu.core.scheduler import (
     any_feasible,
     pick_node,
 )
-from ray_tpu.util.metrics import declare_runtime_metric
+from ray_tpu.core.sched_index import _INDEX_METRIC_META, FeasibilityIndex
+from ray_tpu.util.metrics import (
+    LocalHistogram,
+    declare_runtime_metric,
+    metrics_enabled,
+)
 from ray_tpu.util.tasks import spawn
 
 ALIVE = "ALIVE"
@@ -44,6 +49,24 @@ DEAD = "DEAD"
 # transitions to DEAD (reference: gcs_service.proto DrainNode + the
 # raylet's graceful-drain deadline).
 DRAINING = "DRAINING"
+
+# Placement decisions: sub-0.01 ms index picks through multi-ms full
+# scans at 1,000 nodes.
+PLACEMENT_BOUNDARIES_MS = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+    50.0,
+]
+# Changed nodes per delta reply: idle clusters gossip ~nothing; a full
+# resync at fleet scale lands in the top buckets.
+DELTA_NODES_BOUNDARIES = [
+    0.0, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+]
+
+# How many delta generations the GCS remembers for O(changed) delta
+# computation; a consumer whose cursor predates the log falls back to the
+# O(nodes) node_versions scan (correct, just slower). 512 generations at
+# one flush per read covers minutes of history for any live consumer.
+_DELTA_LOG_LEN = 512
 
 # Drain telemetry (registered in the runtime catalog; tools/metrics_lint.py
 # imports this module). The objects-migrated counter lives node-side
@@ -58,6 +81,30 @@ _GCS_METRIC_META = {
         "raytpu_drain_deadline_forced_total", "counter",
         "drains that ended in the force mark-dead fallback (grace deadline "
         "expired, or force=true / zero grace requested)",
+        layer="core",
+    ),
+    # Fleet-scale control-plane series (round 19): the placement hot
+    # path, the coalesced heartbeat ingest, and the delta fan-out —
+    # exactly what tools/fleet_emu.py profiles at 100->1,000 nodes.
+    "raytpu_gcs_placement_latency_ms": declare_runtime_metric(
+        "raytpu_gcs_placement_latency_ms", "histogram",
+        "scheduler pick time per actor placement decision (the index vs "
+        "full-scan A/B surface; excludes the start_actor RPC)",
+        boundaries=PLACEMENT_BOUNDARIES_MS,
+        layer="core",
+    ),
+    "raytpu_gcs_view_delta_nodes": declare_runtime_metric(
+        "raytpu_gcs_view_delta_nodes", "histogram",
+        "changed-node count per versioned cluster-view delta reply "
+        "(coalesced heartbeat ingest keeps this near the real change "
+        "rate, not the heartbeat rate)",
+        boundaries=DELTA_NODES_BOUNDARIES,
+        layer="core",
+    ),
+    "raytpu_gcs_heartbeat_ingest_total": declare_runtime_metric(
+        "raytpu_gcs_heartbeat_ingest_total", "counter",
+        "node heartbeats ingested by this GCS (accepted beats only: "
+        "unknown/dead-node beats that force re-registration don't count)",
         layer="core",
     ),
 }
@@ -166,8 +213,29 @@ class GcsServer:
         self._history_last_sample = 0.0
         # Versioned view sync: bumped only on REAL state changes so idle
         # clusters gossip ~nothing (reference: delta-streaming RaySyncer).
+        # Bumps are COALESCED (round 19): a state change marks the node
+        # dirty; _flush_view_dirty() turns all dirt accumulated since the
+        # last flush into ONE version generation, so N heartbeats between
+        # two reads cost one delta generation, not N. The delta log keeps
+        # the last _DELTA_LOG_LEN generations for O(changed) delta
+        # replies; node_versions stays as the out-of-log fallback.
         self.view_version = 0
         self.node_versions: dict[str, int] = {}
+        self._dirty_nodes: set[str] = set()
+        self._delta_log: "deque[tuple]" = deque(maxlen=_DELTA_LOG_LEN)
+        # Feasibility index over the authoritative views (round 19): the
+        # actor-placement hot path samples a bounded candidate set from it
+        # instead of scanning self.nodes. Maintained unconditionally (the
+        # transitions are rare); GLOBAL_CONFIG.sched_index gates the READ
+        # path, so the kill switch can flip at runtime.
+        self.sched_index = FeasibilityIndex(self.nodes)
+        # Exact per-decision pick latency (ms), readable in-process by
+        # tools/fleet_emu.py — the A/B witness the >=2x acceptance bar is
+        # judged on (client RTTs would bury the pick under RPC overhead).
+        self.place_latency_ms: "deque[float]" = deque(maxlen=65536)
+        self._place_hist = LocalHistogram(PLACEMENT_BOUNDARIES_MS)
+        self._delta_nodes_hist = LocalHistogram(DELTA_NODES_BOUNDARIES)
+        self.hb_ingest_total = 0
         self.internal_config: str = GLOBAL_CONFIG.to_json()
         self._health_task = None
         self._restored_live: list[str] = []
@@ -358,6 +426,7 @@ class GcsServer:
         # see every already-published batch again.
         self.node_last_seen[p["node_id"]] = time.monotonic()
         self._bump_node_version(p["node_id"])
+        self.sched_index.upsert(view)
         self.events.record(
             "NODE", "DEFINITION", p["node_id"],
             {"labels": dict(p.get("labels", {})),
@@ -418,12 +487,20 @@ class GcsServer:
                 await self._publish(
                     "logs", {"node_id": p["node_id"], "batches": fresh}
                 )
+        self.hb_ingest_total += 1
         new_avail = dict(p["available"])
         new_total = dict(p.get("total", view.total))
         if new_avail != view.available or new_total != view.total:
             self._bump_node_version(p["node_id"])
-        view.available = new_avail
-        view.total = new_total
+            view.available = new_avail
+            view.total = new_total
+            # Values change every beat; the bucket KEY only when the
+            # resource-key set does (e.g. a PG bundle commit landing in
+            # the node's self-report) — upsert no-ops otherwise.
+            self.sched_index.upsert(view)
+        else:
+            view.available = new_avail
+            view.total = new_total
         meta = self.node_meta.setdefault(p["node_id"], {})
         meta["pending_demand"] = p.get("pending_demand", [])
         if p.get("store") is not None:
@@ -463,8 +540,23 @@ class GcsServer:
         }
 
     def _bump_node_version(self, nid: str) -> None:
+        # Coalesced (round 19): mark dirty; the next flush folds every
+        # node dirtied since the last one into a single version bump.
+        self._dirty_nodes.add(nid)
+
+    def _flush_view_dirty(self) -> None:
+        """One version generation for ALL state changes since the last
+        flush. Runs lazily at view-read time plus once per health tick —
+        N heartbeats landing between two reads produce one delta
+        generation, not N, and an idle cluster's version never moves."""
+        if not self._dirty_nodes:
+            return
         self.view_version += 1
-        self.node_versions[nid] = self.view_version
+        ver = self.view_version
+        dirty, self._dirty_nodes = self._dirty_nodes, set()
+        for nid in dirty:
+            self.node_versions[nid] = ver
+        self._delta_log.append((ver, dirty))
 
     async def _h_get_cluster_view(self, conn, p):
         """Full view (no ``since``) or versioned delta (``since``: the
@@ -474,20 +566,42 @@ class GcsServer:
         since = p.get("since")
         if since is None:
             return {nid: self._node_entry(nid) for nid in self.nodes}
+        self._flush_view_dirty()
         if since < 0 or since > self.view_version:
             # Fresh cursor, or one predating a GCS restart: full resync.
             # full=True tells the caller to REPLACE its view — merging
             # would retain nodes that vanished with the old GCS.
+            if metrics_enabled():
+                self._delta_nodes_hist.observe(float(len(self.nodes)))
             return {
                 "version": self.view_version,
                 "changed": {nid: self._node_entry(nid) for nid in self.nodes},
                 "full": True,
             }
-        changed = {
-            nid: self._node_entry(nid)
-            for nid, ver in self.node_versions.items()
-            if ver > since and nid in self.nodes
-        }
+        log = self._delta_log
+        if log and since >= log[0][0] - 1:
+            # The cursor is inside the log window: walk the O(changed)
+            # suffix of generations instead of scanning every node's
+            # version (the fleet-scale path — delta cost now tracks the
+            # change rate, not the fleet size).
+            changed_ids: set = set()
+            for ver, ids in reversed(log):
+                if ver <= since:
+                    break
+                changed_ids.update(ids)
+            changed = {
+                nid: self._node_entry(nid)
+                for nid in sorted(changed_ids)
+                if nid in self.nodes
+            }
+        else:
+            changed = {
+                nid: self._node_entry(nid)
+                for nid, ver in self.node_versions.items()
+                if ver > since and nid in self.nodes
+            }
+        if metrics_enabled():
+            self._delta_nodes_hist.observe(float(len(changed)))
         return {"version": self.view_version, "changed": changed}
 
     async def _h_drain_node(self, conn, p):
@@ -526,7 +640,7 @@ class GcsServer:
             try:
                 await self.endpoint.anotify(
                     view.addr, "node.drain",
-                    {"grace_s": 0.0, "reason": reason},
+                    {"grace_s": 0.0, "reason": reason, "node_id": node_id},
                 )
             except Exception:  # raylint: disable=RL006 -- force-kill notice to an unreachable node; mark_node_dead below is authoritative
                 pass
@@ -567,7 +681,8 @@ class GcsServer:
             try:
                 await self.endpoint.acall(
                     view.addr, "node.drain",
-                    {"grace_s": float(grace), "reason": reason},
+                    {"grace_s": float(grace), "reason": reason,
+                     "node_id": node_id},
                 )
             except Exception:  # raylint: disable=RL006 -- node unreachable: the deadline fallback still fires
                 pass  # node unreachable: the deadline fallback still fires
@@ -639,6 +754,10 @@ class GcsServer:
         while True:
             await asyncio.sleep(cfg.node_heartbeat_interval_s)
             now = time.monotonic()
+            # Keep versions moving even with no active view readers (the
+            # versioned-delta contract: a change is visible within one
+            # health tick at worst).
+            self._flush_view_dirty()
             for nid, view in list(self.nodes.items()):
                 if not view.alive:
                     continue
@@ -686,6 +805,10 @@ class GcsServer:
         self.node_meta.setdefault(node_id, {})["death_reason"] = reason
         self.node_metrics.pop(node_id, None)
         self._bump_node_version(node_id)
+        # Dead nodes leave the index (re-registration re-inserts): at
+        # fleet scale churn would otherwise bloat every bucket with
+        # corpses the probe loop has to step over.
+        self.sched_index.remove(node_id)
         await self._publish(
             "nodes", {"node_id": node_id, "state": DEAD, "reason": reason}
         )
@@ -742,7 +865,15 @@ class GcsServer:
             policy=rec.spec.get("policy", "hybrid"),
         )
         self._stamp_suspects()
-        node_id = pick_node(req, "", self.nodes)
+        t0 = time.perf_counter()
+        if GLOBAL_CONFIG.sched_index:
+            node_id = self.sched_index.pick(req, "")
+        else:
+            node_id = pick_node(req, "", self.nodes)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self.place_latency_ms.append(dt_ms)
+        if metrics_enabled():
+            self._place_hist.observe(dt_ms)
         if node_id is None:
             if any_feasible(req, self.nodes):
                 if rec.actor_id not in self.pending_actors:
@@ -792,6 +923,11 @@ class GcsServer:
                 if k != "name" or v is not None
             },
             "restart_count": rec.restarts,
+            # The chosen node's id travels with the start RPC: real nodes
+            # ignore it (they ARE the target), but the fleet emulator's
+            # shared host endpoint serves node.start_actor for EVERY
+            # emulated node and routes the debit by this key.
+            "node_id": rec.node_id,
         }
 
     async def _retry_pending_actors(self):
@@ -1035,6 +1171,7 @@ class GcsServer:
         )
         meta = dict(meta)
         meta.update(_GCS_METRIC_META)
+        meta.update(_INDEX_METRIC_META)
         tags = {"process": "gcs"}
         points = list(points)
         points.extend(
@@ -1048,6 +1185,26 @@ class GcsServer:
                     "raytpu_drain_deadline_forced_total",
                     tags,
                     float(self.drain_stats["deadline_forced"]),
+                ],
+                [
+                    "raytpu_gcs_placement_latency_ms",
+                    tags,
+                    self._place_hist.as_value(),
+                ],
+                [
+                    "raytpu_gcs_view_delta_nodes",
+                    tags,
+                    self._delta_nodes_hist.as_value(),
+                ],
+                [
+                    "raytpu_gcs_heartbeat_ingest_total",
+                    tags,
+                    float(self.hb_ingest_total),
+                ],
+                [
+                    "raytpu_sched_index_fallback_scans_total",
+                    tags,
+                    float(self.sched_index.fallback_scans),
                 ],
             ]
         )
@@ -1317,6 +1474,10 @@ class GcsServer:
                     for k, v in fmt.items():
                         view.total[k] = view.total.get(k, 0.0) + v
                         view.available[k] = view.available.get(k, 0.0) + v
+            if view is not None:
+                # Bundle commits ADD resource keys (bundle_group_*): the
+                # view's shape changed, so its index bucket moves.
+                self.sched_index.upsert(view)
         if rec.state == PG_REMOVED:
             # Removed mid-commit: release everything we just placed.
             await self._release_pg_bundles(rec)
@@ -1362,6 +1523,8 @@ class GcsServer:
                 for k in fmt:
                     view.total.pop(k, None)
                     view.available.pop(k, None)
+            # Release DROPS the bundle_group_* keys: shape changed back.
+            self.sched_index.upsert(view)
         rec.bundle_nodes = [None] * len(rec.bundles)
 
     async def _h_remove_placement_group(self, conn, p):

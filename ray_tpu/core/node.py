@@ -28,6 +28,7 @@ from ray_tpu.core.errors import FaultInjectedError, SchedulingError
 from ray_tpu.core.ids import NodeID, WorkerID
 from ray_tpu.core.object_store import ShmObjectStore, default_shm_root
 from ray_tpu.core.protocol import Endpoint
+from ray_tpu.core.sched_index import FeasibilityIndex
 from ray_tpu.core.scheduler import (
     NodeView,
     SchedulerMetrics,
@@ -169,6 +170,12 @@ class NodeManager:
         self._pg_state_cache: dict[str, tuple] = {}  # pg_id -> (ts, pending)
         self.cluster_view: dict[str, NodeView] = {}
         self.view_meta: dict[str, dict] = {}
+        # Feasibility index over the gossiped view (round 19): spill /
+        # spread decisions sample a bounded candidate set instead of
+        # scanning every peer. Maintained incrementally by the delta
+        # application below (shape/label transitions only); the
+        # GLOBAL_CONFIG.sched_index kill switch gates the read path.
+        self._view_index = FeasibilityIndex(self.cluster_view)
         # Peers reported suspect by drivers whose direct RPCs to them
         # tripped a breaker (node.peer_suspect), with a TTL matching the
         # breaker's half-open window; merged with this endpoint's OWN
@@ -787,16 +794,41 @@ class NodeManager:
                 self.cluster_view = {}
                 self.view_meta = {}
             for nid, v in reply["changed"].items():
-                self.cluster_view[nid] = NodeView(
-                    node_id=nid,
-                    addr=tuple(v["addr"]),
-                    total=v["total"],
-                    available=v["available"],
-                    labels=v["labels"],
-                    alive=v["alive"],
-                    draining=v.get("draining", False),
-                )
+                cur = self.cluster_view.get(nid)
+                if cur is None:
+                    cur = NodeView(
+                        node_id=nid,
+                        addr=tuple(v["addr"]),
+                        total=v["total"],
+                        available=v["available"],
+                        labels=v["labels"],
+                        alive=v["alive"],
+                        draining=v.get("draining", False),
+                    )
+                    self.cluster_view[nid] = cur
+                else:
+                    # In-place application (round 19): mutate the existing
+                    # view instead of allocating a fresh one per changed
+                    # node per refresh. suspect resets to False exactly as
+                    # a fresh NodeView's default would — the stamper
+                    # re-derives it before any placement decision.
+                    cur.addr = tuple(v["addr"])
+                    cur.total = v["total"]
+                    cur.available = v["available"]
+                    cur.labels = v["labels"]
+                    cur.alive = v["alive"]
+                    cur.draining = v.get("draining", False)
+                    cur.suspect = False
                 self.view_meta[nid] = {"shm_root": v.get("shm_root")}
+                if not reply.get("full"):
+                    if cur.alive:
+                        self._view_index.upsert(cur)
+                    else:
+                        self._view_index.remove(nid)
+            if reply.get("full"):
+                # cluster_view was REPLACED above — rebind the index to
+                # the new dict (it indexes by reference).
+                self._view_index.reset(self.cluster_view)
             if reply["changed"] and self._pending_leases:
                 # A changed cluster (e.g. a NEW node) can unblock queued
                 # requests that were infeasible everywhere — re-evaluate
@@ -1486,10 +1518,18 @@ class NodeManager:
                     return {"spill": tuple(view.addr)}
                 # target gone or infeasible — fall through to hybrid
         if req.policy == "spread":
-            # Round-robin over all feasible nodes (including us).
+            # Round-robin over all feasible nodes (including us). The
+            # index path is bit-identical for spread (bucket filtering
+            # only drops nodes the scan rejects anyway, and the candidate
+            # order is the same sorted-by-node-id list).
             self._spread_rr += 1
-            choice = pick_node(req, self.node_id, self.cluster_view,
-                               self._spread_rr)
+            if GLOBAL_CONFIG.sched_index:
+                choice = self._view_index.pick(
+                    req, self.node_id, self._spread_rr
+                )
+            else:
+                choice = pick_node(req, self.node_id, self.cluster_view,
+                                   self._spread_rr)
             if choice is not None and choice != self.node_id:
                 return {"spill": tuple(self.cluster_view[choice].addr)}
             # fall through: grant locally (or queue) below
@@ -1594,16 +1634,25 @@ class NodeManager:
         ``require_soft``, only peers matching the soft label selector
         qualify (used to honor the preference over a local grant)."""
         self._stamp_suspects()
-        views = dict(self.cluster_view)
-        views.pop(self.node_id, None)
-        if require_soft:
-            views = {
-                nid: v
-                for nid, v in views.items()
-                if labels_match(v.labels, req.soft_label_selector)
-            }
         self._spread_rr += 1
-        choice = pick_node(req, "", views, self._spread_rr)
+        if GLOBAL_CONFIG.sched_index and not require_soft:
+            # Indexed path: exclude ourselves in place of the dict copy
+            # (the copy alone is O(peers) per spill at fleet scale).
+            choice = self._view_index.pick(
+                req, "", self._spread_rr, exclude=self.node_id
+            )
+        else:
+            # require_soft hard-filters candidates by the soft selector —
+            # a rare local-preference branch; the scan stays its engine.
+            views = dict(self.cluster_view)
+            views.pop(self.node_id, None)
+            if require_soft:
+                views = {
+                    nid: v
+                    for nid, v in views.items()
+                    if labels_match(v.labels, req.soft_label_selector)
+                }
+            choice = pick_node(req, "", views, self._spread_rr)
         if choice is not None:
             return {"spill": tuple(self.cluster_view[choice].addr)}
         return None

@@ -21,8 +21,13 @@ import ray_tpu
 from conftest import add_node_and_wait
 from ray_tpu.core import faults
 from ray_tpu.core.config import GLOBAL_CONFIG
-from ray_tpu.core.errors import DeadlineExceededError, PeerUnavailableError
+from ray_tpu.core.errors import (
+    DeadlineExceededError,
+    FaultInjectedError,
+    PeerUnavailableError,
+)
 from ray_tpu.core.faults import FaultInjector, FaultRule
+from ray_tpu.core.fleet_emu import FleetEmulator, schedule_events
 from ray_tpu.core.protocol import Endpoint
 
 _CFG_FIELDS = (
@@ -1162,3 +1167,138 @@ def test_podracer_weightsync_sever_replays_bit_identically():
         assert digests[i] == digests[i - 1]
     # A different seed is a different schedule.
     assert replay(24)[0] != applied
+
+
+# -- fleet-scale control-plane chaos (round 19) -------------------------------
+
+
+def test_fleet_preempt_wave_at_scale_replays_and_never_wedges():
+    """A seeded slice-preemption wave at 220 emulated nodes, driven
+    through the REAL gcs wire handlers: the wave drains a block of nodes
+    mid-tape, every displaced placement decision lands deterministically,
+    and the control plane never wedges — after the wave both CPU and
+    TPU-selector leases still place immediately. Two full replays from
+    the same seed make bit-identical decisions, decision-for-decision."""
+    tape = schedule_events(23, "preempt_wave", 220, 120)
+    witnesses = []
+    for _ in range(2):
+        with FleetEmulator(220, seed=23) as emu:
+            emu.register_all()
+            emu.run_schedule(tape)
+            # The wave actually retired nodes...
+            dead = [v for v in emu.gcs.nodes.values() if not v.alive]
+            assert len(dead) >= 22
+            # ...and nothing is stuck: a PENDING actor with feasible
+            # capacity on a 220-node underloaded fleet is a wedge.
+            assert not emu.gcs.pending_actors
+            # Post-wave leases still place, on every demand shape.
+            for demand, selector in (
+                ({"CPU": 1.0}, None),
+                ({"CPU": 2.0, "TPU": 4.0}, {"accelerator": "tpu-v4"}),
+            ):
+                info = emu.create_actor(demand, selector)
+                assert info["state"] == "ALIVE" and info["node_id"]
+                assert emu.gcs.nodes[info["node_id"]].alive
+            emu.gcs.sched_index.verify()
+            witnesses.append(
+                (emu.decision_digest(), emu.final_state_digest())
+            )
+    assert witnesses[0] == witnesses[1], (
+        "preemption-wave replay diverged decision-for-decision"
+    )
+
+
+def test_fleet_heartbeat_blackhole_at_scale_converges_and_replays():
+    """A heartbeat blackhole over a 30-node block (glob-matched fault
+    rule) of a 210-node emulated fleet, with the REAL health loop armed:
+    the blackholed block is declared dead by heartbeat timeout, actors
+    on it fail terminally (max_restarts=0 keeps the death wave free of
+    timing-dependent reschedules), the surviving 180 nodes keep gossiping
+    throughout, and placement still succeeds immediately afterwards. The
+    in-window death ORDER is timing-dependent, so the replay witness is
+    the order-free final actor->(state, node) fixed point."""
+    doomed_glob = "emu-000[0-2]?"  # emu-00000..emu-00029
+
+    def one_run():
+        GLOBAL_CONFIG.node_heartbeat_interval_s = 0.05
+        GLOBAL_CONFIG.node_death_timeout_s = 0.8
+        emu = FleetEmulator(210, seed=21)
+        emu.start(park_health_loop=False)  # health loop races for real
+        try:
+            doomed = [f"emu-{i:05d}" for i in range(30)]
+
+            def sweep():
+                """One gossip round from every live node; blackholed
+                beats surface the injected fault to the sender."""
+                for e in emu.emu_nodes.values():
+                    if not e.alive:
+                        continue
+                    try:
+                        emu.heartbeat(e)
+                    except FaultInjectedError:
+                        pass
+
+            emu.register_all()
+            sweep()
+            # Pre-partition load: deterministic sequential placements,
+            # some of which land inside the doomed block.
+            for i in range(40):
+                info = emu.create_actor({"CPU": 2.0}, max_restarts=0)
+                assert info["state"] == "ALIVE"
+                if i % 10 == 9:
+                    sweep()
+            assert not emu.gcs.pending_actors
+            on_doomed = {
+                aid
+                for aid, rec in emu.gcs.actors.items()
+                if rec.node_id in set(doomed)
+            }
+
+            faults.install(
+                FaultInjector(
+                    21,
+                    [FaultRule(site="gcs", action="heartbeat_blackhole",
+                               match=doomed_glob)],
+                )
+            )
+            # Keep the survivors beating until the health loop declares
+            # the whole blackholed block dead (each sweep ~one tick).
+            deadline = time.monotonic() + 30.0
+            while any(
+                nid in emu.gcs.nodes and emu.gcs.nodes[nid].alive
+                for nid in doomed
+            ):
+                assert time.monotonic() < deadline, (
+                    "blackholed nodes never declared dead"
+                )
+                sweep()
+                time.sleep(0.02)
+            faults.clear()
+
+            assert on_doomed, "pre-phase placed nothing on the doomed block"
+            for aid in on_doomed:
+                assert emu.gcs.actors[aid].state == "DEAD"
+            # Survivors never paid for the partition...
+            for nid, view in emu.gcs.nodes.items():
+                if nid not in set(doomed):
+                    assert view.alive, f"survivor {nid} wrongly killed"
+            # ...and the index evicted the corpses coherently.
+            emu.gcs.sched_index.verify()
+
+            # Post-partition: placement proceeds immediately, never on a
+            # dead node, and nothing wedges.
+            for _ in range(20):
+                info = emu.create_actor({"CPU": 1.0}, max_restarts=0)
+                assert info["state"] == "ALIVE"
+                assert info["node_id"] not in set(doomed)
+                sweep()
+            assert not emu.gcs.pending_actors
+            return emu.final_state_digest()
+        finally:
+            faults.clear()
+            emu.stop()
+
+    assert one_run() == one_run(), (
+        "blackhole run diverged: the post-death fixed point must be a "
+        "pure function of the seed"
+    )

@@ -1,0 +1,475 @@
+"""Fleet emulation harness + feasibility-indexed scheduler (round 19).
+
+Covers the three layers the fleet-scale tier added:
+
+- the seeded schedule generator and the emulator contract (emulated nodes
+  drive the REAL gcs.* wire handlers; ledger conservation; bit-identical
+  replay from the seed);
+- the scheduler index itself: pick equivalence against the ``pick_node``
+  scan under a randomized lease stream, and coherence across
+  subtract/add/drain/node-death transitions;
+- the ``RAY_TPU_SCHED_INDEX=0`` kill switch: one flag routes every
+  decision through the original scan path (the index is never consulted)
+  and the scan arm's decision sequence is stable run-to-run.
+"""
+
+from random import Random
+
+import pytest
+
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.core.fleet_emu import (
+    EmulatedNode,
+    FleetEmulator,
+    fleet_digest,
+    node_specs,
+    schedule_events,
+)
+from ray_tpu.core.sched_index import FeasibilityIndex
+from ray_tpu.core.scheduler import (
+    NodeView,
+    SchedulingRequest,
+    labels_match,
+    fits,
+    pick_node,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fleet_hygiene():
+    """Every test leaves the process-global scheduler knobs clean."""
+    saved = {
+        f: getattr(GLOBAL_CONFIG, f)
+        for f in (
+            "sched_index",
+            "sched_index_probes",
+            "node_heartbeat_interval_s",
+            "node_death_timeout_s",
+        )
+    }
+    yield
+    for f, v in saved.items():
+        setattr(GLOBAL_CONFIG, f, v)
+
+
+# -- seeded schedules ---------------------------------------------------------
+
+
+def test_schedule_digest_stable_and_seed_sensitive():
+    a = schedule_events(7, "churn", 100, 200)
+    b = schedule_events(7, "churn", 100, 200)
+    assert a == b
+    assert fleet_digest(a) == fleet_digest(b)
+    assert fleet_digest(a) != fleet_digest(schedule_events(8, "churn", 100, 200))
+    assert fleet_digest(a) != fleet_digest(
+        schedule_events(7, "steady", 100, 200)
+    )
+    # The wave op lands exactly once, mid-tape, in the preempt scenario.
+    wave = schedule_events(3, "preempt_wave", 100, 120)
+    waves = [op for op in wave if op[0] == "wave"]
+    assert len(waves) == 1
+    assert waves[0][2] == 10  # wave_fraction=0.1 of 100 nodes
+
+
+def test_node_specs_deterministic_shape_mix():
+    specs = node_specs(100)
+    assert len(specs) == 100
+    assert specs == node_specs(100)
+    cpu_only = [s for s in specs if "TPU" not in s[1]]
+    heads = [s for s in specs if s[2].get("pool") == "head"]
+    assert len(cpu_only) == 70
+    assert len(heads) == 10
+    # Slice labels fan the head population across 8 label buckets.
+    assert {s[2]["slice"] for s in heads} <= {f"slice-{i}" for i in range(8)}
+
+
+# -- the emulator contract ----------------------------------------------------
+
+
+def test_emulator_drives_real_gcs_and_conserves_resources():
+    """Emulated nodes register/heartbeat/place through the real GCS wire
+    handlers; the node-side availability ledger and the GCS view agree
+    after every gossip round; kill credits back what start debited."""
+    tape = schedule_events(5, "steady", 30, 60)
+    with FleetEmulator(30, seed=5) as emu:
+        emu.register_all()
+        gcs = emu.gcs
+        assert len(gcs.nodes) == 30
+        assert all(v.alive for v in gcs.nodes.values())
+
+        emu.run_schedule(tape)
+        placed = [d for d in emu.decision_log if d[2] == "ALIVE"]
+        assert placed, "the tape placed actors"
+        # Every ALIVE actor's demand is debited on ITS emulated node.
+        emu.heartbeat_dirty()
+        for nid, emu_node in emu.emu_nodes.items():
+            view = gcs.nodes[nid]
+            assert view.available == emu_node.available, (
+                f"view/ledger drift on {nid}"
+            )
+            used = {}
+            for rec in gcs.actors.values():
+                if rec.state == "ALIVE" and rec.node_id == nid:
+                    for k, v in rec.spec["resources"].items():
+                        used[k] = used.get(k, 0.0) + v
+            for k, total in emu_node.total.items():
+                assert emu_node.available.get(k, 0.0) == pytest.approx(
+                    total - used.get(k, 0.0)
+                ), f"ledger leak on {nid}:{k}"
+
+        # Kill every live actor: the fleet returns to a full ledger.
+        for aid in list(emu._live_actors):
+            emu.kill_actor(aid)
+        for emu_node in emu.emu_nodes.values():
+            assert emu_node.available == emu_node.total
+
+
+def test_emulator_replay_bit_identical_from_seed():
+    results = []
+    tape = schedule_events(11, "churn", 40, 80)
+    for _ in range(2):
+        with FleetEmulator(40, seed=11) as emu:
+            emu.register_all()
+            emu.run_schedule(tape)
+            results.append(
+                (emu.decision_digest(), emu.final_state_digest(),
+                 len(emu.decision_log))
+            )
+    assert results[0] == results[1]
+    # A different seed is a different run.
+    other = schedule_events(12, "churn", 40, 80)
+    with FleetEmulator(40, seed=12) as emu:
+        emu.register_all()
+        emu.run_schedule(other)
+        assert emu.decision_digest() != results[0][0]
+
+
+# -- index/scan equivalence ---------------------------------------------------
+
+_RES_KEYS = ("CPU", "TPU", "mem", "TPU-v5e-8-head")
+_LABEL_SETS = (
+    {},
+    {"pool": "cpu"},
+    {"pool": "mixed", "accelerator": "tpu-v4"},
+    {"pool": "head", "slice": "slice-0"},
+    {"pool": "head", "slice": "slice-1"},
+)
+
+
+def _random_views(rng: Random, n: int) -> dict:
+    views = {}
+    for i in range(n):
+        keys = rng.sample(_RES_KEYS, rng.randint(1, 3))
+        total = {k: float(rng.randint(1, 16)) for k in keys}
+        avail = {k: rng.uniform(0.0, v) for k, v in total.items()}
+        views[f"n{i:03d}"] = NodeView(
+            node_id=f"n{i:03d}",
+            addr=("127.0.0.1", 1000 + i),
+            total=total,
+            available=avail,
+            labels=dict(rng.choice(_LABEL_SETS)),
+            alive=rng.random() > 0.1,
+            suspect=rng.random() < 0.05,
+            draining=rng.random() < 0.05,
+        )
+    return views
+
+
+def _random_request(rng: Random, views: dict) -> SchedulingRequest:
+    demand = {
+        k: float(rng.randint(1, 4))
+        for k in rng.sample(_RES_KEYS, rng.randint(1, 2))
+    }
+    selector = {}
+    if rng.random() < 0.3:
+        selector = dict(rng.choice(_LABEL_SETS[1:]))
+    soft = {}
+    if rng.random() < 0.2:
+        soft = dict(rng.choice(_LABEL_SETS[1:]))
+    policy = "hybrid"
+    r = rng.random()
+    if r < 0.2:
+        policy = "spread"
+    elif r < 0.3:
+        kind = "strict_node_affinity" if rng.random() < 0.5 else "node_affinity"
+        policy = f"{kind}:{rng.choice(list(views))}"
+    return SchedulingRequest(
+        resources=demand,
+        label_selector=selector,
+        soft_label_selector=soft,
+        policy=policy,
+    )
+
+
+def _scan_candidates(req: SchedulingRequest, views: dict) -> list:
+    return [
+        v
+        for v in views.values()
+        if v.alive
+        and not v.suspect
+        and not v.draining
+        and labels_match(v.labels, req.label_selector)
+        and fits(v.available, req.resources)
+    ]
+
+
+def _headroom(v: NodeView, req: SchedulingRequest) -> float:
+    return sum(
+        v.available.get(k, 0.0) - d for k, d in req.resources.items()
+    ) + sum(v.available.values()) * 1e-3
+
+
+def test_index_scan_pick_equivalence_random_stream():
+    """Property test over a randomized lease stream: for every request,
+
+    - the index returns None exactly when the scan returns None;
+    - spread picks are BIT-IDENTICAL to the scan (same sorted candidate
+      list, same rr index);
+    - strict/soft affinity heads agree exactly;
+    - a full-quota index pick (probes >= fleet) matches the scan's
+      headroom optimum; a bounded pick (probes=4) is always a node the
+      scan considers a valid candidate.
+    """
+    rng = Random("fleet-equiv-19")
+    for round_i in range(8):
+        views = _random_views(rng, rng.randint(5, 48))
+        full = FeasibilityIndex(views, probes=len(views) + 1)
+        bounded = FeasibilityIndex(views, probes=4)
+        for v in views.values():
+            # The GCS indexes on registration; dead views stay out, the
+            # way _mark_node_dead keeps the buckets corpse-free.
+            if not v.alive:
+                full.remove(v.node_id)
+                bounded.remove(v.node_id)
+        for op in range(60):
+            req = _random_request(rng, views)
+            rr = rng.randrange(1 << 10)
+            scan = pick_node(req, "", views, rr)
+            got_full = full.pick(req, "", rr)
+            got_bounded = bounded.pick(req, "", rr)
+            assert (scan is None) == (got_full is None), (
+                f"None-ness drift (full): {req} scan={scan} idx={got_full}"
+            )
+            assert (scan is None) == (got_bounded is None), (
+                f"None-ness drift (bounded): {req} scan={scan} "
+                f"idx={got_bounded}"
+            )
+            if scan is None:
+                continue
+            if req.policy == "spread" or req.policy.startswith("strict"):
+                assert got_full == scan
+                assert got_bounded == scan
+            else:
+                # Hybrid ties can break differently (dict order vs bucket
+                # order); the INVARIANT is the score, not the id.
+                assert _headroom(views[got_full], req) == pytest.approx(
+                    _headroom(views[scan], req)
+                )
+                cands = {v.node_id for v in _scan_candidates(req, views)}
+                affinity_target = None
+                if req.policy.startswith("node_affinity:"):
+                    affinity_target = req.policy.split(":", 1)[1]
+                assert got_bounded in cands or got_bounded == affinity_target
+            # Mutate availability (the heartbeat hot path): NO index
+            # maintenance required — values are read through the views.
+            victim = views[rng.choice(list(views))]
+            for k in list(victim.available):
+                victim.available[k] = max(
+                    0.0, victim.available[k] + rng.uniform(-2.0, 2.0)
+                )
+        full.verify()
+        bounded.verify()
+
+
+def test_index_local_first_and_soft_preference_match_scan():
+    """The hybrid local-first check and the soft-selector interplay are
+    order-sensitive (local wins only if it survives the soft filter) —
+    pin them against the scan on a crafted fleet."""
+    views = {
+        "a": NodeView("a", ("h", 1), {"CPU": 8.0}, {"CPU": 8.0},
+                      {"pool": "cpu"}),
+        "b": NodeView("b", ("h", 2), {"CPU": 8.0}, {"CPU": 2.0},
+                      {"pool": "mixed"}),
+        "c": NodeView("c", ("h", 3), {"CPU": 8.0}, {"CPU": 7.0},
+                      {"pool": "mixed"}),
+    }
+    idx = FeasibilityIndex(views, probes=8)
+    # Local node wins while it fits...
+    req = SchedulingRequest(resources={"CPU": 1.0})
+    assert pick_node(req, "b", views) == "b" == idx.pick(req, "b")
+    # ...but NOT when the soft selector prefers others (scan semantics:
+    # the local check runs on the post-filter candidate list).
+    req = SchedulingRequest(
+        resources={"CPU": 1.0}, soft_label_selector={"pool": "cpu"}
+    )
+    assert pick_node(req, "b", views) == "a" == idx.pick(req, "b")
+    # Soft selector with no fitting match falls back to all candidates.
+    req = SchedulingRequest(
+        resources={"CPU": 1.0}, soft_label_selector={"pool": "nope"}
+    )
+    assert pick_node(req, "b", views) == "b" == idx.pick(req, "b")
+
+
+def test_index_coherent_under_subtract_add_drain_death():
+    """The four shape/label transitions the GCS drives through the index:
+    value-only subtract/add (no-op upsert), resource-KEY addition (PG
+    bundle commit: bucket move), drain (read-time filter, no bucket
+    move), and death (eviction) — ``verify()`` holds throughout and picks
+    track the transitions."""
+    views = {
+        s[0]: NodeView(s[0], ("h", i), dict(s[1]), dict(s[1]), dict(s[2]))
+        for i, s in enumerate(node_specs(20))
+    }
+    idx = FeasibilityIndex(views, probes=4)
+    idx.verify()
+    req_cpu = SchedulingRequest(resources={"CPU": 2.0})
+
+    # subtract/add: availability values move, bucket key unchanged.
+    nid = idx.pick(req_cpu, "")
+    assert nid is not None
+    views[nid].available["CPU"] -= 2.0
+    idx.upsert(views[nid])  # the heartbeat-path call — must no-op
+    idx.verify()
+
+    # PG bundle commit adds a NEW resource key => bucket move.
+    pg_node = "emu-00003"
+    views[pg_node].total["bundle_group_0_pg1"] = 1.0
+    views[pg_node].available["bundle_group_0_pg1"] = 1.0
+    idx.upsert(views[pg_node])
+    idx.verify()
+    req_bundle = SchedulingRequest(resources={"bundle_group_0_pg1": 1.0})
+    assert idx.pick(req_bundle, "") == pg_node
+    assert pick_node(req_bundle, "", views) == pg_node
+    # ...and the release moves it back.
+    views[pg_node].total.pop("bundle_group_0_pg1")
+    views[pg_node].available.pop("bundle_group_0_pg1")
+    idx.upsert(views[pg_node])
+    idx.verify()
+    assert idx.pick(req_bundle, "") is None
+
+    # Drain: stays indexed (it may resume), filtered at probe time.
+    for v in views.values():
+        if v.labels.get("pool") != "head":
+            v.draining = True
+    req_tpu = SchedulingRequest(resources={"TPU": 1.0})
+    got = idx.pick(req_tpu, "")
+    assert got is not None and views[got].labels["pool"] == "head"
+    assert pick_node(req_tpu, "", views) is not None
+    for v in views.values():
+        v.draining = False
+
+    # Death: evicted; None exactly like the scan once every TPU node dies.
+    for v in views.values():
+        if "TPU" in v.total:
+            v.alive = False
+            idx.remove(v.node_id)
+    idx.verify()
+    assert idx.pick(req_tpu, "") is None
+    assert pick_node(req_tpu, "", views) is None
+    # Re-registration re-inserts (the _h_register_node path).
+    back = next(v for v in views.values() if "TPU" in v.total)
+    back.alive = True
+    back.available = dict(back.total)
+    idx.upsert(back)
+    idx.verify()
+    assert idx.pick(req_tpu, "") == back.node_id
+
+
+def test_index_spread_is_bit_identical_over_rr_sweep():
+    views = {
+        s[0]: NodeView(s[0], ("h", i), dict(s[1]), dict(s[1]), dict(s[2]))
+        for i, s in enumerate(node_specs(30))
+    }
+    idx = FeasibilityIndex(views, probes=2)
+    req = SchedulingRequest(resources={"CPU": 1.0}, policy="spread")
+    for rr in range(75):
+        assert idx.pick(req, "", rr) == pick_node(req, "", views, rr)
+
+
+# -- kill switch --------------------------------------------------------------
+
+
+def test_sched_index_kill_switch_routes_to_scan(monkeypatch):
+    """RAY_TPU_SCHED_INDEX=0 e2e: with the one flag off, the index is
+    NEVER consulted for a placement decision (its pick is poisoned here)
+    and the whole emulated run still completes — every decision took the
+    original full-scan path."""
+    GLOBAL_CONFIG.sched_index = False
+
+    def _boom(self, *a, **kw):  # pragma: no cover - must never run
+        raise AssertionError("index consulted with the kill switch off")
+
+    monkeypatch.setattr(FeasibilityIndex, "pick", _boom)
+    tape = schedule_events(3, "steady", 25, 50)
+    with FleetEmulator(25, seed=3) as emu:
+        emu.register_all()
+        emu.run_schedule(tape)
+        placed = [d for d in emu.decision_log if d[2] == "ALIVE"]
+        assert placed, "scan-path run placed actors"
+
+
+def test_sched_index_kill_switch_decisions_stable():
+    """The scan arm (the pre-round-19 scheduler, byte-identical code
+    path) replays decision-for-decision from a fixed seed — the
+    acceptance witness tools/ab_fleet.py automates."""
+    GLOBAL_CONFIG.sched_index = False
+    tape = schedule_events(13, "steady", 25, 50)
+    digests = set()
+    for _ in range(2):
+        with FleetEmulator(25, seed=13) as emu:
+            emu.register_all()
+            emu.run_schedule(tape)
+            digests.add((emu.decision_digest(), emu.final_state_digest()))
+    assert len(digests) == 1
+
+
+def test_sched_index_flag_flips_at_runtime():
+    """The flag gates the READ path only — the index is maintained
+    unconditionally, so flipping mid-run is safe in both directions."""
+    tape = schedule_events(9, "steady", 25, 60)
+    half = len(tape) // 2
+    with FleetEmulator(25, seed=9) as emu:
+        emu.register_all()
+        GLOBAL_CONFIG.sched_index = False
+        emu.run_schedule(tape[:half])
+        GLOBAL_CONFIG.sched_index = True
+        emu.run_schedule(tape[half:])
+        emu.gcs.sched_index.verify()
+        placed = [d for d in emu.decision_log if d[2] == "ALIVE"]
+        assert placed
+
+
+# -- gcs integration details --------------------------------------------------
+
+
+def test_coalesced_heartbeats_one_delta_generation():
+    """N heartbeats landing between two view reads produce ONE version
+    bump and one delta generation carrying all N nodes — not N."""
+    with FleetEmulator(20, seed=1) as emu:
+        emu.register_all()
+        v0 = emu.delta_probe(-1)["version"]
+        # Dirty 12 nodes without any interleaved view read.
+        touched = 0
+        for e in list(emu.emu_nodes.values())[:12]:
+            e.available = dict(e.available)
+            e.available["CPU"] = e.available.get("CPU", 16.0) - 1.0
+            emu.heartbeat(e)
+            touched += 1
+        probe = emu.delta_probe(v0)
+        assert probe["version"] == v0 + 1, "coalesced: one generation"
+        assert probe["changed"] == touched
+        # And the cursor is now current: the next delta is empty.
+        assert emu.delta_probe(probe["version"])["changed"] == 0
+
+
+def test_placement_latency_recorded_per_decision():
+    with FleetEmulator(20, seed=2) as emu:
+        emu.register_all()
+        for _ in range(5):
+            emu.create_actor({"CPU": 1.0})
+        assert len(emu.place_latencies_ms()) == 5
+        assert all(x >= 0.0 for x in emu.place_latencies_ms())
+        assert emu.gcs.hb_ingest_total == 0
+        live = next(iter(emu.emu_nodes.values()))
+        emu.heartbeat(live)
+        assert emu.gcs.hb_ingest_total == 1

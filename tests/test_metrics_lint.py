@@ -240,3 +240,22 @@ def test_podracer_rl_series_registered_and_linted():
     assert catalog["raytpu_rl_replay_occupancy"]["kind"] == "gauge"
     assert catalog["raytpu_rl_replay_occupancy"]["tag_keys"] == ("plane",)
     assert lint_catalog(catalog) == []
+
+
+def test_fleet_scale_series_registered_and_linted():
+    """The fleet-scale control-plane telemetry (round 19: exact placement
+    pick latency, view-delta fan-out size, heartbeat ingest counter, and
+    the scheduler-index degenerate-probe counter) is declared through the
+    catalog so the lint covers it."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    for name, kind in (
+        ("raytpu_gcs_placement_latency_ms", "histogram"),
+        ("raytpu_gcs_view_delta_nodes", "histogram"),
+        ("raytpu_gcs_heartbeat_ingest_total", "counter"),
+        ("raytpu_sched_index_fallback_scans_total", "counter"),
+    ):
+        assert name in catalog, f"{name} missing from the runtime catalog"
+        assert catalog[name]["kind"] == kind
+        assert catalog[name]["tag_keys"] == ()
+    assert lint_catalog(catalog) == []

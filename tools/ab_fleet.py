@@ -1,0 +1,112 @@
+"""Same-session A/B of the feasibility-indexed scheduler at fleet scale
+(PERF.md round 19).
+
+Runs ``tools/ray_perf.py --fleet-only`` alternately with the index ON
+(HEAD defaults) and OFF (``--no-sched-index``: every placement decision
+takes the original full-scan ``pick_node`` path, byte-identical to the
+pre-round-19 scheduler) on the SAME commit, interleaved so ambient box
+load hits both arms equally (the round-3 lesson). Both arms replay the
+SAME seeded lease schedule against the in-process fleet emulator at
+100/500/1,000 emulated nodes. Watch:
+
+    fleet_place_p99_ms_1000   THE acceptance row — the index arm must be
+                              >=2x better than the scan arm at 1,000 nodes
+    fleet_place_p50_ms_*      scan grows linearly with fleet size; the
+                              index stays flat (bounded probe quota)
+    fleet_decision_digest_*   per-arm determinism witness: each arm's
+                              digest must be identical across rounds (the
+                              kill-switch arm's digest IS the pre-change
+                              decision sequence). The arms legitimately
+                              DIFFER from each other: hybrid picks max
+                              headroom over a bounded sample, not over
+                              every view.
+
+    python tools/ab_fleet.py [--rounds 3] [--full]
+
+bench.py records the same pair per round as the ``fleet_scale`` BENCH
+record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import run_once  # noqa: E402 — shared machinery
+
+SCALES = (100, 500, 1000)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument(
+        "--full", action="store_true", help="full (not --quick) perf runs"
+    )
+    args = ap.parse_args()
+
+    on_runs: list = []
+    off_runs: list = []
+    for i in range(args.rounds):
+        order = [
+            (("--fleet-only",), on_runs, "on "),
+            (("--fleet-only", "--no-sched-index"), off_runs, "off"),
+        ]
+        if i % 2:
+            order.reverse()
+        for flags, sink, arm in order:
+            print(f"[round {i}] fleet {arm} ...", flush=True)
+            sink.append(run_once(quick=not args.full, extra_flags=flags))
+
+    summary: dict = {}
+    print(f"\n{'metric':<32} {'index':>12} {'scan':>12} {'scan/index':>11}")
+    for n in SCALES:
+        for q in ("p50", "p99"):
+            k = f"fleet_place_{q}_ms_{n}"
+            on_med = statistics.median(r[k] for r in on_runs)
+            off_med = statistics.median(r[k] for r in off_runs)
+            # scan/index: >1 means the index is faster; the acceptance
+            # bar is >=2.0 on fleet_place_p99_ms_1000.
+            ratio = off_med / on_med if on_med else float("inf")
+            summary[k] = {
+                "index": on_med, "scan": off_med, "ratio": round(ratio, 2),
+            }
+            print(f"{k:<32} {on_med:>12.4f} {off_med:>12.4f} {ratio:>11.2f}")
+    for k in ("fleet_hb_ingest_us", "fleet_delta_bytes_per_node"):
+        on_med = statistics.median(r[k] for r in on_runs)
+        off_med = statistics.median(r[k] for r in off_runs)
+        summary[k] = {"index": on_med, "scan": off_med}
+        print(f"{k:<32} {on_med:>12.1f} {off_med:>12.1f}")
+
+    # Determinism witness: each arm must replay decision-for-decision
+    # across rounds; the scan arm's digest is the pre-change sequence.
+    for n in SCALES:
+        k = f"fleet_decision_digest_{n}"
+        for arm, runs in (("index", on_runs), ("scan", off_runs)):
+            digests = {r[k] for r in runs}
+            stable = len(digests) == 1
+            summary[f"{k}_{arm}_stable"] = stable
+            print(
+                f"{k} [{arm}]: {sorted(digests)} "
+                f"({'stable' if stable else 'NON-DETERMINISTIC'})"
+            )
+            if not stable:
+                print("FAIL: decision replay diverged across rounds")
+                print(json.dumps(summary), flush=True)
+                return 1
+    bar = summary["fleet_place_p99_ms_1000"]["ratio"]
+    print(
+        f"\nacceptance: p99@1000 scan/index = {bar:.2f}x "
+        f"({'PASS' if bar >= 2.0 else 'FAIL'} against the >=2x bar)"
+    )
+    print(json.dumps(summary), flush=True)
+    return 0 if bar >= 2.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

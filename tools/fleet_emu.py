@@ -1,0 +1,108 @@
+"""Fleet emulation CLI — profile the control plane at N emulated nodes.
+
+Front-end for ``ray_tpu.core.fleet_emu``: spins up an in-process GCS,
+registers ``--nodes`` emulated nodes behind one shared host endpoint,
+replays the seeded ``--scenario`` tape through the REAL gcs.* wire
+handlers, and prints one JSON summary line — placement p50/p99 (exact
+per-pick latency, read off ``gcs.place_latency_ms``), heartbeat RPC
+µs/msg, view-delta bytes per changed node, and the run's decision digest
+(the bit-identity witness: same seed => same digest, every time, on any
+machine).
+
+    python tools/fleet_emu.py [--nodes 1000] [--seed 19] [--ops 400]
+                              [--scenario steady|churn|preempt_wave]
+                              [--no-sched-index] [--quick]
+
+``--no-sched-index`` routes every pick through the original full-scan
+``pick_node`` (equivalent to RAY_TPU_SCHED_INDEX=0) — diffing the two
+digests shows WHERE the bounded-sample hybrid diverges from the scan,
+and tools/ab_fleet.py turns the latency pair into the round-19 record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ray_tpu.core.config import GLOBAL_CONFIG  # noqa: E402
+from ray_tpu.core.fleet_emu import (  # noqa: E402
+    FleetEmulator,
+    fleet_digest,
+    schedule_events,
+)
+
+
+def _pctl(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=0,
+                    help="fleet size (default: RAY_TPU_FLEET_EMU_NODES)")
+    ap.add_argument("--seed", type=int, default=19)
+    ap.add_argument("--ops", type=int, default=0,
+                    help="schedule length (default: "
+                    "RAY_TPU_FLEET_EMU_LEASE_OPS)")
+    ap.add_argument("--scenario", default="steady",
+                    choices=("steady", "churn", "preempt_wave"))
+    ap.add_argument("--no-sched-index", action="store_true",
+                    help="kill switch: full-scan pick_node on every "
+                    "decision (RAY_TPU_SCHED_INDEX=0)")
+    ap.add_argument("--quick", action="store_true",
+                    help="cap the tape at 150 ops")
+    args = ap.parse_args()
+
+    nodes = args.nodes or GLOBAL_CONFIG.fleet_emu_nodes
+    ops = args.ops or GLOBAL_CONFIG.fleet_emu_lease_ops
+    if args.quick:
+        ops = min(ops, 150)
+    if args.no_sched_index:
+        GLOBAL_CONFIG.sched_index = False
+
+    tape = schedule_events(args.seed, args.scenario, nodes, ops)
+    with FleetEmulator(nodes, seed=args.seed) as emu:
+        emu.register_all()
+        emu.run_schedule(tape)
+        lat = sorted(emu.place_latencies_ms())
+        cursor = emu.delta_probe(-1)["version"]
+        hb_us = emu.heartbeat_burst_us(200)
+        live = [e for e in emu.emu_nodes.values() if e.alive]
+        for e in live[: max(1, len(live) // 20)]:
+            e.available = dict(e.available)
+            e.available["CPU"] = max(0.0, e.available.get("CPU", 0.0) - 0.5)
+            emu.heartbeat(e)
+        probe = emu.delta_probe(cursor)
+        result = {
+            "scenario": args.scenario,
+            "nodes": nodes,
+            "ops": ops,
+            "seed": args.seed,
+            "sched_index": GLOBAL_CONFIG.sched_index,
+            "schedule_digest": fleet_digest(tape),
+            "decision_digest": emu.decision_digest(),
+            "final_state_digest": emu.final_state_digest(),
+            "decisions": len(emu.decision_log),
+            "fleet_place_p50_ms": round(_pctl(lat, 0.50), 4),
+            "fleet_place_p99_ms": round(_pctl(lat, 0.99), 4),
+            "fleet_hb_ingest_us": round(hb_us, 1),
+            "fleet_delta_bytes_per_node": round(
+                probe["bytes"] / max(1, probe["changed"]), 1
+            ),
+            "fleet_delta_nodes": probe["changed"],
+            "sched_index_fallback_scans": emu.gcs.sched_index.fallback_scans,
+        }
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

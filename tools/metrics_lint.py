@@ -36,6 +36,7 @@ _CATALOG_MODULES = [
     "ray_tpu.core.scheduler",
     "ray_tpu.core.node",
     "ray_tpu.core.gcs",  # drain lifecycle counters
+    "ray_tpu.core.sched_index",  # feasibility-index fallback counter (r19)
     "ray_tpu.serve.router",
     "ray_tpu.serve.replica",
     "ray_tpu.serve.admission",  # overload-plane series (429 tier)

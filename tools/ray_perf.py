@@ -941,6 +941,66 @@ def _data_rows(results: dict, quick: bool) -> None:
     )
 
 
+def _pctl_ms(sorted_ms: list, q: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    return round(sorted_ms[min(len(sorted_ms) - 1, int(q * len(sorted_ms)))], 4)
+
+
+def _fleet_rows(results: dict, quick: bool) -> None:
+    """Fleet-scale control-plane rows (round-19): the in-process fleet
+    emulator (core/fleet_emu.py) drives the REAL GCS wire handlers at
+    100/500/1,000 emulated nodes from one seeded lease schedule and
+    reports exact per-pick placement latency (read off
+    ``gcs.place_latency_ms`` — no RPC overhead in the number), heartbeat
+    RPC cost, and view-delta wire size per changed node. No cluster
+    runtime: the GCS + one shared host endpoint is the whole process
+    tree. The ``--no-sched-index`` arm re-runs the SAME tape through the
+    original full-scan ``pick_node`` (tools/ab_fleet.py and bench.py's
+    fleet_scale record ride this pair)."""
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.core.fleet_emu import FleetEmulator, schedule_events
+
+    ops = 150 if quick else GLOBAL_CONFIG.fleet_emu_lease_ops
+    seed = 19
+    arm = "index" if GLOBAL_CONFIG.sched_index else "scan"
+    for n in (100, 500, 1000):
+        tape = schedule_events(seed, "steady", n, ops)
+        with FleetEmulator(n, seed=seed) as emu:
+            emu.register_all()
+            # Registration pre-populates the latency deque with nothing
+            # (no picks yet); every sample below is a real placement.
+            emu.run_schedule(tape)
+            lat = sorted(emu.place_latencies_ms())
+            results[f"fleet_place_p50_ms_{n}"] = _pctl_ms(lat, 0.50)
+            results[f"fleet_place_p99_ms_{n}"] = _pctl_ms(lat, 0.99)
+            results[f"fleet_decision_digest_{n}"] = emu.decision_digest()
+            if n == 1000:
+                results["fleet_hb_ingest_us"] = round(
+                    emu.heartbeat_burst_us(200 if quick else 500), 1
+                )
+                cursor = emu.delta_probe(-1)["version"]
+                live = [e for e in emu.emu_nodes.values() if e.alive]
+                for e in live[:50]:
+                    e.available = dict(e.available)
+                    e.available["CPU"] = max(
+                        0.0, e.available.get("CPU", 0.0) - 0.5
+                    )
+                    emu.heartbeat(e)
+                probe = emu.delta_probe(cursor)
+                results["fleet_delta_bytes_per_node"] = round(
+                    probe["bytes"] / max(1, probe["changed"]), 1
+                )
+                results["fleet_delta_nodes"] = probe["changed"]
+            print(
+                f"fleet_scale [{arm}] {n} nodes: place p50 "
+                f"{results[f'fleet_place_p50_ms_{n}']} ms, p99 "
+                f"{results[f'fleet_place_p99_ms_{n}']} ms "
+                f"({len(lat)} picks)",
+                flush=True,
+            )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
@@ -1085,6 +1145,24 @@ def main() -> int:
         "arm spills where the governed arm stays under the watermark",
     )
     ap.add_argument(
+        "--fleet-only",
+        action="store_true",
+        help="run only the fleet-scale control-plane rows (in-process "
+        "fleet emulator at 100/500/1,000 emulated nodes driving the real "
+        "GCS handlers, no cluster runtime): placement p50/p99 per scale, "
+        "heartbeat RPC µs/msg, view-delta bytes/node — the round-19 "
+        "scheduler-index A/B rides this via tools/ab_fleet.py and "
+        "bench.py's fleet_scale record",
+    )
+    ap.add_argument(
+        "--no-sched-index",
+        action="store_true",
+        help="kill switch: every placement decision takes the original "
+        "full-scan pick_node path (equivalent to RAY_TPU_SCHED_INDEX=0) "
+        "— the A/B baseline for the round-19 feasibility-indexed "
+        "scheduler",
+    )
+    ap.add_argument(
         "--faults",
         metavar="SEED:SPEC",
         help="enable the fault-injection plane for the whole run "
@@ -1129,6 +1207,7 @@ def main() -> int:
         or args.no_spec_decode
         or args.no_podracer
         or args.no_data_governor
+        or args.no_sched_index
     ):
         from ray_tpu.core.config import GLOBAL_CONFIG
 
@@ -1155,6 +1234,17 @@ def main() -> int:
             GLOBAL_CONFIG.podracer = False
         if args.no_data_governor:
             GLOBAL_CONFIG.data_governor = False
+        if args.no_sched_index:
+            GLOBAL_CONFIG.sched_index = False
+
+    if args.fleet_only:
+        # In-process emulator rows: no cluster runtime at all (the GCS +
+        # one shared host endpoint IS the process tree).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        results = {}
+        _fleet_rows(results, quick=args.quick)
+        print(json.dumps(results), flush=True)
+        return 0
 
     if args.data_only:
         # The store must be capped BEFORE init (capacity is fixed at
