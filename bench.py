@@ -495,6 +495,26 @@ def _serve_overload_rows() -> dict:
     return out
 
 
+def _obs_overhead_rows() -> dict:
+    """Observability-plane overhead A/B (round-20): the serve p99 probe
+    (seeded flash crowd, admission ON) with the flight recorder ON (HEAD
+    default: every hop records a ring event) vs OFF
+    (``--no-flightrec``, the RAY_TPU_FLIGHTREC=0 kill switch). The
+    acceptance bar is ON p99 within ~3% of OFF."""
+    out = _ab_rows(
+        "obs_overhead", ("--serve-overload",), ("--no-flightrec",), 420
+    )
+    if "on" in out and "off" in out:
+        on_p99 = out["on"].get("serve_overload_admitted_p99_ttft_ms", 0)
+        off_p99 = out["off"].get("serve_overload_admitted_p99_ttft_ms", 0)
+        if off_p99:
+            # The recorder's tax on the interactive tail; <=3% is green.
+            out["p99_overhead_pct"] = round(
+                (on_p99 / off_p99 - 1.0) * 100.0, 2
+            )
+    return out
+
+
 def _train_overlap_rows() -> dict:
     """Host-free train-step A/B (round-13): steps/s + host-blocked ms per
     step with async dispatch + device prefetch ON vs the kill-switch arm
@@ -617,6 +637,7 @@ def _emit(
     podracer: dict | None = None,
     data_governor: dict | None = None,
     fleet_scale: dict | None = None,
+    obs_overhead: dict | None = None,
 ) -> None:
     if data_plane:
         record = {**record, "data_plane": data_plane}
@@ -642,6 +663,10 @@ def _emit(
         # Overload-protection A/B (admission ON vs OFF under the seeded
         # flash crowd) rides every record from round 15 on.
         record = {**record, "serve_overload": serve_overload}
+    if obs_overhead:
+        # Flight-recorder overhead A/B (recorder ON vs --no-flightrec on
+        # the serve p99 probe) rides every record from round 20 on.
+        record = {**record, "obs_overhead": obs_overhead}
     if train_overlap:
         # Train-overlap A/B (async dispatch + prefetch ON vs kill switch)
         # rides every record like data_plane/serve_llm from round 13 on.
@@ -675,6 +700,7 @@ def main() -> None:
     serve_llm = _serve_llm_rows()
     serve_disagg = _serve_disagg_rows(serve_llm)
     serve_overload = _serve_overload_rows()
+    obs_overhead = _obs_overhead_rows()
     train_overlap = _train_overlap_rows()
     podracer = _podracer_rows()
     data_governor = _data_governor_rows()
@@ -687,7 +713,7 @@ def main() -> None:
         _emit(
             record, data_plane, probe_record, serve_llm, raylint,
             train_overlap, serve_overload, serve_disagg, podracer,
-            data_governor, fleet_scale,
+            data_governor, fleet_scale, obs_overhead,
         )
 
     try:

@@ -2708,6 +2708,14 @@ class CoreWorker:
 
         return profiling.collect_stack_dump()
 
+    async def _h_worker_flightrec(self, conn, p):
+        """This process's flight-recorder rings (tools/trace_export.py
+        collects one snapshot per process and merges them on the wall
+        anchor each snapshot carries)."""
+        from ray_tpu.util import flightrec
+
+        return flightrec.snapshot(planes=p.get("planes"))
+
     async def _h_worker_jax_trace(self, conn, p):
         """Capture a jax.profiler (XPlane) trace of this process — device
         ops included when this worker drives a TPU (SURVEY §5.1)."""

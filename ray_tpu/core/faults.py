@@ -184,6 +184,7 @@ class FaultInjector:
         restricts to what the call site can apply (a transport hook cannot
         kill a worker). Each matching rule consumes exactly one probability
         draw per opportunity, which is what keeps replays seed-exact."""
+        fired_rule = None
         with self._lock:
             for r in self.rules:
                 if r.site != site:
@@ -202,8 +203,13 @@ class FaultInjector:
                 if r.prob < 1.0 and r.rng.random() >= r.prob:
                     continue
                 r.fired += 1
-                return r
-            return None
+                fired_rule = r
+                break
+        if fired_rule is not None:
+            # Outside the injector lock: the flight recorder takes its
+            # own ring lock and the dump does file IO.
+            _flightrec_fire(fired_rule, name)
+        return fired_rule
 
     def stats(self) -> list:
         with self._lock:
@@ -263,6 +269,26 @@ def parse_env(value: str) -> FaultInjector:
             f"RAY_TPU_FAULTS={value!r} must be '<seed>:<rule>[;<rule>...]'"
         )
     return parse_spec(int(seed), spec)
+
+
+def _flightrec_fire(rule: FaultRule, name: str) -> None:
+    """Flight-recorder hook for a fired fault rule: record the firing in
+    the faults ring and trigger a (throttled) postmortem dump, so every
+    seeded chaos replay comes with a timeline of what each plane saw in
+    the seconds before the injection. Never raises — the injected fault
+    itself is the behavior under test."""
+    try:
+        from ray_tpu.util import flightrec
+
+        if not flightrec.on():
+            return
+        what = f"{rule.site}.{rule.action}"
+        flightrec.record(
+            "faults", what, rid=name or None, fired=rule.fired
+        )
+        flightrec.dump(f"fault:{what}")
+    except Exception:  # raylint: disable=RL006 -- observability-only hook on the chaos path; the fault decision already returned
+        pass
 
 
 # The process-global injector. None = chaos off (production): hot paths

@@ -443,10 +443,14 @@ class FleetEmulator:
 
     def run_schedule(self, tape: list) -> None:
         """Replay one seeded op tape (see ``schedule_events``)."""
+        from ray_tpu.util import flightrec
+
         n = len(self.emu_nodes)
         ids = list(self.emu_nodes)
-        for op in tape:
+        fr = flightrec.on()
+        for i, op in enumerate(tape):
             kind = op[0]
+            t_op = time.monotonic() if fr else 0.0
             if kind == "lease":
                 _, _, demand, selector, max_restarts = op
                 self.create_actor(
@@ -465,6 +469,13 @@ class FleetEmulator:
                 self.churn_node(ids[op[1] % n])
             else:  # pragma: no cover - schedule generator is closed-world
                 raise ValueError(f"unknown fleet op {op!r}")
+            if fr:
+                # One event per tape op: the emulator's timeline shows the
+                # control plane's cost per fleet-scale operation kind.
+                flightrec.record(
+                    "fleet_emu", f"fleet.{kind}", t=t_op,
+                    dur_s=time.monotonic() - t_op, rid=str(i),
+                )
 
     def _collect_decisions(self, cause: str) -> None:
         """Fold placements the GCS made INSIDE the last driver call (pending

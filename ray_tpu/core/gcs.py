@@ -33,6 +33,7 @@ from ray_tpu.core.scheduler import (
     pick_node,
 )
 from ray_tpu.core.sched_index import _INDEX_METRIC_META, FeasibilityIndex
+from ray_tpu.util import flightrec as _flightrec
 from ray_tpu.util.metrics import (
     LocalHistogram,
     declare_runtime_metric,
@@ -488,6 +489,10 @@ class GcsServer:
                     "logs", {"node_id": p["node_id"], "batches": fresh}
                 )
         self.hb_ingest_total += 1
+        if _flightrec.on():
+            _flightrec.record(
+                "gcs", "gcs.hb_ingest", rid=p["node_id"][:12]
+            )
         new_avail = dict(p["available"])
         new_total = dict(p.get("total", view.total))
         if new_avail != view.available or new_total != view.total:
@@ -874,6 +879,12 @@ class GcsServer:
         self.place_latency_ms.append(dt_ms)
         if metrics_enabled():
             self._place_hist.observe(dt_ms)
+        if _flightrec.on():
+            _flightrec.record(
+                "gcs", "gcs.place",
+                t=time.monotonic() - dt_ms / 1000.0, dur_s=dt_ms / 1000.0,
+                rid=rec.actor_id[:12], picked=node_id is not None,
+            )
         if node_id is None:
             if any_feasible(req, self.nodes):
                 if rec.actor_id not in self.pending_actors:
@@ -959,6 +970,14 @@ class GcsServer:
                 "ACTOR", "LIFECYCLE", rec.actor_id,
                 {"state": DEAD, "reason": reason},
             )
+            if _flightrec.on():
+                # Postmortem trigger: an actor just died for good (restarts
+                # exhausted or killed) — freeze the rings around the event.
+                _flightrec.record(
+                    "gcs", "gcs.actor_dead", rid=rec.actor_id[:12],
+                    reason=reason[:120],
+                )
+                _flightrec.dump("actor_death")
             rec.addr = None
             self._wake(rec)
             await self._publish("actors", self._actor_info(rec))

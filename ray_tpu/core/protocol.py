@@ -298,6 +298,7 @@ IDEMPOTENT_RPCS = frozenset(
         "owner.get_object",
         "owner.wait_ready",
         "worker.ping",
+        "worker.flightrec",  # pure read of the in-process rings
     }
 )
 

@@ -222,6 +222,24 @@ class DashboardHead:
                             "text": text or "",
                         }
                 return {"error": f"node {target_node!r} not found"}
+            if path == "/api/v0/timeline":
+                # Flight-recorder timeline (util/flightrec.py): Chrome-
+                # trace JSON of every plane's rings across the cluster;
+                # ?rid=fr-... switches to that request's critical-path
+                # breakdown. ?cluster=0 limits to this process.
+                from ray_tpu.util import trace_export
+
+                snaps = trace_export.collect_snapshots(
+                    cluster=query.get("cluster", "1") != "0"
+                )
+                rid = query.get("rid", "")
+                if rid:
+                    return _jsonable(trace_export.critical_path(snaps, rid))
+                if query.get("rids"):
+                    return _jsonable(
+                        {"rids": trace_export.request_ids(snaps)}
+                    )
+                return _jsonable(trace_export.chrome_trace(snaps))
             if path == "/api/metrics/history":
                 # Bounded per-series time-series rings sampled by the GCS
                 # (reference: dashboard modules/metrics — the Grafana

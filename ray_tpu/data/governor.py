@@ -204,6 +204,7 @@ class MemoryGovernor:
         watermark state, the AIMD budget, and the byte gate must all
         agree. A grant charges the operator's moving-average block size
         until :meth:`release` trues it up."""
+        denied = None
         with self._lock:
             st = self._ops.get(op)
             if st is None:
@@ -212,25 +213,36 @@ class MemoryGovernor:
             if st.inflight == 0:
                 return self._grant(op, st)
             if self.throttled:
-                return False
-            if st.inflight >= st.budget:
-                return False
-            if st.avg_bytes is None:
+                denied = "throttled"
+            elif st.inflight >= st.budget:
+                denied = "budget"
+            elif st.avg_bytes is None:
                 # First block still in flight: its size seeds the
                 # operator's estimate — run the probe solo.
-                return False
-            est = st.avg_bytes
-            total_charged = sum(s.charged for s in self._ops.values())
-            if (
-                self._capacity
-                and self._used + total_charged + est
-                > self.high_frac * self._capacity
-            ):
-                self.throttle_events += 1
-                if _metrics.metrics_enabled():
-                    _THROTTLE_EVENTS.inc()
-                return False
-            return self._grant(op, st)
+                denied = "probe_solo"
+            else:
+                est = st.avg_bytes
+                total_charged = sum(s.charged for s in self._ops.values())
+                if (
+                    self._capacity
+                    and self._used + total_charged + est
+                    > self.high_frac * self._capacity
+                ):
+                    self.throttle_events += 1
+                    if _metrics.metrics_enabled():
+                        _THROTTLE_EVENTS.inc()
+                    denied = "byte_gate"
+                else:
+                    return self._grant(op, st)
+        # Denials ARE the data plane's gate waits (the executor re-polls
+        # until a permit lands). Recorded outside self._lock.
+        from ray_tpu.util import flightrec
+
+        if flightrec.on():
+            flightrec.record(
+                "data", "data.governor_gate", rid=op, reason=denied
+            )
+        return False
 
     def _grant(self, op: str, st: _OpState) -> bool:
         charge = st.avg_bytes or 0.0

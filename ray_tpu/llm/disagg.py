@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.core.errors import PeerUnavailableError
+from ray_tpu.util import flightrec as _flightrec
 from ray_tpu.util import metrics as _metrics
 
 _KV_SHIP_BYTES = _metrics.Counter(
@@ -105,10 +106,18 @@ def export_kv(engine, req, first_token: int, finished: bool) -> dict:
     nb = -(-T // bs)
     ids = list(req.blocks[:nb])
     ids += [0] * (_pad_pow2(nb) - nb)  # pad: scratch rows, ignored remotely
+    t_x = _time.monotonic()
     kv = _gather_blocks(engine.pool, jnp.asarray(ids, jnp.int32))
     fab = fabric()
     desc = fab.arm(None, kv, (1,) * kv.ndim)
     handoff.update({"nblocks": nb, "block_size": bs, "kv": desc})
+    if _flightrec.on():
+        # Disagg leg 1 of 2: gather + arm on the prefill replica.
+        _flightrec.record(
+            "llm", "llm.kv_export", t=t_x,
+            dur_s=_time.monotonic() - t_x,
+            rid=req.request_id, nblocks=nb,
+        )
     now = _time.monotonic()
     exports = engine._kv_exports
     exports.append((desc["uuid"], now))
@@ -141,7 +150,24 @@ def pull_kv(handoff: dict, request_id: str = ""):
                 _time.sleep(min(rule.delay_s, 3600.0))
     from ray_tpu.experimental.transfer import fabric
 
-    kv = fabric().pull(handoff["kv"])
+    t_x = _time.monotonic()
+    try:
+        kv = fabric().pull(handoff["kv"])
+    except Exception:
+        # Disagg leg 2 of 2, failed pull: the caller's fallback takes
+        # over; record the leg so the timeline shows WHERE the fabric
+        # broke, then re-raise unchanged.
+        if _flightrec.on():
+            _flightrec.record(
+                "llm", "llm.kv_pull", t=t_x,
+                dur_s=_time.monotonic() - t_x, rid=request_id, ok=False,
+            )
+        raise
+    if _flightrec.on():
+        _flightrec.record(
+            "llm", "llm.kv_pull", t=t_x,
+            dur_s=_time.monotonic() - t_x, rid=request_id, ok=True,
+        )
     if _metrics.metrics_enabled():
         _KV_SHIP_BYTES.inc(float(kv.size * kv.dtype.itemsize))
     return kv

@@ -34,6 +34,7 @@ from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.llm.config import LLMConfig, SamplingParams
 from ray_tpu.llm.tokenizer import ByteTokenizer
 from ray_tpu.models import gpt2
+from ray_tpu.util import flightrec as _flightrec
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util.prefix_digest import BYTE_BOS_SCHEME, chain_digests
 
@@ -605,6 +606,7 @@ class LLMEngine:
             )
             if _metrics.metrics_enabled():
                 _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
+            self._rec_first_token(req)
             self.positions[slot] = T
             self.last_tokens[slot] = tok
             if self._spec is not None:
@@ -613,6 +615,20 @@ class LLMEngine:
             if req.finished:
                 admit_finished.append(req)
         return admit_finished
+
+    @staticmethod
+    def _rec_first_token(req: _Request) -> None:
+        """Flight-recorder TTFT phase: admission -> first sampled token,
+        recorded as one interval ending now (mono clock; t_admit is a
+        perf_counter anchor so the duration, not its wall start, is the
+        trusted quantity)."""
+        if not _flightrec.on():
+            return
+        ttft = max(0.0, req.t_last_token - req.t_admit)
+        _flightrec.record(
+            "llm", "llm.first_token",
+            t=_time.monotonic() - ttft, dur_s=ttft, rid=req.request_id,
+        )
 
     def _admit_handoff(self, req: _Request, slot: int) -> str:
         """Admit a disaggregated handoff: reserve blocks, pull the shipped
@@ -716,6 +732,7 @@ class LLMEngine:
         )
         if _metrics.metrics_enabled():
             _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
+        self._rec_first_token(req)
         done = req.max_tokens <= 1 or tok == req.stop_token
         req.handoff_out = disagg.export_kv(self, req, tok, finished=done)
         self.stats["handoffs_out"] += 1
@@ -803,6 +820,7 @@ class LLMEngine:
             return None
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :rem] = req.prompt[P:]
+        t_pf = _time.monotonic()
         self.pool, logits = self._pg_prefill(
             self.params,
             jnp.asarray(toks),
@@ -812,6 +830,15 @@ class LLMEngine:
             self.pool,
         )
         self.stats["prefill_tokens"] += rem
+        if _flightrec.on():
+            # Dispatch-side duration: JAX returns before the device
+            # finishes, so this phase is the host cost of the prefill
+            # launch; device truth lives in the jax trace.
+            _flightrec.record(
+                "llm", "llm.prefill", t=t_pf,
+                dur_s=_time.monotonic() - t_pf,
+                rid=req.request_id, tokens=rem, reused=P,
+            )
         self._insert_prefix(req.prompt, slot, blocks=table)
         return logits
 
@@ -883,6 +910,7 @@ class LLMEngine:
                 return None
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :rem] = req.prompt[P:]
+            t_pf = _time.monotonic()
             self.cache, logits = self._prefill_cont(
                 self.params,
                 jnp.asarray(toks),
@@ -892,6 +920,12 @@ class LLMEngine:
                 slot,
             )
             self.stats["prefill_tokens"] += rem
+            if _flightrec.on():
+                _flightrec.record(
+                    "llm", "llm.prefill", t=t_pf,
+                    dur_s=_time.monotonic() - t_pf,
+                    rid=req.request_id, tokens=rem, reused=P,
+                )
         else:
             if self._chunks_feasible(0, T):
                 self._begin_chunked_prefill(req, slot, 0)
@@ -902,6 +936,7 @@ class LLMEngine:
             )
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :T] = req.prompt
+            t_pf = _time.monotonic()
             self.cache, logits = self._prefill(
                 self.params,
                 jnp.asarray(toks),
@@ -910,6 +945,12 @@ class LLMEngine:
                 slot,
             )
             self.stats["prefill_tokens"] += T
+            if _flightrec.on():
+                _flightrec.record(
+                    "llm", "llm.prefill", t=t_pf,
+                    dur_s=_time.monotonic() - t_pf,
+                    rid=req.request_id, tokens=T, reused=0,
+                )
         self._insert_prefix(req.prompt, slot)
         return logits
 
@@ -978,6 +1019,7 @@ class LLMEngine:
         bucket = self._chunk_bucket(start, clen)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :clen] = req.prompt[start : start + clen]
+        t_pf = _time.monotonic()
         if self.paged:
             self.pool, logits = self._pg_prefill(
                 self.params,
@@ -1000,6 +1042,12 @@ class LLMEngine:
         self.stats["prefill_chunks"] += 1
         if _metrics.metrics_enabled():
             _PREFILL_CHUNKS.inc(1.0)
+        if _flightrec.on():
+            _flightrec.record(
+                "llm", "llm.prefill_chunk", t=t_pf,
+                dur_s=_time.monotonic() - t_pf,
+                rid=req.request_id, tokens=clen, start=start,
+            )
         req.pf_next = start + clen
         self.positions[req.slot] = req.pf_next
         return logits
@@ -1045,6 +1093,7 @@ class LLMEngine:
         )
         if _metrics.metrics_enabled():
             _TTFT_SECONDS.observe(req.t_last_token - req.t_admit)
+        self._rec_first_token(req)
         self.positions[req.slot] = T
         self.last_tokens[req.slot] = tok
         if self._spec is not None:
@@ -1103,6 +1152,7 @@ class LLMEngine:
         if active and self._spec is not None and self._spec_eligible(active):
             finished += self._spec.step(active)
         elif active:
+            t_dec = _time.monotonic()
             if self.paged:
                 self.pool, logits = self._pg_decode(
                     self.params,
@@ -1133,6 +1183,13 @@ class LLMEngine:
                 self._maybe_finish(req)
                 if req.finished:
                     finished.append(req)
+            if _flightrec.on():
+                # Batch-wide phase (no rid): dispatch + logits readback +
+                # host sampling for every active slot this step.
+                _flightrec.record(
+                    "llm", "llm.decode_step", t=t_dec,
+                    dur_s=_time.monotonic() - t_dec, batch=len(active),
+                )
         self._steps += 1
         if instrument:
             self._publish_metrics()

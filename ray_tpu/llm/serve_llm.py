@@ -133,6 +133,17 @@ class LLMServer:
         handoff: dict | None = None,
     ) -> str:
         rid = f"req-{next(self._counter)}"
+        from ray_tpu.util import flightrec
+
+        if flightrec.on():
+            # Stitch the router's flight-recorder request id (propagated via
+            # the replica's contextvar) to the engine-local req-N id, so the
+            # timeline exporter can join serve hops to engine phases.
+            from ray_tpu.serve.replica import current_frid
+
+            frid = current_frid()
+            if frid is not None:
+                flightrec.record("llm", "llm.bind", rid=rid, frid=frid)
         with self._pending_lock:
             self._pending.append((rid, prompt, sampling, prefill_only, handoff))
         return rid

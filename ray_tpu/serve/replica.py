@@ -18,7 +18,23 @@ import cloudpickle
 from ray_tpu.core import serialization
 from ray_tpu.core.config import GLOBAL_CONFIG
 from ray_tpu.core.errors import OverloadedError
+from ray_tpu.util import flightrec as _flightrec
 from ray_tpu.util import metrics as _metrics
+
+# Flight-recorder request id of the request THIS task is executing (the
+# router's fr-<pid>-<n>, carried in as an optional trailing RPC arg).
+# Contextvar so it survives the run_in_executor hop (the copied context
+# carries it into the executor thread) — the LLM server reads it via
+# current_frid() to stitch the router's id to its engine request id.
+_active_frid: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_frid", default=None
+)
+
+
+def current_frid():
+    """The flight-recorder id of the serve request being executed on this
+    task/thread, or None (recorder off, or not inside a serve request)."""
+    return _active_frid.get()
 
 # Replica-side half of the serve request breakdown (router wait is
 # recorded by the routing process): user-callable execution time and the
@@ -197,11 +213,16 @@ class ReplicaActor:
         # detection sees the method, not the (non-coroutine) instance.
         return getattr(self._callable, method)
 
-    async def handle(self, method: str, payload: bytes, model_id: str = ""):
+    async def handle(
+        self, method: str, payload: bytes, model_id: str = "", frid=None
+    ):
         """Execute one request. Requests are (method, pickled (args, kwargs));
         sync user code runs in the worker's executor thread so the replica
         keeps answering pings while busy. ``model_id`` (multiplexing) binds
-        serve.get_multiplexed_model_id() for the duration of the call."""
+        serve.get_multiplexed_model_id() for the duration of the call.
+        ``frid`` is the router's flight-recorder request id — only ever
+        passed when RAY_TPU_FLIGHTREC is on (the wire call is otherwise
+        byte-identical to the pre-recorder tree)."""
         from ray_tpu.serve.multiplex import _set_model_id
 
         self._ensure_reporter()
@@ -209,6 +230,8 @@ class ReplicaActor:
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
         _set_model_id(model_id)
+        fr = frid is not None and _flightrec.on()
+        frid_token = _active_frid.set(frid) if fr else None
         instrument = _metrics.metrics_enabled()
         t0 = _time.perf_counter() if instrument else 0.0
         self._inflight += 1
@@ -231,13 +254,33 @@ class ReplicaActor:
                 return list(result)
             return result
 
+        async def run_recorded():
+            t_x = _time.monotonic()
+            try:
+                return await run()
+            finally:
+                _flightrec.record(
+                    "serve", "serve.replica_exec", t=t_x,
+                    dur_s=_time.monotonic() - t_x, rid=frid,
+                )
+
         try:
             gate = self._execution_gate()
             if gate is None:
-                return await run()
+                return await (run_recorded() if fr else run())
+            if fr:
+                t_q = _time.monotonic()
+                async with gate:  # in-cap surplus WAITS here (the queue)
+                    _flightrec.record(
+                        "serve", "serve.replica_queue_wait", t=t_q,
+                        dur_s=_time.monotonic() - t_q, rid=frid,
+                    )
+                    return await run_recorded()
             async with gate:  # in-cap surplus WAITS here (the queue)
                 return await run()
         finally:
+            if frid_token is not None:
+                _active_frid.reset(frid_token)
             self._inflight -= 1
             if instrument:
                 tags = self._tags()
@@ -245,7 +288,7 @@ class ReplicaActor:
                 _QUEUE_LEN.set(float(self._inflight), tags)
 
     async def handle_streaming(
-        self, method: str, payload: bytes, model_id: str = ""
+        self, method: str, payload: bytes, model_id: str = "", frid=None
     ):
         """Streaming twin of ``handle``: an async generator the router
         invokes with num_returns="streaming", so each yielded chunk flows
@@ -265,6 +308,9 @@ class ReplicaActor:
         args, kwargs = serialization.loads(payload)[0]
         fn = self._resolve(method)
         _set_model_id(model_id)
+        fr = frid is not None and _flightrec.on()
+        frid_token = _active_frid.set(frid) if fr else None
+        t_x = _time.monotonic() if fr else 0.0
         instrument = _metrics.metrics_enabled()
         t0 = _time.perf_counter() if instrument else 0.0
         self._inflight += 1
@@ -296,6 +342,15 @@ class ReplicaActor:
             else:
                 yield result
         finally:
+            if fr:
+                # First-byte to last-byte, consumer pacing included —
+                # the same occupancy view _EXEC_SECONDS records.
+                _flightrec.record(
+                    "serve", "serve.replica_exec", t=t_x,
+                    dur_s=_time.monotonic() - t_x, rid=frid,
+                )
+            if frid_token is not None:
+                _active_frid.reset(frid_token)
             self._inflight -= 1
             if instrument:
                 tags = self._tags()

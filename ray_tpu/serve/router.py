@@ -10,6 +10,8 @@ from the controller.
 from __future__ import annotations
 
 import asyncio
+import itertools
+import os as _os
 import random
 import time as _time
 
@@ -23,8 +25,21 @@ from ray_tpu.core.errors import (
     TaskError,
 )
 from ray_tpu.serve import admission as _admission
+from ray_tpu.util import flightrec as _flightrec
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util.prefix_digest import chat_prompt, prompt_digests
+
+# Flight-recorder request ids: stitch the router's phase events to the
+# replica's (the id rides the dispatch as an extra, recorder-only RPC
+# arg — with RAY_TPU_FLIGHTREC=0 the wire call is byte-identical to the
+# pre-recorder tree). A counter, not a uuid: ids only need to be unique
+# within one process's rings, and a seeded run's id sequence stays
+# deterministic for the golden-export tests.
+_frid_counter = itertools.count()
+
+
+def _next_frid() -> str:
+    return f"fr-{_os.getpid()}-{next(_frid_counter)}"
 
 # Serve request SLO series, recorded in the routing process (driver or
 # proxy) and shipped through the standard push path. Request latency
@@ -711,6 +726,9 @@ class Router:
         payload = serialization.dumps((args, kwargs))[0]
         instrument = _metrics.metrics_enabled()
         t0 = _time.perf_counter() if instrument else 0.0
+        fr = _flightrec.on()
+        frid = _next_frid() if fr else None
+        t_req = _time.monotonic() if fr else 0.0
         last_err: Exception | None = None
         adm = _RequestAdmission(self, args, kwargs, tenant, priority)
         hop_tried = disagg_decode = False
@@ -720,7 +738,19 @@ class Router:
                 if not self._replicas:
                     await asyncio.sleep(0.2)
                     continue
-            adm.ensure_checked()  # raises shed/throttled, pre-counted
+            if fr and not adm._admitted:
+                t_ph = _time.monotonic()
+                try:
+                    adm.ensure_checked()
+                except OverloadedError as ov:
+                    self._flightrec_shed(frid, t_req, ov.reason or "shed")
+                    raise
+                _flightrec.record(
+                    "serve", "serve.admission", t=t_ph,
+                    dur_s=_time.monotonic() - t_ph, rid=frid,
+                )
+            else:
+                adm.ensure_checked()  # raises shed/throttled, pre-counted
             if not hop_tried and self._disagg_active():
                 # Disaggregated two-hop, leg 1: prefill on the prefill
                 # tier; on success the decode dispatch below carries the
@@ -728,7 +758,14 @@ class Router:
                 # retry reuses the same handoff (its pull fails closed
                 # into local prefill on the retried replica).
                 hop_tried = True
+                t_ph = _time.monotonic() if fr else 0.0
                 h = await self._prefill_hop(args, kwargs, model_id, payload)
+                if fr:
+                    _flightrec.record(
+                        "serve", "serve.disagg_prefill_hop", t=t_ph,
+                        dur_s=_time.monotonic() - t_ph, rid=frid,
+                        ok=h is not None,
+                    )
                 if h is not None:
                     req2 = dict(args[0])
                     req2["_handoff"] = h
@@ -736,6 +773,7 @@ class Router:
                         ((req2,) + args[1:], kwargs)
                     )[0]
                     disagg_decode = True
+            t_ph = _time.monotonic() if fr else 0.0
             if disagg_decode:
                 # Leg 2: load-only pow-2 over the decode tier (decode
                 # replicas never prefill, so digests carry no signal).
@@ -755,6 +793,12 @@ class Router:
                     exclude=adm.exclude,
                 )
             rid = replica._actor_id
+            if fr:
+                _flightrec.record(
+                    "serve", "serve.pick", t=t_ph,
+                    dur_s=_time.monotonic() - t_ph, rid=frid,
+                    replica=rid[:12], attempt=attempt,
+                )
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             if instrument:
                 tags = {"deployment": self._deployment}
@@ -762,10 +806,26 @@ class Router:
                 _REQUESTS.inc(1.0, tags)
                 instrument = False  # one wait + one request per route()
             try:
-                ref = replica.handle.remote(method, payload, model_id)
+                t_ph = _time.monotonic() if fr else 0.0
+                if frid is not None:
+                    ref = replica.handle.remote(
+                        method, payload, model_id, frid
+                    )
+                else:
+                    ref = replica.handle.remote(method, payload, model_id)
                 result = await core_api.get_async(ref)
                 self._note_model(pick_key, rid)
                 adm.count_once("admitted")
+                if fr:
+                    now = _time.monotonic()
+                    _flightrec.record(
+                        "serve", "serve.dispatch", t=t_ph,
+                        dur_s=now - t_ph, rid=frid, replica=rid[:12],
+                    )
+                    _flightrec.record(
+                        "serve", "serve.request", t=t_req,
+                        dur_s=now - t_req, rid=frid, outcome="ok",
+                    )
                 return result
             except TaskError as e:
                 ov = self._overload_cause(e)
@@ -776,6 +836,7 @@ class Router:
                 if not adm.retry_overload(ov, rid):
                     # Second saturated replica (or nowhere else to go):
                     # shed fast — no backoff, the client owns the retry.
+                    self._flightrec_shed(frid, t_req, "queue_full")
                     raise ov from None
             except (ActorDiedError, ActorUnavailableError) as e:
                 # Replica died mid-request: drop it locally, force-refresh
@@ -796,6 +857,7 @@ class Router:
                     self._inflight[rid] -= 1
         held = adm.exhausted()
         if held is not None:
+            self._flightrec_shed(frid, t_req, "retries_exhausted")
             raise held from None
         if _metrics.metrics_enabled():
             _ERRORS.inc(1.0, {"deployment": self._deployment})
@@ -803,6 +865,21 @@ class Router:
             f"routing to {self._deployment!r} failed after "
             f"{ROUTE_RETRIES} attempts"
         )
+
+    def _flightrec_shed(self, frid, t_req: float, reason: str) -> None:
+        """Record an OverloadedError verdict and trigger the (throttled)
+        postmortem dump — a shed burst is exactly the moment the
+        operator wants the preceding timeline for."""
+        if not _flightrec.on():
+            return
+        now = _time.monotonic()
+        _flightrec.record("serve", "serve.shed", rid=frid, reason=reason)
+        if t_req:
+            _flightrec.record(
+                "serve", "serve.request", t=t_req, dur_s=now - t_req,
+                rid=frid, outcome="shed",
+            )
+        _flightrec.dump("overload")
 
     async def route_stream(
         self,
@@ -823,6 +900,9 @@ class Router:
         payload = serialization.dumps((args, kwargs))[0]
         instrument = _metrics.metrics_enabled()
         t0 = _time.perf_counter() if instrument else 0.0
+        fr = _flightrec.on()
+        frid = _next_frid() if fr else None
+        t_req = _time.monotonic() if fr else 0.0
         last_err: Exception | None = None
         adm = _RequestAdmission(self, args, kwargs, tenant, priority)
         hop_tried = disagg_decode = False
@@ -832,12 +912,31 @@ class Router:
                 if not self._replicas:
                     await asyncio.sleep(0.2)
                     continue
-            adm.ensure_checked()  # raises shed/throttled, pre-counted
+            if fr and not adm._admitted:
+                t_ph = _time.monotonic()
+                try:
+                    adm.ensure_checked()
+                except OverloadedError as ov:
+                    self._flightrec_shed(frid, t_req, ov.reason or "shed")
+                    raise
+                _flightrec.record(
+                    "serve", "serve.admission", t=t_ph,
+                    dur_s=_time.monotonic() - t_ph, rid=frid,
+                )
+            else:
+                adm.ensure_checked()  # raises shed/throttled, pre-counted
             if not hop_tried and self._disagg_active():
                 # Two-hop leg 1 (see route()): prefill before the stream
                 # opens; client TTFT includes this hop by construction.
                 hop_tried = True
+                t_ph = _time.monotonic() if fr else 0.0
                 h = await self._prefill_hop(args, kwargs, model_id, payload)
+                if fr:
+                    _flightrec.record(
+                        "serve", "serve.disagg_prefill_hop", t=t_ph,
+                        dur_s=_time.monotonic() - t_ph, rid=frid,
+                        ok=h is not None,
+                    )
                 if h is not None:
                     req2 = dict(args[0])
                     req2["_handoff"] = h
@@ -845,6 +944,7 @@ class Router:
                         ((req2,) + args[1:], kwargs)
                     )[0]
                     disagg_decode = True
+            t_ph = _time.monotonic() if fr else 0.0
             if disagg_decode:
                 pick_key = ""
                 replica = self._pick(
@@ -862,6 +962,12 @@ class Router:
                     exclude=adm.exclude,
                 )
             rid = replica._actor_id
+            if fr:
+                _flightrec.record(
+                    "serve", "serve.pick", t=t_ph,
+                    dur_s=_time.monotonic() - t_ph, rid=frid,
+                    replica=rid[:12], attempt=attempt,
+                )
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
             if instrument:
                 tags = {"deployment": self._deployment}
@@ -869,18 +975,41 @@ class Router:
                 _REQUESTS.inc(1.0, tags)
                 instrument = False
             delivered = False
+            t_dispatch = _time.monotonic() if fr else 0.0
             try:
-                gen = replica.handle_streaming.options(
-                    num_returns="streaming"
-                ).remote(method, payload, model_id)
+                if frid is not None:
+                    gen = replica.handle_streaming.options(
+                        num_returns="streaming"
+                    ).remote(method, payload, model_id, frid)
+                else:
+                    gen = replica.handle_streaming.options(
+                        num_returns="streaming"
+                    ).remote(method, payload, model_id)
                 async for ref in gen:
                     value = await core_api.get_async(ref)
                     if not delivered:
                         self._note_model(pick_key, rid)
                         adm.count_once("admitted")
+                        if fr:
+                            _flightrec.record(
+                                "serve", "serve.first_chunk", t=t_dispatch,
+                                dur_s=_time.monotonic() - t_dispatch,
+                                rid=frid, replica=rid[:12],
+                            )
                     delivered = True
                     yield value
                 adm.count_once("admitted")  # zero-chunk streams admitted too
+                if fr:
+                    now = _time.monotonic()
+                    _flightrec.record(
+                        "serve", "serve.stream", t=t_dispatch,
+                        dur_s=now - t_dispatch, rid=frid,
+                        replica=rid[:12],
+                    )
+                    _flightrec.record(
+                        "serve", "serve.request", t=t_req,
+                        dur_s=now - t_req, rid=frid, outcome="ok",
+                    )
                 return
             except TaskError as e:
                 ov = self._overload_cause(e)
@@ -888,6 +1017,7 @@ class Router:
                     adm.count_once("admitted")
                     raise
                 if not adm.retry_overload(ov, rid):
+                    self._flightrec_shed(frid, t_req, "queue_full")
                     raise ov from None
             except (ActorDiedError, ActorUnavailableError) as e:
                 if delivered:
@@ -907,6 +1037,7 @@ class Router:
                     self._inflight[rid] -= 1
         held = adm.exhausted()
         if held is not None:
+            self._flightrec_shed(frid, t_req, "retries_exhausted")
             raise held from None
         if _metrics.metrics_enabled():
             _ERRORS.inc(1.0, {"deployment": self._deployment})

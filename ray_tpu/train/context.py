@@ -16,6 +16,7 @@ from typing import Any, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.storage import StorageContext
+from ray_tpu.util import flightrec as _flightrec
 from ray_tpu.util import metrics as _metrics
 
 # Step-time telemetry: train loops call report() once per step (reference
@@ -96,12 +97,18 @@ def _materialize_metrics(metrics: Any) -> Any:
     import numpy as np
 
     t0 = _time.perf_counter()
+    t_m = _time.monotonic()
     # The ONE intended host-sync of the async-dispatch tier: ring
     # eviction/flush/checkpoint materialization. Enqueue-time
     # copy_to_host_async (above) already overlapped the DMA.
     host = jax.device_get(metrics)  # raylint: disable=RL101 -- the ring's designated flush point; readback overlap started at enqueue
     if _metrics.metrics_enabled():
         _HOST_BLOCKED.observe(_time.perf_counter() - t0)
+    if _flightrec.on():
+        _flightrec.record(
+            "train", "train.d2h_report", t=t_m,
+            dur_s=_time.monotonic() - t_m,
+        )
     return jax.tree.map(
         lambda x: x.item()  # raylint: disable=RL101 -- 0-d numpy unwrap AFTER device_get; host memory already
         if isinstance(x, np.ndarray) and x.ndim == 0
@@ -131,6 +138,7 @@ class TrainContext:
     _report_index: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock)
     _last_report_t: float = 0.0  # step-time anchor (perf_counter)
+    _fr_last_report_m: float = 0.0  # flight-recorder step anchor (monotonic)
     # Async-dispatch ring: device-resident metric reports not yet read
     # back to host, oldest first. Bounded by train_async_dispatch_depth;
     # eviction/flush materializes entries (in index order) into _reports.
@@ -204,6 +212,19 @@ class TrainContext:
             if self._last_report_t:
                 _STEP_SECONDS.observe(now - self._last_report_t)
             self._last_report_t = now
+        if _flightrec.on():
+            # The reference convention: one report() per step, so the gap
+            # between consecutive calls IS the step (data + compute +
+            # collectives). Own monotonic anchor — independent of the
+            # metrics kill switch.
+            now_m = _time.monotonic()
+            if self._fr_last_report_m:
+                _flightrec.record(
+                    "train", "train.step", t=self._fr_last_report_m,
+                    dur_s=now_m - self._fr_last_report_m, rid=str(index),
+                    rank=self.world_rank,
+                )
+            self._fr_last_report_m = now_m
         device_resident = _has_device_leaves(metrics)
         if checkpoint is None and sharded_state is None and device_resident:
             depth = self._async_depth()

@@ -19,8 +19,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Any, Iterable, Iterator, Optional
 
+from ray_tpu.util import flightrec as _flightrec
 from ray_tpu.util import metrics as _metrics
 
 # A miss = the consumer reached next() before the staging thread had the
@@ -155,7 +157,14 @@ class DevicePrefetchIterator:
         # The warm-up get races thread startup and is not a signal; from
         # then on, an empty queue means the host data path fell behind.
         underrun = not self._first and self._queue.empty()
+        fr = _flightrec.on()
+        t_w = _time.monotonic() if fr else 0.0
         item = self._queue.get()
+        if fr:
+            _flightrec.record(
+                "train", "train.data_wait", t=t_w,
+                dur_s=_time.monotonic() - t_w, underrun=underrun,
+            )
         self._first = False
         if item is _SENTINEL:
             self._done = True

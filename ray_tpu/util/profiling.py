@@ -48,10 +48,18 @@ def sample_collapsed_stacks(
     duration_s: float = 5.0,
     interval_s: float = 0.01,
     exclude_idle: bool = True,
+    tag_spans: bool = True,
 ) -> dict:
     """Wall-clock sampling profile of THIS process: collapsed stacks
     ('frame;frame;...' -> sample count, the flamegraph input format).
-    Run from a non-sampled thread (callers use an executor thread)."""
+    Run from a non-sampled thread (callers use an executor thread).
+
+    With ``tag_spans`` (default), a sample taken while its thread is
+    inside a live tracing span gets a synthetic root frame
+    ``span:<trace_id>/<span_id>`` — so collapsed stacks can be filtered
+    to one slow request's trace id."""
+    from ray_tpu.util import tracing
+
     me = threading.get_ident()
     counts: Counter = Counter()
     samples = 0
@@ -86,7 +94,12 @@ def sample_collapsed_stacks(
                 # Parked threads (executor waiters, selectors) dominate
                 # otherwise; the CPU story is in the rest.
                 continue
-            counts[";".join(reversed(stack))] += 1
+            key = ";".join(reversed(stack))
+            if tag_spans:
+                span = tracing.active_span_for_thread(ident)
+                if span is not None:
+                    key = f"span:{span[0]}/{span[1]};{key}"
+            counts[key] += 1
         samples += 1
         time.sleep(interval_s)
     return {

@@ -1,0 +1,327 @@
+"""Chrome-trace/Perfetto exporter + critical-path reducer for the
+flight recorder (util/flightrec.py).
+
+Three consumers share this module:
+
+- **CLI**: ``python tools/trace_export.py --out trace.json`` (a thin
+  wrapper over :func:`main` here) collects the driver's (and, with
+  ``--cluster``, every live worker's) flight-recorder snapshot and
+  writes a Chrome-trace JSON — load it at ``chrome://tracing`` or
+  https://ui.perfetto.dev. Postmortem dump files
+  (``flightrec-<pid>-*.json``) are snapshots too: pass them with
+  ``--dump`` to render a crash timeline offline.
+- **Dashboard**: ``GET /api/v0/timeline`` serves the same conversion
+  over HTTP (``?rid=fr-...`` switches to the critical-path breakdown).
+- **Tests**: :func:`chrome_trace` and :func:`critical_path` are pure
+  functions of snapshot dicts, so golden tests replay recorded rings.
+
+Clock stitching: every event timestamp is process-local monotonic; each
+snapshot carries its process's ``(mono_anchor, wall_anchor)`` pair, so
+events from N processes land on one wall timeline as
+``wall_anchor + (t - mono_anchor)`` (the contract shared with
+``util/tracing.py`` spans).
+
+Critical-path semantics: for one request id the reducer takes the
+``serve.request`` envelope event, clips every same-request phase interval
+to it (engine-side events join through ``llm.bind`` rid aliases), and
+attributes each instant of the envelope to the INNERMOST covering phase
+(latest start wins — so ``serve.dispatch`` time spent inside
+``serve.replica_exec`` counts as replica_exec, not dispatch). Instants no
+phase covers are ``(unattributed)``; their share is ``1 - coverage``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+
+# -- snapshot collection ------------------------------------------------------
+
+
+def collect_snapshots(cluster: bool = False, planes=None) -> list:
+    """Flight-recorder snapshots: this process's, plus (``cluster=True``)
+    one per live worker reachable through the nodes' worker tables.
+    Unreachable workers are skipped — a postmortem export must not fail
+    because the process it is about died."""
+    from ray_tpu.util import flightrec
+
+    out = [flightrec.snapshot(planes=planes)]
+    if not cluster:
+        return out
+    try:
+        import ray_tpu
+        from ray_tpu.core import api as core_api
+
+        w = core_api._require_worker(auto_init=False)
+        for node in ray_tpu.nodes():
+            if not node.get("Alive", True):
+                continue
+            try:
+                info = w.endpoint.call(
+                    tuple(node["Address"]), "node.get_info", {}, timeout=5
+                )
+            except Exception:  # raylint: disable=RL006 -- per-node probe; dead nodes simply contribute no rings
+                continue
+            for rec in info.get("workers", []):
+                addr = rec.get("addr")
+                if not addr:
+                    continue
+                try:
+                    snap = w.endpoint.call(
+                        tuple(addr), "worker.flightrec",
+                        {"planes": list(planes) if planes else None},
+                        timeout=10,
+                    )
+                except Exception:  # raylint: disable=RL006 -- per-worker probe; a dead worker's rings are in its dump file, not its RPC
+                    continue
+                if snap and snap.get("rings"):
+                    out.append(snap)
+    except Exception:  # raylint: disable=RL006 -- no live cluster: the local snapshot alone is the export
+        pass
+    return out
+
+
+def load_dumps(paths: list) -> list:
+    """Postmortem dump files -> snapshot list (a dump IS a snapshot plus
+    the trigger reason)."""
+    out = []
+    for p in paths:
+        with open(p) as f:
+            out.append(json.load(f))
+    return out
+
+
+# -- Chrome-trace conversion --------------------------------------------------
+
+
+def _wall(snap: dict, t: float) -> float:
+    return snap["wall_anchor"] + (t - snap["mono_anchor"])
+
+
+def _iter_events(snapshots: list):
+    """(snapshot, plane, event) triples in deterministic order: snapshots
+    as given, planes sorted, events oldest-first (ring order)."""
+    for snap in snapshots:
+        for plane in sorted(snap.get("rings", {})):
+            for ev in snap["rings"][plane].get("events", []):
+                yield snap, plane, ev
+
+
+def chrome_trace(snapshots: list) -> dict:
+    """Convert snapshots to the Chrome trace-event JSON format (``ph: X``
+    complete events, microsecond timestamps on the shared wall timeline,
+    one pid per process, one tid per plane). A pure function of its
+    input: identical snapshots export byte-identical traces."""
+    events = []
+    pids = []
+    for snap in snapshots:
+        pid = int(snap.get("pid", 0))
+        if pid not in pids:
+            pids.append(pid)
+            events.append(
+                {
+                    "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                    "args": {"name": f"ray_tpu pid {pid}"},
+                }
+            )
+    for snap, plane, ev in _iter_events(snapshots):
+        pid = int(snap.get("pid", 0))
+        args = {}
+        if ev.get("rid") is not None:
+            args["rid"] = ev["rid"]
+        if ev.get("trace_id") is not None:
+            args["trace_id"] = ev["trace_id"]
+            args["span_id"] = ev.get("span_id")
+        for k, v in (ev.get("extra") or {}).items():
+            args[k] = v
+        events.append(
+            {
+                "name": ev["phase"],
+                "cat": plane,
+                "ph": "X",
+                "ts": round(_wall(snap, ev["t"]) * 1e6, 3),
+                "dur": round(float(ev.get("dur_s", 0.0)) * 1e6, 3),
+                "pid": pid,
+                "tid": plane,
+                "args": args,
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- critical path ------------------------------------------------------------
+
+#: The request envelope phase; everything else with the same rid is a
+#: candidate for attribution inside it.
+_ENVELOPE_PHASE = "serve.request"
+#: Engine-side alias binder: extra {"frid": router id}, rid = engine id.
+_BIND_PHASE = "llm.bind"
+
+
+def _aliases(snapshots: list, rid: str) -> set:
+    """All request ids that mean "this request": the router's frid plus
+    every engine-local rid an ``llm.bind`` event tied to it (and, given
+    an engine rid, the frid it binds to — lookups work from either)."""
+    ids = {rid}
+    grew = True
+    while grew:
+        grew = False
+        for _snap, _plane, ev in _iter_events(snapshots):
+            if ev.get("phase") != _BIND_PHASE:
+                continue
+            frid = (ev.get("extra") or {}).get("frid")
+            erid = ev.get("rid")
+            if frid in ids and erid not in ids:
+                ids.add(erid)
+                grew = True
+            elif erid in ids and frid is not None and frid not in ids:
+                ids.add(frid)
+                grew = True
+    return ids
+
+
+def critical_path(snapshots: list, rid: str) -> dict:
+    """Dominant-phase latency breakdown for one request id.
+
+    Returns ``{rid, total_s, coverage, phases: [{phase, seconds, frac}],
+    aliases}`` with phases sorted by attributed seconds, descending.
+    ``coverage`` is the fraction of the envelope attributed to SOME named
+    phase; the remainder appears as the ``(unattributed)`` row."""
+    ids = _aliases(snapshots, rid)
+    envelope = None
+    intervals = []  # (start_wall, end_wall, phase)
+    for snap, _plane, ev in _iter_events(snapshots):
+        if ev.get("rid") not in ids:
+            continue
+        start = _wall(snap, ev["t"])
+        end = start + float(ev.get("dur_s", 0.0))
+        if ev["phase"] == _ENVELOPE_PHASE:
+            if envelope is None or end - start > envelope[1] - envelope[0]:
+                envelope = (start, end)
+        elif end > start:
+            intervals.append((start, end, ev["phase"]))
+    if envelope is None:
+        if not intervals:
+            return {
+                "rid": rid, "total_s": 0.0, "coverage": 0.0, "phases": [],
+                "aliases": sorted(ids),
+            }
+        envelope = (
+            min(i[0] for i in intervals), max(i[1] for i in intervals)
+        )
+    e0, e1 = envelope
+    total = max(0.0, e1 - e0)
+    clipped = [
+        (max(s, e0), min(e, e1), ph)
+        for s, e, ph in intervals
+        if min(e, e1) > max(s, e0)
+    ]
+    # Sweep the envelope's elementary segments; each instant goes to the
+    # innermost covering phase (max start; ties to the shorter interval).
+    cuts = sorted({e0, e1, *(s for s, _e, _p in clipped),
+                   *(e for _s, e, _p in clipped)})
+    per_phase: dict = {}
+    unattributed = 0.0
+    for a, b in zip(cuts, cuts[1:]):
+        if b <= e0 or a >= e1:
+            continue
+        seg = b - a
+        covering = [iv for iv in clipped if iv[0] <= a and iv[1] >= b]
+        if not covering:
+            unattributed += seg
+            continue
+        winner = max(covering, key=lambda iv: (iv[0], -(iv[1] - iv[0])))
+        per_phase[winner[2]] = per_phase.get(winner[2], 0.0) + seg
+    phases = [
+        {
+            "phase": ph,
+            "seconds": round(sec, 6),
+            "frac": round(sec / total, 4) if total else 0.0,
+        }
+        for ph, sec in sorted(
+            per_phase.items(), key=lambda kv: (-kv[1], kv[0])
+        )
+    ]
+    coverage = (total - unattributed) / total if total else 0.0
+    if unattributed > 0:
+        phases.append(
+            {
+                "phase": "(unattributed)",
+                "seconds": round(unattributed, 6),
+                "frac": round(unattributed / total, 4) if total else 0.0,
+            }
+        )
+    return {
+        "rid": rid,
+        "total_s": round(total, 6),
+        "coverage": round(coverage, 4),
+        "phases": phases,
+        "aliases": sorted(i for i in ids if i is not None),
+    }
+
+
+def request_ids(snapshots: list) -> list:
+    """Every request id that has an envelope event, oldest first."""
+    out = []
+    for _snap, _plane, ev in _iter_events(snapshots):
+        if ev.get("phase") == _ENVELOPE_PHASE and ev.get("rid"):
+            if ev["rid"] not in out:
+                out.append(ev["rid"])
+    return out
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Export flight-recorder rings as a Chrome trace "
+        "(chrome://tracing / ui.perfetto.dev) or a per-request "
+        "critical-path breakdown."
+    )
+    ap.add_argument(
+        "--dump", nargs="*", default=None,
+        help="read these postmortem dump files instead of live rings",
+    )
+    ap.add_argument(
+        "--cluster", action="store_true",
+        help="also pull every live worker's rings over RPC",
+    )
+    ap.add_argument("--out", default="", help="write here (default stdout)")
+    ap.add_argument(
+        "--rid", default="",
+        help="emit the critical-path breakdown for this request id "
+        "instead of a trace",
+    )
+    ap.add_argument(
+        "--list-rids", action="store_true",
+        help="list request ids with a recorded envelope, then exit",
+    )
+    args = ap.parse_args(argv)
+    if args.dump:
+        snaps = load_dumps(args.dump)
+    else:
+        snaps = collect_snapshots(cluster=args.cluster)
+    if args.list_rids:
+        for r in request_ids(snaps):
+            print(r)
+        return 0
+    if args.rid:
+        doc = critical_path(snaps, args.rid)
+    else:
+        doc = chrome_trace(snaps)
+    text = json.dumps(doc, indent=None, separators=(",", ":"), sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out} ({len(text)} bytes)")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import threading
 import time
 import uuid
 from typing import Any, Optional
@@ -34,6 +35,36 @@ _ctx: contextvars.ContextVar = contextvars.ContextVar(
 )  # (trace_id, span_id) | None
 
 _enabled_override: Optional[bool] = None
+
+# Per-process clock anchor: span events carry monotonic start/end stamps
+# (ordering survives wall-clock adjustment mid-run) plus this anchor, so
+# a cross-process consumer recovers comparable wall time as
+# WALL_ANCHOR + (mono - MONO_ANCHOR). Same contract as util/flightrec.py.
+MONO_ANCHOR = time.monotonic()
+WALL_ANCHOR = time.time()
+
+# Thread -> (trace_id, span_id) of the span each thread is INSIDE right
+# now. Contextvars are invisible from other threads, so the profiler
+# (util/profiling.py sample_collapsed_stacks) reads this registry to tag
+# sampled stacks with the live span. Entries stack: enter saves the
+# previous binding, exit restores it.
+_active_by_thread: dict = {}
+
+
+def active_span_for_thread(ident: int) -> Optional[tuple]:
+    """(trace_id, span_id) the thread ``ident`` is currently executing
+    under, or None. Safe to call from any thread (GIL-atomic read)."""
+    return _active_by_thread.get(ident)
+
+
+def _bind_thread(ctx: Optional[tuple]) -> Optional[tuple]:
+    ident = threading.get_ident()
+    prev = _active_by_thread.get(ident)
+    if ctx is None:
+        _active_by_thread.pop(ident, None)
+    else:
+        _active_by_thread[ident] = (ctx[0], ctx[1])
+    return prev
 
 
 def enable() -> None:
@@ -79,11 +110,15 @@ def span(name: str, **attrs):
         return
     trace_id, span_id, parent_id = new_span_ids(_ctx.get())
     token = _ctx.set((trace_id, span_id))
+    prev_bind = _bind_thread((trace_id, span_id))
     start = time.time()
+    start_mono = time.monotonic()
     try:
         yield (trace_id, span_id)
     finally:
         _ctx.reset(token)
+        _bind_thread(prev_bind)
+        end_mono = time.monotonic()
         _record_span_event(
             {
                 "task_id": f"span-{span_id}",
@@ -96,6 +131,12 @@ def span(name: str, **attrs):
                 "parent_span_id": parent_id,
                 "exec_start_ts": start,
                 "exec_end_ts": time.time(),
+                # Monotonic stamps + this process's anchor: cross-process
+                # ordering survives wall-clock steps (the wall fields
+                # above stay for display/back-compat).
+                "mono_start": start_mono,
+                "mono_end": end_mono,
+                "clock_anchor": [MONO_ANCHOR, WALL_ANCHOR],
                 **({"attrs": attrs} if attrs else {}),
             }
         )
@@ -134,10 +175,46 @@ def execution_scope(trace_ctx: Optional[tuple]):
         yield
         return
     token = _ctx.set(tuple(trace_ctx))
+    prev_bind = _bind_thread(tuple(trace_ctx))
     try:
         yield
     finally:
         _ctx.reset(token)
+        _bind_thread(prev_bind)
+
+
+def wait_flushed(timeout: float = 5.0) -> bool:
+    """Push every span/task event this process has buffered into the GCS
+    store and return True once it landed — so ``trace_tree()`` /
+    ``state.list_tasks()`` reflect all spans recorded before the call.
+
+    Replaces the hand-rolled ``sleep(0.3)``-and-poll loops tests used to
+    need: the GCS merges events by task_id, so synchronously shipping a
+    COPY of the buffer is idempotent against the background flush loop
+    re-sending the same entries."""
+    from ray_tpu.core import api as core_api
+
+    deadline = time.monotonic() + timeout
+    try:
+        worker = core_api._require_worker(auto_init=False)
+    except Exception:  # raylint: disable=RL006 -- no live worker: nothing buffered, nothing to flush
+        return True
+    while True:
+        batch = list(worker._task_events_buf)
+        if not batch:
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        try:
+            worker.gcs.call(
+                "report_task_events",
+                {"events": batch},
+                timeout=max(0.1, remaining),
+            )
+            return True
+        except Exception:  # raylint: disable=RL006 -- transient GCS hiccup; retried until the deadline
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
 
 
 # -- querying ----------------------------------------------------------------

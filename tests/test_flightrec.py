@@ -1,0 +1,473 @@
+"""Cross-plane flight recorder (util/flightrec.py) + Chrome-trace
+exporter / critical-path reducer (util/trace_export.py).
+
+Round-20 tentpole coverage:
+
+- ring mechanics: bounded per-plane rings, oldest-first reads, wrap
+  counted as drops, snapshot shape (wall anchors, per-ring drop counts);
+- the ``RAY_TPU_FLIGHTREC=0`` kill switch: zero events, zero dumps, and
+  byte-identical behavior on the seeded fleet-emulation tape (digest
+  equality, the same contract every kill switch in this repo carries);
+- serve-hop golden export: one routed request produces the exact
+  admission -> pick -> dispatch -> request phase sequence, the Chrome
+  trace serializes deterministically, and the critical-path reducer
+  attributes >=95% of the request envelope to named phases;
+- chaos: a seeded ``kvship.sever`` auto-dumps a postmortem snapshot
+  whose fault event replays bit-identically from the seed.
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import GLOBAL_CONFIG
+from ray_tpu.util import flightrec
+from ray_tpu.util import trace_export
+
+
+@pytest.fixture(autouse=True)
+def _flightrec_hygiene(tmp_path):
+    """Every test starts with empty rings, the recorder ON, and dumps
+    routed into its own tmp dir; process-global knobs restored after."""
+    saved = {
+        f: getattr(GLOBAL_CONFIG, f)
+        for f in ("flightrec", "flightrec_ring_size", "flightrec_dump_dir")
+    }
+    GLOBAL_CONFIG.flightrec = True
+    GLOBAL_CONFIG.flightrec_dump_dir = str(tmp_path)
+    flightrec.reset()
+    yield
+    for f, v in saved.items():
+        setattr(GLOBAL_CONFIG, f, v)
+    flightrec.reset()
+
+
+# -- ring mechanics -----------------------------------------------------------
+
+
+def test_record_and_snapshot_shape():
+    t0 = time.monotonic()
+    flightrec.record("serve", "serve.pick", t=t0, dur_s=0.25, rid="fr-1")
+    flightrec.record("train", "train.step", rid="0", rank=3)
+    snap = flightrec.snapshot()
+    assert snap["flightrec"] is True
+    assert snap["mono_anchor"] == flightrec.MONO_ANCHOR
+    assert snap["wall_anchor"] == flightrec.WALL_ANCHOR
+    assert set(snap["rings"]) == {"serve", "train"}
+    (ev,) = snap["rings"]["serve"]["events"]
+    assert ev["phase"] == "serve.pick" and ev["rid"] == "fr-1"
+    assert ev["t"] == t0 and ev["dur_s"] == 0.25
+    (ev,) = snap["rings"]["train"]["events"]
+    assert ev["extra"] == {"rank": 3}  # kwargs land in extra
+    assert snap["rings"]["train"]["dropped"] == 0
+    # The snapshot is JSON-able as-is (the dump file contract).
+    json.dumps(snap)
+
+
+def test_ring_wrap_counts_drops_keeps_newest():
+    GLOBAL_CONFIG.flightrec_ring_size = 8
+    flightrec.reset()  # rings re-created at the new cap
+    for i in range(20):
+        flightrec.record("serve", "serve.pick", rid=f"fr-{i}")
+    snap = flightrec.snapshot()
+    evs = snap["rings"]["serve"]["events"]
+    assert len(evs) == 8
+    assert [e["rid"] for e in evs] == [f"fr-{i}" for i in range(12, 20)]
+    assert snap["rings"]["serve"]["dropped"] == 12
+    assert flightrec.drops("serve") == 12
+    assert flightrec.drops("nonexistent") == 0
+
+
+def test_phase_contextmanager_times_the_block():
+    with flightrec.phase("data", "data.governor_gate", rid="op-1", reason="x"):
+        time.sleep(0.01)
+    (ev,) = flightrec.snapshot()["rings"]["data"]["events"]
+    assert ev["phase"] == "data.governor_gate"
+    assert ev["dur_s"] >= 0.01
+    assert ev["extra"] == {"reason": "x"}
+
+
+def test_kill_switch_records_nothing():
+    GLOBAL_CONFIG.flightrec = False
+    flightrec.record("serve", "serve.pick", rid="fr-1")
+    with flightrec.phase("serve", "serve.dispatch"):
+        pass
+    snap = flightrec.snapshot()
+    assert snap["rings"] == {}
+    assert snap["flightrec"] is False
+    assert flightrec.dump("overload") is None  # no postmortem either
+
+
+def test_dump_writes_postmortem_and_throttles(tmp_path):
+    flightrec.record("gcs", "gcs.actor_dead", rid="abc123")
+    p = flightrec.dump("actor_death")
+    assert p is not None and p.startswith(str(tmp_path))
+    with open(p) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "actor_death"
+    assert doc["rings"]["gcs"]["events"][0]["phase"] == "gcs.actor_dead"
+    # Same reason within the throttle interval: one file, not a storm.
+    assert flightrec.dump("actor_death") is None
+    # A different reason is a different postmortem.
+    assert flightrec.dump("overload") is not None
+    # load_dumps round-trips the file back into a snapshot list.
+    (snap,) = trace_export.load_dumps([p])
+    assert snap["reason"] == "actor_death"
+
+
+def test_obs_metrics_flow_on_snapshot():
+    from ray_tpu.util.metrics import registry
+
+    def total(name):
+        return sum(
+            v for n, _t, v in registry().snapshot()["points"] if n == name
+        )
+
+    ev0 = total("raytpu_obs_events_total")
+    d0 = total("raytpu_obs_dump_total")
+    GLOBAL_CONFIG.flightrec_ring_size = 8
+    flightrec.reset()
+    for _ in range(12):
+        flightrec.record("serve", "serve.pick")
+    flightrec.snapshot()  # flushes the batched counters
+    assert total("raytpu_obs_events_total") == ev0 + 12
+    assert total("raytpu_obs_ring_drops_total") >= 4
+    assert flightrec.dump("overload") is not None
+    assert total("raytpu_obs_dump_total") == d0 + 1
+
+
+# -- exporter (pure functions over snapshots) ---------------------------------
+
+
+def _synthetic_snapshots():
+    """Two processes with different clock anchors, one request spanning
+    both through an ``llm.bind`` alias — the cross-process stitch case."""
+    router = {
+        "pid": 100, "mono_anchor": 50.0, "wall_anchor": 1000.0,
+        "flightrec": True,
+        "rings": {
+            "serve": {
+                "dropped": 0,
+                "events": [
+                    {"t": 50.0, "plane": "serve", "phase": "serve.admission",
+                     "dur_s": 0.5, "rid": "fr-1"},
+                    {"t": 50.5, "plane": "serve", "phase": "serve.pick",
+                     "dur_s": 0.5, "rid": "fr-1"},
+                    {"t": 51.0, "plane": "serve", "phase": "serve.dispatch",
+                     "dur_s": 8.5, "rid": "fr-1"},
+                    {"t": 50.0, "plane": "serve", "phase": "serve.request",
+                     "dur_s": 10.0, "rid": "fr-1",
+                     "extra": {"outcome": "ok"}},
+                ],
+            },
+        },
+    }
+    engine = {
+        "pid": 200, "mono_anchor": 7.0, "wall_anchor": 958.0,
+        "flightrec": True,
+        "rings": {
+            "llm": {
+                "dropped": 0,
+                "events": [
+                    # wall 1001.5 = 958.0 + (50.5 - 7.0)
+                    {"t": 50.5, "plane": "llm", "phase": "llm.bind",
+                     "rid": "req-0", "dur_s": 0.0,
+                     "extra": {"frid": "fr-1"}},
+                    {"t": 51.5, "plane": "llm", "phase": "llm.prefill",
+                     "dur_s": 3.0, "rid": "req-0"},
+                    {"t": 54.5, "plane": "llm", "phase": "llm.decode_step",
+                     "dur_s": 4.0, "rid": "req-0"},
+                ],
+            },
+        },
+    }
+    return [router, engine]
+
+
+def test_chrome_trace_wall_stitch_and_determinism():
+    snaps = _synthetic_snapshots()
+    doc = trace_export.chrome_trace(snaps)
+    assert doc["displayTimeUnit"] == "ms"
+    by_name = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    # Router event: wall 1000.0s -> 1e9 us.
+    assert by_name["serve.admission"]["ts"] == pytest.approx(1000.0 * 1e6)
+    # Engine event lands on the SAME wall timeline via its own anchors:
+    # 958.0 + (51.5 - 7.0) = 1002.5s, 1.5s after the router admission.
+    assert by_name["llm.prefill"]["ts"] == pytest.approx(1002.5 * 1e6)
+    assert by_name["llm.prefill"]["dur"] == pytest.approx(3.0 * 1e6)
+    assert by_name["serve.dispatch"]["tid"] == "serve"
+    assert by_name["serve.dispatch"]["args"]["rid"] == "fr-1"
+    # Process-name metadata once per pid.
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["pid"] for m in metas} == {100, 200}
+    # Deterministic: identical input -> byte-identical serialization.
+    a = json.dumps(doc, sort_keys=True)
+    b = json.dumps(trace_export.chrome_trace(_synthetic_snapshots()),
+                   sort_keys=True)
+    assert a == b
+
+
+def test_critical_path_innermost_attribution_and_aliases():
+    snaps = _synthetic_snapshots()
+    cp = trace_export.critical_path(snaps, "fr-1")
+    assert cp["aliases"] == ["fr-1", "req-0"]  # llm.bind joined the engine
+    assert cp["total_s"] == pytest.approx(10.0)
+    got = {p["phase"]: p["seconds"] for p in cp["phases"]}
+    # Envelope wall [1000, 1010]. dispatch covers [1001, 1009.5]; inside
+    # it prefill [1002.5, 1005.5] and decode [1005.5, 1009.5] win as the
+    # innermost (latest-start) phases; dispatch keeps only [1001, 1002.5].
+    assert got["serve.admission"] == pytest.approx(0.5)
+    assert got["serve.pick"] == pytest.approx(0.5)
+    assert got["serve.dispatch"] == pytest.approx(1.5)
+    assert got["llm.prefill"] == pytest.approx(3.0)
+    assert got["llm.decode_step"] == pytest.approx(4.0)
+    # [1009.5, 1010] is covered by nothing: the only unattributed slice.
+    assert got["(unattributed)"] == pytest.approx(0.5)
+    assert cp["coverage"] == pytest.approx(0.95)
+    # Phases sort by attributed seconds, descending.
+    secs = [p["seconds"] for p in cp["phases"][:-1]]
+    assert secs == sorted(secs, reverse=True)
+    # The reducer works from the engine-side alias too.
+    assert trace_export.critical_path(snaps, "req-0")["total_s"] == cp[
+        "total_s"
+    ]
+    assert trace_export.request_ids(snaps) == ["fr-1"]
+
+
+def test_critical_path_unknown_rid_is_empty():
+    cp = trace_export.critical_path(_synthetic_snapshots(), "fr-404")
+    assert cp["total_s"] == 0.0 and cp["phases"] == []
+
+
+# -- serve golden path (cluster) ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    runtime = ray_tpu.init(num_cpus=8)
+    yield runtime
+    import ray_tpu.serve as serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_serve_hops_export_golden_and_critical_path(cluster):
+    """One routed request records the exact serve-hop phase sequence;
+    the Chrome trace contains a span per hop (replica-side spans arrive
+    over the ``worker.flightrec`` RPC); the critical-path reducer
+    attributes >=95% of the request envelope to named phases."""
+    import ray_tpu.serve as serve
+
+    class Echo:
+        def __call__(self, request):
+            time.sleep(0.05)  # a real replica-side cost to attribute
+            return {"ok": True}
+
+    # admission_config opts the replica into the bounded queue, so the
+    # request records the queue-wait leg too (ungated replicas have no
+    # queue to wait in).
+    dep = serve.deployment(
+        Echo, name="Echo", num_replicas=1, max_concurrent_queries=2,
+        admission_config={"queue_high": 50, "queue_low": 25},
+    )
+    handle = serve.run(dep.bind())
+    # Warm the router (routing-table fetch rides the first request) so
+    # the measured request's envelope is all named phases.
+    assert handle.remote({"x": 0}).result(timeout=60) == {"ok": True}
+    flightrec.reset()  # drop deploy-time noise; record just this request
+    assert handle.remote({"x": 1}).result(timeout=60) == {"ok": True}
+
+    snap = flightrec.snapshot()
+    evs = [
+        e for e in snap["rings"]["serve"]["events"]
+        if e["phase"] != "serve.shed"
+    ]
+    frids = {e.get("rid") for e in evs}
+    assert len(frids) == 1  # one request, one flight-recorder id
+    (frid,) = frids
+    assert frid and frid.startswith("fr-")
+    # The golden router-side sequence, in ring (= causal) order.
+    assert [e["phase"] for e in evs] == [
+        "serve.admission", "serve.pick", "serve.dispatch", "serve.request",
+    ]
+    req = evs[-1]
+    assert req["extra"]["outcome"] == "ok"
+    assert req["dur_s"] >= 0.05  # envelope covers the replica sleep
+
+    # Cluster export: the replica's queue-wait/exec spans ride in over
+    # worker.flightrec RPCs and join the same trace.
+    deadline = time.time() + 30
+    while True:
+        snaps = trace_export.collect_snapshots(cluster=True)
+        names = {
+            e["name"]
+            for e in trace_export.chrome_trace(snaps)["traceEvents"]
+            if e["ph"] == "X"
+        }
+        if "serve.replica_exec" in names or time.time() > deadline:
+            break
+        time.sleep(0.2)
+    for hop in (
+        "serve.admission", "serve.pick", "serve.dispatch",
+        "serve.replica_queue_wait", "serve.replica_exec", "serve.request",
+    ):
+        assert hop in names, f"missing serve hop span {hop}"
+
+    cp = trace_export.critical_path(snaps, frid)
+    assert cp["total_s"] > 0
+    assert cp["coverage"] >= 0.95, cp
+    dominant = cp["phases"][0]["phase"]
+    assert dominant in ("serve.dispatch", "serve.replica_exec")
+    assert frid in trace_export.request_ids(snaps)
+
+
+def test_dashboard_timeline_endpoint(cluster):
+    """`GET /api/v0/timeline` serves the Chrome-trace conversion over
+    HTTP; `?rid=` switches to the critical-path breakdown."""
+    import urllib.request
+
+    from ray_tpu.dashboard import DashboardHead
+
+    flightrec.reset()
+    flightrec.record("serve", "serve.request", dur_s=0.5, rid="fr-api-1",
+                     outcome="ok")
+    flightrec.record("serve", "serve.dispatch", dur_s=0.4, rid="fr-api-1")
+    head = DashboardHead()
+    port = head.start()
+    try:
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30
+            ) as r:
+                return json.loads(r.read())
+
+        doc = get("/api/v0/timeline?cluster=0")
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert {"serve.request", "serve.dispatch"} <= names
+        assert get("/api/v0/timeline?cluster=0&rids=1")["rids"] == [
+            "fr-api-1"
+        ]
+        cp = get("/api/v0/timeline?cluster=0&rid=fr-api-1")
+        assert cp["rid"] == "fr-api-1"
+        assert cp["phases"][0]["phase"] == "serve.dispatch"
+    finally:
+        head.stop()
+
+
+def test_serve_kill_switch_no_events_same_result(cluster):
+    """RAY_TPU_FLIGHTREC=0 on the router process: the same request
+    succeeds identically and the rings stay empty (replicas receive no
+    frid, so nothing is recorded anywhere on the path)."""
+    import ray_tpu.serve as serve
+
+    @serve.deployment(num_replicas=1)
+    class Quiet:
+        def __call__(self, request):
+            return {"ok": True}
+
+    handle = serve.run(Quiet.bind())
+    GLOBAL_CONFIG.flightrec = False
+    flightrec.reset()
+    assert handle.remote({"x": 1}).result(timeout=60) == {"ok": True}
+    assert flightrec.snapshot()["rings"] == {}
+
+
+# -- kill-switch byte-identity on the seeded fleet tape -----------------------
+
+
+def test_fleet_tape_byte_identical_with_recorder_off():
+    """The recorder must never change a decision: the seeded fleet tape
+    produces digest-identical placement decisions and final state with
+    the recorder ON vs OFF — and the ON run actually recorded the tape."""
+    from ray_tpu.core.fleet_emu import FleetEmulator, schedule_events
+
+    tape = schedule_events(11, "churn", 30, 60)
+    digests = {}
+    for arm in ("on", "off"):
+        GLOBAL_CONFIG.flightrec = arm == "on"
+        flightrec.reset()
+        with FleetEmulator(30, seed=11) as emu:
+            emu.register_all()
+            emu.run_schedule(tape)
+            digests[arm] = (
+                emu.decision_digest(), emu.final_state_digest(),
+            )
+        ring = flightrec.snapshot()["rings"].get("fleet_emu")
+        if arm == "on":
+            evs = ring["events"]
+            assert len(evs) + ring["dropped"] == len(tape)
+            assert all(e["phase"].startswith("fleet.") for e in evs)
+        else:
+            assert ring is None
+    assert digests["on"] == digests["off"]
+
+
+# -- chaos: seeded sever auto-dumps a replayable postmortem -------------------
+
+
+def _severed_llm_run(seed: int, dump_dir: str):
+    """One decode-tier run under a seeded kvship sever (the round-16
+    chaos case) with the recorder on; returns (tokens, fault events,
+    dump files written)."""
+    import os
+
+    from ray_tpu.core import faults
+    from ray_tpu.llm.config import LLMConfig, SamplingParams
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.models.gpt2 import GPT2Config
+
+    def cfg(**kw):
+        model = GPT2Config.tiny(n_layer=2, d_model=64, n_head=2, max_seq=256)
+        return LLMConfig(
+            model_config=model, max_slots=4, max_seq=256,
+            prefill_buckets=(16, 32, 64, 128, 256), prefix_chunk=16, **kw,
+        )
+
+    prompt = list(range(2, 70))
+    greedy = SamplingParams(max_tokens=10, temperature=0.0)
+    flightrec.reset()  # also clears the dump throttle between runs
+    before = set(os.listdir(dump_dir))
+    A = LLMEngine(cfg())
+    B = LLMEngine(cfg(prefill_chunk_tokens=32))
+    A.add_request("p", prompt, greedy, prefill_only=True)
+    while A.has_unfinished():
+        A.step()
+    (pre,) = A.pop_finished()
+    faults.install(faults.parse_spec(seed, "kvship.sever"))
+    try:
+        B.add_handoff_request("d", pre.handoff_out, greedy)
+        while B.has_unfinished():
+            B.step()
+        (req,) = B.pop_finished()
+    finally:
+        faults.clear()
+    fault_evs = [
+        {k: v for k, v in e.items() if k in ("phase", "extra")}
+        for e in flightrec.snapshot()["rings"]["faults"]["events"]
+    ]
+    new_dumps = sorted(set(os.listdir(dump_dir)) - before)
+    return req.generated, fault_evs, new_dumps
+
+
+def test_seeded_sever_dumps_postmortem_replay_identical(tmp_path):
+    """The acceptance chaos case: an injected ``kvship.sever`` writes a
+    flight-recorder postmortem automatically (no code in the failure path
+    asked for one), the dump names the fault, and the whole thing —
+    tokens, fault events, dump content — replays from the seed."""
+    got1, faults1, dumps1 = _severed_llm_run(7, str(tmp_path))
+    assert len(dumps1) == 1 and "kvship.sever" in dumps1[0]
+    with open(tmp_path / dumps1[0]) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "fault:kvship.sever"
+    dumped = doc["rings"]["faults"]["events"]
+    assert any(e["phase"] == "kvship.sever" for e in dumped)
+    assert faults1, "the fault plane recorded the firing"
+    # Replay: same seed, same tokens, same fault events, a fresh dump.
+    got2, faults2, dumps2 = _severed_llm_run(7, str(tmp_path))
+    assert got2 == got1
+    assert faults2 == faults1
+    assert len(dumps2) == 1 and dumps2[0] != dumps1[0]

@@ -9,6 +9,7 @@ from tools.metrics_lint import (
     lint_catalog,
     lint_kinds,
     lint_points,
+    lint_readme,
     populate_catalog,
 )
 
@@ -240,6 +241,84 @@ def test_podracer_rl_series_registered_and_linted():
     assert catalog["raytpu_rl_replay_occupancy"]["kind"] == "gauge"
     assert catalog["raytpu_rl_replay_occupancy"]["tag_keys"] == ("plane",)
     assert lint_catalog(catalog) == []
+
+
+def test_flightrec_series_registered_and_linted():
+    """Round-20 observability-plane series: the flight recorder's event /
+    ring-drop / dump counters are declared through the catalog so the
+    lint covers them — tagged by plane (bounded vocabulary: serve, llm,
+    train, data, gcs, fleet_emu, faults) or trigger reason, never an
+    id."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    for name, tags in (
+        ("raytpu_obs_events_total", ("plane",)),
+        ("raytpu_obs_ring_drops_total", ("plane",)),
+        ("raytpu_obs_dump_total", ("reason",)),
+    ):
+        assert name in catalog, f"{name} missing from the runtime catalog"
+        assert catalog[name]["kind"] == "counter"
+        assert catalog[name]["tag_keys"] == tags
+    assert lint_catalog(catalog) == []
+
+
+def test_readme_doc_drift_both_directions():
+    """The README 'Runtime telemetry' table and the runtime catalog must
+    agree both ways: the real README passes against the real catalog, and
+    the lint catches a declared-but-undocumented series as well as a
+    documented-but-undeclared one."""
+    import os
+
+    populate_catalog(include_optional=False)
+    import ray_tpu.llm.disagg  # noqa: F401 — table rows cover llm series
+    import ray_tpu.llm.engine  # noqa: F401
+    import ray_tpu.llm.serve_llm  # noqa: F401
+    import ray_tpu.llm.spec_decode  # noqa: F401
+    import ray_tpu.rllib.env_runner  # noqa: F401 — and the rl series
+    import ray_tpu.rllib.podracer  # noqa: F401
+    import ray_tpu.rllib.replay_buffer  # noqa: F401
+
+    catalog = m.runtime_catalog()
+    readme = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "README.md",
+    )
+    with open(readme) as f:
+        text = f.read()
+    # Direction guard: synthetic catalogs/tables must fail...
+    drift = lint_readme({"raytpu_ghost_total": {"kind": "counter"}}, text)
+    assert any("raytpu_ghost_total" in p and "missing" in p for p in drift)
+    fake_row = "| `raytpu_vapor_total` | counter | — | core |\n"
+    drift = lint_readme(catalog, text + fake_row)
+    assert any("raytpu_vapor_total" in p and "not declared" in p
+               for p in drift)
+    # ...and the real pair must pass (ignore series only declared by
+    # test-local declare_runtime_metric calls in this process).
+    catalog = {
+        k: v for k, v in catalog.items()
+        if not k.startswith("raytpu_test_")
+    }
+    assert lint_readme(catalog, text) == []
+
+
+def test_readme_shorthand_expansion():
+    """``/ _suffix`` shorthand in a table row expands against the row's
+    first full name at underscore boundaries."""
+    table = (
+        "| Series | Type | Tags | Layer |\n"
+        "|---|---|---|---|\n"
+        "| `raytpu_node_workers` / `_cpu_available` | gauge | — | core |\n"
+    )
+    catalog = {
+        "raytpu_node_workers": {"kind": "gauge"},
+        "raytpu_node_cpu_available": {"kind": "gauge"},
+    }
+    assert lint_readme(catalog, table) == []
+    # A shorthand that matches nothing declared is drift too.
+    bad = table.replace("`_cpu_available`", "`_gpu_available`")
+    drift = lint_readme(catalog, bad)
+    assert any("matches no" in p for p in drift)
+    assert any("raytpu_node_cpu_available" in p for p in drift)
 
 
 def test_fleet_scale_series_registered_and_linted():

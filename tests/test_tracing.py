@@ -22,13 +22,17 @@ def cluster():
 
 
 def _wait_tree(pred, timeout=20.0):
+    # wait_flushed ships this process's buffered span events synchronously,
+    # so driver-recorded spans are visible on the FIRST trace_tree() read;
+    # the short poll below only covers events buffered on other workers.
     deadline = time.time() + timeout
     while time.time() < deadline:
+        tracing.wait_flushed(timeout=max(0.1, deadline - time.time()))
         roots = tracing.trace_tree()
         v = pred(roots)
         if v:
             return v
-        time.sleep(0.3)
+        time.sleep(0.05)
     raise TimeoutError(f"trace condition not met; last roots={roots}")
 
 

@@ -1,0 +1,37 @@
+"""Same-session A/B of the flight-recorder overhead.
+
+Runs ``tools/ray_perf.py --serve-overload`` alternately with the flight
+recorder ON (HEAD default: every serve hop, replica queue wait, engine
+phase, and shed records a ring event) and OFF (``--no-flightrec``,
+equivalent to RAY_TPU_FLIGHTREC=0) on the SAME commit, interleaved so
+ambient box load hits both arms equally. The traffic is the SEEDED flash
+crowd (tools/traffic_gen.py, seed 7), so both arms see a bit-identical
+arrival schedule — the only variable is the recorder.
+
+    python tools/ab_tracing.py [--rounds 3] [--full]
+
+Read the result as: the ON arm's serve_overload_admitted_p99_ttft_ms is
+the serve p99 probe with the recorder charging every hop; the acceptance
+bar for the observability plane is ON within ~3% of OFF. The
+interleaved-median machinery is shared with tools/ab_coalesce.py;
+bench.py folds the same pair into its ``obs_overhead`` record.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ab_coalesce import ab_main  # noqa: E402 — shared harness
+
+
+def main() -> int:
+    return ab_main(
+        "--no-flightrec", "flightrec", base_flags=("--serve-overload",)
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

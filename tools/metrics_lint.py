@@ -6,7 +6,12 @@ Rules (CI-enforced via tests/test_metrics_lint.py):
      conflicting kinds (a counter/gauge flip silently corrupts merges);
   3. bounded tag cardinality — no denylisted id-shaped tag keys
      (task_id, object_id, ...) and no id-shaped tag VALUES (long hex /
-     uuid strings) sneaking in through an allowed key.
+     uuid strings) sneaking in through an allowed key;
+  4. README doc drift — the "Runtime telemetry" table and the runtime
+     catalog must agree BOTH ways: every declared series has a table
+     row, and every table row names a series that actually exists
+     (``_suffix`` shorthand in a row expands against the row's first
+     full name).
 
 Run standalone:  python tools/metrics_lint.py
 (imports every instrumented layer so the catalog is fully populated, then
@@ -46,6 +51,7 @@ _CATALOG_MODULES = [
     "ray_tpu.train.input",  # prefetch-miss counter (host-free train tier)
     "ray_tpu.train.worker_group",
     "ray_tpu.util.collective.hierarchical",  # collective hop/byte series
+    "ray_tpu.util.flightrec",  # flight-recorder obs counters (round 20)
 ]
 _OPTIONAL_MODULES = [
     "ray_tpu.llm.engine",
@@ -143,12 +149,97 @@ def lint_points(snapshots: list, runtime_only: bool = True) -> list[str]:
     return problems
 
 
+# -- README doc drift ---------------------------------------------------------
+
+_TABLE_ROW_RE = re.compile(r"^\|\s*(`[^|]*`)\s*\|")
+_NAME_TOKEN_RE = re.compile(r"`([A-Za-z0-9_]+)`")
+
+
+def _shorthand_matches(name: str, base: str, suffix: str) -> bool:
+    """True if catalog series ``name`` is what the ``/ _suffix``
+    shorthand next to full name ``base`` refers to: ``name`` ends with
+    the suffix and the remaining prefix is an underscore-prefix of
+    ``base`` (so ``raytpu_node_workers / _cpu_available`` documents
+    ``raytpu_node_cpu_available``)."""
+    suffix = "_" + suffix.lstrip("_")
+    if not name.endswith(suffix):
+        return False
+    prefix = name[: -len(suffix)]
+    return bool(prefix) and (
+        base == prefix or base.startswith(prefix + "_")
+    )
+
+
+def lint_readme(catalog: dict, readme_text: str) -> list[str]:
+    """Doc drift between the runtime catalog and the README telemetry
+    table, in BOTH directions: a declared series with no table row is as
+    much a failure as a table row naming a series that no longer exists
+    (renames must update the docs in the same change)."""
+    rows = []  # (base_full_name, [tokens]) per table first-cell
+    for line in readme_text.splitlines():
+        m = _TABLE_ROW_RE.match(line.strip())
+        if not m:
+            continue
+        tokens = [
+            t for t in _NAME_TOKEN_RE.findall(m.group(1))
+            if t not in ("Series",)
+        ]
+        if not tokens or not any(t.startswith("raytpu_") for t in tokens):
+            continue
+        base = next(t for t in tokens if t.startswith("raytpu_"))
+        rows.append((base, tokens))
+
+    declared = set(catalog)
+    problems = []
+
+    def documents(name: str) -> bool:
+        for base, tokens in rows:
+            for tok in tokens:
+                if tok == name:
+                    return True
+                if not tok.startswith("raytpu_") and _shorthand_matches(
+                    name, base, tok
+                ):
+                    return True
+        return False
+
+    for name in sorted(declared):
+        if not documents(name):
+            problems.append(
+                f"{name}: declared but missing from the README "
+                f"'Runtime telemetry' table"
+            )
+    for base, tokens in rows:
+        for tok in tokens:
+            if tok.startswith("raytpu_"):
+                if tok not in declared:
+                    problems.append(
+                        f"{tok}: documented in README but not declared "
+                        f"by any runtime module"
+                    )
+            elif not any(
+                _shorthand_matches(n, base, tok) for n in declared
+            ):
+                problems.append(
+                    f"{base} / {tok}: README shorthand matches no "
+                    f"declared series"
+                )
+    return problems
+
+
 def main() -> int:
     populate_catalog()
     from ray_tpu.util.metrics import registry, runtime_catalog
 
     problems = lint_catalog(runtime_catalog())
     problems += lint_points([registry().snapshot()])
+    readme = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "README.md",
+    )
+    if os.path.exists(readme):
+        with open(readme) as f:
+            problems += lint_readme(runtime_catalog(), f.read())
     if problems:
         for p in problems:
             print(f"FAIL {p}")

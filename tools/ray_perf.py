@@ -1163,6 +1163,15 @@ def main() -> int:
         "scheduler",
     )
     ap.add_argument(
+        "--no-flightrec",
+        action="store_true",
+        help="kill switch: no flight-recorder phase events anywhere "
+        "(equivalent to RAY_TPU_FLIGHTREC=0) — the A/B baseline for the "
+        "observability plane; the ON arm must stay within ~3%% on the "
+        "serve p99 probe (bench.py's obs_overhead record rides "
+        "--serve-overload via tools/ab_tracing.py)",
+    )
+    ap.add_argument(
         "--faults",
         metavar="SEED:SPEC",
         help="enable the fault-injection plane for the whole run "
@@ -1208,6 +1217,7 @@ def main() -> int:
         or args.no_podracer
         or args.no_data_governor
         or args.no_sched_index
+        or args.no_flightrec
     ):
         from ray_tpu.core.config import GLOBAL_CONFIG
 
@@ -1236,6 +1246,8 @@ def main() -> int:
             GLOBAL_CONFIG.data_governor = False
         if args.no_sched_index:
             GLOBAL_CONFIG.sched_index = False
+        if args.no_flightrec:
+            GLOBAL_CONFIG.flightrec = False
 
     if args.fleet_only:
         # In-process emulator rows: no cluster runtime at all (the GCS +

@@ -1,0 +1,34 @@
+"""CLI wrapper: export flight-recorder rings as a Chrome trace or a
+per-request critical-path breakdown.
+
+The library lives in ``ray_tpu/util/trace_export.py`` (the dashboard's
+``/api/v0/timeline`` imports it from there); this entry point exists so
+the export sits next to the other operational tools::
+
+    python tools/trace_export.py --out trace.json          # live rings
+    python tools/trace_export.py --cluster --out trace.json
+    python tools/trace_export.py --dump /tmp/ray_tpu_flightrec/*.json
+    python tools/trace_export.py --list-rids
+    python tools/trace_export.py --rid fr-1234-0           # breakdown
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ray_tpu.util.trace_export import (  # noqa: E402,F401  (re-exported API)
+    chrome_trace,
+    collect_snapshots,
+    critical_path,
+    load_dumps,
+    main,
+    request_ids,
+)
+
+if __name__ == "__main__":
+    sys.exit(main())
