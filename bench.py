@@ -534,6 +534,28 @@ def _train_overlap_rows() -> dict:
     return out
 
 
+def _train_elastic_rows() -> dict:
+    """Elastic-recovery A/B (round-21): preempt-to-first-step latency on
+    a 2-node gang that loses a node to a graceful drain notice mid-run,
+    with live re-formation ON (pause -> peer reshard -> resume in the
+    same generation) vs the kill-switch arm (``--no-elastic``: tear down
+    and rebuild from the latest checkpoint). Both arms stamp the same
+    drain-seen -> first-post-recovery-report interval."""
+    out = _ab_rows(
+        "train_elastic",
+        ("--train-only", "--elastic-probe"),
+        ("--no-elastic",),
+        420,
+    )
+    if "on" in out and "off" in out:
+        on_ms = out["on"].get("train_elastic_recovery_ms") or 0
+        off_ms = out["off"].get("train_elastic_recovery_ms") or 0
+        if on_ms:
+            # >1 = re-forming live beat the checkpoint round trip.
+            out["recovery_off_on_ratio"] = round(off_ms / on_ms, 3)
+    return out
+
+
 def _podracer_rows() -> dict:
     """Podracer decoupled-RL A/B (round-17): env_steps/s + learner
     updates/s + weight-lag p99 on the emulated-cost CartPole with the
@@ -632,6 +654,7 @@ def _emit(
     serve_llm: dict | None = None,
     raylint: dict | None = None,
     train_overlap: dict | None = None,
+    train_elastic: dict | None = None,
     serve_overload: dict | None = None,
     serve_disagg: dict | None = None,
     podracer: dict | None = None,
@@ -671,6 +694,10 @@ def _emit(
         # Train-overlap A/B (async dispatch + prefetch ON vs kill switch)
         # rides every record like data_plane/serve_llm from round 13 on.
         record = {**record, "train_overlap": train_overlap}
+    if train_elastic:
+        # Elastic-recovery A/B (live re-formation ON vs --no-elastic
+        # checkpoint rebuild) rides every record from round 21 on.
+        record = {**record, "train_elastic": train_elastic}
     if podracer:
         # Podracer decoupled-RL A/B (planes ON vs --no-podracer) rides
         # every record from round 17 on.
@@ -702,6 +729,7 @@ def main() -> None:
     serve_overload = _serve_overload_rows()
     obs_overhead = _obs_overhead_rows()
     train_overlap = _train_overlap_rows()
+    train_elastic = _train_elastic_rows()
     podracer = _podracer_rows()
     data_governor = _data_governor_rows()
     fleet_scale = _fleet_scale_rows()
@@ -712,8 +740,8 @@ def main() -> None:
     def emit(record: dict) -> None:
         _emit(
             record, data_plane, probe_record, serve_llm, raylint,
-            train_overlap, serve_overload, serve_disagg, podracer,
-            data_governor, fleet_scale, obs_overhead,
+            train_overlap, train_elastic, serve_overload, serve_disagg,
+            podracer, data_governor, fleet_scale, obs_overhead,
         )
 
     try:

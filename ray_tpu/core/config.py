@@ -392,6 +392,36 @@ class Config:
     flightrec: bool = True
     flightrec_ring_size: int = 4096
     flightrec_dump_dir: str = ""
+    # Elastic pod-scale training (round 21). ``elastic_train`` is the
+    # kill switch (RAY_TPU_ELASTIC_TRAIN=0): off, a membership change
+    # takes the round-10 path byte-identically — the controller tears the
+    # gang down on a drain notice and rebuilds it from the latest
+    # persisted checkpoint ("preempted" outcome, no max_failures burn).
+    # On, the controller enters a RESHAPING state instead: every rank
+    # pauses at its next step boundary (report() raises the pause signal
+    # AFTER the step's state is retained), the two-level topology is
+    # re-derived at the surviving world size, params + optimizer state
+    # reshard device-to-device over the transfer fabric from surviving
+    # peers (zero checkpoint-storage reads), and the run resumes at the
+    # donor boundary — still without burning max_failures. Any reshape
+    # failure (pause timeout, fabric pull failure, a second preemption
+    # mid-reshard) falls back to that same checkpoint-restore path, so
+    # elastic never makes an outcome worse than the kill-switch arm.
+    elastic_train: bool = True
+    # Floor on the post-shrink world size: fewer survivors than this and
+    # the controller skips the live reshape (checkpoint-restore fallback
+    # rebuilds at full size instead of limping at a tiny world).
+    elastic_min_world_size: int = 1
+    # How long the controller waits for every rank to pause at a step
+    # boundary before giving up on the live reshape.
+    elastic_pause_timeout_s: float = 15.0
+    # Budget for the fabric state transfer (snapshot arm + peer pulls).
+    elastic_reshard_timeout_s: float = 60.0
+    # Scale-up arm: while running below ScalingConfig.num_workers (after
+    # a shrink), the controller periodically tries to create replacement
+    # workers and joins them at a step boundary, hydrated from peers.
+    # 0 disables growing (the group stays at the shrunken size).
+    elastic_grow_check_s: float = 2.0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))

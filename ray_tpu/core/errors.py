@@ -91,6 +91,47 @@ class OverloadedError(RayTpuError):
         )
 
 
+class PeerDiedError(RayTpuError):
+    """A collective peer died while the group was forming or mid-op.
+
+    Raised by the coordinator's join/collective wait loops the moment a
+    death is reported (``report_death``), instead of leaving every other
+    rank blocked on the barrier until the full RPC deadline — group
+    (re)formation fails fast and the caller can re-form at the new
+    membership. Carries the dead rank and the reported reason."""
+
+    def __init__(self, rank: int = -1, reason: str = ""):
+        self.rank = int(rank)
+        self.reason = reason
+        super().__init__(
+            f"collective peer rank {rank} died"
+            + (f": {reason}" if reason else "")
+        )
+
+    def __reduce__(self):
+        # Crosses the coordinator-actor RPC boundary as a TaskError cause;
+        # must unpickle with fields intact.
+        return (PeerDiedError, (self.rank, self.reason))
+
+
+class StaleGroupEpochError(RayTpuError):
+    """A rank from a retired group generation issued a collective against
+    a coordinator that has advanced its epoch (elastic re-formation).
+    Fencing: the stale rank fails fast here instead of contributing into
+    (and hanging) the new generation's ops."""
+
+    def __init__(self, epoch: int = -1, current: int = -1):
+        self.epoch = int(epoch)
+        self.current = int(current)
+        super().__init__(
+            f"stale collective epoch {epoch} (coordinator is at "
+            f"epoch {current}); the group re-formed — re-join required"
+        )
+
+    def __reduce__(self):
+        return (StaleGroupEpochError, (self.epoch, self.current))
+
+
 class FaultInjectedError(RayTpuError):
     """Raised by the deterministic fault-injection plane (core/faults.py);
     never seen in production (the injector is off unless RAY_TPU_FAULTS or

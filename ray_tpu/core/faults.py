@@ -54,6 +54,15 @@ site       actions                injected where
                                   resubmit the block to a replacement,
                                   and preserve output block order.
                                   ``match`` globs ``a<actor_index>``.
+``elastic`` sever delay           elastic-train reshard fabric pull
+                                  (``train/worker_group.py``
+                                  ``elastic_hydrate``): ``sever`` = the
+                                  peer state pull fails mid-reshape →
+                                  the controller abandons the live
+                                  reshard and falls back to checkpoint
+                                  restore (still no max_failures burn);
+                                  ``delay`` sleeps the pull. ``match``
+                                  globs ``r<new_rank>``.
 ``envrun`` kill                   RL rollout actor, per vector env step
                                   (``rllib/env_runner.py``
                                   ``_record_episode_step``): the worker
@@ -114,6 +123,11 @@ _SITE_ACTIONS = {
     "weightsync": frozenset({"sever", "delay"}),
     "envrun": frozenset({"kill"}),
     "datapool": frozenset({"kill"}),
+    # Elastic-training reshard plane: the fabric state pulls that hydrate
+    # a re-formed worker group. ``sever`` fails the pull (the controller
+    # falls back to checkpoint restore — the "preemption DURING a
+    # reshard" scenario); ``delay`` stretches it.
+    "elastic": frozenset({"sever", "delay"}),
 }
 
 

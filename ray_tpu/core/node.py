@@ -466,13 +466,29 @@ class NodeManager:
             except Exception:  # raylint: disable=RL006 -- GCS unreachable mid-drain: actors restart post-mortem instead
                 moved = []
             self._retire_actor_workers(moved)
-            # Running tasks get whatever remains of the grace window.
+            # Running tasks AND live non-restartable actors get whatever
+            # remains of the grace window. The actor wait is the
+            # preemption-handoff seam: a non-restartable actor's owner
+            # (e.g. the elastic train controller resharding a paused
+            # gang's state off this node) needs the DRAINING view to stay
+            # up until it releases the actor — retiring the moment our own
+            # bookkeeping is done would turn every preemption notice into
+            # an instant kill. The drain completes the moment the last
+            # such actor is released; an unclaimed actor rides to the
+            # deadline and the GCS force fallback closes the drain.
             while time.monotonic() < deadline:
-                if not any(
-                    (w := self.workers.get(lease.worker_id)) is not None
-                    and not w.actor_ids
-                    for lease in self.leases.values()
-                ):
+                pending = False
+                for lease in self.leases.values():
+                    w = self.workers.get(lease.worker_id)
+                    if w is None:
+                        continue
+                    if not w.actor_ids:
+                        pending = True  # running task finishing out
+                        break
+                    if w.proc is None or w.proc.poll() is None:
+                        pending = True  # live actor awaiting owner handoff
+                        break
+                if not pending:
                     clean = True
                     break
                 await asyncio.sleep(0.05)

@@ -273,17 +273,40 @@ class LearnerGroup:
 
     def update_device(self, cols: dict) -> dict:
         """Device-resident minibatch update (podracer learner plane).
-        Only the in-process (TPU-path) learner supports it: actor-group
-        learners receive host batches over RPC by construction, so the
-        device stream would round-trip anyway — the podracer driver
-        requires num_learners <= 1 for the decoupled arm."""
-        if self._local is None:
-            raise RuntimeError(
-                "update_device() requires the in-process learner "
-                "(num_learners <= 1); actor-group learners take the host "
-                "update() path"
+
+        In-process (TPU-path) learner: the columns go straight into the
+        jitted step. Actor group (n > 1): each actor takes a contiguous
+        dim0 shard of the minibatch over RPC (the host hop is inherent to
+        actor learners — the data plane is host arrays by construction),
+        runs the SAME jitted step, and the per-step flat-gradient
+        allreduce keeps every replica's params identical; rank 0's stats
+        come back. Replica equality with the single-learner full-batch
+        step holds for mean-based losses with equal shards (mean of
+        equal-size shard-means == full-batch mean)."""
+        if self._local is not None:
+            return self._local.update_device(cols)
+        import numpy as np
+
+        import ray_tpu
+
+        n = self.num_learners
+        rows = min(len(v) for v in cols.values())
+        if rows % n:
+            raise ValueError(
+                f"update_device minibatch dim0 {rows} is not divisible by "
+                f"num_learners {n}; gradient means would diverge across "
+                f"replicas"
             )
-        return self._local.update_device(cols)
+        shard = rows // n
+        host = {k: np.asarray(v) for k, v in cols.items()}  # raylint: disable=RL101 -- actor learners receive host arrays over RPC by construction; the device stream ends at the group boundary
+        refs = [
+            a.update_device.remote(
+                {k: v[i * shard : (i + 1) * shard] for k, v in host.items()}
+            )
+            for i, a in enumerate(self._actors)
+        ]
+        results = ray_tpu.get(refs)
+        return results[0]
 
     def get_state(self) -> dict:
         import ray_tpu

@@ -21,6 +21,7 @@ from ray_tpu.train.context import (
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    get_elastic_state,
     report,
 )
 from ray_tpu.train.input import DevicePrefetchIterator
@@ -51,6 +52,7 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "get_elastic_state",
     "load_sharded_state",
     "make_train_state",
     "make_train_step",

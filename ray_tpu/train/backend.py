@@ -17,5 +17,12 @@ class Backend:
     def on_start(self, worker_group, backend_config) -> None:
         pass
 
+    def on_reshape(self, worker_group, backend_config) -> None:
+        """Re-wire the framework runtime after an elastic membership
+        change (the group re-formed at a new world size, survivors kept
+        their processes). Default: run the start hook again — backends
+        whose runtime can't re-init in place override this."""
+        self.on_start(worker_group, backend_config)
+
     def on_shutdown(self, worker_group, backend_config) -> None:
         pass

@@ -143,6 +143,17 @@ class TrainContext:
     # back to host, oldest first. Bounded by train_async_dispatch_depth;
     # eviction/flush materializes entries (in index order) into _reports.
     _pending: list = field(default_factory=list)
+    # Elastic plane: the latest step-boundary state the train fn handed to
+    # report(elastic_state=...) — {"state", "index", "layout"} — retained
+    # in worker memory (never persisted) so a membership change can move
+    # it peer-to-peer instead of restoring from checkpoint storage. On a
+    # resumed generation the worker pre-loads the hydrated boundary state
+    # here before the fn re-runs; get_elastic_state() hands it back.
+    _elastic: Optional[dict] = None
+    # Set by the controller (via TrainWorker.request_pause): report()
+    # raises ElasticPauseSignal AFTER capturing the step's report and
+    # elastic state, so the fn unwinds at a clean boundary.
+    _pause_requested: bool = False
 
     # -- user API ------------------------------------------------------------
 
@@ -176,11 +187,36 @@ class TrainContext:
     def get_checkpoint(self) -> Optional[Checkpoint]:
         return self.latest_checkpoint
 
+    def get_elastic_state(self) -> Optional[dict]:
+        """The hydrated step-boundary state after an elastic reshape:
+        ``{"state": <pytree>, "index": <report index it was captured
+        at>}``, or None on a fresh (or checkpoint-restored) generation.
+        A resumed train fn checks this FIRST — before get_checkpoint() —
+        and continues from ``index + 1``; the step stream is then
+        bit-identical to a from-checkpoint restore at the same boundary."""
+        with self._lock:
+            if self._elastic is None:
+                return None
+            return {
+                "state": self._elastic["state"],
+                "index": self._elastic["index"],
+            }
+
+    def request_pause(self) -> bool:
+        """Arm the step-boundary pause (controller-side elastic RPC). The
+        NEXT report() call completes normally — its report is buffered and
+        its elastic_state retained — then raises ElasticPauseSignal."""
+        with self._lock:
+            self._pause_requested = True
+        return True
+
     def report(
         self,
         metrics: dict,
         checkpoint: Optional[Checkpoint] = None,
         sharded_state: Any = None,
+        elastic_state: Any = None,
+        elastic_layout: str = "replicated",
     ) -> None:
         """Report metrics (all ranks, in lockstep) and optionally persist a
         checkpoint. ``checkpoint`` copies a worker-local directory into the
@@ -198,7 +234,17 @@ class TrainContext:
         of dispatch run ahead of the device. Host readback happens only on
         ring eviction, at checkpoint boundaries (which flush the ring
         first), or at :meth:`flush` — each step's metrics surface at most
-        ``depth`` reports late, bit-identical to the synchronous loop."""
+        ``depth`` reports late, bit-identical to the synchronous loop.
+
+        Elastic mode: ``elastic_state`` retains the step's state pytree in
+        worker memory (a reference — nothing is copied or persisted) so a
+        membership change can reshard it peer-to-peer over the transfer
+        fabric instead of reading checkpoint storage; ``elastic_layout``
+        declares how ranks hold it ("replicated": every rank has the full
+        copy; "sharded": each rank holds its balanced dim0 shard of every
+        sharded leaf). If the controller has requested a pause, report()
+        raises ElasticPauseSignal AFTER the report is buffered and the
+        state retained — the step boundary is the pause point."""
         if checkpoint is not None and sharded_state is not None:
             raise ValueError(
                 "pass either checkpoint= or sharded_state=, not both"
@@ -230,6 +276,7 @@ class TrainContext:
             depth = self._async_depth()
             if depth > 0:
                 self._enqueue_async(index, metrics, depth)
+                self._post_report(index, elastic_state, elastic_layout)
                 return
             # Kill-switch arm: synchronous readback on the step path (the
             # host-blocked time lands in raytpu_train_host_blocked_seconds
@@ -266,6 +313,31 @@ class TrainContext:
                     "world_rank": self.world_rank,
                 }
             )
+        self._post_report(index, elastic_state, elastic_layout)
+
+    def _post_report(
+        self, index: int, elastic_state: Any, elastic_layout: str
+    ) -> None:
+        """Shared report() tail: retain the boundary state, then honor a
+        pending pause — AFTER retention, so the pause point always has the
+        step's state, and after a ring flush, so every report at or before
+        the boundary is materialized when the controller drains."""
+        pause = False
+        with self._lock:
+            if elastic_state is not None:
+                self._elastic = {
+                    "state": elastic_state,
+                    "index": index,
+                    "layout": elastic_layout,
+                }
+            if self._pause_requested:
+                self._pause_requested = False
+                pause = True
+        if pause:
+            from ray_tpu.train.elastic import ElasticPauseSignal
+
+            self.flush()
+            raise ElasticPauseSignal(f"paused at step boundary {index}")
 
     def _persist_sharded(self, state: Any, index: int) -> Checkpoint:
         """Collective sharded save straight into the run's checkpoint dir
@@ -361,16 +433,33 @@ def report(
     metrics: dict,
     checkpoint: Optional[Checkpoint] = None,
     sharded_state: Any = None,
+    elastic_state: Any = None,
+    elastic_layout: str = "replicated",
 ) -> None:
     """Report metrics (+ optional checkpoint) from the train loop
     (reference: ray.train.report). sharded_state= persists a pytree of
-    distributed jax arrays with per-shard parallel IO (see
-    TrainContext.report)."""
-    get_context().report(metrics, checkpoint, sharded_state=sharded_state)
+    distributed jax arrays with per-shard parallel IO; elastic_state=
+    retains the step-boundary state in worker memory for elastic
+    membership changes (see TrainContext.report)."""
+    get_context().report(
+        metrics,
+        checkpoint,
+        sharded_state=sharded_state,
+        elastic_state=elastic_state,
+        elastic_layout=elastic_layout,
+    )
 
 
 def get_checkpoint() -> Optional[Checkpoint]:
     return get_context().get_checkpoint()
+
+
+def get_elastic_state() -> Optional[dict]:
+    """The peer-hydrated step-boundary state after an elastic reshape
+    (``{"state": <pytree>, "index": <boundary report index>}``), or None.
+    Elastic-capable train fns check this BEFORE get_checkpoint() on entry
+    and continue from ``index + 1`` (see TrainContext.get_elastic_state)."""
+    return get_context().get_elastic_state()
 
 
 def get_dataset_shard(name: str = "train"):

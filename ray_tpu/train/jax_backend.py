@@ -33,6 +33,19 @@ class JaxConfig(BackendConfig):
         return _JaxBackend
 
 
+def _jax_shutdown_worker():
+    """Tear down a live jax.distributed runtime inside a surviving worker
+    so the elastic re-formation can re-initialize at the new world size
+    (jax refuses a second initialize() while the old one is up)."""
+    from ray_tpu.util.tpu import jax_distributed_initialized
+
+    if jax_distributed_initialized():
+        import jax
+
+        jax.distributed.shutdown()
+    return True
+
+
 def _jax_init_worker(
     platform: Optional[str],
     coordinator: Optional[str],
@@ -102,3 +115,19 @@ class _JaxBackend(Backend):
                 )
             )
         ray_tpu.get(refs, timeout=300)
+
+    def on_reshape(self, worker_group, backend_config: JaxConfig) -> None:
+        """Live re-init at the new world size: survivors shut their old
+        jax.distributed runtime down (the old coordinator may be on a
+        preempted node), then the start hook re-forms it with the new
+        rank 0 as coordinator and the new process count."""
+        if backend_config.distributed:
+            payload = cloudpickle.dumps(_jax_shutdown_worker)
+            ray_tpu.get(
+                [
+                    w.actor.execute.remote(payload)
+                    for w in worker_group.workers
+                ],
+                timeout=120,
+            )
+        self.on_start(worker_group, backend_config)

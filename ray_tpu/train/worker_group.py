@@ -36,6 +36,7 @@ _WORKER_RUNNING = _metrics.Gauge(
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import ScalingConfig
 from ray_tpu.train.context import TrainContext, set_context
+from ray_tpu.train.elastic import ElasticPauseSignal
 from ray_tpu.train.storage import StorageContext
 
 
@@ -45,9 +46,13 @@ class TrainWorker:
 
     def __init__(self):
         self._thread: Optional[threading.Thread] = None
-        self._state = "idle"  # idle | running | finished | failed
+        self._state = "idle"  # idle | running | paused | finished | failed
         self._error: Optional[str] = None
         self._ctx: Optional[TrainContext] = None
+        # Boundary state staged for the NEXT start_run's context (elastic
+        # resume): either this rank's own retained copy (survivor at the
+        # boundary) or a peer-hydrated reassembly.
+        self._pending_elastic: Optional[dict] = None
 
     # -- metadata / env ------------------------------------------------------
 
@@ -97,6 +102,10 @@ class TrainWorker:
     ) -> bool:
         if self._state == "running":
             raise RuntimeError("already running")
+        # Elastic resume on the same actor: reports the controller hasn't
+        # polled off yet must survive the context swap (a checkpoint round
+        # at the boundary only finalizes once every rank's report lands).
+        leftover = self._ctx.drain_reports() if self._ctx else []
         storage = StorageContext(
             context_spec["storage_path"],
             context_spec["experiment_name"],
@@ -123,6 +132,11 @@ class TrainWorker:
             # directories and silently keep stale state.
             _report_index=context_spec.get("start_report_index", 0),
         )
+        if leftover:
+            self._ctx._reports.extend(leftover)
+        if self._pending_elastic is not None:
+            self._ctx._elastic = self._pending_elastic
+            self._pending_elastic = None
         fn = cloudpickle.loads(fn_payload)
         takes_config = config is not None
         self._state = "running"
@@ -145,6 +159,12 @@ class TrainWorker:
                 # every step's metrics before "finished".
                 self._ctx.flush()
                 self._state = "finished"
+            except ElasticPauseSignal:
+                # Step-boundary pause (elastic membership change): the
+                # context — with its retained boundary state and any
+                # not-yet-polled reports — stays installed on the actor;
+                # the controller hydrates/reforms and calls resume_run.
+                self._state = "paused"
             except BaseException as e:  # noqa: BLE001
                 self._error = (
                     f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
@@ -181,12 +201,112 @@ class TrainWorker:
     def ping(self) -> bool:
         return True
 
+    # -- elastic plane -------------------------------------------------------
+
+    def request_pause(self) -> bool:
+        """Arm the step-boundary pause; the train fn unwinds at its next
+        report() call. False when there's nothing running to pause."""
+        if self._ctx is None or self._state != "running":
+            return False
+        return self._ctx.request_pause()
+
+    def elastic_meta(self) -> dict:
+        """What a paused rank holds: the boundary report index, the
+        declared layout, and each leaf's dim0 length (None for 0-d/
+        unsized leaves) — everything the controller's reshard planner
+        needs, without touching the data."""
+        el = self._ctx._elastic if self._ctx is not None else None
+        if el is None:
+            return {"state": self._state, "index": None}
+        import jax
+
+        leaves = jax.tree.leaves(el["state"])
+        return {
+            "state": self._state,
+            "index": el["index"],
+            "layout": el.get("layout", "replicated"),
+            "leaf_rows": [
+                (int(leaf.shape[0]) if getattr(leaf, "ndim", 0) else None)
+                for leaf in leaves
+            ],
+        }
+
+    def elastic_snapshot(self) -> dict:
+        """Arm this rank's retained boundary state on the transfer fabric
+        for ONE peer pull; returns the pull descriptor."""
+        from ray_tpu.train import elastic as _elastic
+
+        el = self._ctx._elastic if self._ctx is not None else None
+        if el is None:
+            raise RuntimeError("no elastic state retained on this rank")
+        return _elastic.snapshot_state(el["state"])
+
+    def elastic_keep_local(self, boundary_index: int) -> bool:
+        """Survivor-at-the-boundary fast path: stage the locally retained
+        state for the next start_run — zero bytes moved."""
+        el = self._ctx._elastic if self._ctx is not None else None
+        if el is None or el["index"] != boundary_index:
+            return False
+        self._pending_elastic = dict(el)
+        return True
+
+    def elastic_hydrate(
+        self,
+        snapshots: dict,
+        mode: str,
+        new_rank: int,
+        new_world: int,
+        old_world: int,
+        leaf_totals: Optional[list],
+        boundary_index: int,
+    ) -> bool:
+        """Pull + reassemble this rank's boundary state from donor
+        snapshots (see elastic.hydrate_state) and stage it for resume."""
+        from ray_tpu.train import elastic as _elastic
+
+        state = _elastic.hydrate_state(
+            {int(r): s for r, s in snapshots.items()},
+            mode,
+            new_rank,
+            new_world,
+            old_world,
+            leaf_totals,
+        )
+        self._pending_elastic = {
+            "state": state,
+            "index": boundary_index,
+            "layout": mode,
+        }
+        return True
+
+    def resume_run(
+        self,
+        fn_payload: bytes,
+        config: Optional[dict],
+        context_spec: dict,
+        latest_checkpoint_path: Optional[str],
+    ) -> bool:
+        """Restart the train fn after an elastic re-formation: same
+        actor, new context at the new world size, the staged boundary
+        state handed to the fn via ctx.get_elastic_state()."""
+        if self._state == "running":
+            raise RuntimeError("cannot resume a running worker")
+        self._state = "idle"
+        return self.start_run(
+            fn_payload, config, context_spec, latest_checkpoint_path
+        )
+
 
 @dataclass
 class WorkerInfo:
     actor: Any
     metadata: dict
     world_rank: int
+    # Placement-group bundle this worker occupies (-1 = scheduled outside
+    # the gang's pg). Elastic recruit() targets the free indices: the GCS
+    # re-commits a preempted node's bundle onto healthy capacity, so the
+    # reservation outlives the worker that died in it.
+    bundle_index: int = -1
 
 
 class WorkerGroup:
@@ -294,7 +414,12 @@ class WorkerGroup:
             ),
         )
         infos = [
-            WorkerInfo(actor=actors[i], metadata=metas[i], world_rank=r)
+            WorkerInfo(
+                actor=actors[i],
+                metadata=metas[i],
+                world_rank=r,
+                bundle_index=i,
+            )
             for r, i in enumerate(order)
         ]
         return cls(infos, slice_pg=slice_pg, pg=pg)
@@ -305,6 +430,99 @@ class WorkerGroup:
     @property
     def actors(self) -> list:
         return [w.actor for w in self.workers]
+
+    def reform(self, keep: list, joiners: list = ()) -> "WorkerGroup":
+        """Elastic re-formation: survivors (``keep``) plus any hydrating
+        ``joiners`` re-rank under the SAME stable sort the original
+        creation used — so jax process indices stay deterministic at the
+        new world size — and ownership of the placement handles moves to
+        the returned group. This object is left empty: the controller's
+        teardown path shuts down whichever group is current, and the
+        retired one must not double-kill the surviving actors."""
+        members = list(keep) + list(joiners)
+        order = sorted(
+            range(len(members)),
+            key=lambda i: (
+                members[i].metadata["slice_name"],
+                members[i].metadata["tpu_worker_id"],
+                members[i].metadata["node_id"],
+            ),
+        )
+        infos = [
+            WorkerInfo(
+                actor=members[i].actor,
+                metadata=members[i].metadata,
+                world_rank=r,
+                bundle_index=members[i].bundle_index,
+            )
+            for r, i in enumerate(order)
+        ]
+        new = WorkerGroup(infos, slice_pg=self._slice_pg, pg=self._pg)
+        self.workers = []
+        self._slice_pg = None
+        self._pg = None
+        return new
+
+    @staticmethod
+    def recruit(
+        scaling: ScalingConfig,
+        count: int,
+        timeout: float = 10.0,
+        pg=None,
+        occupied: tuple = (),
+    ) -> list:
+        """Try to create ``count`` replacement workers. The gang's
+        placement group keeps reserving a bundle for each departed rank —
+        the GCS re-commits a preempted node's bundles onto healthy
+        capacity — so joiners target the free bundle indices first
+        (``occupied`` lists the indices survivors still sit in) and only
+        spill to plain resource scheduling once the reservation is
+        exhausted. Without that, the rescheduled bundle and the joiner
+        would COMPETE for the same CPUs and the join could never place.
+        Returns [] (after killing any partial gang) when the cluster
+        can't place them yet; the controller simply retries later."""
+        resources = dict(scaling.resources_per_worker or {})
+        num_cpus = resources.pop("CPU", 1)
+        free = []
+        if pg is not None:
+            taken = set(occupied)
+            free = [
+                i for i in range(pg.bundle_count) if i not in taken
+            ][:count]
+        actors = []
+        indices = []
+        for idx in free:
+            actors.append(
+                TrainWorker.options(
+                    num_cpus=num_cpus,
+                    resources=resources,
+                    placement_group=pg,
+                    placement_group_bundle_index=idx,
+                ).remote()
+            )
+            indices.append(idx)
+        for _ in range(count - len(free)):
+            actors.append(
+                TrainWorker.options(
+                    num_cpus=num_cpus, resources=resources
+                ).remote()
+            )
+            indices.append(-1)
+        try:
+            metas = ray_tpu.get(
+                [a.get_metadata.remote() for a in actors], timeout=timeout
+            )
+        except Exception:  # raylint: disable=RL006 -- no capacity yet: kill the partial gang and let the controller retry next tick
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # raylint: disable=RL006 -- rollback kill; actor may never have scheduled
+                    pass
+            return []
+        return [
+            WorkerInfo(actor=a, metadata=m, world_rank=-1, bundle_index=i)
+            for a, m, i in zip(actors, metas, indices)
+        ]
 
     def collective_topology(self):
         """Two-level (slice → host) topology of this gang, derived from the

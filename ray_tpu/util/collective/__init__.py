@@ -26,6 +26,7 @@ from ray_tpu.util.collective.collective import (
     recv,
     reduce,
     reducescatter,
+    report_peer_death,
     send,
 )
 from ray_tpu.util.collective.communicator import Communicator
@@ -51,5 +52,6 @@ __all__ = [
     "recv",
     "reduce",
     "reducescatter",
+    "report_peer_death",
     "send",
 ]

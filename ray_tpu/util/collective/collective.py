@@ -169,7 +169,17 @@ def _coordinator_handle(
         worker.gcs.kv_put(
             _gen_key(group_name), token.encode(), ns=_KV_NS, overwrite=True
         )
-        infos = ray_tpu.get(coord.join.remote(rank, info))
+        try:
+            infos = ray_tpu.get(coord.join.remote(rank, info))
+        except TaskError as e:
+            # Same typed fail-fast the polling ranks get below: a peer
+            # death reported while rank 0 was parked in its own barrier
+            # surfaces as PeerDiedError, not a generic task failure.
+            from ray_tpu.core.errors import PeerDiedError
+
+            if isinstance(getattr(e, "cause", None), PeerDiedError):
+                raise e.cause from None
+            raise
         return coord, infos
     deadline = time.monotonic() + timeout_s
     while True:
@@ -188,11 +198,19 @@ def _coordinator_handle(
             # also in; a stale generation dies under us and we re-poll.
             infos = ray_tpu.get(coord.join.remote(rank, info))
             return coord, infos
+        except TaskError as e:
+            # A peer died while the gang was still forming: surface the
+            # typed verdict out of join() NOW — retrying the barrier can
+            # only time out, the member is gone.
+            from ray_tpu.core.errors import PeerDiedError
+
+            if isinstance(getattr(e, "cause", None), PeerDiedError):
+                raise e.cause from None
+            time.sleep(0.05)  # coordinator-side join error (e.g. timeout)
         except (
             ValueError,  # not registered yet / already deregistered
             ActorDiedError,  # stale generation killed under us
             ActorUnavailableError,
-            TaskError,  # coordinator-side join error (e.g. its timeout)
             TimeoutError,
         ):
             time.sleep(0.05)
@@ -388,6 +406,35 @@ def create_collective_group(
     )
     if not ok:
         raise ValueError(f"collective group {group_name!r} already declared")
+
+
+def report_peer_death(
+    rank: int, group_name: str = DEFAULT_GROUP_NAME, reason: str = ""
+) -> bool:
+    """Tell ``group_name``'s coordinator that ``rank``'s process died.
+
+    Callable from ANY process that can see the cluster (typically the
+    driver / controller that owns the gang and observed the actor die) —
+    not just group members. Every rank blocked in ``join()`` or a
+    collective fails fast with a typed :class:`PeerDiedError` instead of
+    burning the full collective timeout. Best-effort: returns False when
+    the group has no live coordinator (already torn down / re-formed)."""
+    import ray_tpu
+    from ray_tpu.core import api as core_api
+
+    try:
+        worker = core_api._require_worker(auto_init=False)
+        token = worker.gcs.kv_get(_gen_key(group_name), ns=_KV_NS)
+        if token is None:
+            return False
+        coord = ray_tpu.get_actor(_coord_name(group_name, token.decode()))
+        return bool(
+            ray_tpu.get(
+                coord.report_death.remote(int(rank), reason), timeout=30
+            )
+        )
+    except Exception:  # raylint: disable=RL006 -- best-effort death report; the coordinator may already be gone
+        return False
 
 
 def is_group_initialized(group_name: str = DEFAULT_GROUP_NAME) -> bool:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 
+from ray_tpu.core.errors import PeerDiedError, StaleGroupEpochError
 from ray_tpu.util.collective.types import ReduceOp, numpy_reduce
 
 
@@ -27,6 +28,16 @@ class CollectiveCoordinator:
         self._world = int(world_size)
         self._timeout = float(timeout_s)
         self._cv = threading.Condition()
+        # Known-dead members (rank -> reason): set via report_death. Blocked
+        # waiters fail fast with PeerDiedError instead of burning the full
+        # collective timeout on a barrier that can never complete.
+        self._dead: dict[int, str] = {}
+        # Generation fence: advance_epoch bumps this when the group
+        # re-forms (elastic membership change). Calls carrying a stale
+        # epoch raise StaleGroupEpochError immediately — a surviving rank
+        # that missed the re-formation cannot leak contributions into the
+        # new generation's op sequence.
+        self._epoch = 0
         # (seq) -> op state. Collectives must be issued in the same order by
         # every rank (standard communicator contract), so seq alone keys the
         # op; `kind` is cross-checked to catch divergent programs early.
@@ -50,7 +61,73 @@ class CollectiveCoordinator:
     def ping(self) -> bool:
         return True
 
-    def join(self, rank: int, info: dict | None = None) -> dict:
+    def epoch(self) -> int:
+        with self._cv:
+            return self._epoch
+
+    # -- membership lifecycle ------------------------------------------------
+
+    def report_death(self, rank: int, reason: str = "") -> bool:
+        """Record that ``rank``'s process died. Every in-flight op fails
+        NOW and every blocked waiter (join barrier included) unblocks with
+        a typed :class:`PeerDiedError` — fail fast instead of letting the
+        gang discover the death one full collective timeout later."""
+        with self._cv:
+            self._dead[int(rank)] = str(reason)
+            for st in self._ops.values():
+                if st["error"] is None:
+                    st["dead"] = (int(rank), str(reason))
+                    self._fail_op(
+                        st,
+                        f"collective peer rank {rank} died"
+                        + (f": {reason}" if reason else ""),
+                    )
+            self._cv.notify_all()
+        return True
+
+    def advance_epoch(self, epoch: int, world_size: int | None = None) -> int:
+        """Fence a group re-formation: move to generation ``epoch`` (must
+        be ahead of the current one — a lagging re-former gets the same
+        StaleGroupEpochError its collectives would), fail any in-flight
+        ops, and reset membership state (join barrier, mailboxes, death
+        records, op sequence) for the new generation. ``world_size``
+        resizes the group — the elastic path re-fences the surviving
+        ranks on the same coordinator instead of a fresh rendezvous."""
+        with self._cv:
+            if epoch <= self._epoch:
+                raise StaleGroupEpochError(epoch, self._epoch)
+            self._epoch = int(epoch)
+            if world_size is not None:
+                if world_size < 1:
+                    raise ValueError("world_size must be >= 1")
+                self._world = int(world_size)
+            for st in self._ops.values():
+                if st["error"] is None:
+                    self._fail_op(
+                        st,
+                        f"collective group re-formed at epoch {epoch}; "
+                        f"this generation's op was abandoned",
+                    )
+            self._ops = {}
+            self._mail = {}
+            self._joined = set()
+            self._join_info = {}
+            self._dead = {}
+            self._cv.notify_all()
+            return self._epoch
+
+    def _check_epoch(self, epoch: int) -> None:
+        """Callers hold self._cv."""
+        if int(epoch) != self._epoch:
+            raise StaleGroupEpochError(int(epoch), self._epoch)
+
+    def _check_dead(self) -> None:
+        """Callers hold self._cv."""
+        if self._dead:
+            rank, reason = next(iter(self._dead.items()))
+            raise PeerDiedError(rank, reason)
+
+    def join(self, rank: int, info: dict | None = None, epoch: int = 0) -> dict:
         """All-ranks barrier that binds a rank to THIS coordinator generation
         at init time (see collective._coordinator_handle): a rank that bound
         a stale generation blocks here forever instead of leaking collective
@@ -64,6 +141,8 @@ class CollectiveCoordinator:
         """
         deadline = self._deadline()
         with self._cv:
+            self._check_epoch(epoch)
+            self._check_dead()
             self._joined.add(int(rank))
             if info is not None:
                 self._join_info[int(rank)] = info
@@ -94,11 +173,16 @@ class CollectiveCoordinator:
 
     # -- collectives ---------------------------------------------------------
 
-    def collective(self, kind: str, seq: int, rank: int, payload, extra=None):
+    def collective(
+        self, kind: str, seq: int, rank: int, payload, extra=None,
+        epoch: int = 0,
+    ):
         """Contribute ``payload`` for op ``seq`` and block until every rank
         has; returns this rank's share of the result."""
         deadline = self._deadline()
         with self._cv:
+            self._check_epoch(epoch)
+            self._check_dead()
             st = self._ops.get(seq)
             if st is None:
                 st = self._ops[seq] = {
@@ -149,6 +233,11 @@ class CollectiveCoordinator:
                         )
                         raise
                 if st["error"] is not None:
+                    if st.get("dead") is not None:
+                        # Typed: the op died because a peer did — callers
+                        # distinguish "gang lost a member, re-form" from a
+                        # program bug (mismatched kinds, bad shapes).
+                        raise PeerDiedError(*st["dead"])
                     raise RuntimeError(st["error"])
                 return self._share(st, rank)
             finally:
@@ -235,6 +324,9 @@ class CollectiveCoordinator:
     def _wait(self, deadline: float, what: str) -> None:
         import time
 
+        # Fail fast on a known-dead peer: report_death notify_all()s every
+        # waiter; whatever this one was waiting for can no longer happen.
+        self._check_dead()
         remaining = deadline - time.monotonic()
         if remaining <= 0 or not self._cv.wait(timeout=remaining):
             if deadline - time.monotonic() <= 0:
@@ -242,3 +334,4 @@ class CollectiveCoordinator:
                     f"collective timed out after {self._timeout}s "
                     f"waiting for {what}"
                 )
+        self._check_dead()

@@ -28,11 +28,17 @@ class CpuGroup(Communicator):
         rank: int,
         coordinator,  # ActorHandle of CollectiveCoordinator
         timeout_s: float = 120.0,
+        epoch: int = 0,
     ):
         super().__init__(group_name, world_size, rank)
         self._coord = coordinator
         self._timeout = timeout_s
         self._seq = 0
+        # Generation fence: every op carries the epoch this communicator
+        # bound. After an elastic re-formation bumps the coordinator's
+        # epoch, a stale communicator's ops raise StaleGroupEpochError
+        # instead of leaking contributions into the new generation.
+        self._epoch = int(epoch)
         self._send_tags: dict[int, int] = {}
         self._recv_tags: dict[int, int] = {}
 
@@ -42,14 +48,29 @@ class CpuGroup(Communicator):
 
     def _call(self, kind: str, payload, extra=None):
         import ray_tpu
+        from ray_tpu.core.errors import (
+            PeerDiedError,
+            StaleGroupEpochError,
+            TaskError,
+        )
 
         self._seq += 1
-        return ray_tpu.get(
-            self._coord.collective.remote(
-                kind, self._seq, self._rank, payload, extra
-            ),
-            timeout=self._timeout * 2,
-        )
+        try:
+            return ray_tpu.get(
+                self._coord.collective.remote(
+                    kind, self._seq, self._rank, payload, extra, self._epoch
+                ),
+                timeout=self._timeout * 2,
+            )
+        except TaskError as e:
+            # Unwrap the coordinator's typed verdicts: callers branch on
+            # PeerDiedError (gang lost a member — re-form) vs program bugs.
+            if isinstance(
+                getattr(e, "cause", None),
+                (PeerDiedError, StaleGroupEpochError),
+            ):
+                raise e.cause from None
+            raise
 
     def allreduce(self, tensor, op: ReduceOp = ReduceOp.SUM):
         out = self._call("allreduce", to_numpy(tensor), {"op": ReduceOp(op)})

@@ -1302,3 +1302,132 @@ def test_fleet_heartbeat_blackhole_at_scale_converges_and_replays():
         "blackhole run diverged: the post-death fixed point must be a "
         "pure function of the seed"
     )
+
+
+# -- elastic training under a seeded preempt wave ------------------------------
+# Round-21 acceptance: a seeded node.preempt against a node hosting one rank
+# of a 2-worker elastic gang re-forms the gang live at world size 1 — no
+# controller restart, no lineage reconstruction, and the surviving rank's
+# step stream replays bit-identically from the seed.
+
+
+def test_chaos_preempt_wave_elastic_reform_bit_identical(
+    chaos_cluster, wait_for, tmp_path
+):
+    import threading
+
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train.config import (
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+    )
+    from ray_tpu.train.controller import TrainController
+    from ray_tpu.util.metrics import registry
+
+    def _shrinks():
+        return sum(
+            v
+            for n, t, v in registry().snapshot()["points"]
+            if n == "raytpu_train_reshapes_total" and t.get("kind") == "shrink"
+        )
+
+    def train_fn(config):
+        import time as _t
+
+        import numpy as _np
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        el = train.get_elastic_state()
+        if el is not None:
+            state = _np.asarray(el["state"], dtype=_np.float32)
+            start = int(el["index"]) + 1
+        else:
+            state = _np.zeros(2, dtype=_np.float32)
+            start = 0
+        for step in range(start, int(config["steps"])):
+            state = state.copy()
+            state[0] = state[0] * _np.float32(0.75) + _np.float32(
+                step
+            ) * _np.float32(0.125)
+            state[1] = _np.float32(step)
+            train.report(
+                {"step": step, "v": float(state[0])}, elastic_state=state
+            )
+            _t.sleep(0.05)
+
+    runtime = chaos_cluster
+    node2 = add_node_and_wait(runtime, wait_for, {"CPU": 1.0})
+    GLOBAL_CONFIG.drain_grace_s = 20.0
+    saved = (GLOBAL_CONFIG.elastic_train, GLOBAL_CONFIG.elastic_grow_check_s)
+    GLOBAL_CONFIG.elastic_train = True
+    GLOBAL_CONFIG.elastic_grow_check_s = 0.0
+    steps = 60
+    controller = TrainController(
+        train_fn,
+        {"steps": steps},
+        ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"CPU": 1},
+            placement_strategy="SPREAD",
+        ),
+        RunConfig(
+            name="chaos_elastic",
+            storage_path=str(tmp_path / "storage"),
+            failure_config=FailureConfig(max_failures=0),
+        ),
+        BackendConfig(),
+    )
+    before = _shrinks()
+    box = {}
+    th = threading.Thread(
+        target=lambda: box.update(r=controller.run()), daemon=True
+    )
+    th.start()
+    try:
+        wait_for(
+            lambda: controller.state == "RUNNING"
+            and controller._active_group is not None
+            and any(
+                w.metadata["node_id"] == node2.node_id
+                for w in controller._active_group.workers
+            ),
+            timeout=120.0,
+        )
+        time.sleep(0.4)
+        # The seeded wave: probability-1 preempt against secondary nodes.
+        faults.install(
+            faults.parse_spec(17, "node.preempt,match=node*,count=1")
+        )
+        wait_for(lambda: node2._stopping, timeout=40.0)
+        wait_for(lambda: _shrinks() - before >= 1, timeout=60.0)
+        faults.clear()
+        node2.die_silently()  # the preempted VM actually disappears
+        th.join(150)
+        assert not th.is_alive()
+    finally:
+        faults.clear()
+        (
+            GLOBAL_CONFIG.elastic_train,
+            GLOBAL_CONFIG.elastic_grow_check_s,
+        ) = saved
+    result = box["r"]
+    assert result.error is None
+    # Bit-identical replay: every recorded step value equals the float32
+    # analytic recurrence — across the live re-formation.
+    expected = {}
+    v = np.float32(0.0)
+    for step in range(steps):
+        v = v * np.float32(0.75) + np.float32(step) * np.float32(0.125)
+        expected[step] = float(v)
+    seen = set()
+    for m in result.metrics_history:
+        assert m["v"] == expected[m["step"]]
+        seen.add(m["step"])
+    assert max(seen) == steps - 1
+    # Live re-formation, not lineage: nothing was reconstructed.
+    from ray_tpu.core import api as core_api
+
+    assert core_api._require_worker().reconstructions == 0

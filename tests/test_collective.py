@@ -305,3 +305,117 @@ def test_xla_group_two_processes(cluster):
     col.destroy_collective_group("g_xla2")
     for m in members:
         ray_tpu.kill(m)
+
+
+# -- membership fencing (elastic re-formation) -------------------------------
+
+
+def test_coordinator_report_death_unblocks_join():
+    """A rank blocked in the init join barrier fails fast with a typed
+    PeerDiedError when a peer's death is reported — instead of burning
+    the full collective timeout on a barrier that can never complete."""
+    import threading
+
+    from ray_tpu.core.errors import PeerDiedError
+    from ray_tpu.util.collective.coordinator import CollectiveCoordinator
+
+    coord = CollectiveCoordinator(world_size=2, timeout_s=30.0)
+    box = {}
+
+    def blocked_join():
+        try:
+            coord.join(0, info={"r": 0}, epoch=0)
+        except BaseException as e:  # noqa: BLE001 - capturing for assert
+            box["err"] = e
+
+    th = threading.Thread(target=blocked_join, daemon=True)
+    th.start()
+    # Wait until rank 0 is actually parked in the barrier.
+    deadline = 10.0
+    import time
+
+    t0 = time.monotonic()
+    while not coord._joined and time.monotonic() - t0 < deadline:
+        time.sleep(0.01)
+    coord.report_death(1, reason="actor died (preempted)")
+    th.join(10.0)
+    assert not th.is_alive()
+    err = box["err"]
+    assert isinstance(err, PeerDiedError)
+    assert err.rank == 1
+    assert "preempted" in err.reason
+
+
+def test_coordinator_epoch_fences_stale_callers():
+    """advance_epoch resets membership for the new generation; callers
+    carrying a stale epoch are rejected with StaleGroupEpochError, and a
+    lagging re-former (epoch <= current) gets the same typed error."""
+    from ray_tpu.core.errors import StaleGroupEpochError
+    from ray_tpu.util.collective.coordinator import CollectiveCoordinator
+
+    coord = CollectiveCoordinator(world_size=1, timeout_s=10.0)
+    coord.join(0, info={"r": 0}, epoch=0)
+    coord.report_death(5, reason="gone")
+    assert coord.advance_epoch(1, world_size=1) == 1
+    # Death records and the join barrier reset with the generation.
+    assert coord.join(0, info={"r": 0}, epoch=1) == {0: {"r": 0}}
+    with pytest.raises(StaleGroupEpochError) as ei:
+        coord.join(0, epoch=0)
+    assert ei.value.epoch == 0
+    assert ei.value.current == 1
+    with pytest.raises(StaleGroupEpochError):
+        coord.collective("allreduce", 0, 0, np.zeros(1), {}, epoch=0)
+    # A lagging re-former cannot move the group backwards (or sideways).
+    with pytest.raises(StaleGroupEpochError):
+        coord.advance_epoch(1)
+    with pytest.raises(StaleGroupEpochError):
+        coord.advance_epoch(0)
+
+
+def test_coordinator_advance_epoch_resizes_world():
+    """The elastic path re-fences survivors on the same coordinator at a
+    new world size instead of a fresh rendezvous."""
+    from ray_tpu.util.collective.coordinator import CollectiveCoordinator
+
+    coord = CollectiveCoordinator(world_size=4, timeout_s=10.0)
+    assert coord.world_size() == 4
+    coord.advance_epoch(1, world_size=2)
+    assert coord.world_size() == 2
+    with pytest.raises(ValueError):
+        coord.advance_epoch(2, world_size=0)
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class _FencedMember:
+    """Joins a group and reports the typed error init died with."""
+
+    def init_and_classify(self, world, rank, group):
+        try:
+            col.init_collective_group(
+                world, rank, backend="cpu", group_name=group,
+                timeout_s=60.0,
+            )
+            return "joined"
+        except Exception as e:  # raylint: disable=RL006 -- classifying the typed failure is the test
+            return type(e).__name__
+
+
+def test_report_peer_death_fails_blocked_join_fast(cluster, wait_for):
+    """Driver-side report_peer_death (the controller observed an actor
+    die) propagates into a member blocked in the init join barrier as a
+    typed PeerDiedError — well before the 60s collective timeout."""
+    group = "g_fenced_join"
+    m = _FencedMember.remote()
+    ref = m.init_and_classify.remote(2, 0, group)
+    # The coordinator is created asynchronously by the first joiner; poll
+    # until the death report lands on a live coordinator.
+    wait_for(
+        lambda: col.report_peer_death(1, group_name=group, reason="preempted"),
+        timeout=30,
+    )
+    assert ray_tpu.get(ref, timeout=30) == "PeerDiedError"
+    ray_tpu.kill(m)
+
+
+def test_report_peer_death_without_group_is_false(cluster):
+    assert col.report_peer_death(0, group_name="g_never_made") is False

@@ -338,3 +338,21 @@ def test_fleet_scale_series_registered_and_linted():
         assert catalog[name]["kind"] == kind
         assert catalog[name]["tag_keys"] == ()
     assert lint_catalog(catalog) == []
+
+
+def test_elastic_train_series_registered_and_linted():
+    """Round-21 elastic-training telemetry: the reshape counter (tagged by
+    kind: shrink/grow/fallback), the peer-to-peer reshard byte counter,
+    and the live world-size gauge are declared through the catalog so the
+    lint covers them."""
+    populate_catalog(include_optional=False)
+    catalog = m.runtime_catalog()
+    for name, kind, tags in (
+        ("raytpu_train_reshapes_total", "counter", ("kind",)),
+        ("raytpu_train_reshard_bytes_total", "counter", ()),
+        ("raytpu_train_world_size", "gauge", ()),
+    ):
+        assert name in catalog, f"{name} missing from the runtime catalog"
+        assert catalog[name]["kind"] == kind
+        assert catalog[name]["tag_keys"] == tags
+    assert lint_catalog(catalog) == []

@@ -164,3 +164,87 @@ def test_dqn_cartpole_learns(cluster):
     assert result["epsilon"] < first["epsilon"]  # anneal actually happened
     assert result["episode_return_mean"] > 45, result
     algo.stop()
+
+# -- multi-learner device plane (podracer world size > 1) --------------------
+
+
+def _device_cols(rng, n, obs_dim=4, n_act=2):
+    """Replay-column dict for update_device (host arrays; the group ships
+    them to the actor learners over RPC)."""
+    return {
+        sb.OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        sb.ACTIONS: rng.integers(0, n_act, size=(n,)),
+        sb.REWARDS: rng.normal(size=(n,)).astype(np.float32),
+        sb.NEXT_OBS: rng.normal(size=(n, obs_dim)).astype(np.float32),
+        sb.TERMINATEDS: (rng.random(n) < 0.1).astype(np.float32),
+    }
+
+
+def test_learner_group_update_device_multi_learner(cluster):
+    """Two actor learners driven through update_device: the per-step
+    flat-gradient allreduce keeps both replicas' params bit-identical,
+    and (mean loss + equal shards) the pair matches one local learner
+    taking the full batch."""
+    from ray_tpu.rllib.learner import LearnerGroup
+
+    hps = LearnerHyperparams(
+        lr=1e-3, num_sgd_epochs=1, minibatch_size=32, seed=0
+    )
+    dqn_params = DQNParams(gamma=0.9, target_network_update_freq=10_000)
+    group = LearnerGroup(
+        DQNLearner,
+        QModule(obs_dim=4, num_actions=2, hidden=(16,)),
+        hps,
+        num_learners=2,
+        loss_args=(dqn_params,),
+        backend="cpu",
+        group_name="lg_dev2",
+    )
+    local = DQNLearner(
+        QModule(obs_dim=4, num_actions=2, hidden=(16,)), hps, dqn_params
+    )
+    local.build()
+    try:
+        rng = np.random.default_rng(7)
+        stats = None
+        for _ in range(4):
+            cols = _device_cols(rng, 32)
+            stats = group.update_device(cols)
+            local.update_device(cols)
+        assert stats is not None and "total_loss" in stats
+        flats = ray_tpu.get(
+            [a.flat_weights.remote() for a in group._actors], timeout=120
+        )
+        # Replicas stay in lockstep: the allreduced gradient is the same
+        # on both ranks, so the params are bit-identical.
+        np.testing.assert_array_equal(flats[0], flats[1])
+        # Mean of equal-size shard-means == full-batch mean: the group
+        # matches a single learner that took every minibatch whole.
+        np.testing.assert_allclose(
+            flats[0], local.flat_weights(), rtol=2e-4, atol=2e-6
+        )
+    finally:
+        group.shutdown()
+
+
+def test_learner_group_update_device_indivisible_batch(cluster):
+    """A minibatch whose dim0 doesn't split evenly across learners is
+    rejected outright — unequal shards would silently skew the gradient
+    mean."""
+    from ray_tpu.rllib.learner import LearnerGroup
+
+    group = LearnerGroup(
+        DQNLearner,
+        QModule(obs_dim=4, num_actions=2, hidden=(16,)),
+        LearnerHyperparams(lr=1e-3, num_sgd_epochs=1, seed=0),
+        num_learners=2,
+        loss_args=(DQNParams(),),
+        backend="cpu",
+        group_name="lg_dev_odd",
+    )
+    try:
+        rng = np.random.default_rng(3)
+        with pytest.raises(ValueError, match="not divisible"):
+            group.update_device(_device_cols(rng, 33))
+    finally:
+        group.shutdown()

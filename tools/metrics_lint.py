@@ -48,6 +48,7 @@ _CATALOG_MODULES = [
     "ray_tpu.data.executor",
     "ray_tpu.data.governor",  # memory-governor series (round 18)
     "ray_tpu.train.context",
+    "ray_tpu.train.elastic",  # elastic reshape/reshard series (round 21)
     "ray_tpu.train.input",  # prefetch-miss counter (host-free train tier)
     "ray_tpu.train.worker_group",
     "ray_tpu.util.collective.hierarchical",  # collective hop/byte series

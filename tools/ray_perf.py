@@ -792,6 +792,181 @@ def _train_rows(results: dict, no_async_dispatch: bool, quick: bool):
     )
 
 
+def _elastic_train_fn(config):
+    """Worker loop for the elastic-recovery probe: deterministic
+    replicated numpy state retained via ``elastic_state=`` every step,
+    plus a checkpoint round every ``ckpt_every`` steps so the
+    ``--no-elastic`` arm has something to restore from. Module-level so
+    worker processes can unpickle it."""
+    import os as _os
+    import tempfile as _tmp
+    import time as _t
+
+    import numpy as _np
+
+    import ray_tpu.train as train
+
+    ctx = train.get_context()
+    el = train.get_elastic_state()
+    if el is not None:
+        # Live re-formation: resume from the peer-resharded state — no
+        # checkpoint-storage read on this path.
+        state = _np.asarray(el["state"])
+        start = int(el["index"]) + 1
+    else:
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                state = _np.load(_os.path.join(d, "state.npy"))
+            start = int(state[1]) + 1
+        else:
+            state = _np.zeros(2)
+            start = 0
+    for step in range(start, int(config["steps"])):
+        state = state + _np.asarray([1.0, 0.0])
+        state[1] = float(step)
+        if (
+            step % int(config.get("ckpt_every", 5)) == 0
+            and ctx.get_world_rank() == 0
+        ):
+            with _tmp.TemporaryDirectory() as d:
+                _np.save(_os.path.join(d, "state.npy"), state)
+                train.report(
+                    {"step": step},
+                    checkpoint=train.Checkpoint(d),
+                    elastic_state=state,
+                )
+        else:
+            train.report({"step": step}, elastic_state=state)
+        _t.sleep(float(config.get("step_s", 0.05)))
+
+
+def _train_elastic_rows(results: dict, no_elastic: bool, quick: bool):
+    """Elastic-recovery probe (round-21 robustness A/B): a 2-node
+    in-process cluster runs a 2-worker gang whose train fn retains
+    ``elastic_state=`` every step; mid-run the second node gets a
+    graceful drain notice (the preemption lifecycle). The ON arm pauses
+    the survivor at its next step boundary, reshards state peer-to-peer,
+    and resumes at world size 1 in the SAME generation; the OFF arm
+    (``--no-elastic`` = RAY_TPU_ELASTIC_TRAIN=0) tears the gang down and
+    rebuilds from the latest checkpoint. Both arms stamp the SAME
+    interval — drain notice observed -> first post-recovery report — so
+    the row is directly comparable:
+
+      train_elastic_recovery_ms   drain seen -> first report after
+                                  recovery
+      train_elastic_reshapes      raytpu_train_reshapes_total delta
+                                  (1 shrink in the ON arm, 0 in OFF)
+      train_elastic_end_world     raytpu_train_world_size after the run
+                                  (1 = re-formed smaller; 2 = rebuilt at
+                                  full size from the checkpoint)
+    """
+    import tempfile
+    import threading
+
+    import ray_tpu
+    from ray_tpu.core.config import GLOBAL_CONFIG
+    from ray_tpu.train import elastic as train_elastic
+    from ray_tpu.train import FailureConfig, RunConfig, ScalingConfig
+    from ray_tpu.train.backend import BackendConfig
+    from ray_tpu.train.controller import TrainController
+
+    GLOBAL_CONFIG.elastic_train = not no_elastic
+    GLOBAL_CONFIG.elastic_grow_check_s = 0.0  # probe measures the shrink
+    GLOBAL_CONFIG.drain_grace_s = 30.0
+
+    runtime = ray_tpu.init(num_cpus=2)
+    node2 = runtime.add_node({"CPU": 1.0})
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        v = runtime.head.cluster_view.get(node2.node_id)
+        if v is not None and v.alive:
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError("second node never joined the head's view")
+
+    steps = 60 if quick else 120
+    storage = tempfile.mkdtemp(prefix="raytpu_elastic_perf_")
+    controller = TrainController(
+        _elastic_train_fn,
+        {"steps": steps, "ckpt_every": 5, "step_s": 0.05},
+        ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"CPU": 1},
+            # SPREAD (soft): one worker per node while both nodes live,
+            # and the --no-elastic rebuild can still pack both workers
+            # onto the survivor after the drained node dies.
+            placement_strategy="SPREAD",
+        ),
+        RunConfig(
+            name="elastic_probe",
+            storage_path=storage,
+            # Zero failure budget: BOTH recovery paths classify the drain
+            # as "preempted" and must not burn max_failures.
+            failure_config=FailureConfig(max_failures=0),
+        ),
+        BackendConfig(),
+    )
+    reshapes0 = _counter_total("raytpu_train_reshapes_total")
+    box: dict = {}
+
+    def _fit():
+        box["result"] = controller.run()
+
+    th = threading.Thread(target=_fit, daemon=True)
+    th.start()
+    # Drain only once the gang is actually running with a rank on node2 —
+    # a notice during SCHEDULING would just steer placement off the node
+    # and measure nothing.
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        grp = controller._active_group
+        if (
+            controller.state == "RUNNING"
+            and grp is not None
+            and any(
+                w.metadata["node_id"] == node2.node_id for w in grp.workers
+            )
+        ):
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError("gang never started with a rank on node2")
+    time.sleep(0.5)  # a few steps of progress (and a checkpoint round)
+    ray_tpu.drain_node(node2.node_id, grace_s=30.0, reason="preempted")
+    th.join(timeout=180)
+    result = box.get("result")
+    if result is None or result.error is not None:
+        raise RuntimeError(
+            f"elastic probe run did not finish cleanly: "
+            f"{getattr(result, 'error', 'run() still going')}"
+        )
+
+    rec_ms = train_elastic.last_recovery_ms()
+    results["train_elastic_recovery_ms"] = (
+        round(rec_ms, 1) if rec_ms is not None else None
+    )
+    results["train_elastic_reshapes"] = (
+        _counter_total("raytpu_train_reshapes_total") - reshapes0
+    )
+    results["train_elastic_end_world"] = _counter_total(
+        "raytpu_train_world_size"
+    )
+    arm = (
+        "off (checkpoint rebuild)"
+        if no_elastic
+        else "on (live re-formation)"
+    )
+    print(
+        f"train_elastic_recovery_ms: {results['train_elastic_recovery_ms']}"
+        f" ms, {results['train_elastic_reshapes']:.0f} reshapes, end world "
+        f"{results['train_elastic_end_world']:.0f} [elastic {arm}]",
+        flush=True,
+    )
+    ray_tpu.shutdown()
+
+
 def _podracer_env_maker():
     """CartPole with a ~0.25 ms per-env-step cost emulating a non-trivial
     simulator (a raw CartPole step is ~1 µs — three orders of magnitude
@@ -1113,6 +1288,23 @@ def main() -> int:
         "round-13 host-free train steps",
     )
     ap.add_argument(
+        "--elastic-probe",
+        action="store_true",
+        help="with --train-only: run the elastic-recovery row instead "
+        "(2-node in-process cluster, 2-worker gang, graceful drain "
+        "notice mid-run): train_elastic_recovery_ms = drain seen -> "
+        "first report after recovery — the round-21 robustness A/B "
+        "rides this via bench.py's train_elastic record",
+    )
+    ap.add_argument(
+        "--no-elastic",
+        action="store_true",
+        help="kill switch: membership changes tear the gang down and "
+        "rebuild from the latest checkpoint (equivalent to "
+        "RAY_TPU_ELASTIC_TRAIN=0) — the A/B baseline for the round-21 "
+        "elastic live re-formation",
+    )
+    ap.add_argument(
         "--rl-only",
         action="store_true",
         help="run only the podracer RL rows (decoupled DQN on an "
@@ -1196,11 +1388,16 @@ def main() -> int:
         # jitted step. CPU jax even where a TPU plugin is installed.
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         results = {}
-        _train_rows(
-            results,
-            no_async_dispatch=args.no_async_dispatch,
-            quick=args.quick,
-        )
+        if args.elastic_probe:
+            _train_elastic_rows(
+                results, no_elastic=args.no_elastic, quick=args.quick
+            )
+        else:
+            _train_rows(
+                results,
+                no_async_dispatch=args.no_async_dispatch,
+                quick=args.quick,
+            )
         print(json.dumps(results), flush=True)
         return 0
 
